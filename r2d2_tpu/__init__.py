@@ -1,0 +1,22 @@
+"""r2d2_tpu — a TPU-native distributed recurrent-replay RL framework.
+
+A from-scratch JAX/XLA re-architecture of R2D2 (Recurrent Experience Replay in
+Distributed Reinforcement Learning) with the full capability surface of the
+reference implementation (McFredward/R2D2, PyTorch + Ray + CUDA): Ape-X actor
+fan-out, prioritized sequence replay with burn-in and stored LSTM state,
+dueling/double recurrent DQN, invertible value-rescaled n-step targets, Atari
+and ViZDoom single/multiplayer self-play — redesigned TPU-first:
+
+* the learner is a single fused XLA program (sample -> train -> priority
+  update) over HBM-resident replay, so it never stalls on host-side tree walks;
+* scaling is a `jax.sharding.Mesh` axis change (dp over ICI, optional mp),
+  not a comms-library rewrite;
+* CPU actor processes run a jitted CPU policy and pull weights from a
+  shared-memory weight service instead of a Ray object store.
+"""
+
+from r2d2_tpu.config import Config, apex_epsilon, parse_overrides
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "apex_epsilon", "parse_overrides", "__version__"]

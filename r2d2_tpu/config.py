@@ -1,0 +1,306 @@
+"""Typed, hierarchical configuration for the TPU-native R2D2 framework.
+
+Replaces the reference's flat module of ~40 globals (/root/reference/config.py:1-62)
+with an immutable dataclass tree. Every field keeps the reference default so the
+stock Atari-Boxing / ViZDoom-Basic runs are a config-file change, not a code
+change. Unlike the reference — where cross-module constants made the module the
+single source of truth (/root/reference/worker.py:151-152) — components here take
+their whole sub-config, so two differently-configured stacks can coexist in one
+process (needed for multiplayer population training, /root/reference/train.py:28-45).
+
+CLI overriding uses dotted paths (``--replay.capacity=100000``), covering the
+genetic-search hook: the reference tags searchable fields ``<-- GEN``
+(/root/reference/config.py:12-57); here they are enumerated in GENETIC_SEARCH_SPACE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class EnvConfig:
+    """Environment selection and preprocessing (ref config.py:2-13)."""
+
+    # Composed gym id, e.g. "VizdoomBasic-v0", "FakeR2D2-v0", "ALE/Boxing-v5".
+    game_name: str = "Fake"
+    env_type: str = "R2D2-v0"
+    frame_stack: int = 4
+    frame_height: int = 84
+    frame_width: int = 84
+    frame_skip: int = 1
+    clip_rewards: bool = True  # training-time only (ref environment.py:88-89)
+    # Shaped multiplayer reward constants (ref base_gym_env.py:199-211).
+    reward_hurt: float = -20.0
+    reward_death: float = -100.0
+    reward_ammo: float = -5.0
+    reward_hit: float = 25.0
+    reward_frag: float = 100.0
+
+    @property
+    def env_id(self) -> str:
+        return self.game_name + self.env_type
+
+    @property
+    def obs_shape(self) -> Tuple[int, int, int]:
+        return (self.frame_stack, self.frame_height, self.frame_width)
+
+
+@dataclass(frozen=True)
+class NetworkConfig:
+    """Recurrent dueling/double DQN architecture (ref config.py:54-57, model.py:22-46)."""
+
+    hidden_dim: int = 512
+    cnn_out_dim: int = 1024
+    use_dueling: bool = True
+    use_double: bool = False
+    # Conv torso: (out_channels, kernel, stride) triples — Nature DQN.
+    conv_layers: Tuple[Tuple[int, int, int], ...] = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+    # bf16 matmul/conv compute on TPU (replaces torch.cuda.amp, ref config.py:35).
+    bf16: bool = False
+
+
+@dataclass(frozen=True)
+class SequenceConfig:
+    """R2D2 sequence windowing (ref config.py:48-51)."""
+
+    burn_in_steps: int = 40
+    learning_steps: int = 10
+    forward_steps: int = 5  # n-step return horizon
+
+    @property
+    def seq_len(self) -> int:
+        return self.burn_in_steps + self.learning_steps + self.forward_steps
+
+
+@dataclass(frozen=True)
+class ReplayConfig:
+    """Prioritized sequence replay (ref config.py:26-33, worker.py:38-78)."""
+
+    capacity: int = 500_000          # env steps
+    block_length: int = 400          # steps per actor-produced block
+    prio_exponent: float = 0.9       # alpha; 0 disables prioritization
+    importance_sampling_exponent: float = 0.6  # beta
+    batch_size: int = 128            # sequences per training batch
+    learning_starts: int = 1_000     # min buffer steps before training
+    # Where replay lives: "device" = HBM-resident jitted path (the TPU-native
+    # design), "host" = numpy + native C++ sum-tree feeder (reference-style).
+    placement: str = "device"
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """Learner optimization (ref config.py:16-23, worker.py:268-269,341-346)."""
+
+    lr: float = 1e-4
+    adam_eps: float = 1e-3
+    grad_norm: float = 40.0
+    gamma: float = 0.997
+    target_net_update_interval: int = 2_000
+    training_steps: int = 500_000
+    value_rescale_eps: float = 1e-2
+    # Mixed-priority weights: eta*max + (1-eta)*mean (ref worker.py:246).
+    priority_eta: float = 0.9
+
+
+@dataclass(frozen=True)
+class ActorConfig:
+    """Ape-X actor fan-out (ref config.py:37-40, train.py:16-18)."""
+
+    num_actors: int = 2
+    base_eps: float = 0.4
+    eps_alpha: float = 7.0
+    actor_update_interval: int = 400   # steps between weight pulls (ref worker.py:568)
+    max_episode_steps: int = 27_000
+    near_greedy_eps: float = 0.02      # episode-return logging threshold (ref worker.py:555)
+
+
+@dataclass(frozen=True)
+class MultiplayerConfig:
+    """Population self-play (ref config.py:43-45, train.py:28-45)."""
+
+    enabled: bool = False
+    num_players: int = 2
+    base_port: int = 5060
+
+    def port(self, actor_idx: int) -> int:
+        return self.base_port + actor_idx
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """TPU device-mesh layout for the learner.
+
+    The reference has no learner parallelism (one process on half a GPU,
+    ref worker.py:251); here data-parallel over the 'dp' axis (batch sharded,
+    gradient psum over ICI) and model-parallel over 'mp' (hidden/cnn feature
+    sharding) are first-class. A 1x1 mesh degrades to single-chip.
+    """
+
+    dp: int = -1   # -1: use all remaining devices
+    mp: int = 1
+    # Multi-host: initialize jax.distributed (DCN) before mesh construction.
+    multihost: bool = False
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Process orchestration, logging, checkpointing (ref config.py:8-10,20-21,40)."""
+
+    save_dir: str = "models"
+    pretrain: str = ""               # warm-start checkpoint path ("" = none)
+    save_interval: int = 1_000       # learner steps between checkpoints
+    log_interval: float = 20.0       # seconds between metric log lines
+    weight_publish_interval: int = 2  # learner steps between weight publications
+    prefetch_batches: int = 4        # learner-side batch prefetch depth (ref worker.py:302)
+    test_epsilon: float = 0.01
+    seed: int = 0
+    profile_dir: str = ""            # non-empty: write jax.profiler traces here
+    restart_dead_actors: bool = True  # supervisor (the reference has none, SURVEY §5.3)
+
+
+@dataclass(frozen=True)
+class Config:
+    """Root config. Construction validates cross-section size invariants the
+    replay layout depends on (block/sequence divisibility), so a bad genetic-
+    search sample fails here rather than corrupting buffer indexing later."""
+
+    env: EnvConfig = field(default_factory=EnvConfig)
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    sequence: SequenceConfig = field(default_factory=SequenceConfig)
+    replay: ReplayConfig = field(default_factory=ReplayConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    actor: ActorConfig = field(default_factory=ActorConfig)
+    multiplayer: MultiplayerConfig = field(default_factory=MultiplayerConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+
+    def __post_init__(self):
+        if self.replay.block_length % self.sequence.learning_steps != 0:
+            raise ValueError(
+                f"replay.block_length ({self.replay.block_length}) must be a "
+                f"multiple of sequence.learning_steps ({self.sequence.learning_steps})"
+            )
+        if self.replay.capacity % self.replay.block_length != 0:
+            raise ValueError(
+                f"replay.capacity ({self.replay.capacity}) must be a multiple "
+                f"of replay.block_length ({self.replay.block_length})"
+            )
+        if self.sequence.forward_steps < 1:
+            raise ValueError("sequence.forward_steps must be >= 1")
+
+    # ---- derived helpers ----
+
+    @property
+    def seqs_per_block(self) -> int:
+        return self.replay.block_length // self.sequence.learning_steps
+
+    @property
+    def num_blocks(self) -> int:
+        return self.replay.capacity // self.replay.block_length
+
+    @property
+    def num_sequences(self) -> int:
+        return self.replay.capacity // self.sequence.learning_steps
+
+    def replace(self, **dotted: Any) -> "Config":
+        """Return a new Config with dotted-path overrides applied.
+
+        cfg.replace(**{"replay.capacity": 1000, "actor.num_actors": 4})
+        """
+        updates: Dict[str, Dict[str, Any]] = {}
+        for key, value in dotted.items():
+            if "." not in key:
+                raise KeyError(f"override key must be dotted (section.field): {key!r}")
+            section, fname = key.split(".", 1)
+            if "." in fname:
+                raise KeyError(f"only one nesting level supported: {key!r}")
+            updates.setdefault(section, {})[fname] = value
+        replaced = {}
+        for section, fields in updates.items():
+            sub = getattr(self, section)
+            replaced[section] = dataclasses.replace(sub, **fields)
+        return dataclasses.replace(self, **replaced)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+
+# Field annotations are strings (PEP 563 via `from __future__ import
+# annotations`); only scalar fields are CLI-settable.
+_SCALAR_ANNOTATIONS = {"bool": bool, "int": int, "float": float, "str": str}
+
+
+def _coerce(key: str, value: str, annotation: str) -> Any:
+    target_type = _SCALAR_ANNOTATIONS.get(str(annotation).replace("Optional[str]", "str"))
+    if target_type is None:
+        raise SystemExit(
+            f"cannot set {key!r} from the command line (field type {annotation}); "
+            "construct the Config in code instead"
+        )
+    if target_type is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if target_type is str:
+        return value
+    try:
+        return target_type(value)
+    except ValueError:
+        raise SystemExit(
+            f"invalid value {value!r} for {key!r} (expected {target_type.__name__})"
+        ) from None
+
+
+def parse_overrides(cfg: Config, argv: List[str]) -> Config:
+    """Apply ``--section.field=value`` CLI overrides, type-coerced from the
+    dataclass field annotations. Unknown keys raise."""
+    dotted: Dict[str, Any] = {}
+    for arg in argv:
+        if not arg.startswith("--") or "=" not in arg:
+            raise SystemExit(f"unrecognized argument {arg!r}; expected --section.field=value")
+        key, _, raw = arg[2:].partition("=")
+        section, _, fname = key.partition(".")
+        if not hasattr(cfg, section):
+            raise SystemExit(f"unknown config section {section!r}")
+        sub = getattr(cfg, section)
+        matching = {f.name: f for f in dataclasses.fields(sub)}
+        if fname not in matching:
+            raise SystemExit(f"unknown field {fname!r} in section {section!r}")
+        dotted[key] = _coerce(key, raw, matching[fname].type)
+    return cfg.replace(**dotted) if dotted else cfg
+
+
+def apex_epsilon(actor_id: int, num_actors: int, base_eps: float,
+                 alpha: float) -> float:
+    """Ape-X per-actor epsilon ladder: eps_i = base ** (1 + i*alpha/(N-1))
+    (ref train.py:16-18). Single-actor runs get base_eps. No defaults: the
+    authoritative values live in ActorConfig (base_eps, eps_alpha)."""
+    if num_actors <= 1:
+        return base_eps
+    return base_eps ** (1 + actor_id / (num_actors - 1) * alpha)
+
+
+# Fields eligible for population-based/genetic hyperparameter search, mirroring
+# the reference's `<-- GEN` tags (ref config.py:12-57, README.md:28-32).
+GENETIC_SEARCH_SPACE: Dict[str, Tuple[Any, Any]] = {
+    "optim.lr": (1e-5, 1e-3),
+    "optim.gamma": (0.99, 0.999),
+    "optim.target_net_update_interval": (500, 5000),
+    "replay.batch_size": (32, 256),
+    "replay.capacity": (50_000, 1_000_000),
+    "replay.prio_exponent": (0.0, 1.0),
+    "replay.importance_sampling_exponent": (0.0, 1.0),
+    "sequence.burn_in_steps": (0, 80),
+    "sequence.learning_steps": (5, 20),
+    "network.hidden_dim": (128, 1024),
+    "network.cnn_out_dim": (256, 2048),
+    "network.use_dueling": (False, True),
+}

@@ -1,0 +1,32 @@
+"""Pure functional ops: the numerical core of the framework.
+
+Everything here is side-effect free and either a jittable JAX function (device
+path) or a plain numpy function (actor/host path). No processes, no devices
+required — unit-testable against naive references (SURVEY.md §4).
+"""
+
+from r2d2_tpu.ops.value import value_rescale, inverse_value_rescale
+from r2d2_tpu.ops.returns import n_step_return, n_step_gamma, initial_priorities
+from r2d2_tpu.ops.priority import mixed_td_errors_masked, mixed_td_errors_ragged
+from r2d2_tpu.ops.sum_tree import (
+    tree_num_layers,
+    tree_init,
+    tree_update,
+    tree_sample,
+    tree_total,
+)
+
+__all__ = [
+    "value_rescale",
+    "inverse_value_rescale",
+    "n_step_return",
+    "n_step_gamma",
+    "initial_priorities",
+    "mixed_td_errors_masked",
+    "mixed_td_errors_ragged",
+    "tree_num_layers",
+    "tree_init",
+    "tree_update",
+    "tree_sample",
+    "tree_total",
+]

@@ -1,0 +1,44 @@
+"""Per-sequence replay priority from per-step TD errors.
+
+R2D2 mixes max and mean absolute TD error over each sequence's learning steps:
+p = eta*max + (1-eta)*mean, eta=0.9 (/root/reference/worker.py:240-249, where
+it is a numba kernel over a ragged flat layout).
+
+TPU-native form: the jitted train step produces TD errors as a dense
+(batch, learning_steps_max) array with a validity mask — masked max/mean are
+two reductions that XLA fuses into the surrounding step, so priority
+computation costs no extra device<->host sync (SURVEY.md §2.1). A ragged numpy
+twin serves the actor-side initial-priority path.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def mixed_td_errors_masked(
+    td_errors: jnp.ndarray, mask: jnp.ndarray, eta: float = 0.9
+) -> jnp.ndarray:
+    """td_errors: (B, L) abs TD errors; mask: (B, L) 1.0 where the step is a
+    real learning step. Returns (B,) mixed priorities."""
+    mask = mask.astype(td_errors.dtype)
+    neg_inf = jnp.asarray(-jnp.inf, dtype=td_errors.dtype)
+    masked_max = jnp.max(jnp.where(mask > 0, td_errors, neg_inf), axis=1)
+    count = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    masked_mean = jnp.sum(td_errors * mask, axis=1) / count
+    # Sequences with no valid steps (shouldn't happen) get priority 0.
+    valid = jnp.sum(mask, axis=1) > 0
+    return jnp.where(valid, eta * masked_max + (1.0 - eta) * masked_mean, 0.0)
+
+
+def mixed_td_errors_ragged(
+    td_errors: np.ndarray, learning_steps: np.ndarray, eta: float = 0.9
+) -> np.ndarray:
+    """Ragged layout: td_errors is the flat concatenation of each sequence's
+    learning-step errors; learning_steps gives each sequence's length."""
+    out = np.empty(learning_steps.shape, dtype=np.float32)
+    start = 0
+    for i, steps in enumerate(learning_steps):
+        seg = td_errors[start : start + steps]
+        out[i] = eta * seg.max() + (1.0 - eta) * seg.mean()
+        start += steps
+    return out

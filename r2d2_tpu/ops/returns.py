@@ -1,0 +1,72 @@
+"""n-step return math for the actor-side block assembler.
+
+The reference computes these inside ``LocalBuffer.finish``
+(/root/reference/worker.py:443-480): an n-step discounted reward via
+``np.convolve``, a per-step effective discount ``gamma^n`` whose tail encodes
+episode termination (zeroed) or bootstrap shortening — so no ``done`` flag ever
+needs to be stored — and initial sequence priorities computed from the actor's
+own (slightly stale) Q-values so new experience enters the replay tree with a
+meaningful priority before the learner ever sees it.
+
+These run on actor CPUs over one <=400-step block, so they are plain numpy.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+
+def n_step_return(rewards: np.ndarray, gamma: float, n: int) -> np.ndarray:
+    """Discounted n-step reward sum per step.
+
+    out[t] = sum_{i=0..n-1} gamma^i * rewards[t+i], with rewards treated as 0
+    past the end of the block (matches zero-padding at
+    /root/reference/worker.py:463-466).
+    """
+    rewards = np.asarray(rewards, dtype=np.float64)
+    size = rewards.shape[0]
+    padded = np.concatenate([rewards, np.zeros(n - 1, dtype=np.float64)])
+    kernel = gamma ** np.arange(n - 1, -1, -1, dtype=np.float64)
+    return np.convolve(padded, kernel, "valid").astype(np.float32)[:size]
+
+
+def n_step_gamma(size: int, gamma: float, n: int, bootstrap: bool) -> np.ndarray:
+    """Per-step effective discount applied to the bootstrap value.
+
+    For steps with a full n-step window: gamma^n. The final ``min(size, n)``
+    steps have a shortened window ending at the block boundary: gamma^m for the
+    m steps remaining if the block continues (``bootstrap=True``), or 0 if the
+    episode terminated — encoding 'done' in the discount
+    (/root/reference/worker.py:445-456).
+    """
+    max_forward = min(size, n)
+    out = np.full(size, gamma**n, dtype=np.float32)
+    if bootstrap:
+        tail = gamma ** np.arange(max_forward, 0, -1, dtype=np.float64)
+    else:
+        tail = np.zeros(max_forward, dtype=np.float64)
+    out[size - max_forward :] = tail
+    return out
+
+
+def initial_priorities(
+    q_values: np.ndarray,
+    actions: np.ndarray,
+    n_step_rewards: np.ndarray,
+    n_step_gammas: np.ndarray,
+    n: int,
+) -> np.ndarray:
+    """Per-step |TD error| from the actor's own Q-values, used to seed replay
+    priorities when a block is added (/root/reference/worker.py:475-478).
+
+    q_values has one extra row: the bootstrap Q (zeros when the episode
+    terminated). The bootstrap value for step t is max_a Q[t + m] where
+    m = min(size, n) for the window-shortened tail, i.e. max Q over rows
+    [max_forward:size+1] edge-padded to length size.
+    """
+    size = actions.shape[0]
+    max_forward = min(size, n)
+    max_q = q_values[max_forward : size + 1].max(axis=1)
+    max_q = np.pad(max_q, (0, max_forward - 1), "edge")
+    chosen_q = q_values[np.arange(size), actions]
+    return np.abs(n_step_rewards + n_step_gammas * max_q - chosen_q).astype(np.float32)

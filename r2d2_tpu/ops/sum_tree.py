@@ -1,0 +1,169 @@
+"""Prioritized-replay sum tree, as data-parallel array ops.
+
+The reference keeps a flat-array binary sum tree updated and sampled by numba
+LLVM kernels on the replay-buffer host process
+(/root/reference/priority_tree.py:7-49) — every learner step pays a host-side
+tree walk. Both kernels are already expressed as whole-array operations
+(leaf scatter + bottom-up parent rebuild; batched stratified root-to-leaf
+descent), so here they map 1:1 onto jnp scatter/gather with a statically
+unrolled layer loop, and run *on device inside the jitted learner step*: the
+learner never blocks on a host round-trip for priorities (BASELINE.json north
+star). A numpy twin backs the host-feeder fallback path and serves as the test
+oracle; the C++ native variant lives in r2d2_tpu/native/.
+
+Layout: a single 1-D array of 2**num_layers - 1 nodes; node 0 is the root
+holding the total priority mass, leaves occupy [2**(L-1) - 1, 2**L - 1).
+float32 on device (TPU has no fast f64); with <=2**20 leaves and O(1)
+priorities the stratified-descent error from f32 accumulation is far below the
+sampling jitter itself.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_num_layers(capacity: int) -> int:
+    """Smallest L with 2**(L-1) >= capacity leaves (ref priority_tree.py:7-11)."""
+    num_layers = 1
+    while capacity > 2 ** (num_layers - 1):
+        num_layers += 1
+    return num_layers
+
+
+def tree_init(capacity: int, dtype=jnp.float32) -> Tuple[int, jnp.ndarray]:
+    num_layers = tree_num_layers(capacity)
+    return num_layers, jnp.zeros(2**num_layers - 1, dtype=dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def tree_update(
+    num_layers: int,
+    tree: jnp.ndarray,
+    prio_exponent: float,
+    td_errors: jnp.ndarray,
+    idxes: jnp.ndarray,
+) -> jnp.ndarray:
+    """Write p = td**alpha at the given leaves and rebuild ancestor sums.
+
+    alpha = 0 must still give p = 0 for td = 0 so PER can be disabled without a
+    code path change (ref priority_tree.py:17). Duplicate parent writes in the
+    bottom-up sweep all carry the same recomputed value, so scatter-set is safe.
+    """
+    td_errors = td_errors.astype(tree.dtype)
+    priorities = jnp.where(
+        td_errors != 0.0, jnp.abs(td_errors) ** prio_exponent, 0.0
+    )
+    node = idxes.astype(jnp.int32) + 2 ** (num_layers - 1) - 1
+    tree = tree.at[node].set(priorities)
+    for _ in range(num_layers - 1):
+        node = (node - 1) // 2
+        tree = tree.at[node].set(tree[2 * node + 1] + tree[2 * node + 2])
+    return tree
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def tree_sample(
+    num_layers: int,
+    tree: jnp.ndarray,
+    is_exponent: float,
+    num_samples: int,
+    key: jax.Array,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stratified proportional sampling + importance weights.
+
+    The total mass is split into num_samples equal strata; one uniform draw per
+    stratum descends the tree root-to-leaf, the whole batch in lockstep
+    (ref priority_tree.py:29-49). Returns (leaf_idxes, is_weights) with
+    is_weights = (p / min_p) ** -beta.
+
+    Callers must not sample an empty tree (total mass 0 yields NaN weights);
+    training is gated on replay.learning_starts exactly as the reference gates
+    on ReplayBuffer.ready (ref worker.py:214-218).
+    """
+    p_sum = tree[0]
+    interval = p_sum / num_samples
+    jitter = jax.random.uniform(
+        key, (num_samples,), dtype=tree.dtype, minval=0.0, maxval=1.0
+    )
+    prefixsums = (jnp.arange(num_samples, dtype=tree.dtype) + jitter) * interval
+    # f32 rounding can push the top stratum to exactly p_sum (or past a subtree
+    # total mid-descent), which would walk into a zero-priority padding leaf and
+    # produce NaN weights. Clamp below the total, and never enter a zero-mass
+    # right subtree.
+    prefixsums = jnp.minimum(prefixsums, p_sum * (1.0 - 1e-6))
+
+    node = jnp.zeros(num_samples, dtype=jnp.int32)
+    for _ in range(num_layers - 1):
+        left_sum = tree[node * 2 + 1]
+        right_sum = tree[node * 2 + 2]
+        go_left = (prefixsums < left_sum) | (right_sum <= 0.0)
+        node = jnp.where(go_left, node * 2 + 1, node * 2 + 2)
+        prefixsums = jnp.where(
+            go_left, jnp.minimum(prefixsums, left_sum * (1.0 - 1e-6)), prefixsums - left_sum
+        )
+
+    priorities = tree[node]
+    min_p = jnp.min(priorities)
+    is_weights = jnp.power(priorities / min_p, -is_exponent)
+    leaf = node - (2 ** (num_layers - 1) - 1)
+    return leaf, is_weights
+
+
+def tree_total(tree: jnp.ndarray) -> jnp.ndarray:
+    return tree[0]
+
+
+# ---------------------------------------------------------------------------
+# numpy twin (host feeder fallback + test oracle)
+# ---------------------------------------------------------------------------
+
+
+def tree_init_np(capacity: int) -> Tuple[int, np.ndarray]:
+    num_layers = tree_num_layers(capacity)
+    return num_layers, np.zeros(2**num_layers - 1, dtype=np.float64)
+
+
+def tree_update_np(
+    num_layers: int,
+    tree: np.ndarray,
+    prio_exponent: float,
+    td_errors: np.ndarray,
+    idxes: np.ndarray,
+) -> None:
+    priorities = np.where(td_errors != 0.0, np.abs(td_errors) ** prio_exponent, 0.0)
+    node = np.asarray(idxes, dtype=np.int64) + 2 ** (num_layers - 1) - 1
+    tree[node] = priorities
+    for _ in range(num_layers - 1):
+        node = np.unique((node - 1) // 2)
+        tree[node] = tree[2 * node + 1] + tree[2 * node + 2]
+
+
+def tree_sample_np(
+    num_layers: int,
+    tree: np.ndarray,
+    is_exponent: float,
+    num_samples: int,
+    rng: np.random.Generator,
+) -> Tuple[np.ndarray, np.ndarray]:
+    p_sum = tree[0]
+    interval = p_sum / num_samples
+    prefixsums = np.arange(num_samples, dtype=np.float64) * interval + rng.uniform(
+        0, interval, num_samples
+    )
+    prefixsums = np.minimum(prefixsums, p_sum * (1.0 - 1e-12))
+    node = np.zeros(num_samples, dtype=np.int64)
+    for _ in range(num_layers - 1):
+        left_sum = tree[node * 2 + 1]
+        right_sum = tree[node * 2 + 2]
+        go_left = (prefixsums < left_sum) | (right_sum <= 0.0)
+        node = np.where(go_left, node * 2 + 1, node * 2 + 2)
+        prefixsums = np.where(
+            go_left, np.minimum(prefixsums, left_sum * (1.0 - 1e-12)), prefixsums - left_sum
+        )
+    priorities = tree[node]
+    is_weights = np.power(priorities / priorities.min(), -is_exponent)
+    return node - (2 ** (num_layers - 1) - 1), is_weights

@@ -1,0 +1,20 @@
+"""Invertible value rescaling (Pohlen et al. 2018), used by R2D2 in place of
+reward clipping for the n-step target: target = h(r + gamma^n * h^-1(Q')).
+
+Semantics match the reference learner's static methods
+(/root/reference/worker.py:383-390); implementation is jnp so it fuses into the
+jitted train step.
+"""
+
+import jax.numpy as jnp
+
+
+def value_rescale(value: jnp.ndarray, eps: float = 1e-2) -> jnp.ndarray:
+    """h(x) = sign(x) * (sqrt(|x| + 1) - 1) + eps * x"""
+    return jnp.sign(value) * (jnp.sqrt(jnp.abs(value) + 1.0) - 1.0) + eps * value
+
+
+def inverse_value_rescale(value: jnp.ndarray, eps: float = 1e-2) -> jnp.ndarray:
+    """h^-1(x) = sign(x) * ((((sqrt(1 + 4*eps*(|x| + 1 + eps)) - 1) / (2*eps))^2) - 1)"""
+    temp = (jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(value) + 1.0 + eps)) - 1.0) / (2.0 * eps)
+    return jnp.sign(value) * (jnp.square(temp) - 1.0)

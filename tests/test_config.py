@@ -1,0 +1,62 @@
+import pytest
+
+from r2d2_tpu.config import Config, apex_epsilon, parse_overrides
+
+
+def test_defaults_match_reference():
+    cfg = Config()
+    assert cfg.sequence.seq_len == 55
+    assert cfg.replay.capacity == 500_000
+    assert cfg.seqs_per_block == 40
+    assert cfg.num_blocks == 1250
+    assert cfg.num_sequences == 50_000
+    assert cfg.env.obs_shape == (4, 84, 84)
+
+
+def test_replace_dotted():
+    cfg = Config().replace(**{"replay.capacity": 4000, "actor.num_actors": 8})
+    assert cfg.replay.capacity == 4000
+    assert cfg.actor.num_actors == 8
+    # untouched sections preserved
+    assert cfg.optim.lr == 1e-4
+
+
+def test_parse_overrides_types():
+    cfg = parse_overrides(
+        Config(),
+        ["--optim.lr=0.001", "--network.use_double=true", "--replay.batch_size=32"],
+    )
+    assert cfg.optim.lr == pytest.approx(1e-3)
+    assert cfg.network.use_double is True
+    assert cfg.replay.batch_size == 32
+
+
+def test_parse_overrides_rejects_unknown():
+    with pytest.raises(SystemExit):
+        parse_overrides(Config(), ["--nope.lr=1"])
+    with pytest.raises(SystemExit):
+        parse_overrides(Config(), ["--optim.nope=1"])
+
+
+def test_apex_epsilon_ladder():
+    # eps_i = 0.4 ** (1 + 7*i/(N-1)): ref train.py:16-18
+    n = 10
+    eps = [apex_epsilon(i, n, 0.4, 7.0) for i in range(n)]
+    assert eps[0] == pytest.approx(0.4)
+    assert eps[-1] == pytest.approx(0.4**8)
+    assert all(a > b for a, b in zip(eps, eps[1:]))
+    assert apex_epsilon(0, 1, 0.4, 7.0) == pytest.approx(0.4)
+
+
+def test_invalid_sizes_rejected():
+    with pytest.raises(ValueError):
+        Config().replace(**{"sequence.learning_steps": 15})  # 400 % 15 != 0
+    with pytest.raises(ValueError):
+        Config().replace(**{"replay.capacity": 500_100})
+
+
+def test_bad_numeric_override_is_friendly():
+    with pytest.raises(SystemExit):
+        parse_overrides(Config(), ["--replay.batch_size=abc"])
+    with pytest.raises(SystemExit):
+        parse_overrides(Config(), ["--network.conv_layers=((16,4,2),)"])

@@ -1,0 +1,186 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.ops import (
+    inverse_value_rescale,
+    initial_priorities,
+    mixed_td_errors_masked,
+    mixed_td_errors_ragged,
+    n_step_gamma,
+    n_step_return,
+    tree_init,
+    tree_sample,
+    tree_update,
+    value_rescale,
+)
+from r2d2_tpu.ops.sum_tree import (
+    tree_init_np,
+    tree_num_layers,
+    tree_sample_np,
+    tree_update_np,
+)
+
+
+class TestValueRescale:
+    def test_round_trip(self):
+        x = jnp.linspace(-50.0, 50.0, 101)
+        np.testing.assert_allclose(
+            inverse_value_rescale(value_rescale(x)), x, atol=1e-3, rtol=1e-4
+        )
+
+    def test_zero_fixed_point(self):
+        assert float(value_rescale(jnp.array(0.0))) == 0.0
+        assert float(inverse_value_rescale(jnp.array(0.0))) == 0.0
+
+    def test_odd_symmetry(self):
+        x = jnp.array([0.5, 3.0, 17.0])
+        np.testing.assert_allclose(value_rescale(-x), -value_rescale(x), rtol=1e-6)
+
+
+class TestNStepReturn:
+    def test_vs_brute_force(self, rng):
+        rewards = rng.normal(size=37).astype(np.float32)
+        gamma, n = 0.997, 5
+        got = n_step_return(rewards, gamma, n)
+        padded = np.concatenate([rewards, np.zeros(n - 1)])
+        want = np.array(
+            [sum(gamma**i * padded[t + i] for i in range(n)) for t in range(37)]
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_short_block(self):
+        # block shorter than the horizon
+        got = n_step_return(np.array([1.0, 2.0]), 0.5, 5)
+        np.testing.assert_allclose(got, [1.0 + 0.5 * 2.0, 2.0], rtol=1e-6)
+
+    def test_gamma_terminal_zeros_tail(self):
+        g = n_step_gamma(size=12, gamma=0.9, n=5, bootstrap=False)
+        np.testing.assert_allclose(g[:7], 0.9**5, rtol=1e-6)
+        np.testing.assert_allclose(g[7:], 0.0)
+
+    def test_gamma_bootstrap_shortens_tail(self):
+        g = n_step_gamma(size=12, gamma=0.9, n=5, bootstrap=True)
+        np.testing.assert_allclose(g[:7], 0.9**5, rtol=1e-6)
+        np.testing.assert_allclose(g[7:], [0.9**k for k in range(5, 0, -1)], rtol=1e-6)
+
+    def test_gamma_tiny_block(self):
+        g = n_step_gamma(size=3, gamma=0.9, n=5, bootstrap=True)
+        np.testing.assert_allclose(g, [0.9**3, 0.9**2, 0.9**1], rtol=1e-6)
+
+
+class TestInitialPriorities:
+    def test_vs_brute_force(self, rng):
+        size, n, actions_dim = 23, 5, 6
+        q = rng.normal(size=(size + 1, actions_dim)).astype(np.float32)
+        actions = rng.integers(0, actions_dim, size)
+        rewards = rng.normal(size=size).astype(np.float32)
+        gammas = n_step_gamma(size, 0.99, n, bootstrap=True)
+        got = initial_priorities(q, actions, rewards, gammas, n)
+        for t in range(size):
+            boot_row = min(t + n, size)
+            want = abs(rewards[t] + gammas[t] * q[boot_row].max() - q[t, actions[t]])
+            assert got[t] == pytest.approx(want, rel=1e-5)
+
+
+class TestMixedTD:
+    def test_masked_matches_ragged(self, rng):
+        B, L = 16, 10
+        steps = rng.integers(1, L + 1, size=B)
+        dense = rng.uniform(0.01, 2.0, size=(B, L)).astype(np.float32)
+        mask = (np.arange(L)[None, :] < steps[:, None]).astype(np.float32)
+        flat = np.concatenate([dense[i, : steps[i]] for i in range(B)])
+        want = mixed_td_errors_ragged(flat, steps)
+        got = np.asarray(mixed_td_errors_masked(jnp.asarray(dense), jnp.asarray(mask)))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_eta_mix(self):
+        td = jnp.array([[1.0, 3.0]])
+        mask = jnp.ones((1, 2))
+        got = float(mixed_td_errors_masked(td, mask, eta=0.9)[0])
+        assert got == pytest.approx(0.9 * 3.0 + 0.1 * 2.0)
+
+
+class TestSumTree:
+    def test_num_layers(self):
+        assert tree_num_layers(1) == 1
+        assert tree_num_layers(2) == 2
+        assert tree_num_layers(3) == 3
+        assert tree_num_layers(4) == 3
+        assert tree_num_layers(50_000) == 17
+
+    def test_update_total_and_leaves(self, rng):
+        capacity = 64
+        L, tree = tree_init(capacity)
+        td = rng.uniform(0.1, 2.0, size=capacity).astype(np.float32)
+        tree = tree_update(L, tree, 0.9, jnp.asarray(td), jnp.arange(capacity))
+        leaves = np.asarray(tree[2 ** (L - 1) - 1 :])[:capacity]
+        np.testing.assert_allclose(leaves, td**0.9, rtol=1e-5)
+        assert float(tree[0]) == pytest.approx((td**0.9).sum(), rel=1e-4)
+
+    def test_alpha_zero_keeps_zero_priority(self):
+        L, tree = tree_init(8)
+        tree = tree_update(
+            L, tree, 0.0, jnp.array([0.0, 1.0, 2.0]), jnp.array([0, 1, 2])
+        )
+        leaves = np.asarray(tree[2 ** (L - 1) - 1 :])
+        np.testing.assert_allclose(leaves[:3], [0.0, 1.0, 1.0])
+
+    def test_partial_update_preserves_rest(self, rng):
+        L, tree = tree_init(32)
+        tree = tree_update(L, tree, 1.0, jnp.ones(32), jnp.arange(32))
+        tree = tree_update(L, tree, 1.0, jnp.array([5.0]), jnp.array([7]))
+        assert float(tree[0]) == pytest.approx(31 + 5.0, rel=1e-5)
+
+    def test_sample_matches_numpy_semantics(self, rng):
+        capacity = 128
+        td = rng.uniform(0.1, 3.0, size=capacity)
+        L, jtree = tree_init(capacity)
+        jtree = tree_update(L, jtree, 0.9, jnp.asarray(td), jnp.arange(capacity))
+        Ln, ntree = tree_init_np(capacity)
+        tree_update_np(Ln, ntree, 0.9, td, np.arange(capacity))
+        assert L == Ln
+        np.testing.assert_allclose(np.asarray(jtree), ntree, rtol=1e-4)
+
+        idx, w = tree_sample(L, jtree, 0.6, 64, jax.random.PRNGKey(0))
+        idx = np.asarray(idx)
+        assert idx.min() >= 0 and idx.max() < capacity
+        w = np.asarray(w)
+        # (p/min_p)^-beta: highest weight 1.0 at the sampled min-priority leaf
+        assert np.all(w <= 1.0 + 1e-6) and w.max() == pytest.approx(1.0)
+
+    def test_sampling_is_proportional(self, rng):
+        capacity = 16
+        prio = np.zeros(capacity)
+        prio[3] = 1.0
+        prio[10] = 3.0
+        L, tree = tree_init(capacity)
+        tree = tree_update(L, tree, 1.0, jnp.asarray(prio), jnp.arange(capacity))
+        counts = np.zeros(capacity)
+        for s in range(20):
+            idx, _ = tree_sample(L, tree, 0.6, 64, jax.random.PRNGKey(s))
+            np.add.at(counts, np.asarray(idx), 1)
+        assert counts[3] + counts[10] == counts.sum()
+        assert counts[10] / counts[3] == pytest.approx(3.0, rel=0.15)
+
+    def test_partially_filled_tree_never_samples_padding(self):
+        # Regression: with f32 prefix sums, the top stratum could round up to
+        # exactly p_sum and descend into a zero-priority padding leaf (NaN
+        # weights, out-of-range index). 50k leaves, only 20k filled.
+        capacity, filled = 50_000, 20_000
+        L, tree = tree_init(capacity)
+        tree = tree_update(L, tree, 0.9, jnp.ones(filled), jnp.arange(filled))
+        for s in range(5):
+            idx, w = tree_sample(L, tree, 0.6, 128, jax.random.PRNGKey(s))
+            assert int(jnp.max(idx)) < filled
+            assert bool(jnp.all(jnp.isfinite(w)))
+
+    def test_stratified_covers_strata(self):
+        capacity = 64
+        L, tree = tree_init(capacity)
+        tree = tree_update(L, tree, 1.0, jnp.ones(capacity), jnp.arange(capacity))
+        idx, w = tree_sample(L, tree, 0.6, capacity, jax.random.PRNGKey(1))
+        # uniform priorities + stratification => every leaf sampled exactly once
+        assert sorted(np.asarray(idx).tolist()) == list(range(capacity))
+        np.testing.assert_allclose(np.asarray(w), 1.0, rtol=1e-5)
