@@ -1,0 +1,84 @@
+"""CPU-jitted actor policy — the reference's ``Network.step`` + ε-greedy
+(/root/reference/model.py:67-84, /root/reference/worker.py:535-538) without
+torch or Ray.
+
+Actor processes run on host CPUs while the learner owns the TPU, so the
+policy pins its params to the CPU backend: JAX placement follows committed
+operands, making the same Flax module a CPU program here and a TPU program in
+the learner — weight sync is a raw pytree copy, no format conversion
+(the reference ships state_dicts through Ray's object store,
+/root/reference/worker.py:286-290,572-576).
+
+The policy owns the per-episode recurrent state and rolling frame stack
+(ref worker.py:516,526,546-547, model.py:34,86-87).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.models.network import NetworkApply, initial_hidden
+
+
+class ActorPolicy:
+    def __init__(self, net: NetworkApply, params, epsilon: float, seed: int = 0):
+        self.net = net
+        self.epsilon = float(epsilon)
+        self.action_dim = net.action_dim
+        self.rng = np.random.default_rng(seed)
+        self._cpu = jax.devices("cpu")[0]
+        self.params = jax.device_put(params, self._cpu)
+
+        def step_fn(params, stacked_obs, last_action, hidden):
+            # stacked_obs: (H, W, stack) f32 in [0,1]; last_action: () int32
+            obs = stacked_obs[None, None]
+            la = jax.nn.one_hot(last_action, net.action_dim,
+                                dtype=jnp.float32)[None, None]
+            q, h = net.module.apply(params, obs, la, hidden)
+            return jnp.argmax(q[0, 0]), q[0, 0], h
+
+        self._step = jax.jit(step_fn)
+        self.reset_state()
+
+    def reset_state(self) -> None:
+        """Per-episode state reset (ref model.py:86-87, worker.py:584-591)."""
+        self.hidden = jax.device_put(
+            initial_hidden(1, self.net.config.hidden_dim), self._cpu)
+        h, w, s = self.net.obs_hw
+        self.stacked = np.zeros((h, w, s), np.float32)
+        self.last_action = np.int32(-1)
+
+    def observe_reset(self, obs: np.ndarray) -> None:
+        """Fill the frame stack with the initial observation (ref worker.py:587)."""
+        self.reset_state()
+        self.stacked[:] = (np.asarray(obs, np.float32) / 255.0)[..., None]
+
+    def observe(self, obs: np.ndarray, action: int) -> None:
+        """Roll the frame stack and record the taken action (ref worker.py:543-547)."""
+        self.stacked = np.roll(self.stacked, -1, axis=-1)
+        self.stacked[..., -1] = np.asarray(obs, np.float32) / 255.0
+        self.last_action = np.int32(action)
+
+    def update_params(self, params) -> None:
+        self.params = jax.device_put(params, self._cpu)
+
+    def step(self) -> Tuple[int, np.ndarray, np.ndarray]:
+        """Greedy action + Q-values + packed hidden *after* this step; the
+        ε-greedy override happens in ``act`` (ref worker.py:535-538)."""
+        action, q, self.hidden = self._step(
+            self.params, self.stacked, self.last_action, self.hidden)
+        return int(action), np.asarray(q), np.asarray(self.hidden[0])
+
+    def act(self) -> Tuple[int, np.ndarray, np.ndarray]:
+        action, q, hidden = self.step()
+        if self.rng.random() < self.epsilon:
+            action = int(self.rng.integers(self.action_dim))
+        return action, q, hidden
+
+    def bootstrap_q(self) -> np.ndarray:
+        """Q at the current state without advancing the recurrent state —
+        the block-boundary bootstrap (ref worker.py:560-563)."""
+        _, q, _ = self._step(self.params, self.stacked, self.last_action, self.hidden)
+        return np.asarray(q)

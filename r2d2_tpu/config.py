@@ -248,7 +248,14 @@ def _coerce(key: str, value: str, annotation: str) -> Any:
             "construct the Config in code instead"
         )
     if target_type is bool:
-        return value.lower() in ("1", "true", "yes", "on")
+        lowered = value.lower()
+        if lowered in ("1", "true", "yes", "on"):
+            return True
+        if lowered in ("0", "false", "no", "off"):
+            return False
+        raise SystemExit(
+            f"invalid value {value!r} for {key!r} (expected a boolean: "
+            "1/0, true/false, yes/no, on/off)")
     if target_type is str:
         return value
     try:
@@ -268,7 +275,7 @@ def parse_overrides(cfg: Config, argv: List[str]) -> Config:
             raise SystemExit(f"unrecognized argument {arg!r}; expected --section.field=value")
         key, _, raw = arg[2:].partition("=")
         section, _, fname = key.partition(".")
-        if not hasattr(cfg, section):
+        if section not in {f.name for f in dataclasses.fields(cfg)}:
             raise SystemExit(f"unknown config section {section!r}")
         sub = getattr(cfg, section)
         matching = {f.name: f for f in dataclasses.fields(sub)}
@@ -290,17 +297,23 @@ def apex_epsilon(actor_id: int, num_actors: int, base_eps: float,
 
 # Fields eligible for population-based/genetic hyperparameter search, mirroring
 # the reference's `<-- GEN` tags (ref config.py:12-57, README.md:28-32).
-GENETIC_SEARCH_SPACE: Dict[str, Tuple[Any, Any]] = {
-    "optim.lr": (1e-5, 1e-3),
-    "optim.gamma": (0.99, 0.999),
-    "optim.target_net_update_interval": (500, 5000),
-    "replay.batch_size": (32, 256),
-    "replay.capacity": (50_000, 1_000_000),
-    "replay.prio_exponent": (0.0, 1.0),
-    "replay.importance_sampling_exponent": (0.0, 1.0),
-    "sequence.burn_in_steps": (0, 80),
-    "sequence.learning_steps": (5, 20),
-    "network.hidden_dim": (128, 1024),
-    "network.cnn_out_dim": (256, 2048),
-    "network.use_dueling": (False, True),
+# Continuous fields carry a (lo, hi) range; fields constrained by the replay
+# layout invariants (Config.__post_init__: learning_steps | block_length,
+# block_length | capacity) or best kept hardware-friendly carry an explicit
+# choice tuple, so samplers never draw layout-invalid configs.
+GENETIC_SEARCH_SPACE: Dict[str, Dict[str, Any]] = {
+    "optim.lr": {"range": (1e-5, 1e-3), "log": True},
+    "optim.gamma": {"range": (0.99, 0.999)},
+    "optim.target_net_update_interval": {"choices": (500, 1000, 2000, 2500, 5000)},
+    "replay.batch_size": {"choices": (32, 64, 128, 256)},
+    # multiples of block_length=400 (capacity % block_length == 0)
+    "replay.capacity": {"choices": (50_000, 100_000, 200_000, 500_000, 1_000_000)},
+    "replay.prio_exponent": {"range": (0.0, 1.0)},
+    "replay.importance_sampling_exponent": {"range": (0.0, 1.0)},
+    "sequence.burn_in_steps": {"choices": (0, 10, 20, 40, 80)},
+    # divisors of block_length=400 (block_length % learning_steps == 0)
+    "sequence.learning_steps": {"choices": (5, 8, 10, 16, 20)},
+    "network.hidden_dim": {"choices": (128, 256, 512, 1024)},
+    "network.cnn_out_dim": {"choices": (256, 512, 1024, 2048)},
+    "network.use_dueling": {"choices": (False, True)},
 }

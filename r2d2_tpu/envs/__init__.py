@@ -1,0 +1,16 @@
+"""Environment layer.
+
+``create_env`` mirrors the reference factory (/root/reference/environment.py:82-93):
+gym-style construction + Atari preprocessing wrappers, with ViZDoom and
+Atari/ALE gated on availability. ``FakeR2D2Env`` is the hermetic deterministic
+environment the reference lacks (SURVEY.md §4) — the test/CI backend.
+
+Internal Env protocol is the reference's: ``reset() -> obs``,
+``step(a) -> (obs, reward, done, info)``, ``action_space.n`` — gymnasium's
+5-tuple API is adapted in wrappers.py.
+"""
+
+from r2d2_tpu.envs.fake import FakeR2D2Env
+from r2d2_tpu.envs.factory import create_env
+
+__all__ = ["FakeR2D2Env", "create_env"]

@@ -1,0 +1,57 @@
+"""Environment factory (ref /root/reference/environment.py:82-93).
+
+Resolves an env id to a backend:
+  * "Fake*"       — the hermetic deterministic env (tests/benchmarks);
+  * "Vizdoom*"    — the ViZDoom binding (r2d2_tpu.envs.vizdoom_env), gated on
+                    the vizdoom package;
+  * anything else — gymnasium (ALE Atari ids like "ALE/Boxing-v5"), gated on
+                    gymnasium.
+
+Then applies the reference's wrapper stack: WarpFrame always, ClipReward for
+training only (ref environment.py:88-92).
+"""
+
+from typing import Optional
+
+from r2d2_tpu.config import EnvConfig
+from r2d2_tpu.envs.fake import FakeR2D2Env
+from r2d2_tpu.envs.wrappers import ClipReward, GymnasiumAdapter, WarpFrame
+
+
+def create_env(cfg: EnvConfig, *, clip_rewards: Optional[bool] = None,
+               multi_conf: str = "", is_host: bool = False, testing: bool = False,
+               port: int = 5060, num_players: int = 1, name: str = "",
+               seed: int = 0):
+    """Build + wrap one environment instance.
+
+    Signature keeps the reference's parameter surface (environment.py:82-93)
+    including the multiplayer wiring passed through to ViZDoom.
+    """
+    clip = cfg.clip_rewards if clip_rewards is None else clip_rewards
+    env_id = cfg.env_id
+
+    if env_id.startswith("Fake"):
+        env = FakeR2D2Env(height=cfg.frame_height, width=cfg.frame_width, seed=seed)
+    elif env_id.startswith("Vizdoom"):
+        from r2d2_tpu.envs.vizdoom_env import make_vizdoom
+        env = make_vizdoom(
+            env_id, frame_skip=cfg.frame_skip, multi_conf=multi_conf,
+            is_host=is_host, testing=testing, port=port,
+            num_players=num_players, name=name, reward_cfg=cfg)
+        env = WarpFrame(env, cfg.frame_height, cfg.frame_width)
+    else:
+        try:
+            import gymnasium
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                f"env id {env_id!r} requires gymnasium (not installed); "
+                "use the Fake backend for hermetic runs") from e
+        kwargs = {}
+        if cfg.frame_skip > 1:
+            kwargs["frameskip"] = cfg.frame_skip
+        env = GymnasiumAdapter(gymnasium.make(env_id, **kwargs))
+        env = WarpFrame(env, cfg.frame_height, cfg.frame_width)
+
+    if clip:
+        env = ClipReward(env)
+    return env

@@ -1,0 +1,122 @@
+"""Atari-style preprocessing wrappers (ref /root/reference/environment.py:10-79)
+plus a gymnasium-API adapter, torch/cv2-optional.
+
+WarpFrame: RGB→grayscale + resize to 84x84. Uses cv2 when present (same C++
+path as the reference, environment.py:71-75); otherwise a numpy fallback
+(ITU-R 601 luma + area resampling) so the wrapper stack is importable
+everywhere.
+"""
+
+from typing import Any, Tuple
+
+import numpy as np
+
+try:  # pragma: no cover - exercised only when cv2 is installed
+    import cv2
+    _HAS_CV2 = True
+except ImportError:
+    _HAS_CV2 = False
+
+
+def _to_gray(frame: np.ndarray) -> np.ndarray:
+    if frame.ndim == 2:
+        return frame
+    if _HAS_CV2:
+        return cv2.cvtColor(frame, cv2.COLOR_RGB2GRAY)
+    return (frame @ np.array([0.299, 0.587, 0.114])).astype(np.uint8)
+
+
+def _resize(frame: np.ndarray, height: int, width: int) -> np.ndarray:
+    if frame.shape == (height, width):
+        return frame
+    if _HAS_CV2:
+        return cv2.resize(frame, (width, height), interpolation=cv2.INTER_AREA)
+    # numpy area-mean fallback: crop to a multiple then block-average;
+    # exact only for integer ratios, adequate as a dependency-free path.
+    h, w = frame.shape
+    ry, rx = max(h // height, 1), max(w // width, 1)
+    crop = frame[: ry * height, : rx * width]
+    if crop.shape != (ry * height, rx * width):
+        pad_y = ry * height - crop.shape[0]
+        pad_x = rx * width - crop.shape[1]
+        crop = np.pad(crop, ((0, pad_y), (0, pad_x)), mode="edge")
+    return crop.reshape(height, ry, width, rx).mean(axis=(1, 3)).astype(np.uint8)
+
+
+class Wrapper:
+    def __init__(self, env: Any):
+        self.env = env
+
+    @property
+    def action_space(self):
+        return self.env.action_space
+
+    def reset(self):
+        return self.env.reset()
+
+    def step(self, action):
+        return self.env.step(action)
+
+    def close(self):
+        return self.env.close()
+
+
+class GymnasiumAdapter(Wrapper):
+    """gymnasium 5-tuple API → the reference's 4-tuple protocol."""
+
+    def reset(self):
+        out = self.env.reset()
+        return out[0] if isinstance(out, tuple) else out
+
+    def step(self, action):
+        out = self.env.step(action)
+        if len(out) == 5:
+            obs, reward, terminated, truncated, info = out
+            return obs, reward, terminated or truncated, info
+        return out
+
+
+class WarpFrame(Wrapper):
+    """Grayscale + resize (ref environment.py:48-79)."""
+
+    def __init__(self, env, height: int = 84, width: int = 84):
+        super().__init__(env)
+        self.height, self.width = height, width
+
+    def _warp(self, obs: np.ndarray) -> np.ndarray:
+        return _resize(_to_gray(np.asarray(obs)), self.height, self.width)
+
+    def reset(self):
+        return self._warp(self.env.reset())
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        return self._warp(obs), reward, done, info
+
+
+class ClipReward(Wrapper):
+    """Clip rewards to [-1, 1], training-time only (ref environment.py:39-45;
+    actors/eval construct with clip_rewards=False, ref worker.py:507)."""
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        return obs, float(np.clip(reward, -1.0, 1.0)), done, info
+
+
+class NoopReset(Wrapper):
+    """Random no-op burn after reset (ref environment.py:10-37; present but
+    disabled in the reference factory, environment.py:90-91)."""
+
+    def __init__(self, env, noop_max: int = 30, noop_action: int = 0, seed: int = 0):
+        super().__init__(env)
+        self.noop_max = noop_max
+        self.noop_action = noop_action
+        self.rng = np.random.default_rng(seed)
+
+    def reset(self):
+        obs = self.env.reset()
+        for _ in range(int(self.rng.integers(1, self.noop_max + 1))):
+            obs, _, done, _ = self.env.step(self.noop_action)
+            if done:
+                obs = self.env.reset()
+        return obs

@@ -1,0 +1,10 @@
+"""Learner: the fused on-device R2D2 training step and its host-side driver."""
+
+from r2d2_tpu.learner.train_step import (
+    TrainState,
+    create_train_state,
+    make_learner_step,
+    make_loss_fn,
+)
+
+__all__ = ["TrainState", "create_train_state", "make_learner_step", "make_loss_fn"]

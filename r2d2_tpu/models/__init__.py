@@ -1,0 +1,19 @@
+"""Model zoo: recurrent value networks for the TPU-native R2D2 framework."""
+
+from r2d2_tpu.models.network import (
+    R2D2Network,
+    NetworkApply,
+    init_network,
+    initial_hidden,
+    pack_hidden,
+    unpack_hidden,
+)
+
+__all__ = [
+    "R2D2Network",
+    "NetworkApply",
+    "init_network",
+    "initial_hidden",
+    "pack_hidden",
+    "unpack_hidden",
+]

@@ -1,0 +1,201 @@
+"""Recurrent dueling/double DQN in Flax — the R2D2 model, TPU-first.
+
+Capability parity with the reference PyTorch ``Network``
+(/root/reference/model.py:8-157): Nature-DQN conv torso, LSTM over
+[cnn latent ⊕ one-hot last action], dueling value/advantage heads with
+mean-advantage baseline, and the four inference modes (single ``step``,
+grad-enabled sequence Q, no-grad target sequence Q at t+n, hidden reset).
+
+TPU-native re-design rather than translation:
+
+* **One unroll, not three.** The reference runs three LSTM passes per train
+  step: online ``caculate_q_`` for double-DQN action selection, target
+  ``caculate_q_``, and grad-enabled online ``caculate_q``
+  (/root/reference/worker.py:335-344). Because an LSTM output at t depends
+  only on inputs <= t, the online pass over the full window subsumes both
+  online passes: Q(s_t) and the action-selection Q(s_{t+n}) are *gathers from
+  the same unrolled outputs* (see ops/indexing.py). Only the target net needs
+  a second unroll — 2 sequential passes instead of 3.
+* **Static shapes.** No pack/pad (/root/reference/model.py:103-108): every
+  sequence unrolls the full fixed window under ``lax.scan``; ragged semantics
+  live in gather indices + masks computed in ops/indexing.py.
+* **NHWC convs + bf16 policy.** Channels-last is the TPU-friendly conv
+  layout; ``compute_dtype=bfloat16`` replaces torch.cuda.amp
+  (/root/reference/config.py:35) with f32 params and f32 Q outputs.
+* **Sharding-ready.** Kernel params carry logical sharding annotations
+  (``nn.with_partitioning``-free: we annotate at the mesh layer instead so a
+  1-device run pays nothing) — model parallelism is a mesh-axis change.
+"""
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from r2d2_tpu.config import NetworkConfig
+
+# Hidden-state packing convention matches the reference actor protocol:
+# packed[0] = h, packed[1] = c (torch.cat(hidden_state) at
+# /root/reference/model.py:84). Flax LSTMCell carries (c, h).
+
+
+def pack_hidden(carry: Tuple[jnp.ndarray, jnp.ndarray]) -> jnp.ndarray:
+    c, h = carry
+    return jnp.stack([h, c], axis=-2)  # (..., 2, hidden)
+
+
+def unpack_hidden(packed: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    h = packed[..., 0, :]
+    c = packed[..., 1, :]
+    return (c, h)
+
+
+def initial_hidden(batch_size: int, hidden_dim: int, dtype=jnp.float32) -> jnp.ndarray:
+    """Zero packed hidden state (ref model.py:34,86-87)."""
+    return jnp.zeros((batch_size, 2, hidden_dim), dtype=dtype)
+
+
+class ConvTorso(nn.Module):
+    """Nature-DQN feature extractor (ref model.py:22-31), NHWC.
+
+    Input: (B, H, W, stack) normalized f32/bf16. Output: (B, cnn_out_dim).
+    """
+
+    cnn_out_dim: int
+    conv_layers: Sequence[Tuple[int, int, int]]
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for features, kernel, stride in self.conv_layers:
+            # VALID padding matches torch Conv2d's default zero-pad=0.
+            x = nn.Conv(
+                features,
+                (kernel, kernel),
+                strides=(stride, stride),
+                padding="VALID",
+                dtype=self.dtype,
+            )(x)
+            x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(self.cnn_out_dim, dtype=self.dtype)(x)
+        return x
+
+
+class DuelingHead(nn.Module):
+    """Dueling Q decomposition q = v + a - mean(a) (ref model.py:36-46,59-63)."""
+
+    action_dim: int
+    hidden_dim: int
+    use_dueling: bool
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, h: jnp.ndarray) -> jnp.ndarray:
+        adv = nn.Dense(self.hidden_dim, dtype=self.dtype, name="adv_hidden")(h)
+        adv = nn.relu(adv)
+        adv = nn.Dense(self.action_dim, dtype=self.dtype, name="adv_out")(adv)
+        if not self.use_dueling:
+            return adv.astype(jnp.float32)
+        val = nn.Dense(self.hidden_dim, dtype=self.dtype, name="val_hidden")(h)
+        val = nn.relu(val)
+        val = nn.Dense(1, dtype=self.dtype, name="val_out")(val)
+        q = val + adv - jnp.mean(adv, axis=-1, keepdims=True)
+        return q.astype(jnp.float32)
+
+
+class R2D2Network(nn.Module):
+    """The full recurrent Q-network.
+
+    ``__call__`` is the single entry point: unroll T steps from a packed
+    hidden state, returning Q for every step plus the final packed hidden.
+    T=1 is the actor's ``step``; T=seq_len is the learner's sequence pass.
+    """
+
+    action_dim: int
+    config: NetworkConfig
+
+    @property
+    def compute_dtype(self):
+        return jnp.bfloat16 if self.config.bf16 else jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        obs_seq: jnp.ndarray,       # (B, T, H, W, stack) normalized [0,1]
+        last_action_seq: jnp.ndarray,  # (B, T, action_dim) one-hot f32
+        hidden: jnp.ndarray,        # (B, 2, hidden_dim) packed
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        cfg = self.config
+        dtype = self.compute_dtype
+        batch, seq = obs_seq.shape[0], obs_seq.shape[1]
+
+        # Torso over the flattened (B*T) frame batch — one big conv batch is
+        # the MXU-friendly shape (vs per-step convs inside the scan).
+        flat = obs_seq.astype(dtype).reshape(batch * seq, *obs_seq.shape[2:])
+        latent = ConvTorso(cfg.cnn_out_dim, cfg.conv_layers, dtype, name="torso")(flat)
+        latent = latent.reshape(batch, seq, cfg.cnn_out_dim)
+
+        rnn_in = jnp.concatenate(
+            [latent, last_action_seq.astype(dtype)], axis=-1
+        )
+
+        # Time-batched LSTM via nn.scan over axis 1 (ref model.py:33 —
+        # torch nn.LSTM batch_first).
+        cell = nn.scan(
+            nn.OptimizedLSTMCell,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=1,
+            out_axes=1,
+        )(features=cfg.hidden_dim, dtype=dtype, name="lstm")
+        carry = unpack_hidden(hidden.astype(dtype))
+        carry, outputs = cell(carry, rnn_in)
+
+        q = DuelingHead(
+            self.action_dim, cfg.hidden_dim, cfg.use_dueling, dtype, name="head"
+        )(outputs.reshape(batch * seq, cfg.hidden_dim))
+        q = q.reshape(batch, seq, self.action_dim)
+        return q, pack_hidden(carry).astype(jnp.float32)
+
+
+class NetworkApply:
+    """Thin convenience binding of jitted apply functions to a network spec.
+
+    Pure-functional: holds no parameters, only shapes/config. Used by the
+    actor policy (CPU) and the learner (TPU); both call the same module so
+    weight exchange is a raw pytree copy, never a format conversion (the
+    reference ships state_dicts through Ray's object store instead,
+    /root/reference/worker.py:286-290).
+    """
+
+    def __init__(self, action_dim: int, config: NetworkConfig,
+                 frame_stack: int, frame_height: int, frame_width: int):
+        self.action_dim = action_dim
+        self.config = config
+        self.obs_hw = (frame_height, frame_width, frame_stack)
+        self.module = R2D2Network(action_dim=action_dim, config=config)
+
+    def init(self, key: jax.Array):
+        h, w, s = self.obs_hw
+        obs = jnp.zeros((1, 1, h, w, s), jnp.float32)
+        la = jnp.zeros((1, 1, self.action_dim), jnp.float32)
+        hid = initial_hidden(1, self.config.hidden_dim)
+        return self.module.init(key, obs, la, hid)
+
+    def apply(self, params, obs_seq, last_action_seq, hidden):
+        return self.module.apply(params, obs_seq, last_action_seq, hidden)
+
+
+def init_network(
+    key: jax.Array,
+    action_dim: int,
+    config: NetworkConfig,
+    frame_stack: int = 4,
+    frame_height: int = 84,
+    frame_width: int = 84,
+):
+    """Initialize (apply_spec, params)."""
+    spec = NetworkApply(action_dim, config, frame_stack, frame_height, frame_width)
+    return spec, spec.init(key)

@@ -1,0 +1,74 @@
+"""Static-shape index math replacing the reference's ragged sequence slicing.
+
+The reference handles variable burn-in / learning / forward step counts with
+``pack_padded_sequence`` plus per-sequence Python slice loops
+(/root/reference/model.py:103-119,150; /root/reference/worker.py:140-166).
+XLA requires static shapes, so the TPU-native design runs every sequence over
+the full fixed window of ``seq_len = burn_in_max + learning_max + forward_max``
+steps and replaces the slicing with *gather indices* and *validity masks*:
+
+* an LSTM output at time t depends only on inputs <= t, so unrolling past a
+  sequence's true end changes nothing we gather from the valid prefix;
+* the reference's edge-padding of target-Q positions near episode end
+  (repeat the last valid output, /root/reference/model.py:111-118) is exactly
+  a clamp of the gather index to the last valid position.
+
+All functions are jnp and shape-polymorphic over the batch; they also accept
+numpy inputs for host-side tests.
+"""
+
+import jax.numpy as jnp
+
+
+def frame_stack_indices(seq_len: int, frame_stack: int) -> jnp.ndarray:
+    """(seq_len, frame_stack) gather over an unstacked frame row.
+
+    Replay stores raw unstacked frames; stacked observation t is frames
+    [t, t+stack) (the learner-side obs_idx gather, /root/reference/worker.py:310,330).
+    """
+    t = jnp.arange(seq_len)[:, None]
+    j = jnp.arange(frame_stack)[None, :]
+    return t + j
+
+
+def online_q_positions(burn_in_steps: jnp.ndarray, learning_max: int) -> jnp.ndarray:
+    """Positions of the learning-step outputs in the unrolled window.
+
+    Online Q for learning step j sits right after the burn-in prefix:
+    position = burn_in + j (ref model.py:150). Returns (B, learning_max) int32.
+    """
+    j = jnp.arange(learning_max, dtype=jnp.int32)[None, :]
+    return burn_in_steps.astype(jnp.int32)[:, None] + j
+
+
+def target_q_positions(
+    burn_in_steps: jnp.ndarray,
+    learning_steps: jnp.ndarray,
+    forward_steps: jnp.ndarray,
+    learning_max: int,
+    forward_max: int,
+) -> jnp.ndarray:
+    """Positions of the n-step-ahead outputs used for the bootstrap target.
+
+    The reference takes outputs [burn_in + forward_max : burn_in + learning +
+    forward] then repeats the last one ``min(forward_max - forward, learning)``
+    times (ref model.py:110-118) — i.e. target position for learning step j is
+    burn_in + forward_max + j, clamped to the last valid output
+    burn_in + learning + forward - 1. Returns (B, learning_max) int32.
+    """
+    burn_in = burn_in_steps.astype(jnp.int32)[:, None]
+    learning = learning_steps.astype(jnp.int32)[:, None]
+    forward = forward_steps.astype(jnp.int32)[:, None]
+    j = jnp.arange(learning_max, dtype=jnp.int32)[None, :]
+    pos = burn_in + forward_max + j
+    last_valid = burn_in + learning + forward - 1
+    return jnp.minimum(pos, last_valid)
+
+
+def learning_step_mask(learning_steps: jnp.ndarray, learning_max: int) -> jnp.ndarray:
+    """(B, learning_max) float32 mask: 1.0 where step j < learning_steps[b].
+
+    Replaces the ragged concatenation over variable per-sequence learning
+    steps (ref worker.py:168,344-346)."""
+    j = jnp.arange(learning_max, dtype=jnp.int32)[None, :]
+    return (j < learning_steps.astype(jnp.int32)[:, None]).astype(jnp.float32)

@@ -1,0 +1,31 @@
+"""Prioritized sequence replay.
+
+Two placements (config.replay.placement):
+  * "device" — HBM-resident block-ring with jitted add/sample/priority-update;
+    the learner's sample→train→update is one fused XLA program that never
+    stalls on a host-side tree walk (the reference pays a Ray round-trip plus
+    a numba tree walk per batch, /root/reference/worker.py:299-306,122-190).
+  * "host"   — numpy block-ring fed by the native C++ sum tree, mirroring the
+    reference's CPU buffer process for machines where HBM is scarce.
+"""
+
+from r2d2_tpu.replay.structs import Block, ReplaySpec, ReplayState, SampleBatch
+from r2d2_tpu.replay.device_replay import (
+    replay_init,
+    replay_add,
+    replay_sample,
+    replay_update_priorities,
+)
+from r2d2_tpu.replay.host_replay import HostReplay
+
+__all__ = [
+    "Block",
+    "ReplaySpec",
+    "ReplayState",
+    "SampleBatch",
+    "replay_init",
+    "replay_add",
+    "replay_sample",
+    "replay_update_priorities",
+    "HostReplay",
+]

@@ -1,0 +1,144 @@
+"""HBM-resident prioritized sequence replay — jitted add / sample / update.
+
+The reference replay is a dedicated CPU process: numba sum-tree walks plus a
+128-iteration Python slice loop per batch, reached through a Ray RPC
+(/root/reference/worker.py:122-190). Here the whole buffer lives in HBM as
+fixed-shape rings and all three operations are XLA programs:
+
+  * ``replay_add``     — ring-write one block + seed its tree priorities
+                         (ref worker.py:85-120);
+  * ``replay_sample``  — stratified tree descent + batched dynamic-slice
+                         gather of sequence windows (ref worker.py:122-190);
+  * ``replay_update_priorities`` — write back learner TD priorities
+                         (ref worker.py:192-209).
+
+Because the learner fuses sample→train→update into ONE program, sampling and
+its priority write-back are atomic with respect to block ingestion — the
+reference's ring-pointer staleness guard (/root/reference/worker.py:196-206)
+is unnecessary by construction: an ``add`` can never interleave between a
+sample and its update.
+
+All entry points donate the state argument, so XLA aliases the multi-GB obs
+ring in place instead of copying it.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from r2d2_tpu.ops.sum_tree import tree_update, tree_sample
+from r2d2_tpu.replay.structs import Block, ReplaySpec, ReplayState, SampleBatch
+
+
+def replay_init(spec: ReplaySpec) -> ReplayState:
+    n, s, l = spec.num_blocks, spec.seqs_per_block, spec.learning
+    return ReplayState(
+        tree=jnp.zeros(2**spec.tree_layers - 1, jnp.float32),
+        obs=jnp.zeros((n, spec.obs_row_len, spec.frame_height, spec.frame_width), jnp.uint8),
+        last_action=jnp.full((n, spec.la_row_len), -1, jnp.int32),
+        hidden=jnp.zeros((n, s, 2, spec.hidden_dim), jnp.float32),
+        action=jnp.zeros((n, s, l), jnp.int32),
+        reward=jnp.zeros((n, s, l), jnp.float32),
+        gamma=jnp.zeros((n, s, l), jnp.float32),
+        burn_in_steps=jnp.zeros((n, s), jnp.int32),
+        learning_steps=jnp.zeros((n, s), jnp.int32),
+        forward_steps=jnp.zeros((n, s), jnp.int32),
+        seq_start=jnp.zeros((n, s), jnp.int32),
+        block_ptr=jnp.zeros((), jnp.int32),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def replay_add(spec: ReplaySpec, state: ReplayState, block: Block) -> ReplayState:
+    """Ring-write ``block`` at block_ptr and seed its sequence priorities.
+
+    Empty sequence slots carry priority 0 (their leaves become unsamplable)
+    and learning_steps 0, which also re-zeroes slots left over from a longer
+    block previously in this ring position.
+    """
+    ptr = state.block_ptr
+    leaf0 = ptr * spec.seqs_per_block
+    idxes = leaf0 + jnp.arange(spec.seqs_per_block, dtype=jnp.int32)
+    tree = tree_update(spec.tree_layers, state.tree, spec.prio_exponent,
+                       block.priority, idxes)
+    return state.replace(
+        tree=tree,
+        obs=state.obs.at[ptr].set(block.obs_row),
+        last_action=state.last_action.at[ptr].set(block.last_action_row),
+        hidden=state.hidden.at[ptr].set(block.hidden),
+        action=state.action.at[ptr].set(block.action),
+        reward=state.reward.at[ptr].set(block.reward),
+        gamma=state.gamma.at[ptr].set(block.gamma),
+        burn_in_steps=state.burn_in_steps.at[ptr].set(block.burn_in_steps),
+        learning_steps=state.learning_steps.at[ptr].set(block.learning_steps),
+        forward_steps=state.forward_steps.at[ptr].set(block.forward_steps),
+        seq_start=state.seq_start.at[ptr].set(block.seq_start),
+        block_ptr=(ptr + 1) % spec.num_blocks,
+    )
+
+
+def _gather_windows(spec: ReplaySpec, state: ReplayState,
+                    block_idx: jnp.ndarray, window_start: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched dynamic-slice of (obs, last_action) windows.
+
+    window_start is the timeline offset ``seq_start - burn_in`` (>= 0 by
+    construction of the block assembler); rows are padded so the full
+    fixed-length window is always in bounds — no clamping can shift data."""
+    obs_len = spec.seq_window + spec.frame_stack - 1
+
+    def one(b, t0):
+        obs = jax.lax.dynamic_slice(
+            state.obs[b], (t0, 0, 0),
+            (obs_len, spec.frame_height, spec.frame_width))
+        la = jax.lax.dynamic_slice(state.last_action[b], (t0,), (spec.seq_window,))
+        return obs, la
+
+    return jax.vmap(one)(block_idx, window_start)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def replay_sample(spec: ReplaySpec, state: ReplayState, key: jax.Array) -> SampleBatch:
+    """Stratified prioritized sample of ``spec.batch_size`` sequences."""
+    idxes, is_weights = tree_sample(
+        spec.tree_layers, state.tree, spec.is_exponent, spec.batch_size, key)
+    block_idx = idxes // spec.seqs_per_block
+    seq_idx = idxes % spec.seqs_per_block
+
+    burn_in = state.burn_in_steps[block_idx, seq_idx]
+    learning = state.learning_steps[block_idx, seq_idx]
+    forward = state.forward_steps[block_idx, seq_idx]
+    seq_start = state.seq_start[block_idx, seq_idx]
+    obs, last_action = _gather_windows(spec, state, block_idx, seq_start - burn_in)
+
+    return SampleBatch(
+        obs=obs,
+        last_action=last_action,
+        hidden=state.hidden[block_idx, seq_idx],
+        action=state.action[block_idx, seq_idx],
+        reward=state.reward[block_idx, seq_idx],
+        gamma=state.gamma[block_idx, seq_idx],
+        burn_in_steps=burn_in,
+        learning_steps=learning,
+        forward_steps=forward,
+        is_weights=is_weights,
+        idxes=idxes,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def replay_update_priorities(spec: ReplaySpec, state: ReplayState,
+                             idxes: jnp.ndarray, td_errors: jnp.ndarray
+                             ) -> ReplayState:
+    """Standalone priority write-back (host-driven pipelines). The fused
+    learner step calls tree_update directly instead."""
+    tree = tree_update(spec.tree_layers, state.tree, spec.prio_exponent,
+                       td_errors, idxes)
+    return state.replace(tree=tree)
+
+
+def replay_size(state: ReplayState) -> jnp.ndarray:
+    """Total stored learning steps (ref worker.py:81-82 __len__)."""
+    return jnp.sum(state.learning_steps)

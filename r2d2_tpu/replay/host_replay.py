@@ -1,0 +1,151 @@
+"""Host (CPU) replay twin — numpy block-ring with the same Block/SampleBatch
+contract as the device path.
+
+Serves two roles: (a) the ``placement="host"`` configuration for machines
+where HBM is scarce (the reference's CPU buffer process,
+/root/reference/worker.py:29-234, minus Ray); (b) the test oracle the jitted
+device path is checked against. Uses the native C++ sum tree when built
+(r2d2_tpu/native), else the numpy twin.
+
+Unlike the device path, sampling here can race with the learner's async
+priority write-back, so the reference's ring-pointer staleness guard is kept
+(/root/reference/worker.py:196-206).
+"""
+
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from r2d2_tpu.ops.sum_tree import tree_init_np, tree_sample_np, tree_update_np
+from r2d2_tpu.replay.structs import Block, ReplaySpec, SampleBatch
+
+
+class HostReplay:
+    def __init__(self, spec: ReplaySpec, seed: int = 0, use_native: bool = True):
+        self.spec = spec
+        self.rng = np.random.default_rng(seed)
+        self.lock = threading.Lock()
+
+        self._native = None
+        if use_native:
+            try:
+                from r2d2_tpu.native import NativeSumTree
+                self._native = NativeSumTree(spec.num_sequences)
+            except Exception:
+                self._native = None
+        if self._native is None:
+            self.tree_layers, self.tree = tree_init_np(spec.num_sequences)
+
+        n, s, l = spec.num_blocks, spec.seqs_per_block, spec.learning
+        self.obs = np.zeros((n, spec.obs_row_len, spec.frame_height, spec.frame_width), np.uint8)
+        self.last_action = np.full((n, spec.la_row_len), -1, np.int32)
+        self.hidden = np.zeros((n, s, 2, spec.hidden_dim), np.float32)
+        self.action = np.zeros((n, s, l), np.int32)
+        self.reward = np.zeros((n, s, l), np.float32)
+        self.gamma = np.zeros((n, s, l), np.float32)
+        self.burn_in_steps = np.zeros((n, s), np.int32)
+        self.learning_steps = np.zeros((n, s), np.int32)
+        self.forward_steps = np.zeros((n, s), np.int32)
+        self.seq_start = np.zeros((n, s), np.int32)
+        self.block_ptr = 0
+
+    # -- sum-tree indirection (native C++ or numpy) --
+
+    def _tree_update(self, td_errors: np.ndarray, idxes: np.ndarray) -> None:
+        if self._native is not None:
+            self._native.update(self.spec.prio_exponent, td_errors, idxes)
+        else:
+            tree_update_np(self.tree_layers, self.tree, self.spec.prio_exponent,
+                           td_errors, idxes)
+
+    def _tree_sample(self, batch: int) -> Tuple[np.ndarray, np.ndarray]:
+        if self._native is not None:
+            return self._native.sample(self.spec.is_exponent, batch, self.rng)
+        return tree_sample_np(self.tree_layers, self.tree, self.spec.is_exponent,
+                              batch, self.rng)
+
+    # -- replay API --
+
+    def add(self, block: Block) -> None:
+        spec = self.spec
+        with self.lock:
+            ptr = self.block_ptr
+            self.block_ptr = (ptr + 1) % spec.num_blocks
+            idxes = ptr * spec.seqs_per_block + np.arange(spec.seqs_per_block, dtype=np.int64)
+            self._tree_update(np.asarray(block.priority, np.float64), idxes)
+            self.obs[ptr] = block.obs_row
+            self.last_action[ptr] = block.last_action_row
+            self.hidden[ptr] = block.hidden
+            self.action[ptr] = block.action
+            self.reward[ptr] = block.reward
+            self.gamma[ptr] = block.gamma
+            self.burn_in_steps[ptr] = block.burn_in_steps
+            self.learning_steps[ptr] = block.learning_steps
+            self.forward_steps[ptr] = block.forward_steps
+            self.seq_start[ptr] = block.seq_start
+
+    def sample(self, batch_size: Optional[int] = None) -> Tuple[SampleBatch, int]:
+        """Returns (batch, ring_ptr_snapshot) — the snapshot feeds the
+        staleness guard in update_priorities."""
+        spec = self.spec
+        batch = batch_size or spec.batch_size
+        with self.lock:
+            idxes, is_weights = self._tree_sample(batch)
+            idxes = idxes.astype(np.int64)
+            b = idxes // spec.seqs_per_block
+            s = idxes % spec.seqs_per_block
+
+            burn_in = self.burn_in_steps[b, s]
+            learning = self.learning_steps[b, s]
+            forward = self.forward_steps[b, s]
+            start = self.seq_start[b, s] - burn_in
+
+            obs_len = spec.seq_window + spec.frame_stack - 1
+            obs = np.zeros((batch, obs_len, spec.frame_height, spec.frame_width), np.uint8)
+            la = np.zeros((batch, spec.seq_window), np.int32)
+            for i in range(batch):
+                t0 = start[i]
+                obs[i] = self.obs[b[i], t0 : t0 + obs_len]
+                la[i] = self.last_action[b[i], t0 : t0 + spec.seq_window]
+
+            return (
+                SampleBatch(
+                    obs=obs,
+                    last_action=la,
+                    hidden=self.hidden[b, s],
+                    action=self.action[b, s],
+                    reward=self.reward[b, s],
+                    gamma=self.gamma[b, s],
+                    burn_in_steps=burn_in,
+                    learning_steps=learning,
+                    forward_steps=forward,
+                    is_weights=is_weights.astype(np.float32),
+                    idxes=idxes.astype(np.int32),
+                ),
+                self.block_ptr,
+            )
+
+    def update_priorities(self, idxes: np.ndarray, td_errors: np.ndarray,
+                          old_ptr: int) -> None:
+        """Drop updates for ring slots overwritten since the sample was taken
+        (ref worker.py:196-206), then write back."""
+        spec = self.spec
+        idxes = np.asarray(idxes, np.int64)
+        td_errors = np.asarray(td_errors, np.float64)
+        with self.lock:
+            if self.block_ptr > old_ptr:
+                mask = (idxes < old_ptr * spec.seqs_per_block) | (
+                    idxes >= self.block_ptr * spec.seqs_per_block)
+            elif self.block_ptr < old_ptr:
+                mask = (idxes < old_ptr * spec.seqs_per_block) & (
+                    idxes >= self.block_ptr * spec.seqs_per_block)
+            else:
+                mask = np.ones_like(idxes, bool)
+            if not mask.all():
+                idxes, td_errors = idxes[mask], td_errors[mask]
+            if idxes.size:
+                self._tree_update(td_errors, idxes)
+
+    def __len__(self) -> int:
+        return int(self.learning_steps.sum())

@@ -1,0 +1,171 @@
+"""Replay data layout: fixed-shape block records and buffer state.
+
+The reference stores ragged per-block numpy arrays in Python lists
+(/root/reference/worker.py:69-78) and slices them with per-sample Python
+loops (/root/reference/worker.py:140-166). XLA needs static shapes, so here a
+block is a *fixed-shape record* — ragged reality is carried by per-sequence
+metadata (burn_in/learning/forward/seq_start) and masks, and the unused tail
+of a short block is zero padding that sampling can never select (its tree
+leaves get priority 0).
+
+Timeline convention for one block (matches the reference's indexing at
+/root/reference/worker.py:143-149): position t in [0, burn_in0 + size) covers
+the carried burn-in prefix then the block's new steps. ``obs_row[t + j]``
+(j < frame_stack) is the stacked observation fed to the model at step t, with
+``frame_stack - 1`` duplicate leading frames at episode start;
+``last_action_row[t]`` is the action index taken at step t-1 (-1 = none, which
+one-hot-encodes to the reference's zero vector, /root/reference/worker.py:416).
+Sequence s starts at timeline ``seq_start[s] = burn_in0 + sum(learning[:s])``
+and its sampled window begins at ``seq_start[s] - burn_in[s]``.
+"""
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.ops.sum_tree import tree_num_layers
+
+
+@dataclass(frozen=True)
+class ReplaySpec:
+    """Static shape/dtype contract shared by device and host replay, the
+    actor-side block assembler, and the learner. Hashable → usable as a jit
+    static argument."""
+
+    num_blocks: int
+    seqs_per_block: int     # S: sequence slots per block
+    block_length: int       # steps per block
+    burn_in: int            # max burn-in steps
+    learning: int           # max learning steps per sequence (L)
+    forward: int            # max n-step horizon (F)
+    frame_stack: int
+    frame_height: int
+    frame_width: int
+    hidden_dim: int
+    batch_size: int
+    prio_exponent: float
+    is_exponent: float
+
+    @classmethod
+    def from_config(cls, cfg: Config) -> "ReplaySpec":
+        return cls(
+            num_blocks=cfg.num_blocks,
+            seqs_per_block=cfg.seqs_per_block,
+            block_length=cfg.replay.block_length,
+            burn_in=cfg.sequence.burn_in_steps,
+            learning=cfg.sequence.learning_steps,
+            forward=cfg.sequence.forward_steps,
+            frame_stack=cfg.env.frame_stack,
+            frame_height=cfg.env.frame_height,
+            frame_width=cfg.env.frame_width,
+            hidden_dim=cfg.network.hidden_dim,
+            batch_size=cfg.replay.batch_size,
+            prio_exponent=cfg.replay.prio_exponent,
+            is_exponent=cfg.replay.importance_sampling_exponent,
+        )
+
+    @property
+    def seq_window(self) -> int:
+        """Unrolled steps per sampled sequence (ref config.py:51 seq_len)."""
+        return self.burn_in + self.learning + self.forward
+
+    @property
+    def obs_row_len(self) -> int:
+        """Frames stored per block row. Covers the last sequence's full
+        (padded) window: worst-case window start is burn_in0 + block_length -
+        learning - burn_in, so the row must extend forward past the last
+        learning step by the full ``forward`` horizon plus stacking margin."""
+        return self.burn_in + self.block_length + self.forward + self.frame_stack - 1
+
+    @property
+    def la_row_len(self) -> int:
+        return self.burn_in + self.block_length + self.forward
+
+    @property
+    def num_sequences(self) -> int:
+        return self.num_blocks * self.seqs_per_block
+
+    @property
+    def tree_layers(self) -> int:
+        return tree_num_layers(self.num_sequences)
+
+
+class Block(struct.PyTreeNode):
+    """One actor-produced block, fixed shape (device-ingestable as-is).
+
+    The reference's 12-tuple (/root/reference/worker.py:86-91,492) with the
+    ragged fields padded; ``sum_reward`` is NaN when no finished episode
+    should be reported (reference uses None, /root/reference/worker.py:554-556).
+    """
+
+    obs_row: jnp.ndarray       # (obs_row_len, H, W) uint8
+    last_action_row: jnp.ndarray  # (la_row_len,) int32, -1 = null
+    hidden: jnp.ndarray        # (S, 2, hidden_dim) f32
+    action: jnp.ndarray        # (S, L) int32
+    reward: jnp.ndarray        # (S, L) f32 — n-step discounted returns
+    gamma: jnp.ndarray         # (S, L) f32 — effective discount on bootstrap
+    priority: jnp.ndarray      # (S,) f32 — initial |mixed TD|, 0 for empty slots
+    burn_in_steps: jnp.ndarray  # (S,) int32
+    learning_steps: jnp.ndarray  # (S,) int32 — 0 for empty slots
+    forward_steps: jnp.ndarray  # (S,) int32
+    seq_start: jnp.ndarray     # (S,) int32 — timeline offset of first learning step
+    num_sequences: jnp.ndarray  # () int32
+    sum_reward: jnp.ndarray    # () f32, NaN = do not report
+
+
+class ReplayState(struct.PyTreeNode):
+    """Device-resident buffer state. Donated through jitted add/train steps so
+    XLA updates it in place (no copy of the multi-GB obs ring)."""
+
+    tree: jnp.ndarray          # (2**tree_layers - 1,) f32 priority sum tree
+    obs: jnp.ndarray           # (N, obs_row_len, H, W) uint8
+    last_action: jnp.ndarray   # (N, la_row_len) int32
+    hidden: jnp.ndarray        # (N, S, 2, hidden_dim) f32
+    action: jnp.ndarray        # (N, S, L) int32
+    reward: jnp.ndarray        # (N, S, L) f32
+    gamma: jnp.ndarray         # (N, S, L) f32
+    burn_in_steps: jnp.ndarray  # (N, S) int32
+    learning_steps: jnp.ndarray  # (N, S) int32
+    forward_steps: jnp.ndarray  # (N, S) int32
+    seq_start: jnp.ndarray     # (N, S) int32
+    block_ptr: jnp.ndarray     # () int32 ring pointer
+
+
+class SampleBatch(struct.PyTreeNode):
+    """One training batch of sequences, still in storage dtypes (uint8 obs,
+    index actions) — decode/normalize happens inside the train step where XLA
+    fuses it into the conv (ref does /255 on GPU too, worker.py:330-331)."""
+
+    obs: jnp.ndarray           # (B, seq_window + stack - 1, H, W) uint8
+    last_action: jnp.ndarray   # (B, seq_window) int32
+    hidden: jnp.ndarray        # (B, 2, hidden_dim) f32
+    action: jnp.ndarray        # (B, L) int32
+    reward: jnp.ndarray        # (B, L) f32
+    gamma: jnp.ndarray         # (B, L) f32
+    burn_in_steps: jnp.ndarray  # (B,) int32
+    learning_steps: jnp.ndarray  # (B,) int32
+    forward_steps: jnp.ndarray  # (B,) int32
+    is_weights: jnp.ndarray    # (B,) f32
+    idxes: jnp.ndarray         # (B,) int32 — tree leaf indices for write-back
+
+
+def empty_block_np(spec: ReplaySpec) -> dict:
+    """Zeroed numpy block record (host-side assembly scratch)."""
+    return dict(
+        obs_row=np.zeros((spec.obs_row_len, spec.frame_height, spec.frame_width), np.uint8),
+        last_action_row=np.full((spec.la_row_len,), -1, np.int32),
+        hidden=np.zeros((spec.seqs_per_block, 2, spec.hidden_dim), np.float32),
+        action=np.zeros((spec.seqs_per_block, spec.learning), np.int32),
+        reward=np.zeros((spec.seqs_per_block, spec.learning), np.float32),
+        gamma=np.zeros((spec.seqs_per_block, spec.learning), np.float32),
+        priority=np.zeros((spec.seqs_per_block,), np.float32),
+        burn_in_steps=np.zeros((spec.seqs_per_block,), np.int32),
+        learning_steps=np.zeros((spec.seqs_per_block,), np.int32),
+        forward_steps=np.zeros((spec.seqs_per_block,), np.int32),
+        seq_start=np.zeros((spec.seqs_per_block,), np.int32),
+        num_sequences=np.zeros((), np.int32),
+        sum_reward=np.full((), np.nan, np.float32),
+    )
