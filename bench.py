@@ -1,0 +1,109 @@
+"""Benchmark: learner sequence-updates/sec/chip (BASELINE.md north star).
+
+Measures the fused R2D2 learner step — prioritized sample from HBM replay +
+full 55-step conv/LSTM unroll + value-rescaled double/dueling loss + Adam +
+priority write-back, one XLA program — at the reference's training
+configuration (batch 128 sequences, burn-in 40 / learning 10 / n-step 5,
+84x84x4 frames, cnn_out 1024, LSTM 512, dueling on, double off, f32;
+/root/reference/config.py).
+
+vs_baseline: the reference publishes NO numbers (BASELINE.json "published":
+{}). Its learner logs 'training speed' in updates/s (worker.py:229); upstream
+runs of this codebase on a desktop GPU train at ~5 updates/s = 640
+sequence-updates/s (128-sequence batches). That figure is the documented
+baseline estimate used here until a measured reference log is available.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_SEQ_UPDATES_PER_SEC = 640.0  # ~5 train steps/s * batch 128 (see above)
+
+
+def make_synthetic_block(spec, rng):
+    from r2d2_tpu.replay.structs import Block
+    S, L = spec.seqs_per_block, spec.learning
+    burn = np.minimum(np.arange(S) * L, spec.burn_in).astype(np.int32)
+    return Block(
+        obs_row=rng.integers(0, 255, (spec.obs_row_len, spec.frame_height,
+                                      spec.frame_width)).astype(np.uint8),
+        last_action_row=rng.integers(0, 18, (spec.la_row_len,)).astype(np.int32),
+        hidden=rng.normal(size=(S, 2, spec.hidden_dim)).astype(np.float32),
+        action=rng.integers(0, 18, (S, L)).astype(np.int32),
+        reward=rng.normal(size=(S, L)).astype(np.float32),
+        gamma=np.full((S, L), 0.997**spec.forward, np.float32),
+        priority=rng.uniform(0.1, 2.0, (S,)).astype(np.float32),
+        burn_in_steps=burn,
+        learning_steps=np.full((S,), L, np.int32),
+        forward_steps=np.concatenate(
+            [np.full((S - 1,), spec.forward), [1]]).astype(np.int32),
+        seq_start=(burn[0] + L * np.arange(S)).astype(np.int32),
+        num_sequences=np.asarray(S, np.int32),
+        sum_reward=np.asarray(np.nan, np.float32),
+    )
+
+
+def main() -> None:
+    import jax
+
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.learner import create_train_state, make_learner_step
+    from r2d2_tpu.models import init_network
+    from r2d2_tpu.replay import ReplaySpec, replay_add, replay_init
+
+    # reference-default training config; replay capacity trimmed to bound
+    # bench setup time (25.6k steps of ring is plenty to sample 128 from)
+    cfg = Config().replace(**{"replay.capacity": 25_600})
+    spec = ReplaySpec.from_config(cfg)
+    action_dim = 18  # full Atari action set
+
+    net, _ = init_network(jax.random.PRNGKey(0), action_dim, cfg.network)
+    ts = create_train_state(jax.random.PRNGKey(1), net, cfg.optim)
+    rs = replay_init(spec)
+
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    for _ in range(spec.num_blocks):
+        rs = replay_add(spec, rs, make_synthetic_block(spec, rng))
+    jax.block_until_ready(rs.tree)
+    print(f"filled {spec.num_blocks} blocks in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+
+    step = make_learner_step(net, spec, cfg.optim, cfg.network.use_double)
+
+    t0 = time.time()
+    ts, rs, m = step(ts, rs)
+    jax.block_until_ready(m["loss"])
+    print(f"compile + first step: {time.time()-t0:.1f}s "
+          f"loss={float(m['loss']):.5f}", file=sys.stderr)
+
+    for _ in range(3):  # warmup
+        ts, rs, m = step(ts, rs)
+    jax.block_until_ready(m["loss"])
+
+    n_timed = 30
+    t0 = time.time()
+    for _ in range(n_timed):
+        ts, rs, m = step(ts, rs)
+    jax.block_until_ready(m["loss"])
+    dt = time.time() - t0
+
+    steps_per_sec = n_timed / dt
+    seq_updates = steps_per_sec * spec.batch_size
+    print(f"{steps_per_sec:.2f} train steps/s; loss={float(m['loss']):.5f}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "learner_sequence_updates_per_sec_per_chip",
+        "value": round(seq_updates, 1),
+        "unit": "sequences/s",
+        "vs_baseline": round(seq_updates / REFERENCE_SEQ_UPDATES_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -8,10 +8,13 @@ the CPU (cheap, once per 400 steps):
   * per-step effective discount whose tail encodes termination (0) or
     bootstrap-window shortening (gamma^m) so no ``done`` flag is stored
     (ref worker.py:445-456);
-  * LSTM hidden snapshots every ``learning_steps`` (stored-state strategy,
-    ref worker.py:459) — list index s*learning is exactly the state at
-    sequence s's *window start* (burn-in included) because the kept tail
-    after a previous block is the burn-in prefix;
+  * LSTM hidden snapshots at each sequence's *window start*
+    ``seq_start[s] - burn_in[s]`` (stored-state strategy, ref worker.py:459).
+    Deliberate divergence: the reference snapshots at ``s*learning``
+    unconditionally, which in the FIRST block of an episode (carried burn-in
+    < max) hands the learner a state that has already consumed the burn-in
+    steps it is about to replay — steps processed twice. Indexing by window
+    start is identical in steady state and correct at episode starts;
   * initial priorities from the actor's own (slightly stale) Q-values
     (ref worker.py:475-480);
   * carry-over of the last burn_in(+stack) frames/actions/hiddens so the next
@@ -28,7 +31,7 @@ import numpy as np
 
 from r2d2_tpu.ops.priority import mixed_td_errors_ragged
 from r2d2_tpu.ops.returns import initial_priorities, n_step_gamma, n_step_return
-from r2d2_tpu.replay.structs import Block, ReplaySpec
+from r2d2_tpu.replay.structs import Block, ReplaySpec, empty_block_np
 
 
 class LocalBuffer:
@@ -82,7 +85,6 @@ class LocalBuffer:
         assert len(self.last_actions) == self.curr_burn_in + size + 1
 
         num_seq = math.ceil(size / spec.learning)
-        max_fwd = min(size, spec.forward)
 
         gammas = n_step_gamma(size, self.gamma, spec.forward, last_qval is not None)
         qvals = list(self.qvals)
@@ -111,30 +113,18 @@ class LocalBuffer:
         prios = mixed_td_errors_ragged(td, learning, self.eta)
 
         # ---- fixed-shape assembly ----
-        S, L = spec.seqs_per_block, spec.learning
-        blk = Block(
-            obs_row=np.zeros((spec.obs_row_len, spec.frame_height, spec.frame_width), np.uint8),
-            last_action_row=np.full((spec.la_row_len,), -1, np.int32),
-            hidden=np.zeros((S, 2, spec.hidden_dim), np.float32),
-            action=np.zeros((S, L), np.int32),
-            reward=np.zeros((S, L), np.float32),
-            gamma=np.zeros((S, L), np.float32),
-            priority=np.zeros((S,), np.float32),
-            burn_in_steps=np.zeros((S,), np.int32),
-            learning_steps=np.zeros((S,), np.int32),
-            forward_steps=np.zeros((S,), np.int32),
-            seq_start=np.zeros((S,), np.int32),
-            num_sequences=np.asarray(num_seq, np.int32),
-            sum_reward=np.asarray(
-                self.sum_reward if self.done else np.nan, np.float32),
-        )
+        blk = Block(**empty_block_np(spec))
+        blk.num_sequences.fill(num_seq)
+        blk.sum_reward.fill(self.sum_reward if self.done else np.nan)
         frames = np.stack(self.obs_frames)               # (stack+burn0+size, H, W)
         blk.obs_row[: frames.shape[0]] = frames
         la = np.asarray(self.last_actions, np.int32)     # (burn0+size+1,)
         blk.last_action_row[: la.shape[0]] = la
-        hidden_snap = np.stack(self.hiddens[0 : size : spec.learning])
-        assert hidden_snap.shape[0] == num_seq
-        blk.hidden[:num_seq] = hidden_snap
+        # hidden at each sequence's window start (see module docstring)
+        window_starts = [self.curr_burn_in + s * spec.learning - int(burn_in[s])
+                         for s in range(num_seq)]
+        blk.hidden[:num_seq] = np.stack(
+            [self.hiddens[w] for w in window_starts])
         for s in range(num_seq):
             l = int(learning[s])
             lo = s * spec.learning

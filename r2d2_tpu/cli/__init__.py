@@ -1,0 +1,2 @@
+"""Command-line entry points (the reference's train.py / test.py / plot.py
+scripts, SURVEY §1 L6), all configured by ``--section.field=value`` overrides."""

@@ -1,0 +1,122 @@
+"""Evaluation / replay CLI (ref /root/reference/test.py).
+
+Two paths, matching the reference:
+
+  * sweep (default): iterate saved checkpoints ``{game}{k}_player{p}``,
+    evaluate ``--rounds`` greedy episodes each (ε = runtime.test_epsilon,
+    ref test.py:79, config.py:61), print a table and plot reward vs training
+    steps and vs environment steps (ref test.py:18-62 — which is broken in
+    the reference: it passes a nonexistent ``noop_start`` parameter).
+  * --play CKPT: load specific checkpoint(s) and run visible rollouts; for
+    multiplayer pass one checkpoint per player and the first hosts the game
+    (ref test.py:91-144).
+
+    python -m r2d2_tpu.cli.evaluate --env.game_name=Fake --rounds 5
+    python -m r2d2_tpu.cli.evaluate --play models/Fake3_player0 --rounds 3
+"""
+
+import argparse
+import sys
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def rollout_episode(env, policy, max_steps: int = 100_000) -> float:
+    """One greedy-ish episode (the reference's test_one_case, test.py:64-89)."""
+    obs = env.reset()
+    policy.observe_reset(obs)
+    total = 0.0
+    for _ in range(max_steps):
+        action, _, _ = policy.act()
+        obs, reward, done, _ = env.step(action)
+        policy.observe(obs, action)
+        total += float(reward)
+        if done:
+            break
+    return total
+
+
+def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
+                        testing: bool = False, is_host: bool = False,
+                        port: int = 5060, seed: int = 0
+                        ) -> Tuple[float, int, int]:
+    """Returns (mean_return, training_steps, env_steps)."""
+    import jax
+
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.checkpoint import restore_checkpoint
+
+    env = create_env(cfg.env, clip_rewards=False, testing=testing,
+                     is_host=is_host, port=port, seed=seed)
+    net = NetworkApply(env.action_space.n, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    template = net.init(jax.random.PRNGKey(0))
+    restored = restore_checkpoint(ckpt_path)
+    params = jax.tree_util.tree_map(
+        lambda t, p: np.asarray(p, np.asarray(t).dtype),
+        template, restored["params"])
+    policy = ActorPolicy(net, params, cfg.runtime.test_epsilon, seed=seed)
+    returns = [rollout_episode(env, policy) for _ in range(rounds)]
+    env.close()
+    return (float(np.mean(returns)), int(restored.get("step", 0)),
+            int(restored.get("env_steps", 0)))
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--play", nargs="*", default=None,
+                   help="checkpoint path(s) to replay (one per player)")
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--player", type=int, default=0)
+    p.add_argument("--out", default="eval_curve.png")
+    args, config_overrides = p.parse_known_args(argv)
+
+    from r2d2_tpu.config import Config, parse_overrides
+    cfg = parse_overrides(Config(), config_overrides)
+
+    if args.play is not None:
+        # replay path: first checkpoint hosts in multiplayer (ref test.py:129-141)
+        for i, ckpt in enumerate(args.play):
+            mean_ret, step, env_steps = evaluate_checkpoint(
+                cfg, ckpt, args.rounds, testing=True, is_host=(i == 0),
+                port=cfg.multiplayer.base_port, seed=i)
+            print(f"{ckpt}: mean return {mean_ret:.2f} over {args.rounds} "
+                  f"rounds (step {step}, env steps {env_steps})")
+        return
+
+    # checkpoint sweep (ref test.py:18-62)
+    from r2d2_tpu.runtime.checkpoint import list_checkpoints
+    ckpts = list_checkpoints(cfg.runtime.save_dir, cfg.env.game_name, args.player)
+    if not ckpts:
+        raise SystemExit(
+            f"no checkpoints for game={cfg.env.game_name!r} "
+            f"player={args.player} under {cfg.runtime.save_dir!r}")
+    rows = []
+    for idx, path in ckpts:
+        mean_ret, step, env_steps = evaluate_checkpoint(cfg, path, args.rounds,
+                                                        seed=idx)
+        rows.append((idx, step, env_steps, mean_ret))
+        print(f"checkpoint {idx}: step={step} env_steps={env_steps} "
+              f"mean_return={mean_ret:.2f}", flush=True)
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    rows_np = np.asarray(rows, float)
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(12, 4))
+    ax1.plot(rows_np[:, 1], rows_np[:, 3], "o-")
+    ax1.set_xlabel("training steps")
+    ax1.set_ylabel("average reward")
+    ax2.plot(rows_np[:, 2], rows_np[:, 3], "o-")
+    ax2.set_xlabel("environment steps")
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Genetic hyperparameter search CLI (the reference's genetic-branch
+capability, README.md:28-32).
+
+Fitness = mean episode return over the final log intervals of a short
+training slice on the configured env (default Fake, hermetic).
+
+    python -m r2d2_tpu.cli.genetic --population 6 --generations 3 \
+        --slice-steps 200 --env.game_name=Fake
+"""
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def make_slice_eval(base_overrides, slice_steps: int, slice_seconds: float):
+    from r2d2_tpu.runtime.orchestrator import train
+
+    def eval_fn(cfg) -> float:
+        records = []
+        try:
+            stacks = train(cfg, max_training_steps=slice_steps,
+                           max_seconds=slice_seconds, actor_mode="thread",
+                           log_fn=records.append)
+        except Exception as e:  # invalid genome (e.g. OOM-scale) scores -inf
+            print(f"genome failed: {e}", file=sys.stderr)
+            return float("-inf")
+        returns = [r["avg_episode_return"] for r in records
+                   if r.get("avg_episode_return") is not None]
+        m = stacks[0].metrics
+        if m.num_episodes:
+            returns.append(m.episode_reward / m.num_episodes)
+        return float(np.mean(returns[-3:])) if returns else float("-inf")
+
+    return eval_fn
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--population", type=int, default=6)
+    p.add_argument("--generations", type=int, default=3)
+    p.add_argument("--slice-steps", type=int, default=300)
+    p.add_argument("--slice-seconds", type=float, default=600.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="genetic_results.json")
+    args, config_overrides = p.parse_known_args(argv)
+
+    from r2d2_tpu.config import Config, parse_overrides
+    from r2d2_tpu.tools.genetic import run_search
+
+    base = parse_overrides(Config(), config_overrides)
+    eval_fn = make_slice_eval(config_overrides, args.slice_steps,
+                              args.slice_seconds)
+
+    def log(gen, result):
+        genome, fit = result.best
+        print(f"generation {gen}: best fitness {fit:.3f} genome {genome}",
+              flush=True)
+
+    history = run_search(eval_fn, base=base, population=args.population,
+                         generations=args.generations, seed=args.seed, log_fn=log)
+    best_genome, best_fit = history[-1].best
+    with open(args.out, "w") as f:
+        json.dump({"best_genome": best_genome, "best_fitness": best_fit,
+                   "generations": [
+                       {"genomes": h.genomes, "fitnesses": h.fitnesses}
+                       for h in history]}, f, indent=2, default=str)
+    print(f"best fitness {best_fit:.3f}; wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

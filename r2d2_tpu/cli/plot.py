@@ -1,0 +1,94 @@
+"""Training-curve plotting CLI (ref /root/reference/plot.py).
+
+    python -m r2d2_tpu.cli.plot --file_path . --show_all --max_time 120 \
+        --loss_interpolation
+
+Reads ``train_player{i}.log`` files (reference-compatible key strings),
+converts log-interval counts to minutes (interval * 20s / 60, matching
+plot.py:42-46), spline-interpolates the reward curve and optionally the loss,
+and renders a per-player reward(/loss) grid to ``training_curves.png``.
+"""
+
+import argparse
+import glob
+import os
+import re
+
+import numpy as np
+
+from r2d2_tpu.tools.logparse import parse_log
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--file_path", default=".",
+                   help="directory containing train_player*.log")
+    p.add_argument("--show_all", action="store_true",
+                   help="also plot loss on a twin axis")
+    p.add_argument("--max_time", type=float, default=None,
+                   help="clip the x axis to this many minutes")
+    p.add_argument("--loss_interpolation", action="store_true",
+                   help="spline-interpolate the loss curve")
+    p.add_argument("--log_interval", type=float, default=20.0,
+                   help="seconds per log interval (ref config.py:40)")
+    p.add_argument("--out", default="training_curves.png")
+    p.add_argument("--show", action="store_true")
+    args = p.parse_args(argv)
+
+    import matplotlib
+    if not args.show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from scipy.interpolate import make_interp_spline
+
+    paths = sorted(glob.glob(os.path.join(args.file_path, "train_player*.log")))
+    if not paths:
+        raise SystemExit(f"no train_player*.log under {args.file_path!r}")
+
+    fig, axes = plt.subplots(len(paths), 1, squeeze=False,
+                             figsize=(10, 4 * len(paths)))
+    for ax, path in zip(axes[:, 0], paths):
+        player = re.search(r"train_player(\d+)\.log", path).group(1)
+        log = parse_log(path)
+        minutes = np.asarray(log.return_counts, float) * args.log_interval / 60.0
+        rewards = np.asarray(log.returns, float)
+        if args.max_time is not None:
+            keep = minutes <= args.max_time
+            minutes, rewards = minutes[keep], rewards[keep]
+        if len(minutes) >= 4:
+            xs = np.linspace(minutes.min(), minutes.max(), 300)
+            ys = make_interp_spline(minutes, rewards, k=3)(xs)
+            ax.plot(xs, ys, label="avg episode return")
+            ax.plot(minutes, rewards, ".", alpha=0.4)
+        else:
+            ax.plot(minutes, rewards, ".-", label="avg episode return")
+        ax.set_xlabel("training time (minutes)")
+        ax.set_ylabel("average episode return")
+        ax.set_title(f"player {player}")
+        ax.legend(loc="upper left")
+
+        if args.show_all and log.losses:
+            lmin = np.asarray(log.loss_counts, float) * args.log_interval / 60.0
+            losses = np.asarray(log.losses, float)
+            if args.max_time is not None:
+                keep = lmin <= args.max_time
+                lmin, losses = lmin[keep], losses[keep]
+            ax2 = ax.twinx()
+            if args.loss_interpolation and len(lmin) >= 4:
+                xs = np.linspace(lmin.min(), lmin.max(), 300)
+                ys = make_interp_spline(lmin, losses, k=3)(xs)
+                ax2.plot(xs, ys, color="tab:red", alpha=0.7, label="loss")
+            else:
+                ax2.plot(lmin, losses, color="tab:red", alpha=0.7, label="loss")
+            ax2.set_ylabel("loss")
+            ax2.legend(loc="upper right")
+
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(f"wrote {args.out}")
+    if args.show:
+        plt.show()
+
+
+if __name__ == "__main__":
+    main()

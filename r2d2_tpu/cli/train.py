@@ -1,0 +1,43 @@
+"""Training CLI (ref /root/reference/train.py).
+
+    python -m r2d2_tpu.cli.train --env.game_name=Fake --actor.num_actors=2
+    python -m r2d2_tpu.cli.train --env.game_name=ALE/Boxing --env.env_type=-v5
+    python -m r2d2_tpu.cli.train --multiplayer.enabled=true  # self-play stacks
+
+Extra (non-config) flags:
+    --actor-mode=thread|process   actor execution mode (default process)
+    --max-steps=N                 stop after N learner steps
+    --max-seconds=S               wall-clock bound
+"""
+
+import sys
+
+from r2d2_tpu.config import Config, parse_overrides
+from r2d2_tpu.runtime.orchestrator import train
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    actor_mode, max_steps, max_seconds = "process", None, None
+    rest = []
+    for arg in argv:
+        if arg.startswith("--actor-mode="):
+            actor_mode = arg.split("=", 1)[1]
+        elif arg.startswith("--max-steps="):
+            max_steps = int(arg.split("=", 1)[1])
+        elif arg.startswith("--max-seconds="):
+            max_seconds = float(arg.split("=", 1)[1])
+        else:
+            rest.append(arg)
+    cfg = parse_overrides(Config(), rest)
+
+    def log(record: dict) -> None:
+        print(" | ".join(f"{k}={v}" for k, v in record.items() if v is not None),
+              flush=True)
+
+    train(cfg, max_training_steps=max_steps, max_seconds=max_seconds,
+          actor_mode=actor_mode, log_fn=log)
+
+
+if __name__ == "__main__":
+    main()

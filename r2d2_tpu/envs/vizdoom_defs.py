@@ -1,0 +1,126 @@
+"""ViZDoom pure logic — engine-free, hermetically testable.
+
+Everything the reference's gym wrapper computes *around* the C++ engine
+(/root/reference/vizdoom_gym_wrapper/base_gym_env.py) factored into pure
+functions: scenario registry, DELTA-button expansion, discrete→engine action
+vectors, multiplayer game-argument strings, and the shaped multiplayer reward
+from game-variable deltas. The engine binding in vizdoom_env.py is a thin
+shell over these.
+"""
+
+from typing import Dict, List, Sequence, Tuple
+
+from r2d2_tpu.config import EnvConfig
+
+# Scenario registry: 14 env ids → bundled scenario cfg files
+# (ref vizdoom_gym_wrapper/__init__.py:3-85).
+SCENARIOS: Dict[str, str] = {
+    "VizdoomBasic-v0": "basic.cfg",
+    "VizdoomCorridor-v0": "deadly_corridor.cfg",
+    "VizdoomDefendCenter-v0": "defend_the_center.cfg",
+    "VizdoomDefendLine-v0": "defend_the_line.cfg",
+    "VizdoomHealthGathering-v0": "health_gathering.cfg",
+    "VizdoomMyWayHome-v0": "my_way_home.cfg",
+    "VizdoomPredictPosition-v0": "predict_position.cfg",
+    "VizdoomTakeCover-v0": "take_cover.cfg",
+    "VizdoomDeathmatch-v0": "deathmatch.cfg",
+    "VizdoomHealthGatheringSupreme-v0": "health_gathering_supreme.cfg",
+    "VizdoomBasicWithAttack-v0": "basic_with_attack.cfg",
+    "VizdoomBasicWithAttackLessActions-v0": "basic_with_attack_less_actions.cfg",
+    "VizdoomBasicDeathmatch-v0": "multi.cfg",
+    "VizdoomSingleDeathmatch-v0": "multi_single.cfg",
+}
+
+# Scenarios whose reward comes from game-variable deltas even single-player
+# (ref base_gym_env.py:157-159).
+MULTI_REWARD_SCENARIOS = ("multi_single.cfg",)
+
+
+def expand_buttons(button_names: Sequence[str]) -> Tuple[List[str], int]:
+    """DELTA (continuous) buttons become two discrete actions _POS_i/_NEG_i so
+    the action space stays Discrete (ref base_gym_env.py:114-127).
+
+    Returns (expanded_names, num_delta_buttons)."""
+    expanded: List[str] = []
+    num_delta = 0
+    for name in button_names:
+        if "DELTA" in name:
+            expanded.append(f"{name}_POS_{num_delta}")
+            expanded.append(f"{name}_NEG_{num_delta}")
+            num_delta += 1
+        else:
+            expanded.append(name)
+    return expanded, num_delta
+
+
+def build_action_vector(action: int, expanded_names: Sequence[str],
+                        num_delta: int) -> List[int]:
+    """Discrete action index → engine button vector (ref base_gym_env.py:146-154).
+
+    The engine vector has one slot per *original* button; a DELTA button's
+    slot receives +1/-1 depending on which expanded action was chosen.
+
+    Note: the reference indexes ``act[action]`` for non-DELTA actions, which
+    is out of bounds whenever a non-DELTA button follows a DELTA button in
+    the config (latent because its scenarios list DELTA buttons last). Here
+    each expanded entry is mapped to its true engine slot instead."""
+    n_engine = len(expanded_names) - num_delta
+    act = [0] * n_engine
+    engine_slot = 0
+    for i, name in enumerate(expanded_names):
+        is_delta_pos = "DELTA" in name and name.rsplit("_", 2)[-2] == "POS"
+        if i == action:
+            act[engine_slot] = -1 if ("DELTA" in name and not is_delta_pos) else 1
+            break
+        # a _POS_ entry shares its engine slot with the _NEG_ that follows
+        if not is_delta_pos:
+            engine_slot += 1
+    return act
+
+
+def shaped_multiplayer_reward(old_vars: Sequence[float],
+                              new_vars: Sequence[float],
+                              cfg: EnvConfig) -> float:
+    """Reward from (health, hitcount, ammo, frags) deltas, because the ACS
+    script reward is global to the map (ref base_gym_env.py:190-214):
+    hurt -20, death -100, ammo spent -5, hit +25, frag +100 (defaults in
+    EnvConfig, overridable)."""
+    old_health, old_hits, old_ammo, old_frags = old_vars
+    new_health, new_hits, new_ammo, new_frags = new_vars
+    reward = 0.0
+    if old_health > new_health and new_health != 0:
+        reward += cfg.reward_hurt
+    elif old_health > new_health and new_health == 0:
+        reward += cfg.reward_death
+    if old_ammo > new_ammo:
+        reward += cfg.reward_ammo
+    if old_hits < new_hits:
+        reward += cfg.reward_hit
+    if old_frags < new_frags:
+        reward += cfg.reward_frag
+    return reward
+
+
+def host_game_args(num_players: int, port: int) -> str:
+    """Host-side engine args for a deathmatch game (ref base_gym_env.py:71-83)."""
+    return (
+        f"-host {num_players} "
+        f"-port {port} "
+        "+viz_connect_timeout 60 "
+        "-deathmatch "
+        "+timelimit 10.0 "
+        "+sv_forcerespawn 1 "
+        "+sv_noautoaim 1 "
+        "+sv_respawnprotect 1 "
+        "+sv_spawnfarthest 1 "
+        "+viz_respawn_delay 10 "
+        "+viz_nocheat 1")
+
+
+def join_game_args(ip: str, port: int) -> str:
+    """Client-side join args (ref base_gym_env.py:84-86)."""
+    return f"-join {ip} -port {port}"
+
+
+def player_args(player_name: str, colorset: int) -> str:
+    return f"+name {player_name} +colorset {colorset}"

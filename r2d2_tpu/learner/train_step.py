@@ -158,7 +158,11 @@ def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def step(train_state: TrainState, replay_state: ReplayState):
-        key, sample_key = jax.random.split(train_state.key)
+        key, sample_base = jax.random.split(train_state.key)
+        # fold_in(0) matches the dp-sharded step's per-shard key derivation,
+        # so a dp=1 mesh reproduces the single-chip sample stream exactly
+        # (tested in tests/test_parallel.py)
+        sample_key = jax.random.fold_in(sample_base, 0)
         # nested-jit calls trace inline into this one program
         batch = replay_sample(spec, replay_state, sample_key)
 

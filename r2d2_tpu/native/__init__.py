@@ -1,0 +1,79 @@
+"""ctypes binding for the native C++ sum tree (see sum_tree.cc).
+
+Builds the shared library on first import via the bundled Makefile (g++ is a
+baked-in toolchain dependency); import fails cleanly if the toolchain is
+absent, and HostReplay falls back to the numpy twin.
+"""
+
+import ctypes
+import os
+import subprocess
+from typing import Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libsumtree.so")
+
+
+def _build() -> None:
+    subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                   capture_output=True, text=True)
+
+
+def _load() -> ctypes.CDLL:
+    if not os.path.exists(_SO) or (
+            os.path.getmtime(_SO) <
+            os.path.getmtime(os.path.join(_DIR, "sum_tree.cc"))):
+        _build()
+    lib = ctypes.CDLL(_SO)
+    lib.st_create.argtypes = [ctypes.c_int64]
+    lib.st_create.restype = ctypes.c_void_p
+    lib.st_destroy.argtypes = [ctypes.c_void_p]
+    lib.st_num_layers.argtypes = [ctypes.c_void_p]
+    lib.st_num_layers.restype = ctypes.c_int64
+    lib.st_total.argtypes = [ctypes.c_void_p]
+    lib.st_total.restype = ctypes.c_double
+    dptr = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    iptr = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.st_update.argtypes = [ctypes.c_void_p, ctypes.c_double, dptr, iptr,
+                              ctypes.c_int64]
+    lib.st_sample.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                              ctypes.c_int64, dptr, iptr, dptr]
+    return lib
+
+
+_LIB = _load()
+
+
+class NativeSumTree:
+    """API-compatible with the numpy twin in ops/sum_tree.py."""
+
+    def __init__(self, capacity: int):
+        self._handle = _LIB.st_create(capacity)
+        self.capacity = capacity
+        self.num_layers = int(_LIB.st_num_layers(self._handle))
+
+    def update(self, alpha: float, td_errors: np.ndarray,
+               idxes: np.ndarray) -> None:
+        td = np.ascontiguousarray(td_errors, np.float64)
+        ix = np.ascontiguousarray(idxes, np.int64)
+        _LIB.st_update(self._handle, float(alpha), td, ix, len(ix))
+
+    def sample(self, beta: float, n: int, rng: np.random.Generator
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        jitter = np.ascontiguousarray(rng.uniform(0.0, 1.0, n), np.float64)
+        out_idx = np.empty(n, np.int64)
+        out_w = np.empty(n, np.float64)
+        _LIB.st_sample(self._handle, float(beta), n, jitter, out_idx, out_w)
+        return out_idx, out_w
+
+    @property
+    def total(self) -> float:
+        return float(_LIB.st_total(self._handle))
+
+    def __del__(self):
+        try:
+            _LIB.st_destroy(self._handle)
+        except Exception:
+            pass

@@ -1,0 +1,107 @@
+// Native host-side priority sum tree — the CPU-feeder fallback for
+// host-placement replay (SURVEY §2.1: the reference compiles these two
+// kernels with numba→LLVM, /root/reference/priority_tree.py:15-49; numba is
+// not a dependency here, so the host path gets a real compiled
+// implementation).
+//
+// Semantics match r2d2_tpu/ops/sum_tree.py's numpy twin bit-for-bit given the
+// same stratified jitter: float64 storage, p = |td|^alpha with p(0) = 0,
+// stratified prefix-sum descent that never enters a zero-mass right subtree,
+// IS weights (p / min_p)^-beta.
+//
+// C ABI (ctypes-friendly), single-threaded per tree; the caller (HostReplay)
+// serializes access under its lock exactly as the reference's buffer lock
+// does (/root/reference/worker.py:65).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct SumTree {
+  int64_t num_layers;
+  int64_t capacity;      // leaves
+  std::vector<double> nodes;  // 2^num_layers - 1
+};
+
+int64_t layers_for(int64_t capacity) {
+  int64_t layers = 1;
+  while (capacity > (int64_t(1) << (layers - 1))) ++layers;
+  return layers;
+}
+
+}  // namespace
+
+extern "C" {
+
+SumTree* st_create(int64_t capacity) {
+  auto* t = new SumTree;
+  t->num_layers = layers_for(capacity);
+  t->capacity = capacity;
+  t->nodes.assign((int64_t(1) << t->num_layers) - 1, 0.0);
+  return t;
+}
+
+void st_destroy(SumTree* t) { delete t; }
+
+int64_t st_num_layers(const SumTree* t) { return t->num_layers; }
+
+double st_total(const SumTree* t) { return t->nodes[0]; }
+
+// Write p = |td|^alpha at the given leaves, then rebuild ancestor sums
+// bottom-up (level-synchronous like the numba kernel's np.unique dedup —
+// here a simple walk per index; n is <= seqs_per_block or batch_size).
+void st_update(SumTree* t, double alpha, const double* td_errors,
+               const int64_t* idxes, int64_t n) {
+  const int64_t leaf0 = (int64_t(1) << (t->num_layers - 1)) - 1;
+  for (int64_t i = 0; i < n; ++i) {
+    const double td = td_errors[i];
+    const double p = td != 0.0 ? std::pow(std::fabs(td), alpha) : 0.0;
+    int64_t node = leaf0 + idxes[i];
+    const double delta = p - t->nodes[node];
+    t->nodes[node] = p;
+    while (node != 0) {
+      node = (node - 1) / 2;
+      t->nodes[node] += delta;
+    }
+  }
+}
+
+// Stratified proportional sampling. jitter[i] in [0,1) supplies stratum i's
+// uniform draw (provided by the caller's RNG so python/numpy/C++ paths can
+// share one stream). Returns leaf indices and IS weights (p/min_p)^-beta.
+void st_sample(const SumTree* t, double beta, int64_t n, const double* jitter,
+               int64_t* out_idxes, double* out_weights) {
+  const int64_t leaf0 = (int64_t(1) << (t->num_layers - 1)) - 1;
+  const double p_sum = t->nodes[0];
+  const double interval = p_sum / static_cast<double>(n);
+  double min_p = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    double prefix = (static_cast<double>(i) + jitter[i]) * interval;
+    if (prefix > p_sum * (1.0 - 1e-12)) prefix = p_sum * (1.0 - 1e-12);
+    int64_t node = 0;
+    for (int64_t layer = 0; layer < t->num_layers - 1; ++layer) {
+      const double left = t->nodes[2 * node + 1];
+      const double right = t->nodes[2 * node + 2];
+      if (prefix < left || right <= 0.0) {
+        node = 2 * node + 1;
+        const double cap = left * (1.0 - 1e-12);
+        if (prefix > cap) prefix = cap;
+      } else {
+        node = 2 * node + 2;
+        prefix -= left;
+      }
+    }
+    const double p = t->nodes[node];
+    out_idxes[i] = node - leaf0;
+    out_weights[i] = p;
+    if (i == 0 || p < min_p) min_p = p;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    out_weights[i] = std::pow(out_weights[i] / min_p, -beta);
+  }
+}
+
+}  // extern "C"

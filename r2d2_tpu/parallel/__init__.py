@@ -1,0 +1,23 @@
+"""Multi-chip parallelism.
+
+The reference has NO learner parallelism — one process on half a GPU, no
+collectives anywhere (/root/reference/worker.py:251, SURVEY §2.2). Here
+scaling is a mesh-axis change: the fused learner step runs under shard_map
+over a ``jax.sharding.Mesh`` 'dp' axis with the replay ring sharded
+block-wise per chip, per-shard prioritized sampling, and gradient pmean over
+ICI; multi-host extends the same mesh over DCN via jax.distributed.
+"""
+
+from r2d2_tpu.parallel.mesh import make_mesh, init_distributed
+from r2d2_tpu.parallel.sharded import (
+    make_sharded_learner_step,
+    sharded_replay_add,
+    sharded_replay_init,
+    sharded_buffer_steps,
+)
+
+__all__ = [
+    "make_mesh", "init_distributed",
+    "make_sharded_learner_step", "sharded_replay_add", "sharded_replay_init",
+    "sharded_buffer_steps",
+]

@@ -1,0 +1,36 @@
+"""Device-mesh construction (SURVEY §5.8 TPU-native equivalent).
+
+dp = data parallelism (batch + replay sharding, gradient pmean over ICI);
+mp = model parallelism axis, reserved in the mesh so enabling tensor sharding
+of the wide layers is a config change, not a rewrite (SURVEY §2.2).
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from r2d2_tpu.config import MeshConfig
+
+
+def init_distributed(cfg: MeshConfig) -> None:
+    """Multi-host bring-up over DCN (ref has no equivalent; its scaling unit
+    is one process on half a GPU, worker.py:251)."""
+    if cfg.multihost:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id)
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None, max_devices: Optional[int] = None
+              ) -> Mesh:
+    cfg = cfg or MeshConfig()
+    devices = jax.devices()
+    if max_devices is not None:
+        devices = devices[:max_devices]
+    mp = max(cfg.mp, 1)
+    dp = cfg.dp if cfg.dp > 0 else len(devices) // mp
+    devices = np.asarray(devices[: dp * mp]).reshape(dp, mp)
+    return Mesh(devices, ("dp", "mp"))

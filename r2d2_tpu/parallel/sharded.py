@@ -1,0 +1,148 @@
+"""Sharded learner: the fused step over a device mesh via shard_map.
+
+Design (SURVEY §5.8, scaling-book recipe — pick a mesh, annotate shardings,
+let XLA insert collectives):
+
+  * The replay ring gains a leading ``dp`` axis sharded across chips: each
+    chip owns ``num_blocks`` blocks, its own priority sum tree, and its own
+    ring pointer. Prioritized sampling is per-shard (stratified within the
+    chip's tree) — with round-robin block feeding this factorizes global
+    stratified sampling across chips, and priority write-back stays chip-local
+    (zero cross-chip traffic on the replay path).
+  * Params / optimizer state are replicated; each chip computes gradients on
+    its local ``batch_size`` sequences and a single ``pmean`` over ICI makes
+    the Adam update identical everywhere — the global batch is
+    ``dp * batch_size`` (the reference's learner has no equivalent axis; its
+    batch is bounded by half a GPU, worker.py:251).
+  * The RNG key is replicated; each shard folds in its axis index for
+    sampling, and the carried key stays replicated.
+
+The inner computation is the SAME ``make_loss_fn``/tree code as the
+single-chip path — the mesh is an orthogonal layer, exactly the property the
+reference's Ray design lacks.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from r2d2_tpu.config import OptimConfig
+from r2d2_tpu.learner.train_step import TrainState, make_loss_fn, make_optimizer
+from r2d2_tpu.models.network import NetworkApply
+from r2d2_tpu.ops.sum_tree import tree_update
+from r2d2_tpu.replay.device_replay import replay_init, replay_sample, replay_add
+from r2d2_tpu.replay.structs import Block, ReplaySpec, ReplayState
+
+
+def _shard0(tree):
+    """Per-shard view: drop the leading dp axis (local size 1)."""
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+def _unshard0(tree):
+    return jax.tree_util.tree_map(lambda x: x[None], tree)
+
+
+def sharded_replay_init(spec: ReplaySpec, mesh: Mesh) -> ReplayState:
+    """Global replay state with leading dp axis, placed shard-per-chip."""
+    dp = mesh.shape["dp"]
+    state = replay_init(spec)
+    state = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (dp,) + x.shape), state)
+    sharding = NamedSharding(mesh, P("dp"))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), state)
+
+
+def make_sharded_replay_add(spec: ReplaySpec, mesh: Mesh):
+    """add(state, block, shard_idx): ring-write ``block`` into one chip's
+    shard (host feeder round-robins shard_idx). The block is broadcast and
+    non-owners no-op — a few MB over ICI per 400 env steps."""
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("dp"), P(), P()), out_specs=P("dp"), check_vma=False)
+    def add(state: ReplayState, block: Block, shard_idx):
+        my = jax.lax.axis_index("dp")
+        local = _shard0(state)
+
+        def write(s):
+            return replay_add(spec, s, block)
+
+        local = jax.lax.cond(my == shard_idx[0], write, lambda s: s, local)
+        return _unshard0(local)
+
+    def add_fn(state, block, shard_idx: int):
+        return add(state, block, jnp.asarray([shard_idx], jnp.int32))
+
+    return jax.jit(add_fn, donate_argnums=0)
+
+
+def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
+                              optim: OptimConfig, use_double: bool, mesh: Mesh):
+    """The dp-sharded fused step. Same contract as make_learner_step."""
+    loss_fn = make_loss_fn(net, spec, optim, use_double)
+    tx = make_optimizer(optim)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P("dp")), out_specs=(P(), P("dp"), P()),
+        check_vma=False)
+    def step(train_state: TrainState, replay_global: ReplayState):
+        replay_state = _shard0(replay_global)
+        my = jax.lax.axis_index("dp")
+
+        key, sample_base = jax.random.split(train_state.key)
+        sample_key = jax.random.fold_in(sample_base, my)
+        batch = replay_sample(spec, replay_state, sample_key)
+
+        (loss, aux), grads = grad_fn(
+            train_state.params, train_state.target_params, batch)
+        # gradient allreduce over ICI — the only cross-chip traffic per step
+        grads = jax.lax.pmean(grads, "dp")
+        loss = jax.lax.pmean(loss, "dp")
+
+        updates, opt_state = tx.update(grads, train_state.opt_state,
+                                       train_state.params)
+        params = optax.apply_updates(train_state.params, updates)
+
+        tree = tree_update(spec.tree_layers, replay_state.tree,
+                           spec.prio_exponent, aux["priorities"], batch.idxes)
+        replay_state = replay_state.replace(tree=tree)
+
+        new_step = train_state.step + 1
+        if use_double:
+            sync = (new_step % optim.target_net_update_interval) == 0
+            target_params = jax.tree_util.tree_map(
+                lambda p, t: jnp.where(sync, p, t), params,
+                train_state.target_params)
+        else:
+            target_params = train_state.target_params
+
+        metrics = {
+            "loss": loss,
+            "mean_abs_td": jax.lax.pmean(aux["mean_abs_td"], "dp"),
+            "mean_q": jax.lax.pmean(aux["mean_q"], "dp"),
+            "grad_norm": optax.global_norm(grads),
+        }
+        train_state = train_state.replace(
+            params=params, target_params=target_params,
+            opt_state=opt_state, step=new_step, key=key)
+        return train_state, _unshard0(replay_state), metrics
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+# convenience thin wrapper so callers don't need the factory
+def sharded_replay_add(spec, mesh, state, block, shard_idx: int):
+    return make_sharded_replay_add(spec, mesh)(state, block, shard_idx)
+
+
+def sharded_buffer_steps(state: ReplayState) -> int:
+    """Total stored learning steps across all shards."""
+    return int(jnp.sum(state.learning_steps))

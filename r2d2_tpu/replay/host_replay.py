@@ -8,8 +8,10 @@ device path is checked against. Uses the native C++ sum tree when built
 (r2d2_tpu/native), else the numpy twin.
 
 Unlike the device path, sampling here can race with the learner's async
-priority write-back, so the reference's ring-pointer staleness guard is kept
-(/root/reference/worker.py:196-206).
+priority write-back, so a staleness guard drops updates for overwritten ring
+slots (the reference's guard, /root/reference/worker.py:196-206, compares raw
+ring pointers and silently fails when the ring wraps back to exactly the
+snapshot pointer or laps it; here a monotonic add counter closes that hole).
 """
 
 import threading
@@ -49,6 +51,7 @@ class HostReplay:
         self.forward_steps = np.zeros((n, s), np.int32)
         self.seq_start = np.zeros((n, s), np.int32)
         self.block_ptr = 0
+        self.total_adds = 0   # monotonic; never wraps
 
     # -- sum-tree indirection (native C++ or numpy) --
 
@@ -72,6 +75,7 @@ class HostReplay:
         with self.lock:
             ptr = self.block_ptr
             self.block_ptr = (ptr + 1) % spec.num_blocks
+            self.total_adds += 1
             idxes = ptr * spec.seqs_per_block + np.arange(spec.seqs_per_block, dtype=np.int64)
             self._tree_update(np.asarray(block.priority, np.float64), idxes)
             self.obs[ptr] = block.obs_row
@@ -86,7 +90,7 @@ class HostReplay:
             self.seq_start[ptr] = block.seq_start
 
     def sample(self, batch_size: Optional[int] = None) -> Tuple[SampleBatch, int]:
-        """Returns (batch, ring_ptr_snapshot) — the snapshot feeds the
+        """Returns (batch, total_adds_snapshot) — the snapshot feeds the
         staleness guard in update_priorities."""
         spec = self.spec
         batch = batch_size or spec.batch_size
@@ -123,26 +127,30 @@ class HostReplay:
                     is_weights=is_weights.astype(np.float32),
                     idxes=idxes.astype(np.int32),
                 ),
-                self.block_ptr,
+                self.total_adds,
             )
 
     def update_priorities(self, idxes: np.ndarray, td_errors: np.ndarray,
-                          old_ptr: int) -> None:
+                          adds_snapshot: int) -> None:
         """Drop updates for ring slots overwritten since the sample was taken
-        (ref worker.py:196-206), then write back."""
+        (ref worker.py:196-206). ``adds_snapshot`` is the total_adds value
+        returned by sample(); being monotonic it detects full ring laps that
+        raw pointer comparison cannot."""
         spec = self.spec
         idxes = np.asarray(idxes, np.int64)
         td_errors = np.asarray(td_errors, np.float64)
         with self.lock:
-            if self.block_ptr > old_ptr:
-                mask = (idxes < old_ptr * spec.seqs_per_block) | (
-                    idxes >= self.block_ptr * spec.seqs_per_block)
-            elif self.block_ptr < old_ptr:
-                mask = (idxes < old_ptr * spec.seqs_per_block) & (
-                    idxes >= self.block_ptr * spec.seqs_per_block)
-            else:
-                mask = np.ones_like(idxes, bool)
-            if not mask.all():
+            adds = self.total_adds - adds_snapshot
+            if adds >= spec.num_blocks:
+                return  # the whole ring was rewritten; everything is stale
+            if adds > 0:
+                old_ptr = (self.block_ptr - adds) % spec.num_blocks
+                if self.block_ptr > old_ptr:
+                    mask = (idxes < old_ptr * spec.seqs_per_block) | (
+                        idxes >= self.block_ptr * spec.seqs_per_block)
+                else:  # wrapped: stale range is [old_ptr, N) U [0, block_ptr)
+                    mask = (idxes < old_ptr * spec.seqs_per_block) & (
+                        idxes >= self.block_ptr * spec.seqs_per_block)
                 idxes, td_errors = idxes[mask], td_errors[mask]
             if idxes.size:
                 self._tree_update(td_errors, idxes)

@@ -1,0 +1,25 @@
+"""Process/host runtime: everything the reference delegates to Ray
+(/root/reference/train.py:23, worker.py:29,251,502) rebuilt without it.
+
+* weights.py    — seqlock shared-memory weight service (replaces the plasma
+                  object-store weight publication, worker.py:286-290,572-576);
+* feeder.py     — actor→learner experience transport (replaces remote
+                  ReplayBuffer.add RPCs, worker.py:558,565);
+* metrics.py    — reference-log-compatible training metrics (worker.py:220-234);
+* checkpoint.py — orbax checkpoint of (params, opt_state, step, env_steps)
+                  with the reference's weights-only warm-start (SURVEY §5.4);
+* learner_loop.py / actor_loop.py / orchestrator.py — the Learner/Actor/train()
+  trio (worker.py:251-390,502-591, train.py:21-66) as plain processes/threads.
+"""
+
+from r2d2_tpu.runtime.weights import InProcWeightStore, WeightPublisher, WeightSubscriber
+from r2d2_tpu.runtime.feeder import BlockQueue
+from r2d2_tpu.runtime.metrics import TrainMetrics
+from r2d2_tpu.runtime.learner_loop import Learner
+from r2d2_tpu.runtime.actor_loop import run_actor
+from r2d2_tpu.runtime.orchestrator import train
+
+__all__ = [
+    "InProcWeightStore", "WeightPublisher", "WeightSubscriber",
+    "BlockQueue", "TrainMetrics", "Learner", "run_actor", "train",
+]

@@ -1,0 +1,68 @@
+"""Actor rollout loop (ref /root/reference/worker.py:528-591) — runs in a
+thread (tests) or a spawned process (production) with a CPU-pinned policy.
+
+Per step: policy step → ε-greedy → env.step → frame-stack roll →
+LocalBuffer.add; on episode end finish without bootstrap (episode return
+reported only from near-greedy actors, ref worker.py:555-556); on block
+boundary finish with bootstrap Q; pull fresh weights every
+``actor_update_interval`` steps (ref worker.py:567-570 — the reference
+hardcodes 400; here the config field is honored).
+"""
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from r2d2_tpu.actor.local_buffer import LocalBuffer
+from r2d2_tpu.actor.policy import ActorPolicy
+from r2d2_tpu.config import Config
+from r2d2_tpu.replay.structs import ReplaySpec
+
+
+def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
+              weight_poll: Callable, should_stop: Callable[[], bool],
+              max_env_steps: Optional[int] = None) -> int:
+    """Returns total env steps taken. ``block_sink(block)`` ships a finished
+    block; ``weight_poll()`` returns fresh params or None."""
+    spec = ReplaySpec.from_config(cfg)
+    lb = LocalBuffer(spec, policy.action_dim, cfg.optim.gamma,
+                     cfg.optim.priority_eta)
+
+    obs = env.reset()
+    policy.observe_reset(obs)
+    lb.reset(obs)
+    episode_steps = 0
+    total_steps = 0
+    counter = 0
+
+    while not should_stop():
+        action, q, hidden = policy.act()
+        next_obs, reward, done, _ = env.step(action)
+        policy.observe(next_obs, action)
+        lb.add(action, reward, next_obs, q, hidden)
+        episode_steps += 1
+        total_steps += 1
+
+        if done or episode_steps == cfg.actor.max_episode_steps:
+            block = lb.finish(None)
+            if policy.epsilon > cfg.actor.near_greedy_eps:
+                # only near-greedy actors report episode returns
+                block = block.replace(sum_reward=np.asarray(np.nan, np.float32))
+            block_sink(block)
+            obs = env.reset()
+            policy.observe_reset(obs)
+            lb.reset(obs)
+            episode_steps = 0
+        elif len(lb) == spec.block_length:
+            block_sink(lb.finish(policy.bootstrap_q()))
+
+        counter += 1
+        if counter >= cfg.actor.actor_update_interval:
+            params = weight_poll()
+            if params is not None:
+                policy.update_params(params)
+            counter = 0
+
+        if max_env_steps is not None and total_steps >= max_env_steps:
+            break
+    return total_steps

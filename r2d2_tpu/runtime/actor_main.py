@@ -1,0 +1,69 @@
+"""Spawned actor process entry point.
+
+Kept import-light on purpose: with the ``spawn`` start method the child
+re-imports this module before unpickling the target function, and the env
+vars pinning JAX to the host CPU must be set before any jax import — the TPU
+belongs to the learner process alone (the reference gets this isolation for
+free from Ray's per-actor processes + CUDA_VISIBLE_DEVICES,
+/root/reference/config.py:1).
+"""
+
+import os
+
+
+def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
+                       epsilon: float, shm_name: str, queue, stop_event,
+                       is_host: bool, port: int) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # late imports: only after the platform pin
+    import jax
+    import numpy as np
+
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.actor_loop import run_actor
+    from r2d2_tpu.runtime.weights import WeightSubscriber
+
+    cfg = _config_from_dict(cfg_dict)
+    seed = cfg.runtime.seed + 10_000 * player_idx + 100 * actor_idx
+    env = create_env(cfg.env, clip_rewards=True, is_host=is_host, port=port,
+                     num_players=cfg.multiplayer.num_players,
+                     name=f"p{player_idx}a{actor_idx}", seed=seed)
+    net = NetworkApply(env.action_space.n, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    params = net.init(jax.random.PRNGKey(cfg.runtime.seed))
+    sub = WeightSubscriber(shm_name, params)
+    fresh = sub.poll()
+    if fresh is not None:
+        params = fresh
+    policy = ActorPolicy(net, params, epsilon, seed=seed)
+
+    try:
+        run_actor(cfg, env, policy,
+                  block_sink=lambda b: queue.put(b, timeout=60.0),
+                  weight_poll=sub.poll,
+                  should_stop=stop_event.is_set)
+    finally:
+        sub.close()
+        env.close()
+
+
+def _config_from_dict(d: dict):
+    from r2d2_tpu.config import (ActorConfig, Config, EnvConfig, MeshConfig,
+                                 MultiplayerConfig, NetworkConfig, OptimConfig,
+                                 ReplayConfig, RuntimeConfig, SequenceConfig)
+    sections = dict(
+        env=EnvConfig, network=NetworkConfig, sequence=SequenceConfig,
+        replay=ReplayConfig, optim=OptimConfig, actor=ActorConfig,
+        multiplayer=MultiplayerConfig, mesh=MeshConfig, runtime=RuntimeConfig)
+    kwargs = {}
+    for name, cls in sections.items():
+        sub = dict(d[name])
+        # tuples serialized as lists by asdict/json
+        for k, v in sub.items():
+            if isinstance(v, list):
+                sub[k] = tuple(tuple(x) if isinstance(x, list) else x for x in v)
+        kwargs[name] = cls(**sub)
+    return Config(**kwargs)

@@ -1,0 +1,75 @@
+"""Checkpoint / resume (ref /root/reference/worker.py:311,380-381 +
+SURVEY §5.4).
+
+The reference torch.saves ``(state_dict, training_steps, env_steps)`` every
+``save_interval`` learner steps and warm-starts weights-only via
+``config.pretrain``. Here the full training state — params, target params,
+optimizer state, step, env_steps — goes through orbax (atomic directory
+writes, async-safe), and ``load_pretrain`` reproduces the weights-only
+warm-start path for both learner and actors.
+
+Checkpoint k lives at ``{save_dir}/{game}{k}_player{p}`` mirroring the
+reference's ``{game}{k}_player{p}.pth`` naming (worker.py:381) so evaluation
+sweeps iterate checkpoints the same way (test.py:30-32).
+"""
+
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def _ckpt_dir(save_dir: str, game: str, index: int, player: int) -> str:
+    return os.path.abspath(os.path.join(save_dir, f"{game}{index}_player{player}"))
+
+
+def save_checkpoint(save_dir: str, game: str, index: int, player: int,
+                    params, opt_state, target_params, step: int,
+                    env_steps: int) -> str:
+    path = _ckpt_dir(save_dir, game, index, player)
+    ckptr = ocp.PyTreeCheckpointer()
+    payload = {
+        "params": jax.device_get(params),
+        "target_params": jax.device_get(target_params),
+        "opt_state": jax.device_get(opt_state),
+        "step": np.asarray(step, np.int64),
+        "env_steps": np.asarray(env_steps, np.int64),
+    }
+    ckptr.save(path, payload, force=True)
+    return path
+
+
+def restore_checkpoint(path: str, template: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+    ckptr = ocp.PyTreeCheckpointer()
+    if template is not None:
+        return ckptr.restore(os.path.abspath(path), item=template)
+    return ckptr.restore(os.path.abspath(path))
+
+
+def load_pretrain(path: str, params_template):
+    """Weights-only warm start (ref worker.py:260-261,511-512): restores just
+    ``params`` from a checkpoint directory, leaving optimizer/step fresh."""
+    restored = restore_checkpoint(path)
+    params = restored["params"] if isinstance(restored, dict) else restored
+    # conform dtypes/structure to the template
+    return jax.tree_util.tree_map(
+        lambda t, p: np.asarray(p, np.asarray(t).dtype), params_template, params)
+
+
+def list_checkpoints(save_dir: str, game: str, player: int
+                     ) -> List[Tuple[int, str]]:
+    """Sorted (index, path) pairs, the eval sweep's iteration order
+    (ref test.py:30-32)."""
+    if not os.path.isdir(save_dir):
+        return []
+    pat = re.compile(re.escape(game) + r"(\d+)_player" + str(player) + r"$")
+    out = []
+    for name in os.listdir(save_dir):
+        m = pat.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(save_dir, name)))
+    return sorted(out)

@@ -1,0 +1,43 @@
+"""Experience transport: actor processes → learner host thread.
+
+Replaces the reference's ``replay_buffer.add.remote(block)`` through Ray's
+object store (/root/reference/worker.py:558,565). A bounded multiprocessing
+queue of fixed-shape Block records; the learner drains it between fused train
+steps and ingests via the jitted ``replay_add``. Bounded so a stalled learner
+back-pressures actors instead of exhausting host RAM.
+"""
+
+import multiprocessing as mp
+import queue as queue_mod
+from typing import List, Optional
+
+from r2d2_tpu.replay.structs import Block
+
+
+class BlockQueue:
+    """Works in both modes: mp.Queue for process actors, queue.Queue for
+    thread actors (hermetic tests)."""
+
+    def __init__(self, maxsize: int = 64, use_mp: bool = True,
+                 ctx: Optional[mp.context.BaseContext] = None):
+        if use_mp:
+            ctx = ctx or mp.get_context("spawn")
+            self._q = ctx.Queue(maxsize=maxsize)
+        else:
+            self._q = queue_mod.Queue(maxsize=maxsize)
+
+    def put(self, block: Block, timeout: Optional[float] = None) -> None:
+        self._q.put(block, timeout=timeout)
+
+    def drain(self, max_items: int = 16) -> List[Block]:
+        """Non-blocking drain of up to max_items blocks."""
+        out = []
+        for _ in range(max_items):
+            try:
+                out.append(self._q.get_nowait())
+            except queue_mod.Empty:
+                break
+        return out
+
+    def get(self, timeout: Optional[float] = None) -> Block:
+        return self._q.get(timeout=timeout)

@@ -1,0 +1,148 @@
+"""Learner host driver around the fused device step.
+
+The reference Learner is a Ray GPU actor with a prefetch thread pulling
+batches over RPC and a train thread running torch ops
+(/root/reference/worker.py:251-390). Here batches never cross the host
+boundary — the fused step samples in HBM — so the host loop is thin: drain
+the feeder queue (jitted ring-writes), gate on learning_starts, dispatch
+steps, publish weights, checkpoint, count metrics.
+
+Ingestion between steps is the only add/sample interleaving point, which is
+what makes the fused step's priority write-back race-free (see
+replay/device_replay.py).
+"""
+
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.learner.train_step import (
+    TrainState, create_train_state, make_learner_step)
+from r2d2_tpu.models.network import NetworkApply
+from r2d2_tpu.replay.device_replay import replay_add, replay_init
+from r2d2_tpu.replay.structs import Block, ReplaySpec
+from r2d2_tpu.runtime.checkpoint import load_pretrain, save_checkpoint
+from r2d2_tpu.runtime.metrics import TrainMetrics
+
+
+class Learner:
+    def __init__(self, cfg: Config, net: NetworkApply, player_idx: int = 0,
+                 seed: Optional[int] = None, metrics: Optional[TrainMetrics] = None):
+        self.cfg = cfg
+        self.net = net
+        self.player_idx = player_idx
+        self.spec = ReplaySpec.from_config(cfg)
+        seed = cfg.runtime.seed if seed is None else seed
+        key = jax.random.PRNGKey(seed + 1000 * player_idx)
+
+        self.train_state = create_train_state(key, net, cfg.optim)
+        if cfg.runtime.pretrain:
+            params = load_pretrain(cfg.runtime.pretrain, self.train_state.params)
+            self.train_state = self.train_state.replace(
+                params=params,
+                target_params=jax.tree_util.tree_map(np.copy, params))
+        self.replay_state = replay_init(self.spec)
+        self._step_fn = make_learner_step(
+            net, self.spec, cfg.optim, cfg.network.use_double)
+
+        self.metrics = metrics or TrainMetrics(player_idx, cfg.runtime.save_dir)
+        self.publish: Optional[Callable] = None   # wired by orchestrator
+
+        # Host mirrors of device counters. The learner is the only writer of
+        # the ring and the step counter, so mirroring them avoids a blocking
+        # device read (a full tunnel round-trip under remote TPU dispatch)
+        # per ingested block / per step.
+        self.buffer_steps = 0
+        self.env_steps = 0
+        self._host_ptr = 0
+        self._slot_steps = [0] * self.spec.num_blocks
+        self._host_step = 0
+        self._pending_losses: list = []   # device scalars, flushed lazily
+
+    # -- ingestion --
+
+    def ingest(self, block: Block) -> None:
+        """Jitted ring-write of one actor block (ref worker.py:85-120).
+        Purely async on device — all counter accounting uses host mirrors."""
+        learning = int(np.asarray(block.learning_steps).sum())
+        ptr = self._host_ptr
+        self.replay_state = replay_add(self.spec, self.replay_state, block)
+        # ring overwrite: subtract the steps previously in this slot
+        self.buffer_steps += learning - self._slot_steps[ptr]
+        self._slot_steps[ptr] = learning
+        self._host_ptr = (ptr + 1) % self.spec.num_blocks
+        self.env_steps += learning
+        ret = float(np.asarray(block.sum_reward))
+        self.metrics.on_block(learning, None if np.isnan(ret) else ret)
+        self.metrics.set_buffer_size(self.buffer_steps)
+
+    def drain(self, queue, max_items: int = 32) -> int:
+        blocks = queue.drain(max_items)
+        for blk in blocks:
+            self.ingest(blk)
+        return len(blocks)
+
+    @property
+    def ready(self) -> bool:
+        """Training gate (ref worker.py:214-218, config.learning_starts)."""
+        return self.buffer_steps >= self.cfg.replay.learning_starts
+
+    @property
+    def training_steps(self) -> int:
+        """Host-mirrored step counter (no device sync)."""
+        return self._host_step
+
+    # -- training --
+
+    def step(self) -> dict:
+        """One fused device step. Never blocks on the device: metrics stay
+        device arrays until flush_metrics() (called at log time); the step
+        counter is host-mirrored."""
+        self.train_state, self.replay_state, m = self._step_fn(
+            self.train_state, self.replay_state)
+        self._host_step += 1
+        step = self._host_step
+        self._pending_losses.append(m["loss"])
+
+        rt = self.cfg.runtime
+        if self.publish is not None and step % rt.weight_publish_interval == 0:
+            self.publish(self.train_state.params)
+        if rt.save_interval and step % rt.save_interval == 0:
+            self.save(step // rt.save_interval)
+        return m
+
+    def flush_metrics(self) -> None:
+        """Convert accumulated device losses to host floats (ONE sync for the
+        whole interval) and feed the training counters."""
+        if self._pending_losses:
+            losses = np.asarray(jax.device_get(self._pending_losses))
+            for loss in losses:
+                self.metrics.on_train_step(float(loss))
+            self._pending_losses.clear()
+
+    def save(self, index: int) -> str:
+        ts = self.train_state
+        return save_checkpoint(
+            self.cfg.runtime.save_dir, self.cfg.env.game_name, index,
+            self.player_idx, ts.params, ts.opt_state, ts.target_params,
+            int(ts.step), self.env_steps)
+
+    def run(self, queue, should_stop: Callable[[], bool],
+            max_steps: Optional[int] = None) -> int:
+        """Drain + train until should_stop() or max_steps training steps
+        (the reference trains for config.training_steps, worker.py:312)."""
+        max_steps = max_steps or self.cfg.optim.training_steps
+        # initial checkpoint at step 0 (ref worker.py:311)
+        if self.cfg.runtime.save_interval:
+            self.save(0)
+        while not should_stop() and self._host_step < max_steps:
+            self.drain(queue)
+            if self.ready:
+                self.step()
+            else:
+                time.sleep(0.05)
+        self.flush_metrics()
+        return self._host_step

@@ -1,0 +1,98 @@
+"""Training metrics and logging, key-compatible with the reference.
+
+The reference centralizes counters in the ReplayBuffer actor and writes
+``train_player{p}.log`` lines that plot.py regex-matches
+(/root/reference/worker.py:35-37,220-234; plot.py:33-48). This class keeps the
+exact key strings so the reference's offline plots work unchanged, and adds a
+structured JSONL stream for programmatic consumers.
+"""
+
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+
+class TrainMetrics:
+    def __init__(self, player_idx: int = 0, log_dir: str = ".",
+                 jsonl: bool = True):
+        self.player_idx = player_idx
+        os.makedirs(log_dir, exist_ok=True) if log_dir else None
+        self.logger = logging.getLogger(f"r2d2_tpu.player_{player_idx}")
+        self.logger.setLevel(logging.INFO)
+        self.logger.propagate = False
+        path = os.path.join(log_dir or ".", f"train_player{player_idx}.log")
+        handler = logging.FileHandler(path, "w")
+        handler.setFormatter(logging.Formatter("%(message)s"))
+        self.logger.handlers = [handler]
+        self._jsonl_path = (os.path.join(log_dir or ".", f"metrics_player{player_idx}.jsonl")
+                            if jsonl else None)
+        self._start = time.time()
+
+        self.buffer_size = 0
+        self.env_steps = 0
+        self.last_env_steps = 0
+        self.num_episodes = 0
+        self.episode_reward = 0.0
+        self.training_steps = 0
+        self.last_training_steps = 0
+        self.sum_loss = 0.0
+
+    # -- feed points --
+
+    def on_block(self, learning_steps: int, episode_return: Optional[float]) -> None:
+        """Called per ingested block (ref worker.py:117-120)."""
+        self.env_steps += learning_steps
+        if episode_return is not None and not np.isnan(episode_return):
+            self.episode_reward += float(episode_return)
+            self.num_episodes += 1
+
+    def on_train_step(self, loss: float) -> None:
+        """Called per learner step (ref worker.py:211-212)."""
+        self.training_steps += 1
+        self.sum_loss += float(loss)
+
+    def set_buffer_size(self, size: int) -> None:
+        self.buffer_size = int(size)
+
+    # -- emission (exact reference key strings, ref worker.py:220-234) --
+
+    def log(self, log_interval: float) -> dict:
+        self.logger.info(f"buffer size: {self.buffer_size}")
+        buffer_speed = (self.env_steps - self.last_env_steps) / log_interval
+        self.logger.info(f"buffer update speed: {buffer_speed}/s")
+        self.logger.info(f"number of environment steps: {self.env_steps}")
+        avg_return = None
+        if self.num_episodes != 0:
+            avg_return = self.episode_reward / self.num_episodes
+            self.logger.info(f"average episode return: {avg_return:.4f}")
+            self.episode_reward = 0.0
+            self.num_episodes = 0
+        self.logger.info(f"number of training steps: {self.training_steps}")
+        train_speed = (self.training_steps - self.last_training_steps) / log_interval
+        self.logger.info(f"training speed: {train_speed}/s")
+        mean_loss = None
+        if self.training_steps != self.last_training_steps:
+            mean_loss = self.sum_loss / (self.training_steps - self.last_training_steps)
+            self.logger.info(f"loss: {mean_loss:.4f}")
+            self.last_training_steps = self.training_steps
+            self.sum_loss = 0.0
+        self.last_env_steps = self.env_steps
+
+        record = {
+            "t": time.time() - self._start,
+            "buffer_size": self.buffer_size,
+            "buffer_speed": buffer_speed,
+            "env_steps": self.env_steps,
+            "avg_episode_return": avg_return,
+            "training_steps": self.training_steps,
+            "training_speed": train_speed,
+            "loss": mean_loss,
+        }
+        if self._jsonl_path:
+            with open(self._jsonl_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        return record

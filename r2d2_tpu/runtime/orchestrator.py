@@ -1,0 +1,185 @@
+"""System bring-up: the reference's ``train()`` (/root/reference/train.py:21-66)
+without Ray.
+
+Per player (1, or ``num_players`` complete stacks for multiplayer self-play):
+one Learner on the TPU, a weight service, a block queue, and N actors on host
+CPUs with the Ape-X ε ladder. Actors start first; training begins once the
+buffer passes ``learning_starts`` (the reference polls buffer.ready,
+train.py:49-54); the driver loop logs every ``log_interval`` seconds.
+
+Actor modes:
+  * "thread"  — actors are threads with CPU-pinned jitted policies; hermetic,
+    used by tests and single-host quickstarts.
+  * "process" — spawned OS processes (the reference's Ray-actor equivalent):
+    JAX_PLATFORMS=cpu children, shared-memory weight reads, mp.Queue blocks.
+
+Multiplayer wiring mirrors train.py:28-45: actor i of player 0 hosts game i
+on port base+i; actor i of every other player joins that game.
+"""
+
+import multiprocessing as mp
+import threading
+import time
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+
+from r2d2_tpu.config import Config, apex_epsilon
+from r2d2_tpu.envs.factory import create_env
+from r2d2_tpu.models.network import NetworkApply
+from r2d2_tpu.runtime.actor_loop import run_actor
+from r2d2_tpu.runtime.actor_main import actor_process_main
+from r2d2_tpu.runtime.feeder import BlockQueue
+from r2d2_tpu.runtime.learner_loop import Learner
+from r2d2_tpu.runtime.metrics import TrainMetrics
+from r2d2_tpu.runtime.weights import InProcWeightStore, WeightPublisher
+
+
+class PlayerStack:
+    """One player's buffer+learner+actors (the reference creates these per
+    player in train.py:28-45)."""
+
+    def __init__(self, cfg: Config, player_idx: int, action_dim: int):
+        self.cfg = cfg
+        self.player_idx = player_idx
+        self.net = NetworkApply(action_dim, cfg.network, cfg.env.frame_stack,
+                                cfg.env.frame_height, cfg.env.frame_width)
+        self.metrics = TrainMetrics(player_idx, cfg.runtime.save_dir)
+        self.learner = Learner(cfg, self.net, player_idx, metrics=self.metrics)
+        self.threads: List[threading.Thread] = []
+        self.processes: List[mp.Process] = []
+        self.publisher = None
+        self.store = None
+        self.queue: Optional[BlockQueue] = None
+
+    def actor_env_args(self, actor_idx: int):
+        """Multiplayer host/join wiring (ref train.py:33-38)."""
+        mpc = self.cfg.multiplayer
+        if not mpc.enabled:
+            return dict(is_host=False, port=mpc.base_port)
+        return dict(is_host=self.player_idx == 0, port=mpc.port(actor_idx))
+
+    def start_actors_threads(self, stop: threading.Event) -> None:
+        from r2d2_tpu.actor.policy import ActorPolicy
+        cfg = self.cfg
+        self.store = InProcWeightStore(self.learner.train_state.params)
+        self.learner.publish = self.store.publish
+        self.queue = BlockQueue(use_mp=False)
+        for i in range(cfg.actor.num_actors):
+            eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
+                               cfg.actor.eps_alpha)
+            seed = cfg.runtime.seed + 10_000 * self.player_idx + 100 * i
+            env = create_env(cfg.env, clip_rewards=True, seed=seed,
+                             num_players=cfg.multiplayer.num_players,
+                             name=f"p{self.player_idx}a{i}",
+                             **self.actor_env_args(i))
+            policy = ActorPolicy(self.net, self.learner.train_state.params,
+                                 eps, seed=seed)
+            reader_id = i
+
+            def loop(env=env, policy=policy, reader_id=reader_id):
+                run_actor(cfg, env, policy,
+                          block_sink=lambda b: self.queue.put(b, timeout=60.0),
+                          weight_poll=lambda: self.store.poll(reader_id),
+                          should_stop=stop.is_set)
+
+            t = threading.Thread(target=loop, daemon=True,
+                                 name=f"actor-p{self.player_idx}-{i}")
+            t.start()
+            self.threads.append(t)
+
+    def start_actors_processes(self, stop_event) -> None:
+        cfg = self.cfg
+        ctx = mp.get_context("spawn")
+        self.publisher = WeightPublisher(self.learner.train_state.params)
+        self.learner.publish = self.publisher.publish
+        self.queue = BlockQueue(use_mp=True, ctx=ctx)
+        for i in range(cfg.actor.num_actors):
+            eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
+                               cfg.actor.eps_alpha)
+            p = ctx.Process(
+                target=actor_process_main,
+                args=(cfg.to_dict(), self.player_idx, i, eps,
+                      self.publisher.name, self.queue._q, stop_event),
+                kwargs=self.actor_env_args(i),
+                daemon=True, name=f"actor-p{self.player_idx}-{i}")
+            p.start()
+            self.processes.append(p)
+
+    def close(self) -> None:
+        if self.publisher is not None:
+            self.publisher.close()
+        for p in self.processes:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+
+
+def train(cfg: Config, *, max_training_steps: Optional[int] = None,
+          max_seconds: Optional[float] = None, actor_mode: str = "thread",
+          log_fn: Callable[[dict], None] = None) -> List[PlayerStack]:
+    """Run the full system; returns the player stacks (learners hold final
+    state). Blocking — the reference's train.py never returns either
+    (train.py:60-66); here max_training_steps / max_seconds bound the run."""
+    assert actor_mode in ("thread", "process")
+    num_players = cfg.multiplayer.num_players if cfg.multiplayer.enabled else 1
+
+    # probe env for the action dim (ref worker.py:259 creates a throwaway env)
+    probe = create_env(cfg.env, seed=cfg.runtime.seed)
+    action_dim = probe.action_space.n
+    probe.close()
+
+    if actor_mode == "thread":
+        stop = threading.Event()
+    else:
+        stop = mp.get_context("spawn").Event()
+
+    stacks = [PlayerStack(cfg, p, action_dim) for p in range(num_players)]
+    for st in stacks:
+        if actor_mode == "thread":
+            st.start_actors_threads(stop)
+        else:
+            st.start_actors_processes(stop)
+
+    start = time.time()
+    deadline = start + max_seconds if max_seconds else None
+    max_steps = max_training_steps or cfg.optim.training_steps
+    last_log = start
+
+    def timed_out() -> bool:
+        return deadline is not None and time.time() > deadline
+
+    try:
+        # warm-up: fill buffers to learning_starts (ref train.py:49-54)
+        while not all(st.learner.ready for st in stacks) and not timed_out():
+            for st in stacks:
+                st.learner.drain(st.queue)
+            time.sleep(0.02)
+
+        # initial step-0 checkpoint (ref worker.py:311)
+        for st in stacks:
+            if cfg.runtime.save_interval:
+                st.learner.save(0)
+
+        while (not timed_out()
+               and any(st.learner.training_steps < max_steps for st in stacks)):
+            for st in stacks:
+                st.learner.drain(st.queue)
+                if st.learner.ready and st.learner.training_steps < max_steps:
+                    st.learner.step()
+            now = time.time()
+            if now - last_log >= cfg.runtime.log_interval:
+                for st in stacks:
+                    st.learner.flush_metrics()
+                    record = st.metrics.log(now - last_log)
+                    if log_fn:
+                        log_fn({"player": st.player_idx, **record})
+                last_log = now
+        for st in stacks:
+            st.learner.flush_metrics()
+    finally:
+        stop.set()
+        for st in stacks:
+            st.close()
+    return stacks

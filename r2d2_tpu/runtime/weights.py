@@ -1,0 +1,108 @@
+"""Weight service: learner → actors parameter distribution.
+
+The reference publishes CPU state_dicts into Ray's plasma object store every
+2 learner steps and actors ray.get them every 400 env steps
+(/root/reference/worker.py:286-290,567-576). Here the transport is a
+POSIX shared-memory segment with a seqlock header — single writer (learner),
+many readers (actor processes), zero RPCs, torn reads detected by version
+mismatch and retried.
+
+Layout: [u64 version][f32 payload...] where payload is the ravel of the param
+pytree (jax.flatten_util.ravel_pytree order). Version is odd while a write is
+in flight; readers spin until they observe the same even version before and
+after the copy.
+
+``InProcWeightStore`` is the thread-mode twin (tests, single-process runs).
+"""
+
+import threading
+from multiprocessing import shared_memory
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+
+def _flatten(params) -> Tuple[np.ndarray, Any]:
+    flat, unravel = ravel_pytree(params)
+    return np.asarray(jax.device_get(flat), np.float32), unravel
+
+
+class WeightPublisher:
+    """Learner-side writer. Owns (creates/destroys) the shm segment."""
+
+    def __init__(self, params, name: Optional[str] = None):
+        flat, self._unravel = _flatten(params)
+        self.num_weights = flat.shape[0]
+        nbytes = 8 + 4 * self.num_weights
+        self.shm = shared_memory.SharedMemory(create=True, size=nbytes, name=name)
+        self.name = self.shm.name
+        self._version = np.ndarray((1,), np.uint64, self.shm.buf, 0)
+        self._payload = np.ndarray((self.num_weights,), np.float32, self.shm.buf, 8)
+        self._version[0] = 0
+        self.publish(params)
+
+    def publish(self, params) -> None:
+        flat = np.asarray(jax.device_get(ravel_pytree(params)[0]), np.float32)
+        self._version[0] += 1          # odd: write in flight
+        self._payload[:] = flat
+        self._version[0] += 1          # even: stable
+
+    def close(self) -> None:
+        self.shm.close()
+        try:
+            self.shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+class WeightSubscriber:
+    """Actor-side reader. ``template`` provides the pytree structure."""
+
+    def __init__(self, name: str, template):
+        flat, self._unravel = _flatten(template)
+        self.num_weights = flat.shape[0]
+        self.shm = shared_memory.SharedMemory(name=name)
+        self._version = np.ndarray((1,), np.uint64, self.shm.buf, 0)
+        self._payload = np.ndarray((self.num_weights,), np.float32, self.shm.buf, 8)
+        self.last_version = 0
+
+    def poll(self):
+        """Return fresh params, or None if unchanged / write in flight."""
+        v1 = int(self._version[0])
+        if v1 == self.last_version or v1 % 2 == 1:
+            return None
+        for _ in range(64):             # seqlock retry loop
+            buf = self._payload.copy()
+            v2 = int(self._version[0])
+            if v1 == v2 and v2 % 2 == 0:
+                self.last_version = v2
+                return self._unravel(buf)
+            v1 = int(self._version[0])
+        return None
+
+    def close(self) -> None:
+        self.shm.close()
+
+
+class InProcWeightStore:
+    """Thread-mode store: one process, no shm. Same poll() contract."""
+
+    def __init__(self, params):
+        self._lock = threading.Lock()
+        self._params = jax.device_get(params)
+        self._version = 1
+        self._reader_versions = {}
+
+    def publish(self, params) -> None:
+        with self._lock:
+            self._params = jax.device_get(params)
+            self._version += 1
+
+    def poll(self, reader_id: int = 0):
+        with self._lock:
+            if self._reader_versions.get(reader_id) == self._version:
+                return None
+            self._reader_versions[reader_id] = self._version
+            return self._params

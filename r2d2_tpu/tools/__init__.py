@@ -1,0 +1,1 @@
+"""Offline tools: log parsing/plotting, checkpoint evaluation, genetic search."""

@@ -1,0 +1,124 @@
+"""Genetic / population-based hyperparameter search.
+
+The reference keeps this on a separate ``genetic`` branch (not in the
+snapshot) driven by the ``<-- GEN`` tags in config.py
+(/root/reference/README.md:13,28-32, config.py:12-57). Here it is a
+first-class tool over ``GENETIC_SEARCH_SPACE`` (r2d2_tpu/config.py), whose
+entries are layout-safe by construction: continuous fields carry (lo, hi)
+ranges (optionally log-scaled), constrained fields carry explicit choices, so
+every sampled genome builds a valid Config.
+
+Generic over the fitness function: pass any ``eval_fn(Config) -> float``
+(e.g. mean episode return of a short training slice — see cli/genetic.py).
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from r2d2_tpu.config import Config, GENETIC_SEARCH_SPACE
+
+Genome = Dict[str, Any]
+
+
+def sample_gene(rng: np.random.Generator, spec: Dict[str, Any]) -> Any:
+    if "choices" in spec:
+        return spec["choices"][int(rng.integers(len(spec["choices"])))]
+    lo, hi = spec["range"]
+    if spec.get("log"):
+        return float(np.exp(rng.uniform(math.log(lo), math.log(hi))))
+    return float(rng.uniform(lo, hi))
+
+
+def sample_genome(rng: np.random.Generator,
+                  space: Optional[Dict[str, Dict]] = None) -> Genome:
+    space = space or GENETIC_SEARCH_SPACE
+    return {key: sample_gene(rng, spec) for key, spec in space.items()}
+
+
+def mutate(rng: np.random.Generator, genome: Genome, rate: float = 0.25,
+           space: Optional[Dict[str, Dict]] = None) -> Genome:
+    """Resample each gene with probability ``rate``; continuous genes take a
+    log/linear perturbation instead of a full resample half the time."""
+    space = space or GENETIC_SEARCH_SPACE
+    out = dict(genome)
+    for key, spec in space.items():
+        if rng.random() >= rate:
+            continue
+        if "choices" in spec or rng.random() < 0.5:
+            out[key] = sample_gene(rng, spec)
+        else:
+            lo, hi = spec["range"]
+            if spec.get("log"):
+                out[key] = float(np.clip(
+                    out[key] * np.exp(rng.normal(0, 0.3)), lo, hi))
+            else:
+                out[key] = float(np.clip(
+                    out[key] + rng.normal(0, 0.15 * (hi - lo)), lo, hi))
+    return out
+
+
+def crossover(rng: np.random.Generator, a: Genome, b: Genome) -> Genome:
+    return {k: (a[k] if rng.random() < 0.5 else b[k]) for k in a}
+
+
+def genome_to_config(base: Config, genome: Genome) -> Config:
+    # int-typed fields arrive as floats from perturbation; coerce by field type
+    import dataclasses
+    coerced = {}
+    for key, value in genome.items():
+        section, fname = key.split(".")
+        f = {x.name: x for x in dataclasses.fields(getattr(base, section))}[fname]
+        if f.type == "int":
+            value = int(round(value))
+        elif f.type == "bool":
+            value = bool(value)
+        coerced[key] = value
+    return base.replace(**coerced)
+
+
+@dataclass
+class GenerationResult:
+    genomes: List[Genome]
+    fitnesses: List[float]
+
+    @property
+    def best(self) -> Tuple[Genome, float]:
+        i = int(np.argmax(self.fitnesses))
+        return self.genomes[i], self.fitnesses[i]
+
+
+def run_search(eval_fn: Callable[[Config], float], *, base: Optional[Config] = None,
+               population: int = 8, generations: int = 4, elite_frac: float = 0.25,
+               mutation_rate: float = 0.25, seed: int = 0,
+               space: Optional[Dict[str, Dict]] = None,
+               log_fn: Optional[Callable[[int, GenerationResult], None]] = None
+               ) -> List[GenerationResult]:
+    """Elitist GA: keep the top ``elite_frac``, refill by crossover of two
+    elites + mutation. Returns per-generation results (last one's ``best`` is
+    the answer)."""
+    rng = np.random.default_rng(seed)
+    base = base or Config()
+    space = space or GENETIC_SEARCH_SPACE
+    genomes = [sample_genome(rng, space) for _ in range(population)]
+    history: List[GenerationResult] = []
+    n_elite = max(1, int(population * elite_frac))
+
+    for gen in range(generations):
+        fitnesses = [float(eval_fn(genome_to_config(base, g))) for g in genomes]
+        result = GenerationResult(genomes, fitnesses)
+        history.append(result)
+        if log_fn:
+            log_fn(gen, result)
+        order = np.argsort(fitnesses)[::-1]
+        elites = [genomes[i] for i in order[:n_elite]]
+        children = []
+        while len(children) < population - n_elite:
+            a, b = rng.choice(n_elite, 2, replace=True)
+            children.append(
+                mutate(rng, crossover(rng, elites[a], elites[b]),
+                       mutation_rate, space))
+        genomes = elites + children
+    return history

@@ -1,0 +1,43 @@
+"""Parser for ``train_player{i}.log`` files.
+
+Key strings match the reference's ReplayBuffer.log emissions exactly
+(/root/reference/worker.py:220-234), which is also what the reference's
+plot.py regexes expect (/root/reference/plot.py:33-48) — so this parser reads
+logs from either framework.
+"""
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class ParsedLog:
+    buffer_sizes: List[float] = field(default_factory=list)
+    returns: List[float] = field(default_factory=list)        # per log interval
+    return_counts: List[int] = field(default_factory=list)    # interval index
+    losses: List[float] = field(default_factory=list)
+    loss_counts: List[int] = field(default_factory=list)
+    env_steps: List[float] = field(default_factory=list)
+    training_steps: List[float] = field(default_factory=list)
+
+
+def parse_log(path: str) -> ParsedLog:
+    out = ParsedLog()
+    count = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("buffer size:"):
+                out.buffer_sizes.append(float(line.split(":")[1]))
+                count += 1
+            elif line.startswith("average episode return:"):
+                out.returns.append(float(line.split(":")[1]))
+                out.return_counts.append(count)
+            elif line.startswith("loss:"):
+                out.losses.append(float(line.split(":")[1]))
+                out.loss_counts.append(count)
+            elif line.startswith("number of environment steps:"):
+                out.env_steps.append(float(line.split(":")[1]))
+            elif line.startswith("number of training steps:"):
+                out.training_steps.append(float(line.split(":")[1]))
+    return out

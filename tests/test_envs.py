@@ -1,0 +1,141 @@
+"""Environment-layer tests: fake env determinism, wrapper stack, factory
+gating, and the ViZDoom pure logic (DELTA expansion, action vectors, shaped
+reward, game args) — hermetic, no engine (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import EnvConfig
+from r2d2_tpu.envs import FakeR2D2Env, create_env
+from r2d2_tpu.envs.vizdoom_defs import (
+    MULTI_REWARD_SCENARIOS,
+    SCENARIOS,
+    build_action_vector,
+    expand_buttons,
+    host_game_args,
+    join_game_args,
+    shaped_multiplayer_reward,
+)
+from r2d2_tpu.envs.wrappers import ClipReward, GymnasiumAdapter, WarpFrame
+
+
+def test_fake_env_deterministic_and_learnable():
+    e1, e2 = FakeR2D2Env(seed=3), FakeR2D2Env(seed=3)
+    o1, o2 = e1.reset(), e2.reset()
+    np.testing.assert_array_equal(o1, o2)
+    r_total = 0.0
+    for t in range(e1.episode_len):
+        target = int(e1._schedule[e1.t])
+        obs, r, done, _ = e1.step(target)      # oracle policy gets reward 1
+        r_total += r
+    assert done and r_total == e1.episode_len
+
+
+def test_fake_env_wrapped_by_factory():
+    cfg = EnvConfig(game_name="Fake", frame_height=84, frame_width=84)
+    env = create_env(cfg, clip_rewards=True, seed=0)
+    obs = env.reset()
+    assert obs.shape == (84, 84) and obs.dtype == np.uint8
+    obs, r, done, info = env.step(env.action_space.sample())
+    assert -1.0 <= r <= 1.0
+
+
+def test_warpframe_grayscale_resize():
+    class RGBEnv:
+        class action_space:
+            n = 2
+        def reset(self):
+            return np.full((120, 160, 3), 100, np.uint8)
+        def step(self, a):
+            return np.full((120, 160, 3), 200, np.uint8), 5.0, False, {}
+        def close(self):
+            pass
+
+    env = WarpFrame(RGBEnv(), 84, 84)
+    obs = env.reset()
+    assert obs.shape == (84, 84) and obs.dtype == np.uint8
+    assert abs(int(obs.mean()) - 100) <= 2   # gray of uniform gray
+    obs, r, done, _ = env.step(0)
+    assert r == 5.0 and abs(int(obs.mean()) - 200) <= 2
+
+
+def test_clip_reward():
+    class E:
+        class action_space:
+            n = 1
+        def step(self, a):
+            return None, -3.7, False, {}
+    assert ClipReward(E()).step(0)[1] == -1.0
+
+
+def test_gymnasium_adapter_5tuple():
+    class G5:
+        class action_space:
+            n = 1
+        def reset(self):
+            return "obs", {"info": 1}
+        def step(self, a):
+            return "obs", 1.0, False, True, {}
+    env = GymnasiumAdapter(G5())
+    assert env.reset() == "obs"
+    obs, r, done, info = env.step(0)
+    assert done is True   # truncated folds into done
+
+
+# ---- ViZDoom pure logic (ref base_gym_env.py:114-154,190-214) ----
+
+
+def test_scenario_registry_complete():
+    assert len(SCENARIOS) == 14
+    assert SCENARIOS["VizdoomBasic-v0"] == "basic.cfg"
+    assert SCENARIOS["VizdoomBasicDeathmatch-v0"] == "multi.cfg"
+    assert SCENARIOS["VizdoomSingleDeathmatch-v0"] == "multi_single.cfg"
+    assert MULTI_REWARD_SCENARIOS == ("multi_single.cfg",)
+
+
+def test_delta_button_expansion():
+    names, nd = expand_buttons(["ATTACK", "TURN_LEFT_RIGHT_DELTA", "MOVE_LEFT"])
+    assert nd == 1
+    assert names == ["ATTACK", "TURN_LEFT_RIGHT_DELTA_POS_0",
+                     "TURN_LEFT_RIGHT_DELTA_NEG_0", "MOVE_LEFT"]
+
+
+@pytest.mark.parametrize("buttons,action,expected", [
+    # no deltas: plain one-hot (ref base_gym_env.py:153-154)
+    (["ATTACK", "MOVE_LEFT"], 1, [0, 1]),
+    # delta POS at expanded idx 1 → +1 in engine slot 1
+    (["ATTACK", "TURN_DELTA", "MOVE"], 1, [0, 1, 0]),
+    # delta NEG at expanded idx 2 → -1 in engine slot 1
+    (["ATTACK", "TURN_DELTA", "MOVE"], 2, [0, -1, 0]),
+    # expanded MOVE shifted by one: expanded idx 3 → engine slot 2
+    (["ATTACK", "TURN_DELTA", "MOVE"], 3, [0, 0, 1]),
+])
+def test_action_vectors(buttons, action, expected):
+    names, nd = expand_buttons(buttons)
+    assert build_action_vector(action, names, nd) == expected
+
+
+def test_shaped_multiplayer_reward_cases():
+    cfg = EnvConfig()
+    # (health, hits, ammo, frags)
+    base = (100, 0, 50, 0)
+    assert shaped_multiplayer_reward(base, (80, 0, 50, 0), cfg) == -20.0
+    assert shaped_multiplayer_reward(base, (0, 0, 50, 0), cfg) == -100.0
+    assert shaped_multiplayer_reward(base, (100, 0, 49, 0), cfg) == -5.0
+    assert shaped_multiplayer_reward(base, (100, 1, 50, 0), cfg) == 25.0
+    assert shaped_multiplayer_reward(base, (100, 0, 50, 1), cfg) == 100.0
+    # combo: hit + ammo spent
+    assert shaped_multiplayer_reward(base, (100, 1, 49, 0), cfg) == 20.0
+
+
+def test_game_args():
+    h = host_game_args(2, 5060)
+    assert "-host 2" in h and "-port 5060" in h and "-deathmatch" in h
+    assert "+sv_forcerespawn 1" in h and "+viz_nocheat 1" in h
+    assert join_game_args("127.0.0.1", 5061) == "-join 127.0.0.1 -port 5061"
+
+
+def test_vizdoom_gated_import():
+    cfg = EnvConfig(game_name="Vizdoom", env_type="Basic-v0")
+    with pytest.raises(ImportError, match="vizdoom"):
+        create_env(cfg)

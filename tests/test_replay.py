@@ -76,6 +76,27 @@ def test_local_buffer_full_block_metadata(rng):
     np.testing.assert_allclose(blk.reward.reshape(-1)[:20], want, rtol=1e-5)
 
 
+def test_first_block_hidden_at_window_start(rng):
+    """Episode-start blocks: the stored hidden must be the state at the
+    sequence's WINDOW start (seq_start - burn_in), not s*learning steps in.
+    The reference stores the latter (worker.py:459), handing the learner a
+    state that already consumed the burn-in it is about to replay — a
+    deliberate divergence fixed here."""
+    spec = make_spec()
+    buf = LocalBuffer(spec, A, gamma=0.9)
+    buf.reset(np.zeros((12, 12), np.uint8))
+    recs = drive(buf, rng, 20)
+    blk = buf.finish(last_qval=np.ones(A, np.float32))
+
+    # s=0: window start 0 -> initial zero state
+    np.testing.assert_array_equal(blk.hidden[0], 0.0)
+    # s=1: burn_in=min(5,4)=4, seq_start=5 -> window start 1 -> state after
+    # step 1 = recs[0]'s hidden
+    np.testing.assert_allclose(blk.hidden[1], recs[0][4], rtol=1e-6)
+    # s=2: burn_in=4, seq_start=10 -> window start 6 -> recs[5]'s hidden
+    np.testing.assert_allclose(blk.hidden[2], recs[5][4], rtol=1e-6)
+
+
 def test_local_buffer_episode_end_and_carry(rng):
     """Partial block at episode end: zeroed gamma tail, episode return
     reported, next episode restarts burn-in at 0 (ref worker.py:445-456)."""
@@ -209,8 +230,8 @@ def test_host_replay_matches_contract_and_staleness_guard(rng):
         host.add(blk)
     assert len(host) == 3 * spec.block_length
 
-    batch, old_ptr = host.sample()
-    assert old_ptr == 3
+    batch, snapshot = host.sample()
+    assert snapshot == 3
     assert batch.obs.shape == (
         spec.batch_size, spec.seq_window + spec.frame_stack - 1, 12, 12)
 
@@ -220,9 +241,27 @@ def test_host_replay_matches_contract_and_staleness_guard(rng):
         host.add(blk)  # ptr: 3..8 -> wraps, overwrites block 0
     leaf0 = 2**host.tree_layers // 2 - 1
     before = host.tree[leaf0 : leaf0 + spec.seqs_per_block].copy()
-    host.update_priorities(batch.idxes, np.full(spec.batch_size, 99.0), old_ptr)
+    host.update_priorities(batch.idxes, np.full(spec.batch_size, 99.0), snapshot)
     after = host.tree[leaf0 : leaf0 + spec.seqs_per_block]
     np.testing.assert_array_equal(before, after)
+
+
+def test_host_replay_guard_survives_full_ring_lap(rng):
+    """Exactly num_blocks adds between sample and write-back returns the ring
+    pointer to its snapshot value — the reference's pointer-equality guard
+    (worker.py:196-206) would apply every stale update; the monotonic
+    add-counter guard must drop them all."""
+    spec = make_spec()
+    host = HostReplay(spec, seed=0, use_native=False)
+    for blk in _fill_blocks(spec, 3, rng):
+        host.add(blk)
+    batch, snapshot = host.sample()
+    for blk in _fill_blocks(spec, spec.num_blocks, rng):  # full lap
+        host.add(blk)
+    assert host.block_ptr == 3  # pointer is back where it was
+    tree_before = host.tree.copy()
+    host.update_priorities(batch.idxes, np.full(spec.batch_size, 99.0), snapshot)
+    np.testing.assert_array_equal(host.tree, tree_before)
 
 
 def test_device_host_same_layout(rng):

@@ -1,0 +1,79 @@
+"""Tools tests: log parsing, genetic search mechanics (mock fitness), and the
+plot CLI on a synthetic reference-format log."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config, GENETIC_SEARCH_SPACE
+from r2d2_tpu.tools.genetic import (
+    genome_to_config, mutate, run_search, sample_genome)
+from r2d2_tpu.tools.logparse import parse_log
+
+
+def _write_reference_style_log(path, n=12):
+    """Emit exactly the reference's log line format (ref worker.py:220-234)."""
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(f"buffer size: {1000 + i * 100}\n")
+            f.write(f"buffer update speed: {50.0}/s\n")
+            f.write(f"number of environment steps: {i * 1000}\n")
+            if i % 2 == 0:
+                f.write(f"average episode return: {float(i):.4f}\n")
+            f.write(f"number of training steps: {i * 10}\n")
+            f.write("training speed: 0.5/s\n")
+            if i > 0:
+                f.write(f"loss: {1.0 / (i + 1):.4f}\n")
+
+
+def test_parse_reference_log(tmp_path):
+    path = str(tmp_path / "train_player0.log")
+    _write_reference_style_log(path)
+    log = parse_log(path)
+    assert len(log.buffer_sizes) == 12
+    assert len(log.returns) == 6 and log.returns[0] == 0.0
+    assert len(log.losses) == 11
+    assert log.return_counts[1] == 3  # third interval (0-based count after 3 'buffer size' lines)
+    assert log.env_steps[-1] == 11000
+
+
+def test_plot_cli(tmp_path):
+    _write_reference_style_log(str(tmp_path / "train_player0.log"))
+    _write_reference_style_log(str(tmp_path / "train_player1.log"))
+    out = str(tmp_path / "curves.png")
+    from r2d2_tpu.cli.plot import main
+    main(["--file_path", str(tmp_path), "--show_all", "--loss_interpolation",
+          "--out", out])
+    assert os.path.getsize(out) > 1000
+
+
+def test_genome_sampling_always_valid():
+    """Every sampled/mutated genome must construct a valid Config (the
+    layout-safe space contract)."""
+    rng = np.random.default_rng(0)
+    base = Config()
+    for _ in range(50):
+        g = sample_genome(rng)
+        g = mutate(rng, g, rate=0.5)
+        cfg = genome_to_config(base, g)      # __post_init__ validates
+        assert cfg.replay.block_length % cfg.sequence.learning_steps == 0
+        assert isinstance(cfg.network.hidden_dim, int)
+        assert isinstance(cfg.network.use_dueling, bool)
+
+
+def test_run_search_improves_mock_fitness():
+    """GA must climb a simple deterministic objective (closer lr to 3e-4 and
+    bigger hidden_dim is better)."""
+    def fitness(cfg: Config) -> float:
+        return (-abs(np.log10(cfg.optim.lr) - np.log10(3e-4))
+                + cfg.network.hidden_dim / 1024.0)
+
+    history = run_search(fitness, population=8, generations=5, seed=1)
+    first_best = history[0].best[1]
+    last_best = history[-1].best[1]
+    assert last_best >= first_best
+    # elitism: best fitness is monotonically non-decreasing
+    bests = [h.best[1] for h in history]
+    assert all(b2 >= b1 - 1e-9 for b1, b2 in zip(bests, bests[1:]))
