@@ -47,8 +47,19 @@ def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
     from r2d2_tpu.actor.policy import ActorPolicy
     from r2d2_tpu.envs.factory import create_env
     from r2d2_tpu.models.network import NetworkApply
-    from r2d2_tpu.runtime.checkpoint import restore_checkpoint
+    from r2d2_tpu.runtime.checkpoint import (
+        load_checkpoint_config, restore_checkpoint)
 
+    # the Config stored beside the checkpoint supplies the SHAPE-bearing
+    # sections (network architecture, env preprocessing, sequence windows) so
+    # the trained network reconstructs exactly (the reference instead trusts
+    # config.py to still match the .pth); evaluation-time settings —
+    # test_epsilon, multiplayer wiring, save_dir — stay with the CLI config
+    stored = load_checkpoint_config(ckpt_path)
+    if stored is not None:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, env=stored.env, network=stored.network,
+                                  sequence=stored.sequence)
     env = create_env(cfg.env, clip_rewards=False, testing=testing,
                      is_host=is_host, port=port, seed=seed)
     net = NetworkApply(env.action_space.n, cfg.network, cfg.env.frame_stack,
@@ -66,12 +77,17 @@ def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
 
 
 def main(argv=None) -> None:
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
     argv = list(sys.argv[1:] if argv is None else argv)
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--play", nargs="*", default=None,
                    help="checkpoint path(s) to replay (one per player)")
     p.add_argument("--rounds", type=int, default=5)
     p.add_argument("--player", type=int, default=0)
+    p.add_argument("--workers", type=int, default=5,
+                   help="concurrent checkpoint evaluations (the reference "
+                        "uses a 5-way multiprocessing pool, test.py:23)")
     p.add_argument("--out", default="eval_curve.png")
     args, config_overrides = p.parse_known_args(argv)
 
@@ -95,10 +111,17 @@ def main(argv=None) -> None:
         raise SystemExit(
             f"no checkpoints for game={cfg.env.game_name!r} "
             f"player={args.player} under {cfg.runtime.save_dir!r}")
+    # concurrent sweep (ref test.py:23 uses multiprocessing.Pool(5); here a
+    # thread pool — each worker holds its own env+policy, and the jitted CPU
+    # policy releases the GIL during execution)
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=max(1, args.workers)) as pool:
+        results = list(pool.map(
+            lambda item: evaluate_checkpoint(cfg, item[1], args.rounds,
+                                             seed=item[0]),
+            ckpts))
     rows = []
-    for idx, path in ckpts:
-        mean_ret, step, env_steps = evaluate_checkpoint(cfg, path, args.rounds,
-                                                        seed=idx)
+    for (idx, _), (mean_ret, step, env_steps) in zip(ckpts, results):
         rows.append((idx, step, env_steps, mean_ret))
         print(f"checkpoint {idx}: step={step} env_steps={env_steps} "
               f"mean_return={mean_ret:.2f}", flush=True)

@@ -38,6 +38,8 @@ def make_slice_eval(base_overrides, slice_steps: int, slice_seconds: float):
 
 
 def main(argv=None) -> None:
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
     argv = list(sys.argv[1:] if argv is None else argv)
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--population", type=int, default=6)

@@ -17,6 +17,8 @@ from r2d2_tpu.runtime.orchestrator import train
 
 
 def main(argv=None) -> None:
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
     argv = list(sys.argv[1:] if argv is None else argv)
     actor_mode, max_steps, max_seconds = "process", None, None
     rest = []

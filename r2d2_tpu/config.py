@@ -104,6 +104,9 @@ class OptimConfig:
     value_rescale_eps: float = 1e-2
     # Mixed-priority weights: eta*max + (1-eta)*mean (ref worker.py:246).
     priority_eta: float = 0.9
+    # Decode uint8 obs windows with the fused pallas kernel (TPU only;
+    # ops/pallas_kernels.py). Off = XLA gather path, correct everywhere.
+    pallas_obs_decode: bool = False
 
 
 @dataclass(frozen=True)
@@ -158,6 +161,10 @@ class RuntimeConfig:
     save_interval: int = 1_000       # learner steps between checkpoints
     log_interval: float = 20.0       # seconds between metric log lines
     weight_publish_interval: int = 2  # learner steps between weight publications
+    # Fused train steps per device dispatch (lax.scan). >1 amortizes host
+    # dispatch latency; weight publish / checkpoint cadence coarsens to
+    # dispatch boundaries. 1 = reference-faithful per-step cadence.
+    steps_per_dispatch: int = 1
     prefetch_batches: int = 4        # learner-side batch prefetch depth (ref worker.py:302)
     test_epsilon: float = 0.01
     seed: int = 0
@@ -234,6 +241,30 @@ class Config:
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2, sort_keys=True)
 
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Config":
+        """Inverse of to_dict (tuples round-trip through JSON lists)."""
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            sub = dict(d[f.name])
+            for key, value in sub.items():
+                if isinstance(value, list):
+                    sub[key] = tuple(
+                        tuple(x) if isinstance(x, list) else x for x in value)
+            kwargs[f.name] = _SECTION_TYPES[f.name](**sub)
+        return cls(**kwargs)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Config":
+        return cls.from_dict(json.loads(text))
+
+
+_SECTION_TYPES = {
+    "env": EnvConfig, "network": NetworkConfig, "sequence": SequenceConfig,
+    "replay": ReplayConfig, "optim": OptimConfig, "actor": ActorConfig,
+    "multiplayer": MultiplayerConfig, "mesh": MeshConfig,
+    "runtime": RuntimeConfig,
+}
 
 # Field annotations are strings (PEP 563 via `from __future__ import
 # annotations`); only scalar fields are CLI-settable.

@@ -5,6 +5,8 @@ from r2d2_tpu.learner.train_step import (
     create_train_state,
     make_learner_step,
     make_loss_fn,
+    make_multi_learner_step,
 )
 
-__all__ = ["TrainState", "create_train_state", "make_learner_step", "make_loss_fn"]
+__all__ = ["TrainState", "create_train_state", "make_learner_step",
+           "make_loss_fn", "make_multi_learner_step"]

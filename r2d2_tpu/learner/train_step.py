@@ -78,14 +78,15 @@ def create_train_state(key: jax.Array, net: NetworkApply, optim: OptimConfig
 
 
 def _unrolled_q(net: NetworkApply, spec: ReplaySpec, params,
-                batch: SampleBatch) -> jnp.ndarray:
+                batch: SampleBatch, use_pallas: bool = False) -> jnp.ndarray:
     """Decode the storage-format batch and unroll the network: uint8 frame
-    rows → stacked normalized obs (B,T,H,W,K), action indices → one-hot
+    rows → stacked normalized obs (B,T,H,W,K) (fused pallas kernel on TPU,
+    jnp gather elsewhere — ops/pallas_kernels.py), action indices → one-hot
     (-1 encodes the null action as zeros), then the full-window unroll from
     the stored hidden state. Returns (B, T, A) f32 Q-values."""
-    fsi = frame_stack_indices(spec.seq_window, spec.frame_stack)   # (T, K)
-    stacked = batch.obs[:, fsi]                                     # (B,T,K,H,W)
-    stacked = stacked.transpose(0, 1, 3, 4, 2).astype(jnp.float32) / 255.0
+    from r2d2_tpu.ops.pallas_kernels import stack_frames
+    stacked = stack_frames(batch.obs, spec.seq_window, spec.frame_stack,
+                           use_pallas=use_pallas)
     last_action = jax.nn.one_hot(batch.last_action, net.action_dim,
                                  dtype=jnp.float32)
     q, _ = net.module.apply(params, stacked, last_action, batch.hidden)
@@ -97,8 +98,10 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
     """Returns loss(params, target_params, batch) -> (loss, aux). Pure —
     shared by the single-chip jit, the shard_map path, and the tests."""
 
+    use_pallas = optim.pallas_obs_decode
+
     def loss_fn(params, target_params, batch: SampleBatch):
-        q_online = _unrolled_q(net, spec, params, batch)            # (B,T,A)
+        q_online = _unrolled_q(net, spec, params, batch, use_pallas)  # (B,T,A)
 
         tpos = target_q_positions(batch.burn_in_steps, batch.learning_steps,
                                   batch.forward_steps, spec.learning, spec.forward)
@@ -110,7 +113,8 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
             jnp.take_along_axis(q_online, tpos[:, :, None], axis=1))  # (B,L,A)
         if use_double:
             a_star = jnp.argmax(q_online_tn, axis=-1)               # (B,L)
-            q_target_all = _unrolled_q(net, spec, target_params, batch)
+            q_target_all = _unrolled_q(net, spec, target_params, batch,
+                                       use_pallas)
             q_target_tn = jnp.take_along_axis(q_target_all, tpos[:, :, None], axis=1)
             q_next = jnp.take_along_axis(
                 q_target_tn, a_star[:, :, None], axis=2)[:, :, 0]
@@ -203,3 +207,76 @@ def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
     if jit:
         return jax.jit(step, donate_argnums=(0, 1))
     return step
+
+
+def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
+                             optim: OptimConfig, use_double: bool):
+    """Train step for host-placement replay (config replay.placement="host"):
+    the batch is sampled by HostReplay on the CPU (native C++ sum tree) and
+    fed across the host boundary, mirroring the reference's architecture
+    (/root/reference/worker.py:299-306) minus Ray. Returns
+    (train_state, metrics) — priorities in metrics["priorities"] go back to
+    the host tree asynchronously, guarded by HostReplay's staleness check.
+    """
+    loss_fn = make_loss_fn(net, spec, optim, use_double)
+    tx = make_optimizer(optim)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def step(train_state: TrainState, batch: SampleBatch):
+        (loss, aux), grads = grad_fn(
+            train_state.params, train_state.target_params, batch)
+        updates, opt_state = tx.update(grads, train_state.opt_state,
+                                       train_state.params)
+        params = optax.apply_updates(train_state.params, updates)
+
+        new_step = train_state.step + 1
+        if use_double:
+            sync = (new_step % optim.target_net_update_interval) == 0
+            target_params = jax.tree_util.tree_map(
+                lambda p, t: jnp.where(sync, p, t), params,
+                train_state.target_params)
+        else:
+            target_params = train_state.target_params
+
+        metrics = {
+            "loss": loss,
+            "priorities": aux["priorities"],
+            "mean_abs_td": aux["mean_abs_td"],
+            "mean_q": aux["mean_q"],
+            "grad_norm": optax.global_norm(grads),
+        }
+        train_state = train_state.replace(
+            params=params, target_params=target_params,
+            opt_state=opt_state, step=new_step, key=train_state.key)
+        return train_state, metrics
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def make_multi_learner_step(net: NetworkApply, spec: ReplaySpec,
+                            optim: OptimConfig, use_double: bool,
+                            steps_per_dispatch: int):
+    """K fused steps per dispatch via lax.scan — one host round-trip buys K
+    training steps.
+
+    The reference pays a Ray RPC and a GPU sync per step by construction
+    (/root/reference/worker.py:303,348); on TPU the remaining per-step cost
+    is the host dispatch itself, which this amortizes. Semantics are
+    identical to K calls of the single step (same RNG chain, same per-step
+    target-sync schedule via the carried step counter); only the host-side
+    observation points (weight publish, checkpoint) coarsen to dispatch
+    boundaries. Returns stacked (K,) metrics per dispatch.
+    """
+    inner = make_learner_step(net, spec, optim, use_double, jit=False)
+
+    def multi_step(train_state: TrainState, replay_state: ReplayState):
+        def body(carry, _):
+            ts, rs = carry
+            ts, rs, m = inner(ts, rs)
+            return (ts, rs), m
+
+        (train_state, replay_state), metrics = jax.lax.scan(
+            body, (train_state, replay_state), None, length=steps_per_dispatch)
+        return train_state, replay_state, metrics
+
+    return jax.jit(multi_step, donate_argnums=(0, 1))

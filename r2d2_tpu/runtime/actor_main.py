@@ -15,7 +15,10 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                        epsilon: float, shm_name: str, queue, stop_event,
                        is_host: bool, port: int) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    # late imports: only after the platform pin
+    # late imports: only after the platform pin; jax.config route as well —
+    # a wedged accelerator plugin can hang discovery despite the env var
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
     import jax
     import numpy as np
 
@@ -26,7 +29,7 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
     from r2d2_tpu.runtime.actor_loop import run_actor
     from r2d2_tpu.runtime.weights import WeightSubscriber
 
-    cfg = _config_from_dict(cfg_dict)
+    cfg = Config.from_dict(cfg_dict)
     seed = cfg.runtime.seed + 10_000 * player_idx + 100 * actor_idx
     env = create_env(cfg.env, clip_rewards=True, is_host=is_host, port=port,
                      num_players=cfg.multiplayer.num_players,
@@ -48,22 +51,3 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
     finally:
         sub.close()
         env.close()
-
-
-def _config_from_dict(d: dict):
-    from r2d2_tpu.config import (ActorConfig, Config, EnvConfig, MeshConfig,
-                                 MultiplayerConfig, NetworkConfig, OptimConfig,
-                                 ReplayConfig, RuntimeConfig, SequenceConfig)
-    sections = dict(
-        env=EnvConfig, network=NetworkConfig, sequence=SequenceConfig,
-        replay=ReplayConfig, optim=OptimConfig, actor=ActorConfig,
-        multiplayer=MultiplayerConfig, mesh=MeshConfig, runtime=RuntimeConfig)
-    kwargs = {}
-    for name, cls in sections.items():
-        sub = dict(d[name])
-        # tuples serialized as lists by asdict/json
-        for k, v in sub.items():
-            if isinstance(v, list):
-                sub[k] = tuple(tuple(x) if isinstance(x, list) else x for x in v)
-        kwargs[name] = cls(**sub)
-    return Config(**kwargs)

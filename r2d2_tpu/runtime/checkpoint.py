@@ -28,7 +28,7 @@ def _ckpt_dir(save_dir: str, game: str, index: int, player: int) -> str:
 
 def save_checkpoint(save_dir: str, game: str, index: int, player: int,
                     params, opt_state, target_params, step: int,
-                    env_steps: int) -> str:
+                    env_steps: int, config_json: Optional[str] = None) -> str:
     path = _ckpt_dir(save_dir, game, index, player)
     ckptr = ocp.PyTreeCheckpointer()
     payload = {
@@ -39,7 +39,23 @@ def save_checkpoint(save_dir: str, game: str, index: int, player: int,
         "env_steps": np.asarray(env_steps, np.int64),
     }
     ckptr.save(path, payload, force=True)
+    if config_json is not None:
+        # the training Config rides next to the weights so evaluation can
+        # rebuild the exact network (the reference's checkpoints silently
+        # depend on config.py not having changed since training)
+        with open(path + ".config.json", "w") as f:
+            f.write(config_json)
     return path
+
+
+def load_checkpoint_config(path: str):
+    """Config stored by save_checkpoint, or None for config-less checkpoints."""
+    cfg_path = os.path.abspath(path) + ".config.json"
+    if not os.path.exists(cfg_path):
+        return None
+    from r2d2_tpu.config import Config
+    with open(cfg_path) as f:
+        return Config.from_json(f.read())
 
 
 def restore_checkpoint(path: str, template: Optional[Dict[str, Any]] = None

@@ -2,16 +2,24 @@
 
 The reference Learner is a Ray GPU actor with a prefetch thread pulling
 batches over RPC and a train thread running torch ops
-(/root/reference/worker.py:251-390). Here batches never cross the host
-boundary — the fused step samples in HBM — so the host loop is thin: drain
-the feeder queue (jitted ring-writes), gate on learning_starts, dispatch
-steps, publish weights, checkpoint, count metrics.
+(/root/reference/worker.py:251-390). Two placements here
+(config replay.placement):
 
-Ingestion between steps is the only add/sample interleaving point, which is
-what makes the fused step's priority write-back race-free (see
-replay/device_replay.py).
+  * "device" (default): batches never cross the host boundary — the fused
+    step samples in HBM — so the host loop is thin: drain the feeder queue
+    (jitted ring-writes), gate on learning_starts, dispatch steps, publish
+    weights, checkpoint, count metrics. Ingestion between steps is the only
+    add/sample interleaving point, which is what makes the fused step's
+    priority write-back race-free (see replay/device_replay.py).
+  * "host": the reference's architecture minus Ray — numpy ring + native C++
+    sum tree on the CPU, a prefetch thread keeping ``prefetch_batches``
+    device-resident batches in flight (ref worker.py:292-306), and an async
+    priority write-back thread guarded by the staleness check
+    (ref worker.py:368,192-209).
 """
 
+import queue as queue_mod
+import threading
 import time
 from typing import Callable, Optional
 
@@ -20,9 +28,11 @@ import numpy as np
 
 from r2d2_tpu.config import Config
 from r2d2_tpu.learner.train_step import (
-    TrainState, create_train_state, make_learner_step)
+    TrainState, create_train_state, make_external_batch_step,
+    make_learner_step, make_multi_learner_step)
 from r2d2_tpu.models.network import NetworkApply
 from r2d2_tpu.replay.device_replay import replay_add, replay_init
+from r2d2_tpu.replay.host_replay import HostReplay
 from r2d2_tpu.replay.structs import Block, ReplaySpec
 from r2d2_tpu.runtime.checkpoint import load_pretrain, save_checkpoint
 from r2d2_tpu.runtime.metrics import TrainMetrics
@@ -44,9 +54,34 @@ class Learner:
             self.train_state = self.train_state.replace(
                 params=params,
                 target_params=jax.tree_util.tree_map(np.copy, params))
-        self.replay_state = replay_init(self.spec)
-        self._step_fn = make_learner_step(
-            net, self.spec, cfg.optim, cfg.network.use_double)
+        self.host_mode = cfg.replay.placement == "host"
+        if self.host_mode:
+            if cfg.runtime.steps_per_dispatch > 1:
+                raise ValueError(
+                    "runtime.steps_per_dispatch > 1 requires the device "
+                    "replay placement (each host-mode step consumes one "
+                    "host-sampled batch); set replay.placement='device' or "
+                    "steps_per_dispatch=1")
+            self._k = 1
+            self._bg_error: Optional[BaseException] = None
+            self.replay_state = None
+            self.host_replay = HostReplay(self.spec, seed=seed)
+            self._step_fn = make_external_batch_step(
+                net, self.spec, cfg.optim, cfg.network.use_double)
+            self._prefetch_q: queue_mod.Queue = queue_mod.Queue(
+                maxsize=max(1, cfg.runtime.prefetch_batches))
+            self._writeback_q: queue_mod.Queue = queue_mod.Queue(maxsize=64)
+            self._bg_stop = threading.Event()
+            self._bg_threads: list = []
+        else:
+            self.replay_state = replay_init(self.spec)
+            self._k = max(1, cfg.runtime.steps_per_dispatch)
+            if self._k > 1:
+                self._step_fn = make_multi_learner_step(
+                    net, self.spec, cfg.optim, cfg.network.use_double, self._k)
+            else:
+                self._step_fn = make_learner_step(
+                    net, self.spec, cfg.optim, cfg.network.use_double)
 
         self.metrics = metrics or TrainMetrics(player_idx, cfg.runtime.save_dir)
         self.publish: Optional[Callable] = None   # wired by orchestrator
@@ -65,11 +100,15 @@ class Learner:
     # -- ingestion --
 
     def ingest(self, block: Block) -> None:
-        """Jitted ring-write of one actor block (ref worker.py:85-120).
-        Purely async on device — all counter accounting uses host mirrors."""
+        """Ring-write of one actor block (ref worker.py:85-120) — jitted on
+        device, or into the host replay. All counter accounting uses host
+        mirrors so the device path never blocks."""
         learning = int(np.asarray(block.learning_steps).sum())
         ptr = self._host_ptr
-        self.replay_state = replay_add(self.spec, self.replay_state, block)
+        if self.host_mode:
+            self.host_replay.add(block)
+        else:
+            self.replay_state = replay_add(self.spec, self.replay_state, block)
         # ring overwrite: subtract the steps previously in this slot
         self.buffer_steps += learning - self._slot_steps[ptr]
         self._slot_steps[ptr] = learning
@@ -95,22 +134,97 @@ class Learner:
         """Host-mirrored step counter (no device sync)."""
         return self._host_step
 
+    # -- host-placement pipeline (ref worker.py:292-306,368) --
+
+    def _start_background(self) -> None:
+        def prefetch():
+            try:
+                while not self._bg_stop.is_set():
+                    batch, snapshot = self.host_replay.sample()
+                    dev = jax.device_put(batch)
+                    while not self._bg_stop.is_set():
+                        try:
+                            self._prefetch_q.put((dev, snapshot), timeout=0.5)
+                            break
+                        except queue_mod.Full:
+                            continue
+            except BaseException as e:  # surfaced by _host_step_once
+                self._bg_error = e
+                raise
+
+        def writeback():
+            try:
+                while not self._bg_stop.is_set():
+                    try:
+                        idxes, prios, snapshot = self._writeback_q.get(timeout=0.5)
+                    except queue_mod.Empty:
+                        continue
+                    self.host_replay.update_priorities(
+                        np.asarray(idxes), np.asarray(jax.device_get(prios)),
+                        snapshot)
+            except BaseException as e:
+                self._bg_error = e
+                raise
+
+        for fn, name in ((prefetch, "prefetch"), (writeback, "prio-writeback")):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"learner-{name}-p{self.player_idx}")
+            t.start()
+            self._bg_threads.append(t)
+
+    def stop_background(self) -> None:
+        if self.host_mode:
+            self._bg_stop.set()
+
+    def _host_step_once(self) -> dict:
+        if not self._bg_threads:
+            self._start_background()
+        while True:
+            try:
+                batch, snapshot = self._prefetch_q.get(timeout=2.0)
+                break
+            except queue_mod.Empty:
+                # fail loudly instead of hanging if a pipeline thread died
+                if self._bg_error is not None:
+                    raise RuntimeError(
+                        "host-replay pipeline thread died"
+                    ) from self._bg_error
+                if not any(t.is_alive() for t in self._bg_threads):
+                    raise RuntimeError(
+                        "host-replay pipeline threads exited without error")
+        self.train_state, m = self._step_fn(self.train_state, batch)
+        # async priority write-back (ref worker.py:368); staleness-guarded
+        try:
+            self._writeback_q.put_nowait(
+                (batch.idxes, m.pop("priorities"), snapshot))
+        except queue_mod.Full:
+            m.pop("priorities", None)   # drop under backpressure
+        return m
+
     # -- training --
 
     def step(self) -> dict:
-        """One fused device step. Never blocks on the device: metrics stay
-        device arrays until flush_metrics() (called at log time); the step
-        counter is host-mirrored."""
-        self.train_state, self.replay_state, m = self._step_fn(
-            self.train_state, self.replay_state)
-        self._host_step += 1
+        """One device dispatch = ``steps_per_dispatch`` fused steps. Never
+        blocks on the device: metrics stay device arrays until
+        flush_metrics() (called at log time); the step counter is
+        host-mirrored. Publish/checkpoint fire when their interval boundary
+        falls inside the dispatched step range."""
+        prev = self._host_step
+        if self.host_mode:
+            m = self._host_step_once()
+        else:
+            self.train_state, self.replay_state, m = self._step_fn(
+                self.train_state, self.replay_state)
+        self._host_step += self._k
         step = self._host_step
-        self._pending_losses.append(m["loss"])
+        self._pending_losses.append(m["loss"])  # scalar (k=1) or (k,) array
 
         rt = self.cfg.runtime
-        if self.publish is not None and step % rt.weight_publish_interval == 0:
+        if (self.publish is not None
+                and step // rt.weight_publish_interval
+                    > prev // rt.weight_publish_interval):
             self.publish(self.train_state.params)
-        if rt.save_interval and step % rt.save_interval == 0:
+        if rt.save_interval and step // rt.save_interval > prev // rt.save_interval:
             self.save(step // rt.save_interval)
         return m
 
@@ -118,17 +232,17 @@ class Learner:
         """Convert accumulated device losses to host floats (ONE sync for the
         whole interval) and feed the training counters."""
         if self._pending_losses:
-            losses = np.asarray(jax.device_get(self._pending_losses))
-            for loss in losses:
-                self.metrics.on_train_step(float(loss))
+            arrays = jax.device_get(self._pending_losses)
             self._pending_losses.clear()
+            for loss in np.concatenate([np.atleast_1d(a) for a in arrays]):
+                self.metrics.on_train_step(float(loss))
 
     def save(self, index: int) -> str:
         ts = self.train_state
         return save_checkpoint(
             self.cfg.runtime.save_dir, self.cfg.env.game_name, index,
             self.player_idx, ts.params, ts.opt_state, ts.target_params,
-            int(ts.step), self.env_steps)
+            int(ts.step), self.env_steps, config_json=self.cfg.to_json())
 
     def run(self, queue, should_stop: Callable[[], bool],
             max_steps: Optional[int] = None) -> int:

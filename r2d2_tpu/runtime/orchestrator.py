@@ -61,53 +61,86 @@ class PlayerStack:
         return dict(is_host=self.player_idx == 0, port=mpc.port(actor_idx))
 
     def start_actors_threads(self, stop: threading.Event) -> None:
-        from r2d2_tpu.actor.policy import ActorPolicy
         cfg = self.cfg
         self.store = InProcWeightStore(self.learner.train_state.params)
         self.learner.publish = self.store.publish
         self.queue = BlockQueue(use_mp=False)
+        self._stop = stop
         for i in range(cfg.actor.num_actors):
-            eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
-                               cfg.actor.eps_alpha)
-            seed = cfg.runtime.seed + 10_000 * self.player_idx + 100 * i
-            env = create_env(cfg.env, clip_rewards=True, seed=seed,
-                             num_players=cfg.multiplayer.num_players,
-                             name=f"p{self.player_idx}a{i}",
-                             **self.actor_env_args(i))
-            policy = ActorPolicy(self.net, self.learner.train_state.params,
-                                 eps, seed=seed)
-            reader_id = i
+            self._spawn_thread_actor(i)
 
-            def loop(env=env, policy=policy, reader_id=reader_id):
-                run_actor(cfg, env, policy,
-                          block_sink=lambda b: self.queue.put(b, timeout=60.0),
-                          weight_poll=lambda: self.store.poll(reader_id),
-                          should_stop=stop.is_set)
+    def _spawn_thread_actor(self, i: int) -> None:
+        from r2d2_tpu.actor.policy import ActorPolicy
+        cfg = self.cfg
+        eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
+                           cfg.actor.eps_alpha)
+        seed = cfg.runtime.seed + 10_000 * self.player_idx + 100 * i
+        env = create_env(cfg.env, clip_rewards=True, seed=seed,
+                         num_players=cfg.multiplayer.num_players,
+                         name=f"p{self.player_idx}a{i}",
+                         **self.actor_env_args(i))
+        policy = ActorPolicy(self.net, self.learner.train_state.params,
+                             eps, seed=seed)
 
-            t = threading.Thread(target=loop, daemon=True,
-                                 name=f"actor-p{self.player_idx}-{i}")
-            t.start()
+        def loop(env=env, policy=policy, reader_id=i):
+            run_actor(cfg, env, policy,
+                      block_sink=lambda b: self.queue.put(b, timeout=60.0),
+                      weight_poll=lambda: self.store.poll(reader_id),
+                      should_stop=self._stop.is_set)
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"actor-p{self.player_idx}-{i}")
+        t.start()
+        if i < len(self.threads):
+            self.threads[i] = t
+        else:
             self.threads.append(t)
 
     def start_actors_processes(self, stop_event) -> None:
         cfg = self.cfg
-        ctx = mp.get_context("spawn")
+        self._ctx = mp.get_context("spawn")
         self.publisher = WeightPublisher(self.learner.train_state.params)
         self.learner.publish = self.publisher.publish
-        self.queue = BlockQueue(use_mp=True, ctx=ctx)
+        self.queue = BlockQueue(use_mp=True, ctx=self._ctx)
+        self._stop = stop_event
         for i in range(cfg.actor.num_actors):
-            eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
-                               cfg.actor.eps_alpha)
-            p = ctx.Process(
-                target=actor_process_main,
-                args=(cfg.to_dict(), self.player_idx, i, eps,
-                      self.publisher.name, self.queue._q, stop_event),
-                kwargs=self.actor_env_args(i),
-                daemon=True, name=f"actor-p{self.player_idx}-{i}")
-            p.start()
+            self._spawn_process_actor(i)
+
+    def _spawn_process_actor(self, i: int) -> None:
+        cfg = self.cfg
+        eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
+                           cfg.actor.eps_alpha)
+        p = self._ctx.Process(
+            target=actor_process_main,
+            args=(cfg.to_dict(), self.player_idx, i, eps,
+                  self.publisher.name, self.queue._q, self._stop),
+            kwargs=self.actor_env_args(i),
+            daemon=True, name=f"actor-p{self.player_idx}-{i}")
+        p.start()
+        if i < len(self.processes):
+            self.processes[i] = p
+        else:
             self.processes.append(p)
 
+    def supervise(self) -> int:
+        """Restart dead actors (the reference has no failure handling at all
+        — a crashed Ray actor silently reduces throughput forever, SURVEY
+        §5.3). Returns the number of restarts performed."""
+        if not self.cfg.runtime.restart_dead_actors or self._stop.is_set():
+            return 0
+        restarted = 0
+        for i, t in enumerate(self.threads):
+            if not t.is_alive():
+                self._spawn_thread_actor(i)
+                restarted += 1
+        for i, p in enumerate(self.processes):
+            if not p.is_alive():
+                self._spawn_process_actor(i)
+                restarted += 1
+        return restarted
+
     def close(self) -> None:
+        self.learner.stop_background()
         if self.publisher is not None:
             self.publisher.close()
         for p in self.processes:
@@ -162,6 +195,13 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
             if cfg.runtime.save_interval:
                 st.learner.save(0)
 
+        # optional jax.profiler trace of the first training interval
+        # (SURVEY §5.1 — the reference has no profiling at all)
+        profiling = bool(cfg.runtime.profile_dir)
+        if profiling:
+            jax.profiler.start_trace(cfg.runtime.profile_dir)
+            profile_until = time.time() + min(cfg.runtime.log_interval, 30.0)
+
         while (not timed_out()
                and any(st.learner.training_steps < max_steps for st in stacks)):
             for st in stacks:
@@ -169,13 +209,19 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
                 if st.learner.ready and st.learner.training_steps < max_steps:
                     st.learner.step()
             now = time.time()
+            if profiling and now > profile_until:
+                jax.profiler.stop_trace()
+                profiling = False
             if now - last_log >= cfg.runtime.log_interval:
                 for st in stacks:
                     st.learner.flush_metrics()
+                    st.supervise()
                     record = st.metrics.log(now - last_log)
                     if log_fn:
                         log_fn({"player": st.player_idx, **record})
                 last_log = now
+        if profiling:
+            jax.profiler.stop_trace()
         for st in stacks:
             st.learner.flush_metrics()
     finally:

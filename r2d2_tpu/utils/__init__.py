@@ -1,0 +1,5 @@
+"""Small shared utilities."""
+
+from r2d2_tpu.utils.platform import pin_platform
+
+__all__ = ["pin_platform"]

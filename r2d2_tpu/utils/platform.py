@@ -1,0 +1,26 @@
+"""JAX platform pinning that actually sticks.
+
+A PJRT plugin registered via site hooks (e.g. a remote-TPU tunnel plugin) can
+hang *platform discovery* itself when its backend is unreachable — even when
+``JAX_PLATFORMS`` excludes it, because the env var filters after the plugin
+initializes. Routing the same request through ``jax.config`` filters before
+any backend init, so a CPU-pinned process (actor subprocess, test runner,
+CPU-only CLI run) never touches the accelerator plugin.
+"""
+
+import os
+from typing import Optional
+
+
+def pin_platform(platform: Optional[str] = None) -> None:
+    """Apply ``platform`` (default: the JAX_PLATFORMS env var) through
+    jax.config. No-op if no request or if a backend already initialized."""
+    platform = platform or os.environ.get("JAX_PLATFORMS")
+    if not platform:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except Exception:
+        pass  # backends already initialized; the env var governed them

@@ -41,3 +41,14 @@ def test_evaluate_checkpoint_sweep(tmp_path):
     mean_ret, step, env_steps = evaluate_checkpoint(cfg, ckpts[-1][1], rounds=2)
     assert np.isfinite(mean_ret)
     assert step >= 0 and env_steps >= 0
+
+    # the full CLI sweep path: thread-pool evaluation + curve plot
+    from r2d2_tpu.cli.evaluate import main
+    out = str(tmp_path / "eval_curve.png")
+    main(["--rounds", "1", "--workers", "2", "--out", out,
+          "--env.game_name=Fake", "--env.frame_height=24",
+          "--env.frame_width=24", "--env.frame_stack=2",
+          "--network.hidden_dim=16", "--network.cnn_out_dim=32",
+          f"--runtime.save_dir={tmp_path}"])
+    import os
+    assert os.path.getsize(out) > 1000

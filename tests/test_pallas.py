@@ -1,0 +1,35 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh — the real lowering
+runs on TPU; bench.py compares both paths there)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2_tpu.ops.pallas_kernels import (
+    stack_frames_pallas, stack_frames_reference)
+
+
+def test_stack_frames_pallas_matches_reference(rng):
+    B, T, K, H, W = 3, 7, 4, 12, 16
+    obs = jnp.asarray(rng.integers(0, 255, (B, T + K - 1 + 2, H, W)),
+                      jnp.uint8)  # +2: row longer than the window, like replay
+    want = np.asarray(stack_frames_reference(obs, T, K))
+    got = np.asarray(stack_frames_pallas(obs, T, K, True))
+    assert got.shape == (B, T, H, W, K)
+    # kernel multiplies by 1/255 (one VPU op) vs the reference's divide —
+    # identical up to one ulp
+    np.testing.assert_allclose(got, want, rtol=2e-7)
+    assert got.dtype == np.float32
+    assert got.max() <= 1.0 and got.min() >= 0.0
+
+
+def test_stack_frames_reference_window_semantics(rng):
+    """out[b, t, :, :, k] must be frame t+k (the learner-side obs_idx gather,
+    ref worker.py:310,330)."""
+    B, T, K, H, W = 1, 4, 2, 6, 6
+    obs = jnp.asarray(rng.integers(0, 255, (B, T + K - 1, H, W)), jnp.uint8)
+    out = np.asarray(stack_frames_reference(obs, T, K))
+    for t in range(T):
+        for k in range(K):
+            np.testing.assert_allclose(
+                out[0, t, :, :, k], np.asarray(obs[0, t + k], np.float32) / 255.0)

@@ -150,6 +150,52 @@ def test_loss_matches_naive_ragged_oracle(rng):
     assert float(loss) == pytest.approx(naive_loss, rel=2e-4)
 
 
+def test_multi_step_dispatch_matches_single_steps(rng):
+    """K fused steps per dispatch (lax.scan) must reproduce K sequential
+    single-step dispatches exactly — same RNG chain, same updates."""
+    from r2d2_tpu.learner import make_multi_learner_step
+
+    spec = make_spec(batch_size=8)
+    net, _ = _net(spec)
+
+    ts_a = create_train_state(jax.random.PRNGKey(5), net, OPT)
+    rs_a = _filled_replay(spec, np.random.default_rng(0))
+    single = make_learner_step(net, spec, OPT, use_double=False)
+    losses_a = []
+    for _ in range(4):
+        ts_a, rs_a, m = single(ts_a, rs_a)
+        losses_a.append(float(m["loss"]))
+
+    ts_b = create_train_state(jax.random.PRNGKey(5), net, OPT)
+    rs_b = _filled_replay(spec, np.random.default_rng(0))
+    multi = make_multi_learner_step(net, spec, OPT, use_double=False,
+                                    steps_per_dispatch=4)
+    ts_b, rs_b, m = multi(ts_b, rs_b)
+    losses_b = [float(x) for x in np.asarray(m["loss"])]
+
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ts_a.params),
+                    jax.tree_util.tree_leaves(ts_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rs_a.tree), np.asarray(rs_b.tree),
+                               rtol=1e-5)
+
+
+def test_long_sequence_window_is_config_change(rng):
+    """Long-context scaling (SURVEY §5.7): a 4x longer BPTT window — burn-in
+    16, learning 20, n-step 4 (window 40 vs the small specs' 12) — is purely
+    a spec change; static shapes keep the same compiled structure (scan body
+    compiles once regardless of length)."""
+    spec = make_spec(burn_in=16, learning=20, forward=4, block_length=40,
+                     seqs_per_block=2, batch_size=4)
+    net, _ = _net(spec)
+    ts = create_train_state(jax.random.PRNGKey(9), net, OPT)
+    rs = _filled_replay(spec, rng, n_blocks=2)
+    step = make_learner_step(net, spec, OPT, use_double=False)
+    ts, rs, m = step(ts, rs)
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_bf16_and_double_compile(rng):
     spec = make_spec(batch_size=4)
     cfg = NetworkConfig(hidden_dim=spec.hidden_dim, cnn_out_dim=16,
