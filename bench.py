@@ -16,13 +16,38 @@ baseline estimate used here until a measured reference log is available.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
+import dataclasses
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 REFERENCE_SEQ_UPDATES_PER_SEC = 640.0  # ~5 train steps/s * batch 128 (see above)
+
+
+def init_backend_or_die():
+    """Initialize the JAX backend up front with actionable diagnostics —
+    round 1 died with a bare 'Unable to initialize backend' when the remote
+    TPU tunnel was wedged by an earlier hard-killed process."""
+    import jax
+
+    try:
+        devs = jax.devices()
+    except RuntimeError as e:
+        print(
+            "bench: JAX backend init FAILED.\n"
+            f"  error: {e}\n"
+            f"  JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')!r}\n"
+            "  If this is the remote-TPU tunnel: a previously killed "
+            "TPU-holding process can wedge the tunnel until the environment "
+            "resets; retry later or run with JAX_PLATFORMS=cpu for a "
+            "smoke-only number.",
+            file=sys.stderr)
+        sys.exit(1)
+    print(f"backend: {devs[0].platform} x{len(devs)}", file=sys.stderr)
+    return devs
 
 
 def make_synthetic_block(spec, rng):
@@ -48,7 +73,38 @@ def make_synthetic_block(spec, rng):
     )
 
 
+def measure_path(step, ts, rs, label: str, n_timed: int = 30):
+    """Compile, warm up, and time one step function. Returns
+    (seq_updates_per_sec, ts, rs) — threading state through so the two
+    decode paths reuse the same filled replay ring."""
+    import jax
+
+    t0 = time.time()
+    ts, rs, m = step(ts, rs)
+    jax.block_until_ready(m["loss"])
+    print(f"[{label}] compile + first step: {time.time()-t0:.1f}s "
+          f"loss={float(m['loss']):.5f}", file=sys.stderr)
+
+    for _ in range(3):  # warmup
+        ts, rs, m = step(ts, rs)
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.time()
+    for _ in range(n_timed):
+        ts, rs, m = step(ts, rs)
+    jax.block_until_ready(m["loss"])
+    dt = time.time() - t0
+
+    steps_per_sec = n_timed / dt
+    print(f"[{label}] {steps_per_sec:.2f} train steps/s; "
+          f"loss={float(m['loss']):.5f}", file=sys.stderr)
+    return steps_per_sec, ts, rs
+
+
 def main() -> None:
+    devs = init_backend_or_die()
+    on_tpu = devs[0].platform not in ("cpu",)
+
     import jax
 
     from r2d2_tpu.config import Config
@@ -74,34 +130,42 @@ def main() -> None:
     print(f"filled {spec.num_blocks} blocks in {time.time()-t0:.1f}s",
           file=sys.stderr)
 
-    step = make_learner_step(net, spec, cfg.optim, cfg.network.use_double)
+    # A/B the two obs-decode paths (VERDICT r1 #5): XLA gather vs the fused
+    # pallas VMEM kernel (ops/pallas_kernels.py). Pallas compiles on TPU only.
+    results = {}
+    for label, use_pallas in (("xla_decode", False), ("pallas_decode", True)):
+        if use_pallas and not on_tpu:
+            results[label] = None
+            print(f"[{label}] skipped: pallas needs a TPU backend "
+                  f"(have {devs[0].platform})", file=sys.stderr)
+            continue
+        opt = dataclasses.replace(cfg.optim, pallas_obs_decode=use_pallas)
+        step = make_learner_step(net, spec, opt, cfg.network.use_double)
+        try:
+            sps, ts, rs = measure_path(step, ts, rs, label)
+            results[label] = sps * spec.batch_size
+        except Exception as e:  # pallas lowering failure must not kill the bench
+            if not use_pallas:
+                raise
+            results[label] = None
+            print(f"[{label}] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
 
-    t0 = time.time()
-    ts, rs, m = step(ts, rs)
-    jax.block_until_ready(m["loss"])
-    print(f"compile + first step: {time.time()-t0:.1f}s "
-          f"loss={float(m['loss']):.5f}", file=sys.stderr)
-
-    for _ in range(3):  # warmup
-        ts, rs, m = step(ts, rs)
-    jax.block_until_ready(m["loss"])
-
-    n_timed = 30
-    t0 = time.time()
-    for _ in range(n_timed):
-        ts, rs, m = step(ts, rs)
-    jax.block_until_ready(m["loss"])
-    dt = time.time() - t0
-
-    steps_per_sec = n_timed / dt
-    seq_updates = steps_per_sec * spec.batch_size
-    print(f"{steps_per_sec:.2f} train steps/s; loss={float(m['loss']):.5f}",
-          file=sys.stderr)
+    # primary metric follows the config-default decode path, falling back to
+    # the other path if the default one was skipped/failed on this backend
+    default_label = ("pallas_decode" if cfg.optim.pallas_obs_decode
+                     else "xla_decode")
+    seq_updates = results[default_label]
+    if seq_updates is None:
+        fallback = "xla_decode" if default_label != "xla_decode" else "pallas_decode"
+        seq_updates = results[fallback]
     print(json.dumps({
         "metric": "learner_sequence_updates_per_sec_per_chip",
         "value": round(seq_updates, 1),
         "unit": "sequences/s",
         "vs_baseline": round(seq_updates / REFERENCE_SEQ_UPDATES_PER_SEC, 2),
+        "xla_decode": results["xla_decode"] and round(results["xla_decode"], 1),
+        "pallas_decode": (results["pallas_decode"]
+                          and round(results["pallas_decode"], 1)),
     }))
 
 

@@ -95,11 +95,77 @@ def main(argv=None) -> None:
     cfg = parse_overrides(Config(), config_overrides)
 
     if args.play is not None:
-        # replay path: first checkpoint hosts in multiplayer (ref test.py:129-141)
-        for i, ckpt in enumerate(args.play):
-            mean_ret, step, env_steps = evaluate_checkpoint(
+        # Replay path. With several checkpoints (multiplayer) the evaluators
+        # must run CONCURRENTLY — the first hosts the live game and stays up
+        # while the others join it (the reference launches one `play` Ray
+        # task per checkpoint simultaneously, test.py:129-144). A sequential
+        # loop can never connect: the host's game would be over before any
+        # joiner starts.
+        def play_one(i: int, ckpt: str):
+            return evaluate_checkpoint(
                 cfg, ckpt, args.rounds, testing=True, is_host=(i == 0),
                 port=cfg.multiplayer.base_port, seed=i)
+
+        if len(args.play) <= 1:
+            results = [play_one(i, c) for i, c in enumerate(args.play)]
+        else:
+            # Daemon threads, not a ThreadPoolExecutor: if the host evaluator
+            # dies, joiners may be blocked connecting to a game that will
+            # never exist — the error must surface and the process must be
+            # able to exit rather than join stuck workers forever.
+            import threading
+
+            results = [None] * len(args.play)
+            errors = []
+
+            def run(i: int, ckpt: str) -> None:
+                try:
+                    results[i] = play_one(i, ckpt)
+                except BaseException as e:  # surfaced below
+                    errors.append((i, e))
+
+            import time as time_mod
+
+            threads = [threading.Thread(target=run, args=(i, c), daemon=True)
+                       for i, c in enumerate(args.play)]
+            for t in threads:
+                t.start()
+            # No overall deadline while everyone is still working, but once
+            # the first evaluator completes the rest get a bounded straggler
+            # window — in a shared multiplayer game all players' episodes
+            # end together, so a peer still "running" long after another
+            # finished is stuck (e.g. blocked joining a dead host).
+            straggler_deadline = None
+            while any(t.is_alive() for t in threads) and not errors:
+                for t in threads:
+                    t.join(timeout=0.5)
+                if straggler_deadline is None:
+                    if any(not t.is_alive() for t in threads):
+                        straggler_deadline = time_mod.time() + 60.0
+                elif time_mod.time() > straggler_deadline:
+                    stuck = [args.play[i] for i, t in enumerate(threads)
+                             if t.is_alive()]
+                    print(f"warning: abandoning stuck evaluator(s) after "
+                          f"60s straggler window: {stuck}", file=sys.stderr)
+                    break
+            if errors:
+                # Give surviving evaluators a short grace window to wind
+                # down cleanly (exiting immediately would kill daemon
+                # threads mid-rollout); a joiner stuck on a dead host is
+                # abandoned after the grace period rather than hanging the
+                # CLI forever.
+                grace_deadline = time_mod.time() + 15.0
+                for t in threads:
+                    t.join(timeout=max(0.0, grace_deadline - time_mod.time()))
+                i, err = errors[0]
+                raise SystemExit(
+                    f"evaluator for {args.play[i]} failed: "
+                    f"{type(err).__name__}: {err}")
+        for ckpt, res in zip(args.play, results):
+            if res is None:
+                print(f"{ckpt}: no result (evaluator abandoned)")
+                continue
+            mean_ret, step, env_steps = res
             print(f"{ckpt}: mean return {mean_ret:.2f} over {args.rounds} "
                   f"rounds (step {step}, env steps {env_steps})")
         return

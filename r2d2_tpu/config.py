@@ -32,7 +32,11 @@ class EnvConfig:
     frame_height: int = 84
     frame_width: int = 84
     frame_skip: int = 1
-    clip_rewards: bool = True  # training-time only (ref environment.py:88-89)
+    # The reference's factory defaults clip_rewards=True (environment.py:82)
+    # but every call site passes False — actors (worker.py:507) and eval
+    # (test.py:97) — relying on invertible value rescaling for reward
+    # magnitudes instead. Match the effective behavior, not the dead default.
+    clip_rewards: bool = False
     # Shaped multiplayer reward constants (ref base_gym_env.py:199-211).
     reward_hurt: float = -20.0
     reward_death: float = -100.0
@@ -158,6 +162,10 @@ class RuntimeConfig:
 
     save_dir: str = "models"
     pretrain: str = ""               # warm-start checkpoint path ("" = none)
+    # Full-resume checkpoint path: restores params, target_params, opt_state,
+    # step, and env_steps into the learner (the reference can only warm-start
+    # weights, worker.py:260-261; SURVEY §5.4 sets the full-state bar).
+    resume: str = ""
     save_interval: int = 1_000       # learner steps between checkpoints
     log_interval: float = 20.0       # seconds between metric log lines
     weight_publish_interval: int = 2  # learner steps between weight publications

@@ -124,3 +124,34 @@ def join_game_args(ip: str, port: int) -> str:
 
 def player_args(player_name: str, colorset: int) -> str:
     return f"+name {player_name} +colorset {colorset}"
+
+
+def compose_render_image(obs_shape, screen=None, depth=None,
+                         labels_buffer=None, labels=(), automap=None,
+                         label_colors=None, n_panels: int = 1):
+    """Side-by-side composition of the engine's view buffers — pure numpy.
+
+    The reference builds this image inline in its pygame render
+    (ref base_gym_env.py:242-297): screen, then (when enabled) a
+    3-channel-tiled depth buffer, a label mask recolored per object, and the
+    automap, concatenated horizontally. ``labels`` is a sequence of
+    ``(object_id, value)`` pairs; ``label_colors`` a (N, 3) uint8 palette.
+    With no ``screen`` (terminal state) returns a black image sized for
+    ``n_panels`` panels.
+    """
+    import numpy as np
+
+    if screen is None:
+        return np.zeros((obs_shape[0], obs_shape[1] * n_panels, 3), np.uint8)
+    images = [screen]
+    if depth is not None:
+        images.append(np.repeat(depth[..., None], 3, axis=2))
+    if labels_buffer is not None:
+        labels_rgb = np.zeros_like(screen)
+        for object_id, value in labels:
+            color = label_colors[int(object_id) % len(label_colors)]
+            labels_rgb[labels_buffer == value] = color
+        images.append(labels_rgb)
+    if automap is not None:
+        images.append(automap)
+    return np.concatenate(images, axis=1)

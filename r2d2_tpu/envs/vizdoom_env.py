@@ -25,6 +25,7 @@ from r2d2_tpu.envs.vizdoom_defs import (
     MULTI_REWARD_SCENARIOS,
     SCENARIOS,
     build_action_vector,
+    compose_render_image,
     expand_buttons,
     host_game_args,
     join_game_args,
@@ -153,22 +154,19 @@ class VizdoomEnv:
 
     def _render_image(self) -> np.ndarray:
         state = self.game.get_state()
+        n_panels = 1 + self.depth + self.labels + self.automap
         if state is None:
-            n = 1 + self.depth + self.labels + self.automap
-            return np.zeros((self.observation_shape[0],
-                             self.observation_shape[1] * n, 3), np.uint8)
-        images = [state.screen_buffer]
-        if self.depth:
-            images.append(np.repeat(state.depth_buffer[..., None], 3, axis=2))
-        if self.labels:
-            labels_rgb = np.zeros_like(state.screen_buffer)
-            for label in state.labels:
-                color = self._label_colors[label.object_id % 256]
-                labels_rgb[state.labels_buffer == label.value] = color
-            images.append(labels_rgb)
-        if self.automap:
-            images.append(state.automap_buffer)
-        return np.concatenate(images, axis=1)
+            return compose_render_image(self.observation_shape,
+                                        n_panels=n_panels)
+        return compose_render_image(
+            self.observation_shape,
+            screen=state.screen_buffer,
+            depth=state.depth_buffer if self.depth else None,
+            labels_buffer=state.labels_buffer if self.labels else None,
+            labels=[(l.object_id, l.value) for l in state.labels]
+            if self.labels else (),
+            automap=state.automap_buffer if self.automap else None,
+            label_colors=self._label_colors)
 
     def close(self):
         if self.window_surface is not None:

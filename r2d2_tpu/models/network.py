@@ -175,6 +175,19 @@ class NetworkApply:
         self.action_dim = action_dim
         self.config = config
         self.obs_hw = (frame_height, frame_width, frame_stack)
+        # Validate the conv pyramid against the frame size up front — a
+        # zero/negative spatial output otherwise surfaces as an opaque
+        # ZeroDivisionError inside flax's variance-scaling initializer.
+        h, w = frame_height, frame_width
+        for i, (_, kernel, stride) in enumerate(config.conv_layers):
+            h = (h - kernel) // stride + 1
+            w = (w - kernel) // stride + 1
+            if h < 1 or w < 1:
+                raise ValueError(
+                    f"conv layer {i} (kernel {kernel}, stride {stride}) "
+                    f"shrinks the {frame_height}x{frame_width} frame to "
+                    f"{h}x{w}; use smaller network.conv_layers for this "
+                    "frame size")
         self.module = R2D2Network(action_dim=action_dim, config=config)
 
     def init(self, key: jax.Array):

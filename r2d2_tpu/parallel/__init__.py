@@ -11,13 +11,13 @@ ICI; multi-host extends the same mesh over DCN via jax.distributed.
 from r2d2_tpu.parallel.mesh import make_mesh, init_distributed
 from r2d2_tpu.parallel.sharded import (
     make_sharded_learner_step,
-    sharded_replay_add,
+    make_sharded_replay_add,
     sharded_replay_init,
     sharded_buffer_steps,
 )
 
 __all__ = [
     "make_mesh", "init_distributed",
-    "make_sharded_learner_step", "sharded_replay_add", "sharded_replay_init",
-    "sharded_buffer_steps",
+    "make_sharded_learner_step", "make_sharded_replay_add",
+    "sharded_replay_init", "sharded_buffer_steps",
 ]

@@ -1,0 +1,74 @@
+"""Shared tiny-shapes validation step for the multichip / multihost dryruns.
+
+Builds the FULL dp-sharded training pipeline — sharded replay ring, one
+synthetic block ring-written into every shard, one fused sharded learner
+step (sample → unroll → loss → pmean(grads) → Adam → priority write-back) —
+at toy sizes, then asserts the loss is finite and the updated params are
+bit-identical on every locally-addressable shard. Used by
+``__graft_entry__.dryrun_multichip`` (single-process virtual mesh) and by
+``r2d2_tpu.parallel.multihost_dryrun`` (two ``jax.distributed`` processes
+over a loopback coordinator — the DCN bring-up path of SURVEY §5.8).
+"""
+
+import numpy as np
+
+
+def run_tiny_sharded_step(mesh) -> float:
+    """Run one sharded step over ``mesh`` (axis 'dp'); returns the loss."""
+    import jax
+
+    from r2d2_tpu.config import NetworkConfig, OptimConfig
+    from r2d2_tpu.learner import create_train_state
+    from r2d2_tpu.models import init_network
+    from r2d2_tpu.parallel import make_sharded_learner_step, sharded_replay_init
+    from r2d2_tpu.parallel.sharded import make_sharded_replay_add
+    from r2d2_tpu.replay.structs import Block, ReplaySpec
+
+    n_shards = mesh.shape["dp"]
+    spec = ReplaySpec(
+        num_blocks=4, seqs_per_block=2, block_length=10, burn_in=4,
+        learning=5, forward=3, frame_stack=2, frame_height=20, frame_width=20,
+        hidden_dim=16, batch_size=4, prio_exponent=0.9, is_exponent=0.6)
+    ncfg = NetworkConfig(hidden_dim=16, cnn_out_dim=32,
+                         conv_layers=((8, 4, 2), (16, 3, 1)), use_double=True)
+    opt = OptimConfig(target_net_update_interval=2)
+    net, _ = init_network(jax.random.PRNGKey(0), 4, ncfg, frame_stack=2,
+                          frame_height=20, frame_width=20)
+
+    ts = create_train_state(jax.random.PRNGKey(1), net, opt)
+    rs = sharded_replay_init(spec, mesh)
+
+    # one synthetic block per shard (full sequences, unit priorities);
+    # seeded identically in every process so multi-controller SPMD holds
+    rng = np.random.default_rng(0)
+    add = make_sharded_replay_add(spec, mesh)
+    for d in range(n_shards):
+        S, L = spec.seqs_per_block, spec.learning
+        blk = Block(
+            obs_row=rng.integers(0, 255, (spec.obs_row_len, 20, 20)).astype(np.uint8),
+            last_action_row=rng.integers(0, 4, (spec.la_row_len,)).astype(np.int32),
+            hidden=rng.normal(size=(S, 2, 16)).astype(np.float32),
+            action=rng.integers(0, 4, (S, L)).astype(np.int32),
+            reward=rng.normal(size=(S, L)).astype(np.float32),
+            gamma=np.full((S, L), 0.99, np.float32),
+            priority=np.ones((S,), np.float32),
+            burn_in_steps=np.full((S,), spec.burn_in, np.int32),
+            learning_steps=np.full((S,), L, np.int32),
+            forward_steps=np.concatenate(
+                [np.full((S - 1,), spec.forward), [1]]).astype(np.int32),
+            seq_start=(spec.burn_in + L * np.arange(S)).astype(np.int32),
+            num_sequences=np.asarray(S, np.int32),
+            sum_reward=np.asarray(np.nan, np.float32),
+        )
+        rs = add(rs, blk, d)
+
+    step = make_sharded_learner_step(net, spec, opt, use_double=True, mesh=mesh)
+    ts, rs, metrics = step(ts, rs)
+    loss = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    # params replicated identically on every locally-addressable shard
+    leaf = jax.tree_util.tree_leaves(ts.params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+    return loss

@@ -1,0 +1,102 @@
+"""Loopback multi-host bring-up dryrun (SURVEY §5.8 DCN story).
+
+Launches ``--num-processes`` worker processes on this machine, each a
+separate JAX controller with its own virtual CPU devices, wires them into
+one ``jax.distributed`` job over a loopback coordinator, builds the global
+('dp','mp') mesh spanning both processes, and runs one fused dp-sharded
+training step — the same multi-controller SPMD path a real multi-host TPU
+pod uses over DCN (the reference's scaling unit is a single process on half
+a GPU; it has no analog, /root/reference/worker.py:251).
+
+    python -m r2d2_tpu.parallel.multihost_dryrun            # launcher
+    python -m r2d2_tpu.parallel.multihost_dryrun --process-id=0 ...  # worker
+"""
+
+import argparse
+import socket
+import subprocess
+import sys
+import time
+
+
+def _worker(process_id: int, num_processes: int, coordinator: str,
+            devices_per_process: int) -> None:
+    from r2d2_tpu.utils.platform import pin_cpu_platform
+    pin_cpu_platform(devices_per_process)
+
+    import jax
+
+    from r2d2_tpu.config import MeshConfig
+    from r2d2_tpu.parallel import make_mesh
+    from r2d2_tpu.parallel.dryrun import run_tiny_sharded_step
+    from r2d2_tpu.parallel.mesh import init_distributed
+
+    init_distributed(MeshConfig(
+        multihost=True, coordinator_address=coordinator,
+        num_processes=num_processes, process_id=process_id))
+
+    n_global = num_processes * devices_per_process
+    assert len(jax.devices()) == n_global, (
+        f"global device view: want {n_global}, got {len(jax.devices())}")
+    assert len(jax.local_devices()) == devices_per_process
+
+    mesh = make_mesh(MeshConfig(dp=n_global))
+    loss = run_tiny_sharded_step(mesh)
+    print(f"[proc {process_id}] multihost dryrun ok, loss={loss:.5f}",
+          flush=True)
+
+
+def launch(num_processes: int = 2, devices_per_process: int = 4,
+           timeout: float = 300.0) -> None:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+
+    procs = []
+    for pid in range(num_processes):
+        procs.append(subprocess.Popen([
+            sys.executable, "-m", "r2d2_tpu.parallel.multihost_dryrun",
+            f"--process-id={pid}", f"--num-processes={num_processes}",
+            f"--coordinator={coordinator}",
+            f"--devices-per-process={devices_per_process}",
+        ]))
+    # One shared deadline; kill survivors on ANY exit path (a crashed
+    # coordinator process would otherwise leave its peer blocked in
+    # jax.distributed.initialize as an orphan).
+    deadline = time.time() + timeout
+    rcs = []
+    try:
+        for p in procs:
+            try:
+                rcs.append(p.wait(timeout=max(1.0, deadline - time.time())))
+            except subprocess.TimeoutExpired:
+                rcs.append(None)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(rc != 0 for rc in rcs):
+        raise SystemExit(
+            f"multihost dryrun failed: worker rcs={rcs} (None = timed out "
+            f"after {timeout:.0f}s and was killed)")
+    print(f"multihost dryrun: {num_processes} processes x "
+          f"{devices_per_process} devices ok")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--num-processes", type=int, default=2)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--devices-per-process", type=int, default=4)
+    args = p.parse_args(argv)
+    if args.process_id is None:
+        launch(args.num_processes, args.devices_per_process)
+    else:
+        _worker(args.process_id, args.num_processes, args.coordinator,
+                args.devices_per_process)
+
+
+if __name__ == "__main__":
+    main()

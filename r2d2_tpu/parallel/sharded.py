@@ -138,11 +138,6 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-# convenience thin wrapper so callers don't need the factory
-def sharded_replay_add(spec, mesh, state, block, shard_idx: int):
-    return make_sharded_replay_add(spec, mesh)(state, block, shard_idx)
-
-
 def sharded_buffer_steps(state: ReplayState) -> int:
     """Total stored learning steps across all shards."""
     return int(jnp.sum(state.learning_steps))

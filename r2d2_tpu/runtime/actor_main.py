@@ -31,7 +31,7 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
 
     cfg = Config.from_dict(cfg_dict)
     seed = cfg.runtime.seed + 10_000 * player_idx + 100 * actor_idx
-    env = create_env(cfg.env, clip_rewards=True, is_host=is_host, port=port,
+    env = create_env(cfg.env, is_host=is_host, port=port,
                      num_players=cfg.multiplayer.num_players,
                      name=f"p{player_idx}a{actor_idx}", seed=seed)
     net = NetworkApply(env.action_space.n, cfg.network, cfg.env.frame_stack,

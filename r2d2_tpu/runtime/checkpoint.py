@@ -76,6 +76,29 @@ def load_pretrain(path: str, params_template):
         lambda t, p: np.asarray(p, np.asarray(t).dtype), params_template, params)
 
 
+def resume_training_state(path: str, train_state):
+    """Full resume (SURVEY §5.4): restore params, target_params, opt_state,
+    step, and env_steps from a checkpoint into ``train_state``. Returns
+    ``(new_train_state, env_steps)``. The RNG key is NOT checkpointed (the
+    reference checkpoints no RNG either) — the carried key stays fresh."""
+    template = {
+        "params": jax.device_get(train_state.params),
+        "target_params": jax.device_get(train_state.target_params),
+        "opt_state": jax.device_get(train_state.opt_state),
+        "step": np.asarray(0, np.int64),
+        "env_steps": np.asarray(0, np.int64),
+    }
+    restored = restore_checkpoint(path, template)
+    import jax.numpy as jnp
+    new_state = train_state.replace(
+        params=restored["params"],
+        target_params=restored["target_params"],
+        opt_state=restored["opt_state"],
+        step=jnp.asarray(int(restored["step"]), jnp.int32),
+    )
+    return new_state, int(restored["env_steps"])
+
+
 def list_checkpoints(save_dir: str, game: str, player: int
                      ) -> List[Tuple[int, str]]:
     """Sorted (index, path) pairs, the eval sweep's iteration order

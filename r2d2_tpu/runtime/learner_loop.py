@@ -34,7 +34,8 @@ from r2d2_tpu.models.network import NetworkApply
 from r2d2_tpu.replay.device_replay import replay_add, replay_init
 from r2d2_tpu.replay.host_replay import HostReplay
 from r2d2_tpu.replay.structs import Block, ReplaySpec
-from r2d2_tpu.runtime.checkpoint import load_pretrain, save_checkpoint
+from r2d2_tpu.runtime.checkpoint import (
+    load_pretrain, resume_training_state, save_checkpoint)
 from r2d2_tpu.runtime.metrics import TrainMetrics
 
 
@@ -49,7 +50,15 @@ class Learner:
         key = jax.random.PRNGKey(seed + 1000 * player_idx)
 
         self.train_state = create_train_state(key, net, cfg.optim)
-        if cfg.runtime.pretrain:
+        resumed_env_steps = 0
+        if cfg.runtime.resume:
+            if cfg.runtime.pretrain:
+                raise ValueError(
+                    "runtime.resume and runtime.pretrain are mutually "
+                    "exclusive — resume restores the full training state")
+            self.train_state, resumed_env_steps = resume_training_state(
+                cfg.runtime.resume, self.train_state)
+        elif cfg.runtime.pretrain:
             params = load_pretrain(cfg.runtime.pretrain, self.train_state.params)
             self.train_state = self.train_state.replace(
                 params=params,
@@ -91,10 +100,10 @@ class Learner:
         # device read (a full tunnel round-trip under remote TPU dispatch)
         # per ingested block / per step.
         self.buffer_steps = 0
-        self.env_steps = 0
+        self.env_steps = resumed_env_steps
         self._host_ptr = 0
         self._slot_steps = [0] * self.spec.num_blocks
-        self._host_step = 0
+        self._host_step = int(self.train_state.step)
         self._pending_losses: list = []   # device scalars, flushed lazily
 
     # -- ingestion --
@@ -172,9 +181,31 @@ class Learner:
             t.start()
             self._bg_threads.append(t)
 
-    def stop_background(self) -> None:
-        if self.host_mode:
-            self._bg_stop.set()
+    def stop_background(self, join_timeout: float = 10.0) -> None:
+        if not self.host_mode:
+            return
+        self._bg_stop.set()
+        # Unblock a prefetch thread parked in a full-queue put by draining
+        # the prefetch queue, then join; surface anything still stuck (a
+        # thread blocked inside a device transfer would otherwise outlive
+        # the orchestrator's close() silently).
+        stuck = []
+        for t in self._bg_threads:
+            deadline = time.time() + join_timeout
+            while t.is_alive() and time.time() < deadline:
+                try:
+                    self._prefetch_q.get_nowait()
+                except queue_mod.Empty:
+                    pass
+                t.join(timeout=0.1)
+            if t.is_alive():
+                stuck.append(t.name)
+        self._bg_threads = [t for t in self._bg_threads if t.is_alive()]
+        if stuck:
+            import logging
+            logging.getLogger(__name__).warning(
+                "learner background threads did not exit within %.1fs: %s",
+                join_timeout, stuck)
 
     def _host_step_once(self) -> dict:
         if not self._bg_threads:

@@ -18,6 +18,7 @@ on port base+i; actor i of every other player joins that game.
 """
 
 import multiprocessing as mp
+import signal
 import threading
 import time
 from typing import Callable, List, Optional
@@ -75,7 +76,7 @@ class PlayerStack:
         eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
                            cfg.actor.eps_alpha)
         seed = cfg.runtime.seed + 10_000 * self.player_idx + 100 * i
-        env = create_env(cfg.env, clip_rewards=True, seed=seed,
+        env = create_env(cfg.env, seed=seed,
                          num_players=cfg.multiplayer.num_players,
                          name=f"p{self.player_idx}a{i}",
                          **self.actor_env_args(i))
@@ -168,24 +169,53 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
     else:
         stop = mp.get_context("spawn").Event()
 
-    stacks = [PlayerStack(cfg, p, action_dim) for p in range(num_players)]
-    for st in stacks:
-        if actor_mode == "thread":
-            st.start_actors_threads(stop)
-        else:
-            st.start_actors_processes(stop)
-
-    start = time.time()
-    deadline = start + max_seconds if max_seconds else None
-    max_steps = max_training_steps or cfg.optim.training_steps
-    last_log = start
-
-    def timed_out() -> bool:
-        return deadline is not None and time.time() > deadline
-
+    # Map external SIGTERM/SIGINT onto the clean stop path: a hard kill of a
+    # process holding a live TPU dispatch can wedge a remote-TPU tunnel for
+    # every process that follows (observed round 1 — it cost both driver
+    # artifacts). Only the main thread may install handlers; restored below.
+    prev_handlers = {}
+    stacks: List[PlayerStack] = []
     try:
+        # Everything after handler installation sits inside this try so the
+        # finally always restores them — even when stack construction or
+        # actor startup raises.
+        if threading.current_thread() is threading.main_thread():
+            def _on_signal(signum, frame):
+                if stop.is_set():
+                    # Second signal: the clean path is already requested but
+                    # may be blocked inside a wedged device call — restore
+                    # the previous handler so a repeated Ctrl+C/SIGTERM can
+                    # still interrupt rather than being swallowed forever.
+                    prev = prev_handlers.get(signum) or signal.SIG_DFL
+                    signal.signal(signum, prev)
+                    if signum == signal.SIGINT:
+                        raise KeyboardInterrupt
+                    return
+                stop.set()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    prev_handlers[sig] = signal.signal(sig, _on_signal)
+                except (ValueError, OSError):
+                    pass
+
+        stacks = [PlayerStack(cfg, p, action_dim) for p in range(num_players)]
+        for st in stacks:
+            if actor_mode == "thread":
+                st.start_actors_threads(stop)
+            else:
+                st.start_actors_processes(stop)
+
+        start = time.time()
+        deadline = start + max_seconds if max_seconds else None
+        max_steps = max_training_steps or cfg.optim.training_steps
+        last_log = start
+
+        def timed_out() -> bool:
+            return deadline is not None and time.time() > deadline
+
         # warm-up: fill buffers to learning_starts (ref train.py:49-54)
-        while not all(st.learner.ready for st in stacks) and not timed_out():
+        while (not all(st.learner.ready for st in stacks) and not timed_out()
+               and not stop.is_set()):
             for st in stacks:
                 st.learner.drain(st.queue)
             time.sleep(0.02)
@@ -202,7 +232,7 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
             jax.profiler.start_trace(cfg.runtime.profile_dir)
             profile_until = time.time() + min(cfg.runtime.log_interval, 30.0)
 
-        while (not timed_out()
+        while (not timed_out() and not stop.is_set()
                and any(st.learner.training_steps < max_steps for st in stacks)):
             for st in stacks:
                 st.learner.drain(st.queue)
@@ -228,4 +258,9 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
         stop.set()
         for st in stacks:
             st.close()
+        for sig, handler in prev_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
     return stacks

@@ -44,6 +44,12 @@ class WeightPublisher:
         self.publish(params)
 
     def publish(self, params) -> None:
+        # Seqlock ordering note: the version/payload/version stores have no
+        # explicit memory barriers — readers are correct under x86-TSO store
+        # ordering (this deployment). On weakly-ordered hosts (ARM) a reader
+        # could observe an even version with a partially updated payload;
+        # add a fence (e.g. write payload via a memoryview + os.write-style
+        # flush, or an atomic version word) before targeting ARM.
         flat = np.asarray(jax.device_get(ravel_pytree(params)[0]), np.float32)
         self._version[0] += 1          # odd: write in flight
         self._payload[:] = flat

@@ -12,6 +12,37 @@ import os
 from typing import Optional
 
 
+def force_host_device_count(n: int) -> None:
+    """Set the virtual CPU device count in XLA_FLAGS, REPLACING any existing
+    ``--xla_force_host_platform_device_count`` (an inherited value from a
+    parent test/driver process would otherwise win). Must run before the CPU
+    backend initializes."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    kept = [f for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    kept.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
+
+
+def pin_cpu_platform(n_devices: int) -> None:
+    """Pin this process to an ``n_devices``-wide virtual CPU platform.
+
+    The one blessed preamble for every CPU-pinned entry point (tests,
+    multichip/multihost dryruns): env vars for fresh/child processes, then
+    the jax.config route for a jax that is already imported (effective until
+    the first backend initialization). Must run before any jax computation.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    force_host_device_count(n_devices)
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; callers verify jax.devices()
+
+
 def pin_platform(platform: Optional[str] = None) -> None:
     """Apply ``platform`` (default: the JAX_PLATFORMS env var) through
     jax.config. No-op if no request or if a backend already initialized."""

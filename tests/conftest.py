@@ -1,23 +1,15 @@
 """Test harness: force an 8-device virtual CPU platform so every sharding/
 multi-chip test runs hermetically (no TPU required), per SURVEY.md §4."""
 
-import os
-
 # The shell may pre-set JAX_PLATFORMS to the TPU platform, and a pytest
-# plugin imports jax before this conftest runs — so pin the platform through
-# jax.config (effective until the first backend initialization) as well as
-# the environment, unconditionally.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+# plugin imports jax before this conftest runs — pin_cpu_platform covers
+# both routes (env vars + jax.config before first backend init).
+from r2d2_tpu.utils.platform import pin_cpu_platform
+
+pin_cpu_platform(8)
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu", (
     "test suite must run on the virtual CPU mesh; a backend was initialized "
     "on another platform before conftest could pin it")

@@ -128,6 +128,37 @@ def test_shaped_multiplayer_reward_cases():
     assert shaped_multiplayer_reward(base, (100, 1, 49, 0), cfg) == 20.0
 
 
+def test_compose_render_image():
+    """Render composition (ref base_gym_env.py:242-297) as pure numpy: panel
+    stacking order, depth tiling, label recoloring, terminal black frame."""
+    from r2d2_tpu.envs.vizdoom_defs import compose_render_image
+
+    h, w = 6, 8
+    screen = np.full((h, w, 3), 10, np.uint8)
+    depth = np.full((h, w), 77, np.uint8)
+    labels_buffer = np.zeros((h, w), np.uint8)
+    labels_buffer[2, 3] = 9
+    palette = np.arange(256 * 3, dtype=np.uint8).reshape(256, 3)
+    automap = np.full((h, w, 3), 200, np.uint8)
+
+    img = compose_render_image(
+        (h, w, 3), screen=screen, depth=depth, labels_buffer=labels_buffer,
+        labels=[(300, 9)], automap=automap, label_colors=palette)
+    assert img.shape == (h, 4 * w, 3)
+    np.testing.assert_array_equal(img[:, :w], screen)          # panel 1
+    assert (img[:, w:2 * w] == 77).all()                       # depth tiled
+    np.testing.assert_array_equal(img[2, 2 * w + 3],
+                                  palette[300 % 256])          # label color
+    assert (img[0, 2 * w:3 * w] == 0).all()                    # mask bg black
+    np.testing.assert_array_equal(img[:, 3 * w:], automap)     # panel 4
+
+    # screen-only: no extra panels
+    assert compose_render_image((h, w, 3), screen=screen).shape == (h, w, 3)
+    # terminal state: black image sized for the enabled panel count
+    black = compose_render_image((h, w, 3), n_panels=4)
+    assert black.shape == (h, 4 * w, 3) and not black.any()
+
+
 def test_game_args():
     h = host_game_args(2, 5060)
     assert "-host 2" in h and "-port 5060" in h and "-deathmatch" in h
@@ -139,3 +170,108 @@ def test_vizdoom_gated_import():
     cfg = EnvConfig(game_name="Vizdoom", env_type="Basic-v0")
     with pytest.raises(ImportError, match="vizdoom"):
         create_env(cfg)
+
+
+# ---- gymnasium-backend conformance (the ALE path, ref environment.py:82-93)
+# ale_py is not installable in this build environment (no network installs);
+# a registered RGB stub drives the identical factory branch — real gymnasium
+# registry, real make(), adapter, WarpFrame, ClipReward. The tests below it
+# run the true engines whenever ale_py / vizdoom become importable.
+
+
+def _register_stub_ale():
+    gymnasium = pytest.importorskip("gymnasium")
+    from gymnasium import spaces
+
+    class StubALE(gymnasium.Env):
+        """210x160 RGB Atari-shaped env with out-of-range rewards."""
+
+        action_space = spaces.Discrete(4)
+        observation_space = spaces.Box(0, 255, (210, 160, 3), np.uint8)
+
+        def __init__(self, frameskip: int = 1):
+            self.frameskip = frameskip
+            self._t = 0
+
+        def reset(self, *, seed=None, options=None):
+            self._t = 0
+            return np.full((210, 160, 3), 100, np.uint8), {}
+
+        def step(self, action):
+            self._t += 1
+            obs = np.full((210, 160, 3), 50 + 10 * self._t, np.uint8)
+            return obs, 2.5, self._t >= 10, False, {}
+
+    if "StubALE-v5" not in gymnasium.registry:
+        gymnasium.register(id="StubALE-v5",
+                           entry_point=lambda **kw: StubALE(**kw))
+    return gymnasium
+
+
+def test_gymnasium_backend_conformance():
+    _register_stub_ale()
+    cfg = EnvConfig(game_name="StubALE", env_type="-v5",
+                    frame_height=84, frame_width=84)
+    env = create_env(cfg, clip_rewards=True, seed=0)
+    obs = env.reset()
+    assert obs.shape == (84, 84) and obs.dtype == np.uint8
+    steps = 0
+    done = False
+    while not done:
+        obs, r, done, _ = env.step(env.action_space.sample())
+        steps += 1
+        assert obs.shape == (84, 84)
+        assert r == 1.0          # 2.5 clipped (training path)
+    assert steps == 10
+    env.close()
+
+    # eval path: rewards unclipped (ref test.py:97 clip_rewards=False)
+    env = create_env(cfg, clip_rewards=False, seed=0)
+    env.reset()
+    assert env.step(0)[1] == 2.5
+    env.close()
+
+
+def test_gymnasium_frameskip_passthrough():
+    gymnasium = _register_stub_ale()
+    cfg = EnvConfig(game_name="StubALE", env_type="-v5", frame_skip=4)
+    env = create_env(cfg, clip_rewards=False)
+    # the factory forwards frame_skip as the backend's native frameskip
+    # (ref environment.py:83 passes frame_skip into gym.make)
+    inner = env
+    while hasattr(inner, "env"):
+        inner = inner.env
+    inner = getattr(inner, "unwrapped", inner)
+    assert inner.frameskip == 4
+    env.close()
+
+
+def test_real_ale_boxing_episode():
+    """Runs the true ALE backend when ale_py is importable (not installable
+    in this build env — documented in README); skipped otherwise."""
+    pytest.importorskip("ale_py")
+    cfg = EnvConfig(game_name="ALE/Boxing", env_type="-v5")
+    env = create_env(cfg, clip_rewards=False, seed=0)
+    obs = env.reset()
+    assert obs.shape == (84, 84) and obs.dtype == np.uint8
+    for _ in range(20):
+        obs, r, done, _ = env.step(env.action_space.sample())
+        assert obs.shape == (84, 84)
+        if done:
+            env.reset()
+    env.close()
+
+
+def test_real_vizdoom_basic_episode():
+    """Runs the true ViZDoom engine when vizdoom is importable; skipped
+    otherwise (the env shell's pure logic is tested above either way)."""
+    pytest.importorskip("vizdoom")
+    cfg = EnvConfig(game_name="Vizdoom", env_type="Basic-v0")
+    env = create_env(cfg, clip_rewards=False, seed=0)
+    obs = env.reset()
+    assert obs.shape == (84, 84) and obs.dtype == np.uint8
+    for _ in range(10):
+        obs, r, done, _ = env.step(env.action_space.sample())
+        if done:
+            env.reset()
+    env.close()

@@ -30,6 +30,51 @@ def test_multiplayer_population_two_stacks(tmp_path):
     assert not np.allclose(np.asarray(a), np.asarray(b))
 
 
+def test_multiplayer_play_runs_evaluators_concurrently(tmp_path, monkeypatch):
+    """--play with N checkpoints must run N evaluators simultaneously (the
+    host stays alive while joiners connect — ref test.py:129-144). A barrier
+    inside env.reset can only be passed if both evaluators are live at once;
+    a sequential loop deadlocks it (BrokenBarrierError after timeout)."""
+    import threading
+
+    from r2d2_tpu.envs import factory as factory_mod
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.learner_loop import Learner
+
+    cfg = tiny_config(tmp_path)
+    probe = create_env(cfg.env)
+    net = NetworkApply(probe.action_space.n, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    probe.close()
+    learner = Learner(cfg, net)
+    ckpt_a = learner.save(1)
+    ckpt_b = learner.save(2)
+
+    barrier = threading.Barrier(2)
+    real_create = factory_mod.create_env
+
+    def synced_create(env_cfg, **kw):
+        env = real_create(env_cfg, **kw)
+        orig_reset = env.reset
+        armed = [True]
+
+        def reset(*a, **k):
+            if armed[0]:
+                armed[0] = False
+                barrier.wait(timeout=60)   # both evaluators or bust
+            return orig_reset(*a, **k)
+
+        env.reset = reset
+        return env
+
+    monkeypatch.setattr(factory_mod, "create_env", synced_create)
+
+    from r2d2_tpu.cli.evaluate import main
+    main(["--play", ckpt_a, ckpt_b, "--rounds", "1"])
+    assert barrier.n_waiting == 0
+
+
 def test_evaluate_checkpoint_sweep(tmp_path):
     cfg = tiny_config(tmp_path, **{"replay.learning_starts": 60,
                                    "runtime.save_interval": 2})

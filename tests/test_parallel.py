@@ -112,3 +112,12 @@ def test_eight_device_full_mesh_compiles(rng):
     __graft_entry__.dryrun_multichip."""
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_multihost_loopback_dryrun():
+    """Two separate jax.distributed controller processes over a loopback
+    coordinator run one fused dp-sharded step on a global mesh spanning both
+    (SURVEY §5.8 DCN bring-up — multi-controller SPMD, the path a real
+    multi-host pod takes)."""
+    from r2d2_tpu.parallel.multihost_dryrun import launch
+    launch(num_processes=2, devices_per_process=4, timeout=280.0)

@@ -119,6 +119,87 @@ def test_checkpoint_roundtrip_and_pretrain(tmp_path, small_params):
     assert list_checkpoints(str(tmp_path), "Fake", 0) == [(3, path)]
 
 
+def test_full_resume_continues_exactly(tmp_path):
+    """Train K steps → checkpoint → resume → continued run matches the
+    uninterrupted run bit-for-bit (params AND opt_state restored; the
+    reference can only warm-start weights, worker.py:260-261)."""
+    import numpy.random as npr
+    from r2d2_tpu.config import NetworkConfig, OptimConfig
+    from r2d2_tpu.learner import create_train_state
+    from r2d2_tpu.learner.train_step import make_external_batch_step
+    from r2d2_tpu.replay import replay_add, replay_init
+    from r2d2_tpu.replay.device_replay import replay_sample
+    from r2d2_tpu.runtime.checkpoint import (
+        resume_training_state, save_checkpoint)
+    from tests.test_replay import A, _fill_blocks, make_spec
+
+    rng = npr.default_rng(0)
+    spec = make_spec(batch_size=8)
+    ncfg = NetworkConfig(hidden_dim=spec.hidden_dim, cnn_out_dim=16,
+                         conv_layers=((8, 4, 2), (16, 3, 1)))
+    net, _ = init_network(jax.random.PRNGKey(0), A, ncfg,
+                          frame_stack=spec.frame_stack,
+                          frame_height=spec.frame_height,
+                          frame_width=spec.frame_width)
+    opt = OptimConfig(lr=1e-3)
+    rs = replay_init(spec)
+    for blk in _fill_blocks(spec, 3, rng):
+        rs = replay_add(spec, rs, blk)
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(7))
+    step = make_external_batch_step(net, spec, opt, use_double=False)
+
+    ts = create_train_state(jax.random.PRNGKey(1), net, opt)
+    for _ in range(3):
+        ts, _m = step(ts, batch)
+    path = save_checkpoint(str(tmp_path), "Fake", 1, 0, ts.params,
+                           ts.opt_state, ts.target_params, int(ts.step),
+                           env_steps=123)
+    for _ in range(3):
+        ts, _m = step(ts, batch)          # uninterrupted continuation
+
+    # resume into a DIFFERENTLY-seeded fresh state: everything must come
+    # from the checkpoint, nothing from the fresh init
+    ts2 = create_train_state(jax.random.PRNGKey(99), net, opt)
+    ts2, env_steps = resume_training_state(path, ts2)
+    assert env_steps == 123
+    assert int(ts2.step) == 3
+    for _ in range(3):
+        ts2, _m = step(ts2, batch)
+
+    assert int(ts.step) == int(ts2.step) == 6
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        ts.params, ts2.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        ts.opt_state, ts2.opt_state)
+
+
+def test_learner_resume_wiring(tmp_path):
+    """cfg.runtime.resume restores step/env_steps into the Learner; resume
+    and pretrain are mutually exclusive."""
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.learner_loop import Learner
+
+    cfg = tiny_config(tmp_path)
+    net = NetworkApply(4, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    learner = Learner(cfg, net)
+    path = learner.save(2)
+    learner.env_steps = 0  # save() recorded env_steps=0
+
+    cfg2 = cfg.replace(**{"runtime.resume": path})
+    resumed = Learner(cfg2, net)
+    assert resumed.training_steps == int(learner.train_state.step)
+    assert resumed.env_steps == 0
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Learner(cfg.replace(**{"runtime.resume": path,
+                               "runtime.pretrain": path}), net)
+
+
 def test_supervisor_restarts_dead_actor(tmp_path):
     """PlayerStack.supervise respawns dead actor threads (failure handling
     the reference lacks entirely, SURVEY §5.3)."""
@@ -139,11 +220,32 @@ def test_supervisor_restarts_dead_actor(tmp_path):
         stack.threads[0] = dead
         assert stack.supervise() == 1
         assert stack.threads[0].is_alive()
-        # disabled flag: no restart
+        # stop requested: no restart
         stack.threads[0] = dead
-        object.__setattr__  # noqa — cfg is frozen; rebuild stack config path
         stop.set()
         assert stack.supervise() == 0
+    finally:
+        stop.set()
+        stack.close()
+
+
+def test_supervisor_disabled_by_config(tmp_path):
+    """runtime.restart_dead_actors=False turns supervision off entirely."""
+    import threading
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.runtime.orchestrator import PlayerStack
+
+    cfg = tiny_config(tmp_path, **{"runtime.restart_dead_actors": False})
+    probe = create_env(cfg.env)
+    stack = PlayerStack(cfg, 0, probe.action_space.n)
+    stop = threading.Event()
+    stack.start_actors_threads(stop)
+    try:
+        dead = threading.Thread(target=lambda: None)
+        dead.start(); dead.join()
+        stack.threads[0] = dead
+        assert stack.supervise() == 0
+        assert not stack.threads[0].is_alive()
     finally:
         stop.set()
         stack.close()
@@ -177,6 +279,32 @@ def test_end_to_end_host_placement(tmp_path):
     assert learner.host_mode
     assert learner.training_steps >= 10
     assert len(learner.host_replay) >= cfg.replay.learning_starts
+    # close() (already run by train()) must have joined the pipeline threads
+    assert not any(t.is_alive() for t in learner._bg_threads)
+    assert not learner._bg_threads
+
+
+def test_sigterm_maps_to_clean_stop(tmp_path):
+    """An external SIGTERM lands on the stop-event path (wedge avoidance:
+    TPU-holding runs must never be hard-killed mid-dispatch) and the previous
+    handler is restored afterwards."""
+    import signal
+    import threading
+    import time as time_mod
+
+    cfg = tiny_config(tmp_path, **{"runtime.save_interval": 0})
+    prev = signal.getsignal(signal.SIGTERM)
+    timer = threading.Timer(
+        2.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    timer.start()
+    t0 = time_mod.time()
+    try:
+        train(cfg, max_training_steps=10**9, max_seconds=60.0,
+              actor_mode="thread")
+    finally:
+        timer.cancel()
+    assert time_mod.time() - t0 < 55.0, "signal did not stop the run"
+    assert signal.getsignal(signal.SIGTERM) is prev
 
 
 def test_multi_step_dispatch_end_to_end(tmp_path):
