@@ -4,8 +4,16 @@ Measures the fused R2D2 learner step — prioritized sample from HBM replay +
 full 55-step conv/LSTM unroll + value-rescaled double/dueling loss + Adam +
 priority write-back, one XLA program — at the reference's training
 configuration (batch 128 sequences, burn-in 40 / learning 10 / n-step 5,
-84x84x4 frames, cnn_out 1024, LSTM 512, dueling on, double off, f32;
+84x84x4 frames, cnn_out 1024, LSTM 512, dueling on, double off;
 /root/reference/config.py).
+
+Three measurements (VERDICT r2 #1/#3):
+  1. obs-decode A/B at the base config: XLA gather vs the pallas VMEM kernel;
+  2. the perf matrix {f32, bf16} x {steps_per_dispatch 1, 4, 16} on the
+     default decode path — the reference's amp analog (config.py:35) and the
+     host-dispatch amortization the reference cannot do (it pays a Ray RPC
+     per step by construction, worker.py:303);
+  3. an analytic model-FLOPs/s estimate against the chip's peak (MFU).
 
 vs_baseline: the reference publishes NO numbers (BASELINE.json "published":
 {}). Its learner logs 'training speed' in updates/s (worker.py:229); upstream
@@ -13,7 +21,11 @@ runs of this codebase on a desktop GPU train at ~5 updates/s = 640
 sequence-updates/s (128-sequence batches). That figure is the documented
 baseline estimate used here until a measured reference log is available.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Env knobs (used by tests/test_bench_diag.py):
+  R2D2_BENCH_SMOKE=1                 tiny config, xla-decode spd=1 only
+  R2D2_BENCH_SIMULATE_DISPATCH_FAILURE=1  raise at first dispatch (diagnostics path)
 """
 
 import dataclasses
@@ -25,6 +37,27 @@ import time
 import numpy as np
 
 REFERENCE_SEQ_UPDATES_PER_SEC = 640.0  # ~5 train steps/s * batch 128 (see above)
+
+BACKEND_GUIDANCE = (
+    "  If this is the remote-TPU tunnel: a previously killed "
+    "TPU-holding process can wedge the tunnel until the environment "
+    "resets; retry later or run with JAX_PLATFORMS=cpu for a "
+    "smoke-only number."
+)
+
+# Per-chip dense peak (bf16 matmul FLOP/s) by device_kind substring — the
+# MFU denominator convention of jax-ml.github.io/scaling-book. f32 configs
+# are reported against the same bf16 peak (stated in the output) since the
+# MXU's native multiply precision is bf16.
+PEAK_FLOPS_BY_KIND = (
+    ("v6", 918e12),       # Trillium
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # v5e
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
 
 def init_backend_or_die():
@@ -40,14 +73,45 @@ def init_backend_or_die():
             "bench: JAX backend init FAILED.\n"
             f"  error: {e}\n"
             f"  JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')!r}\n"
-            "  If this is the remote-TPU tunnel: a previously killed "
-            "TPU-holding process can wedge the tunnel until the environment "
-            "resets; retry later or run with JAX_PLATFORMS=cpu for a "
-            "smoke-only number.",
+            + BACKEND_GUIDANCE,
             file=sys.stderr)
         sys.exit(1)
-    print(f"backend: {devs[0].platform} x{len(devs)}", file=sys.stderr)
+    print(f"backend: {devs[0].platform} x{len(devs)} "
+          f"({devs[0].device_kind})", file=sys.stderr)
     return devs
+
+
+def peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for marker, peak in PEAK_FLOPS_BY_KIND:
+        if marker in kind:
+            return peak
+    return 0.0  # unknown chip: MFU omitted
+
+
+def model_flops_per_step(cfg, action_dim: int, use_double: bool) -> float:
+    """Analytic model FLOPs for one train step (fwd + bwd ~= 3x fwd MACs*2),
+    counting the conv torso, FC, LSTM, and head matmuls over the full
+    (batch x seq_window) unroll. Elementwise/decode/Adam FLOPs are noise
+    against these and are not counted."""
+    net, env = cfg.network, cfg.env
+    h, w, c = env.frame_height, env.frame_width, env.frame_stack
+    macs = 0.0
+    for features, kernel, stride in net.conv_layers:
+        h = (h - kernel) // stride + 1
+        w = (w - kernel) // stride + 1
+        macs += h * w * features * kernel * kernel * c
+        c = features
+    macs += h * w * c * net.cnn_out_dim                       # FC
+    lstm_in = net.cnn_out_dim + action_dim
+    macs += 4 * net.hidden_dim * (lstm_in + net.hidden_dim)   # LSTM gates
+    macs += net.hidden_dim * net.hidden_dim + net.hidden_dim * action_dim
+    if net.use_dueling:
+        macs += net.hidden_dim * net.hidden_dim + net.hidden_dim
+    per_token = 2.0 * macs                                    # FLOPs = 2*MACs
+    tokens = cfg.replay.batch_size * cfg.sequence.seq_len
+    unrolls = 3.0 + (1.0 if use_double else 0.0)              # fwd+bwd (+target fwd)
+    return per_token * tokens * unrolls
 
 
 def make_synthetic_block(spec, rng):
@@ -73,17 +137,42 @@ def make_synthetic_block(spec, rng):
     )
 
 
-def measure_path(step, ts, rs, label: str, n_timed: int = 30):
+class FirstDispatchError(Exception):
+    """First compile+dispatch of a known-good program failed — the backend
+    (not the program) is the suspect."""
+
+
+def _last_loss(metrics):
+    """Scalar loss from single-step ({} of scalars) or multi-step ((K,))."""
+    loss = np.asarray(metrics["loss"])
+    return float(loss.reshape(-1)[-1])
+
+
+def measure_path(step, ts, rs, label: str, steps_per_dispatch: int = 1,
+                 n_timed: int = 30, diagnose_backend: bool = False):
     """Compile, warm up, and time one step function. Returns
-    (seq_updates_per_sec, ts, rs) — threading state through so the two
-    decode paths reuse the same filled replay ring."""
+    (train_steps_per_sec, ts, rs) — threading state through so all paths
+    reuse the same filled replay ring.
+
+    With diagnose_backend, a RuntimeError at the first compile+dispatch is
+    wrapped in FirstDispatchError: the program is known-good, so the failure
+    is the backend's (VERDICT r2 #5 — BENCH_r02 n=1 died with a raw
+    traceback when the wedged tunnel surfaced at first dispatch, after
+    init's jax.devices() guard had already passed)."""
     import jax
 
     t0 = time.time()
-    ts, rs, m = step(ts, rs)
-    jax.block_until_ready(m["loss"])
+    try:
+        if os.environ.get("R2D2_BENCH_SIMULATE_DISPATCH_FAILURE"):
+            raise RuntimeError("simulated backend failure at first dispatch")
+        ts, rs, m = step(ts, rs)
+        jax.block_until_ready(m["loss"])
+    except (RuntimeError, jax.errors.JaxRuntimeError) as e:
+        if diagnose_backend:
+            raise FirstDispatchError(str(e)) from e
+        raise
     print(f"[{label}] compile + first step: {time.time()-t0:.1f}s "
-          f"loss={float(m['loss']):.5f}", file=sys.stderr)
+          f"loss={_last_loss(m):.5f}", file=sys.stderr)
 
     for _ in range(3):  # warmup
         ts, rs, m = step(ts, rs)
@@ -95,26 +184,34 @@ def measure_path(step, ts, rs, label: str, n_timed: int = 30):
     jax.block_until_ready(m["loss"])
     dt = time.time() - t0
 
-    steps_per_sec = n_timed / dt
+    steps_per_sec = n_timed * steps_per_dispatch / dt
     print(f"[{label}] {steps_per_sec:.2f} train steps/s; "
-          f"loss={float(m['loss']):.5f}", file=sys.stderr)
+          f"loss={_last_loss(m):.5f}", file=sys.stderr)
     return steps_per_sec, ts, rs
 
 
 def main() -> None:
     devs = init_backend_or_die()
     on_tpu = devs[0].platform not in ("cpu",)
+    smoke = bool(os.environ.get("R2D2_BENCH_SMOKE"))
 
     import jax
 
     from r2d2_tpu.config import Config
-    from r2d2_tpu.learner import create_train_state, make_learner_step
+    from r2d2_tpu.learner import (
+        create_train_state, make_learner_step, make_multi_learner_step)
     from r2d2_tpu.models import init_network
+    from r2d2_tpu.ops.pallas_kernels import resolve_pallas_obs_decode
     from r2d2_tpu.replay import ReplaySpec, replay_add, replay_init
 
     # reference-default training config; replay capacity trimmed to bound
     # bench setup time (25.6k steps of ring is plenty to sample 128 from)
     cfg = Config().replace(**{"replay.capacity": 25_600})
+    if smoke:
+        cfg = cfg.replace(**{
+            "replay.capacity": 1_600, "replay.block_length": 400,
+            "replay.batch_size": 8, "network.hidden_dim": 64,
+            "network.cnn_out_dim": 64})
     spec = ReplaySpec.from_config(cfg)
     action_dim = 18  # full Atari action set
 
@@ -130,43 +227,122 @@ def main() -> None:
     print(f"filled {spec.num_blocks} blocks in {time.time()-t0:.1f}s",
           file=sys.stderr)
 
-    # A/B the two obs-decode paths (VERDICT r1 #5): XLA gather vs the fused
-    # pallas VMEM kernel (ops/pallas_kernels.py). Pallas compiles on TPU only.
+    use_double = cfg.network.use_double
+    flops_per_step = model_flops_per_step(cfg, action_dim, use_double)
+    peak = peak_flops(devs[0].device_kind) if on_tpu else 0.0
+
+    def build_step(use_pallas: bool, bf16: bool, spd: int):
+        opt = dataclasses.replace(
+            cfg.optim, pallas_obs_decode="on" if use_pallas else "off")
+        netcfg = dataclasses.replace(cfg.network, bf16=bf16)
+        from r2d2_tpu.models import NetworkApply
+        net_b = NetworkApply(action_dim, netcfg, cfg.env.frame_stack,
+                             cfg.env.frame_height, cfg.env.frame_width)
+        if spd == 1:
+            return make_learner_step(net_b, spec, opt, use_double)
+        return make_multi_learner_step(net_b, spec, opt, use_double, spd)
+
     results = {}
+
+    # --- 1. decode A/B at the base config (f32, spd=1) ------------------
+    first = True
     for label, use_pallas in (("xla_decode", False), ("pallas_decode", True)):
-        if use_pallas and not on_tpu:
+        if use_pallas and (not on_tpu or smoke):
             results[label] = None
-            print(f"[{label}] skipped: pallas needs a TPU backend "
-                  f"(have {devs[0].platform})", file=sys.stderr)
+            reason = ("smoke mode measures the xla path only" if smoke else
+                      f"pallas needs a TPU backend (have {devs[0].platform})")
+            print(f"[{label}] skipped: {reason}", file=sys.stderr)
             continue
-        opt = dataclasses.replace(cfg.optim, pallas_obs_decode=use_pallas)
-        step = make_learner_step(net, spec, opt, cfg.network.use_double)
+        step = build_step(use_pallas, bf16=False, spd=1)
         try:
-            sps, ts, rs = measure_path(step, ts, rs, label)
+            sps, ts, rs = measure_path(step, ts, rs, label,
+                                       diagnose_backend=first)
             results[label] = sps * spec.batch_size
+        except FirstDispatchError as e:
+            print(
+                "bench: first compile+dispatch FAILED on a known-good "
+                "program — the backend, not the program, is the suspect.\n"
+                f"  error: {e}\n"
+                f"  JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')!r}\n"
+                + BACKEND_GUIDANCE,
+                file=sys.stderr)
+            sys.exit(1)
         except Exception as e:  # pallas lowering failure must not kill the bench
             if not use_pallas:
                 raise
             results[label] = None
             print(f"[{label}] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        first = False
 
-    # primary metric follows the config-default decode path, falling back to
-    # the other path if the default one was skipped/failed on this backend
-    default_label = ("pallas_decode" if cfg.optim.pallas_obs_decode
-                     else "xla_decode")
-    seq_updates = results[default_label]
+    # default decode path for the matrix (auto: pallas on TPU)
+    default_pallas = (resolve_pallas_obs_decode(cfg.optim.pallas_obs_decode)
+                      and results.get("pallas_decode") is not None)
+
+    # --- 2. perf matrix {f32, bf16} x {steps_per_dispatch 1, 4, 16} -----
+    matrix = {}
+    combos = [(False, 1)] if smoke else [
+        (False, 1), (False, 4), (False, 16),
+        (True, 1), (True, 4), (True, 16)]
+    for bf16, spd in combos:
+        label = f"{'bf16' if bf16 else 'f32'}_spd{spd}"
+        if bf16 and not on_tpu:
+            matrix[label] = None
+            print(f"[{label}] skipped: bf16 matrix is a TPU measurement",
+                  file=sys.stderr)
+            continue
+        if not bf16 and spd == 1:
+            # identical configuration to the part-1 A/B winner — reuse the
+            # measurement instead of paying another compile + timing window
+            reused = (results["pallas_decode"] if default_pallas
+                      else results["xla_decode"])
+            matrix[label] = reused
+            print(f"[{label}] = {reused:.1f} seq/s (reused from part-1 A/B)",
+                  file=sys.stderr)
+            continue
+        step = build_step(default_pallas, bf16, spd)
+        sps, ts, rs = measure_path(step, ts, rs, label, steps_per_dispatch=spd)
+        matrix[label] = sps * spec.batch_size
+        if peak:
+            mfu = sps * flops_per_step / peak
+            print(f"[{label}] ~{sps * flops_per_step / 1e12:.1f} TFLOP/s "
+                  f"model flops = {100*mfu:.1f}% of {peak/1e12:.0f} TFLOP/s "
+                  "bf16 peak", file=sys.stderr)
+
+    # --- report ----------------------------------------------------------
+    # primary metric: what the SHIPPED defaults actually run — default
+    # decode path, NetworkConfig.bf16, RuntimeConfig.steps_per_dispatch.
+    # The full matrix is attached so the defaults can be re-validated
+    # against the measurements each round.
+    candidates = [v for v in matrix.values() if v is not None]
+    default_label = (f"{'bf16' if cfg.network.bf16 else 'f32'}"
+                     f"_spd{cfg.runtime.steps_per_dispatch}")
+    seq_updates = matrix.get(default_label)
     if seq_updates is None:
-        fallback = "xla_decode" if default_label != "xla_decode" else "pallas_decode"
-        seq_updates = results[fallback]
-    print(json.dumps({
+        base = results["pallas_decode"] if default_pallas else results["xla_decode"]
+        if base is None:
+            base = results["xla_decode"]
+        seq_updates = max(candidates) if candidates else base
+    best_label = max(
+        (k for k, v in matrix.items() if v is not None),
+        key=lambda k: matrix[k], default=None)
+    out = {
         "metric": "learner_sequence_updates_per_sec_per_chip",
         "value": round(seq_updates, 1),
         "unit": "sequences/s",
         "vs_baseline": round(seq_updates / REFERENCE_SEQ_UPDATES_PER_SEC, 2),
+        "default_config": default_label,
+        "best_config": best_label,
         "xla_decode": results["xla_decode"] and round(results["xla_decode"], 1),
         "pallas_decode": (results["pallas_decode"]
                           and round(results["pallas_decode"], 1)),
-    }))
+        "matrix": {k: v and round(v, 1) for k, v in matrix.items()},
+    }
+    if peak and candidates:
+        steps_per_sec = seq_updates / spec.batch_size
+        out["model_tflops_per_sec"] = round(steps_per_sec * flops_per_step / 1e12, 1)
+        out["mfu_vs_bf16_peak"] = round(
+            steps_per_sec * flops_per_step / peak, 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -98,7 +98,8 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
     """Returns loss(params, target_params, batch) -> (loss, aux). Pure —
     shared by the single-chip jit, the shard_map path, and the tests."""
 
-    use_pallas = optim.pallas_obs_decode
+    from r2d2_tpu.ops.pallas_kernels import resolve_pallas_obs_decode
+    use_pallas = resolve_pallas_obs_decode(optim.pallas_obs_decode)
 
     def loss_fn(params, target_params, batch: SampleBatch):
         q_online = _unrolled_q(net, spec, params, batch, use_pallas)  # (B,T,A)

@@ -14,11 +14,21 @@ the pallas kernel streams each batch row through VMEM once and emits the
 stacked f32 directly, fusing window expansion, transpose, dtype conversion,
 and normalization.
 
-Grid: one program per batch row. Per-program working set (defaults
-T=55, K=4, 84x84): 409 KB uint8 in + 6.2 MB f32 out — fits VMEM. The window
-shifts are static Python offsets, so each shift is a contiguous VMEM slice
-(no dynamic gather). No custom VJP is needed: observations carry no
-gradient (grads flow to params only).
+Grid: (batch, seq_window), t fastest. The input spec maps every t to the
+same uint8 row block, so Pallas's revisiting optimization DMAs each row
+into VMEM once per batch index and the K-frame windows are VMEM slices;
+the output streams one timestep slab per program.
+
+Layout note (measured, round 3): the kernel emits (B, T, K, H, W) — K
+*before* the spatial dims — and the wrapper transposes to the public
+(B, T, H, W, K) contract outside the kernel. Emitting K minor-most
+directly is catastrophic on TPU: the (8, 128) register tile pads the
+trailing (84, 4) dims to (88, 128), inflating the HBM buffer 32x (26 GB
+at batch 128) and a full-window VMEM block to 416 MB. With (84, 84)
+minor the padding is 1.6x and the per-timestep VMEM slab is ~180 KB; the
+explicit transpose lands inside the jitted train step where XLA folds it
+into its own layout assignment for the conv torso. No custom VJP is
+needed: observations carry no gradient (grads flow to params only).
 
 ``stack_frames_reference`` is the jnp twin — the test oracle and the
 non-TPU fallback.
@@ -40,12 +50,19 @@ def stack_frames_reference(obs: jnp.ndarray, seq_window: int,
     return stacked.transpose(0, 1, 3, 4, 2).astype(jnp.float32) / 255.0
 
 
-def _stack_kernel(seq_window: int, frame_stack: int, in_ref, out_ref):
-    # in_ref: (1, T+K-1, H, W) uint8; out_ref: (1, T, H, W, K) f32
+def _stack_kernel(frame_stack: int, in_ref, out_ref):
+    # in_ref: (1, T+K-1, H, W) uint8 (whole row, revisited across t);
+    # out_ref: (1, 1, K, H, W) f32 — this program's timestep slab.
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
     inv = jnp.float32(1.0 / 255.0)
     for k in range(frame_stack):
-        window = in_ref[0, k : k + seq_window]               # (T, H, W) u8
-        out_ref[0, :, :, :, k] = window.astype(jnp.float32) * inv
+        frame = in_ref[0, pl.dslice(t + k, 1)]               # (1, H, W) u8
+        # Mosaic can't lower uint8 -> float32 directly (BENCH_r02 failure);
+        # widen through int32 first, which it can, then convert.
+        widened = frame[0].astype(jnp.int32).astype(jnp.float32)
+        out_ref[0, 0, k] = widened * inv
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
@@ -59,24 +76,45 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
     batch, row_len, height, width = obs.shape
     assert row_len >= seq_window + frame_stack - 1
 
-    kernel = functools.partial(_stack_kernel, seq_window, frame_stack)
-    return pl.pallas_call(
+    kernel = functools.partial(_stack_kernel, frame_stack)
+    planar = pl.pallas_call(
         kernel,
-        grid=(batch,),
+        grid=(batch, seq_window),
         in_specs=[pl.BlockSpec(
             (1, row_len, height, width),
-            lambda b: (b, 0, 0, 0),
+            lambda b, t: (b, 0, 0, 0),   # constant in t: one DMA per row
             memory_space=pltpu.VMEM,
         )],
         out_specs=pl.BlockSpec(
-            (1, seq_window, height, width, frame_stack),
-            lambda b: (b, 0, 0, 0, 0),
+            (1, 1, frame_stack, height, width),
+            lambda b, t: (b, t, 0, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (batch, seq_window, height, width, frame_stack), jnp.float32),
+            (batch, seq_window, frame_stack, height, width), jnp.float32),
         interpret=interpret,
     )(obs)
+    return planar.transpose(0, 1, 3, 4, 2)                   # (B, T, H, W, K)
+
+
+def resolve_pallas_obs_decode(setting: str) -> bool:
+    """Resolve the OptimConfig.pallas_obs_decode tri-state: "on", "off", or
+    "auto" = pallas iff the default backend is TPU (the measured winner
+    there — BENCH_r03 — while Mosaic cannot compile for CPU/GPU backends).
+    Accepts legacy bools (checkpoints/configs serialized before the
+    tri-state existed) and their CLI string spellings
+    (--optim.pallas_obs_decode=true coerces to the literal string "true")."""
+    if isinstance(setting, bool):
+        return setting
+    lowered = str(setting).lower()
+    if lowered == "auto":
+        return jax.default_backend() == "tpu"
+    if lowered in ("on", "true", "1", "yes"):
+        return True
+    if lowered in ("off", "false", "0", "no"):
+        return False
+    raise ValueError(
+        f"pallas_obs_decode must be 'on', 'off', or 'auto'; got {setting!r}")
 
 
 def stack_frames(obs: jnp.ndarray, seq_window: int, frame_stack: int,

@@ -66,11 +66,15 @@ class Learner:
         self.host_mode = cfg.replay.placement == "host"
         if self.host_mode:
             if cfg.runtime.steps_per_dispatch > 1:
-                raise ValueError(
-                    "runtime.steps_per_dispatch > 1 requires the device "
-                    "replay placement (each host-mode step consumes one "
-                    "host-sampled batch); set replay.placement='device' or "
-                    "steps_per_dispatch=1")
+                # dispatch amortization needs the device-resident replay
+                # (each host-mode step consumes one host-sampled batch);
+                # degrade rather than reject, since 16 is the config default
+                import logging
+                logging.getLogger(__name__).info(
+                    "replay.placement='host': ignoring "
+                    "runtime.steps_per_dispatch=%d (host mode trains one "
+                    "host-sampled batch per step)",
+                    cfg.runtime.steps_per_dispatch)
             self._k = 1
             self._bg_error: Optional[BaseException] = None
             self.replay_state = None

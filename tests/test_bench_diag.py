@@ -1,0 +1,45 @@
+"""bench.py diagnostics tests (VERDICT r2 #5).
+
+BENCH_r02 n=1 died with a raw traceback when the wedged remote-TPU tunnel
+surfaced at the *first dispatch*, after init's jax.devices() guard had
+passed. These tests run bench.py as a subprocess on the CPU backend in its
+smoke configuration and assert (a) a simulated backend failure at first
+dispatch produces the actionable guidance message with rc=1, and (b) the
+happy path still emits the one-line JSON contract the driver parses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _run_bench(extra_env):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env.update({"JAX_PLATFORMS": "cpu", "R2D2_BENCH_SMOKE": "1"})
+    env.update(extra_env)
+    return subprocess.run([sys.executable, BENCH], env=env,
+                          capture_output=True, text=True, timeout=600)
+
+
+def test_simulated_dispatch_failure_prints_guidance():
+    proc = _run_bench({"R2D2_BENCH_SIMULATE_DISPATCH_FAILURE": "1"})
+    assert proc.returncode == 1
+    assert "first compile+dispatch FAILED" in proc.stderr
+    assert "JAX_PLATFORMS" in proc.stderr          # the actionable guidance
+    assert "retry later" in proc.stderr
+    assert "Traceback" not in proc.stderr          # no raw traceback
+
+
+def test_smoke_bench_emits_json_contract():
+    proc = _run_bench({})
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "learner_sequence_updates_per_sec_per_chip"
+    assert out["unit"] == "sequences/s"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 0
+    assert out["matrix"]["f32_spd1"] == out["value"]

@@ -34,6 +34,9 @@ def tiny_config(tmp_path, **overrides) -> Config:
         "optim.lr": 1e-3,
         "runtime.save_dir": str(tmp_path), "runtime.save_interval": 50,
         "runtime.log_interval": 0.2, "runtime.weight_publish_interval": 5,
+        # per-step dispatch: these tests assert per-step cadences (publish,
+        # checkpoint, step counts); the production default is 16
+        "runtime.steps_per_dispatch": 1,
     })
     return cfg.replace(**overrides) if overrides else cfg
 
