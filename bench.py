@@ -310,26 +310,26 @@ def main() -> None:
 
     # --- report ----------------------------------------------------------
     # primary metric: what the SHIPPED defaults actually run — default
-    # decode path, NetworkConfig.bf16, RuntimeConfig.steps_per_dispatch.
-    # The full matrix is attached so the defaults can be re-validated
-    # against the measurements each round.
-    candidates = [v for v in matrix.values() if v is not None]
+    # decode path, NetworkConfig.bf16, RuntimeConfig.steps_per_dispatch —
+    # when that cell was measured; otherwise (smoke mode trims the matrix)
+    # the best measured cell, reported under its own label so value and
+    # measured_config always describe the same configuration. The full
+    # matrix is attached so the defaults can be re-validated against the
+    # measurements each round. matrix['f32_spd1'] is always populated (a
+    # failed base measurement exits in part 1), so the max is never empty.
     default_label = (f"{'bf16' if cfg.network.bf16 else 'f32'}"
                      f"_spd{cfg.runtime.steps_per_dispatch}")
-    seq_updates = matrix.get(default_label)
-    if seq_updates is None:
-        base = results["pallas_decode"] if default_pallas else results["xla_decode"]
-        if base is None:
-            base = results["xla_decode"]
-        seq_updates = max(candidates) if candidates else base
-    best_label = max(
-        (k for k, v in matrix.items() if v is not None),
-        key=lambda k: matrix[k], default=None)
+    best_label = max((k for k, v in matrix.items() if v is not None),
+                     key=lambda k: matrix[k])
+    measured_label = (default_label if matrix.get(default_label) is not None
+                      else best_label)
+    seq_updates = matrix[measured_label]
     out = {
         "metric": "learner_sequence_updates_per_sec_per_chip",
         "value": round(seq_updates, 1),
         "unit": "sequences/s",
         "vs_baseline": round(seq_updates / REFERENCE_SEQ_UPDATES_PER_SEC, 2),
+        "measured_config": measured_label,
         "default_config": default_label,
         "best_config": best_label,
         "xla_decode": results["xla_decode"] and round(results["xla_decode"], 1),
@@ -337,7 +337,7 @@ def main() -> None:
                           and round(results["pallas_decode"], 1)),
         "matrix": {k: v and round(v, 1) for k, v in matrix.items()},
     }
-    if peak and candidates:
+    if peak:
         steps_per_sec = seq_updates / spec.batch_size
         out["model_tflops_per_sec"] = round(steps_per_sec * flops_per_step / 1e12, 1)
         out["mfu_vs_bf16_peak"] = round(

@@ -39,9 +39,12 @@ def rollout_episode(env, policy, max_steps: int = 100_000) -> float:
 
 def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
                         testing: bool = False, is_host: bool = False,
-                        port: int = 5060, seed: int = 0
+                        port: int = 5060, seed: int = 0,
+                        env_sink: Optional[callable] = None
                         ) -> Tuple[float, int, int]:
-    """Returns (mean_return, training_steps, env_steps)."""
+    """Returns (mean_return, training_steps, env_steps). ``env_sink``
+    receives the created env handle so a supervising caller can close it if
+    this evaluator is abandoned mid-rollout (--play straggler handling)."""
     import jax
 
     from r2d2_tpu.actor.policy import ActorPolicy
@@ -62,6 +65,8 @@ def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
                                   sequence=stored.sequence)
     env = create_env(cfg.env, clip_rewards=False, testing=testing,
                      is_host=is_host, port=port, seed=seed)
+    if env_sink is not None:
+        env_sink(env)
     net = NetworkApply(env.action_space.n, cfg.network, cfg.env.frame_stack,
                        cfg.env.frame_height, cfg.env.frame_width)
     template = net.init(jax.random.PRNGKey(0))
@@ -88,6 +93,14 @@ def main(argv=None) -> None:
     p.add_argument("--workers", type=int, default=5,
                    help="concurrent checkpoint evaluations (the reference "
                         "uses a 5-way multiprocessing pool, test.py:23)")
+    p.add_argument("--straggler-window", type=float, default=60.0,
+                   help="--play: seconds a peer evaluator may keep running "
+                        "after the first one finishes before being "
+                        "abandoned (in a shared game all episodes end "
+                        "together; a late peer is stuck)")
+    p.add_argument("--grace-window", type=float, default=15.0,
+                   help="--play: seconds surviving evaluators get to wind "
+                        "down after a peer fails before the CLI exits")
     p.add_argument("--out", default="eval_curve.png")
     args, config_overrides = p.parse_known_args(argv)
 
@@ -101,10 +114,30 @@ def main(argv=None) -> None:
         # task per checkpoint simultaneously, test.py:129-144). A sequential
         # loop can never connect: the host's game would be over before any
         # joiner starts.
+        # Every joiner targets multiplayer.base_port: replay runs exactly ONE
+        # concurrent game that all players share. This matches the
+        # reference's replay usage (test.py:129-144, one host + joiners on a
+        # single port); it is the TRAINING side that fans out one game per
+        # actor index (orchestrator.py actor_env_args, ref train.py:33-38).
+        envs_by_idx: dict = {}
+
         def play_one(i: int, ckpt: str):
             return evaluate_checkpoint(
                 cfg, ckpt, args.rounds, testing=True, is_host=(i == 0),
-                port=cfg.multiplayer.base_port, seed=i)
+                port=cfg.multiplayer.base_port, seed=i,
+                env_sink=lambda e: envs_by_idx.setdefault(i, []).append(e))
+
+        def close_abandoned(indices) -> None:
+            """Tear down envs owned by abandoned evaluator threads — a
+            daemon thread blocked inside env.reset/step would otherwise
+            keep its engine (a live ViZDoom process for real envs) open
+            until interpreter exit."""
+            for i in indices:
+                for e in envs_by_idx.get(i, ()):  # noqa: B007
+                    try:
+                        e.close()
+                    except Exception:
+                        pass
 
         if len(args.play) <= 1:
             results = [play_one(i, c) for i, c in enumerate(args.play)]
@@ -136,27 +169,39 @@ def main(argv=None) -> None:
             # end together, so a peer still "running" long after another
             # finished is stuck (e.g. blocked joining a dead host).
             straggler_deadline = None
+            abandoned = False
             while any(t.is_alive() for t in threads) and not errors:
                 for t in threads:
                     t.join(timeout=0.5)
                 if straggler_deadline is None:
                     if any(not t.is_alive() for t in threads):
-                        straggler_deadline = time_mod.time() + 60.0
+                        straggler_deadline = (time_mod.time()
+                                              + args.straggler_window)
                 elif time_mod.time() > straggler_deadline:
                     stuck = [args.play[i] for i, t in enumerate(threads)
                              if t.is_alive()]
                     print(f"warning: abandoning stuck evaluator(s) after "
-                          f"60s straggler window: {stuck}", file=sys.stderr)
+                          f"{args.straggler_window:.0f}s straggler window: "
+                          f"{stuck}", file=sys.stderr)
+                    # closing a stuck evaluator's env typically wakes its
+                    # blocked rollout with an exception — that error is a
+                    # consequence of the abandonment, not a failure, so the
+                    # error check below is gated on `abandoned`
+                    abandoned = True
+                    close_abandoned(
+                        i for i, t in enumerate(threads) if t.is_alive())
                     break
-            if errors:
+            if errors and not abandoned:
                 # Give surviving evaluators a short grace window to wind
                 # down cleanly (exiting immediately would kill daemon
                 # threads mid-rollout); a joiner stuck on a dead host is
                 # abandoned after the grace period rather than hanging the
-                # CLI forever.
-                grace_deadline = time_mod.time() + 15.0
+                # CLI forever — its env is closed so no engine leaks.
+                grace_deadline = time_mod.time() + args.grace_window
                 for t in threads:
                     t.join(timeout=max(0.0, grace_deadline - time_mod.time()))
+                close_abandoned(
+                    i for i, t in enumerate(threads) if t.is_alive())
                 i, err = errors[0]
                 raise SystemExit(
                     f"evaluator for {args.play[i]} failed: "

@@ -20,7 +20,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from r2d2_tpu.ops.sum_tree import tree_init_np, tree_sample_np, tree_update_np
-from r2d2_tpu.replay.structs import Block, ReplaySpec, SampleBatch
+from r2d2_tpu.replay.structs import (
+    Block, ReplaySpec, RingAccountant, SampleBatch)
 
 
 class HostReplay:
@@ -50,8 +51,9 @@ class HostReplay:
         self.learning_steps = np.zeros((n, s), np.int32)
         self.forward_steps = np.zeros((n, s), np.int32)
         self.seq_start = np.zeros((n, s), np.int32)
-        self.block_ptr = 0
-        self.total_adds = 0   # monotonic; never wraps
+        # single authority for pointer/step accounting; in host placement
+        # the Learner reads this same instance (no mirrored pointer)
+        self.ring = RingAccountant(n)
 
     # -- sum-tree indirection (native C++ or numpy) --
 
@@ -73,9 +75,7 @@ class HostReplay:
     def add(self, block: Block) -> None:
         spec = self.spec
         with self.lock:
-            ptr = self.block_ptr
-            self.block_ptr = (ptr + 1) % spec.num_blocks
-            self.total_adds += 1
+            ptr = self.ring.advance(int(np.asarray(block.learning_steps).sum()))
             idxes = ptr * spec.seqs_per_block + np.arange(spec.seqs_per_block, dtype=np.int64)
             self._tree_update(np.asarray(block.priority, np.float64), idxes)
             self.obs[ptr] = block.obs_row
@@ -127,7 +127,7 @@ class HostReplay:
                     is_weights=is_weights.astype(np.float32),
                     idxes=idxes.astype(np.int32),
                 ),
-                self.total_adds,
+                self.ring.total_adds,
             )
 
     def update_priorities(self, idxes: np.ndarray, td_errors: np.ndarray,
@@ -140,17 +140,18 @@ class HostReplay:
         idxes = np.asarray(idxes, np.int64)
         td_errors = np.asarray(td_errors, np.float64)
         with self.lock:
-            adds = self.total_adds - adds_snapshot
+            adds = self.ring.stale_adds(adds_snapshot)
             if adds >= spec.num_blocks:
                 return  # the whole ring was rewritten; everything is stale
             if adds > 0:
-                old_ptr = (self.block_ptr - adds) % spec.num_blocks
-                if self.block_ptr > old_ptr:
+                block_ptr = self.ring.ptr
+                old_ptr = (block_ptr - adds) % spec.num_blocks
+                if block_ptr > old_ptr:
                     mask = (idxes < old_ptr * spec.seqs_per_block) | (
-                        idxes >= self.block_ptr * spec.seqs_per_block)
+                        idxes >= block_ptr * spec.seqs_per_block)
                 else:  # wrapped: stale range is [old_ptr, N) U [0, block_ptr)
                     mask = (idxes < old_ptr * spec.seqs_per_block) & (
-                        idxes >= self.block_ptr * spec.seqs_per_block)
+                        idxes >= block_ptr * spec.seqs_per_block)
                 idxes, td_errors = idxes[mask], td_errors[mask]
             if idxes.size:
                 self._tree_update(td_errors, idxes)

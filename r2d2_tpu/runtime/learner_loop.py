@@ -65,12 +65,19 @@ class Learner:
                 target_params=jax.tree_util.tree_map(np.copy, params))
         self.host_mode = cfg.replay.placement == "host"
         if self.host_mode:
-            if cfg.runtime.steps_per_dispatch > 1:
-                # dispatch amortization needs the device-resident replay
-                # (each host-mode step consumes one host-sampled batch);
-                # degrade rather than reject, since 16 is the config default
-                import logging
-                logging.getLogger(__name__).info(
+            # dispatch amortization needs the device-resident replay (each
+            # host-mode step consumes one host-sampled batch); degrade
+            # rather than reject, since >1 is the config default. Warn only
+            # for a non-default value — that one was asked for explicitly.
+            # (warning, not info: nothing configures logging, so only the
+            # stdlib lastResort handler [WARNING+] makes this visible)
+            import dataclasses as dc
+            import logging
+            spd_default = next(
+                f.default for f in dc.fields(cfg.runtime)
+                if f.name == "steps_per_dispatch")
+            if cfg.runtime.steps_per_dispatch not in (1, spd_default):
+                logging.getLogger(__name__).warning(
                     "replay.placement='host': ignoring "
                     "runtime.steps_per_dispatch=%d (host mode trains one "
                     "host-sampled batch per step)",
@@ -99,14 +106,17 @@ class Learner:
         self.metrics = metrics or TrainMetrics(player_idx, cfg.runtime.save_dir)
         self.publish: Optional[Callable] = None   # wired by orchestrator
 
-        # Host mirrors of device counters. The learner is the only writer of
-        # the ring and the step counter, so mirroring them avoids a blocking
-        # device read (a full tunnel round-trip under remote TPU dispatch)
-        # per ingested block / per step.
-        self.buffer_steps = 0
+        # Ring accounting: ONE RingAccountant per replay (VERDICT r2 weak
+        # #5). Host placement shares HostReplay's own instance; device
+        # placement keeps a host mirror of the compiled pointer in
+        # ReplayState.block_ptr — mirroring avoids a blocking device read (a
+        # full tunnel round-trip under remote TPU dispatch) per ingested
+        # block, and replay_add advances the device pointer with the
+        # identical wrap rule (asserted in tests/test_replay.py).
+        from r2d2_tpu.replay.structs import RingAccountant
+        self.ring = (self.host_replay.ring if self.host_mode
+                     else RingAccountant(self.spec.num_blocks))
         self.env_steps = resumed_env_steps
-        self._host_ptr = 0
-        self._slot_steps = [0] * self.spec.num_blocks
         self._host_step = int(self.train_state.step)
         self._pending_losses: list = []   # device scalars, flushed lazily
 
@@ -114,22 +124,18 @@ class Learner:
 
     def ingest(self, block: Block) -> None:
         """Ring-write of one actor block (ref worker.py:85-120) — jitted on
-        device, or into the host replay. All counter accounting uses host
-        mirrors so the device path never blocks."""
+        device, or into the host replay. Accounting goes through the single
+        RingAccountant so the device path never blocks on a pointer read."""
         learning = int(np.asarray(block.learning_steps).sum())
-        ptr = self._host_ptr
         if self.host_mode:
-            self.host_replay.add(block)
+            self.host_replay.add(block)   # advances the shared accountant
         else:
             self.replay_state = replay_add(self.spec, self.replay_state, block)
-        # ring overwrite: subtract the steps previously in this slot
-        self.buffer_steps += learning - self._slot_steps[ptr]
-        self._slot_steps[ptr] = learning
-        self._host_ptr = (ptr + 1) % self.spec.num_blocks
+            self.ring.advance(learning)
         self.env_steps += learning
         ret = float(np.asarray(block.sum_reward))
         self.metrics.on_block(learning, None if np.isnan(ret) else ret)
-        self.metrics.set_buffer_size(self.buffer_steps)
+        self.metrics.set_buffer_size(self.ring.buffer_steps)
 
     def drain(self, queue, max_items: int = 32) -> int:
         blocks = queue.drain(max_items)
@@ -140,7 +146,7 @@ class Learner:
     @property
     def ready(self) -> bool:
         """Training gate (ref worker.py:214-218, config.learning_starts)."""
-        return self.buffer_steps >= self.cfg.replay.learning_starts
+        return self.ring.buffer_steps >= self.cfg.replay.learning_starts
 
     @property
     def training_steps(self) -> int:

@@ -177,6 +177,10 @@ def test_vizdoom_gated_import():
 # a registered RGB stub drives the identical factory branch — real gymnasium
 # registry, real make(), adapter, WarpFrame, ClipReward. The tests below it
 # run the true engines whenever ale_py / vizdoom become importable.
+# Re-checked 2026-07-29 (round 3): `import ale_py` / `import vizdoom` still
+# raise ModuleNotFoundError, no vendored wheels in the image, and installs
+# remain policy-forbidden (no network). gymnasium 1.2.2 itself is present,
+# so the stub-driven factory branch is the live coverage.
 
 
 def _register_stub_ale():

@@ -75,6 +75,66 @@ def test_multiplayer_play_runs_evaluators_concurrently(tmp_path, monkeypatch):
     assert barrier.n_waiting == 0
 
 
+def test_multiplayer_play_host_death_surfaces_and_closes_joiner(
+        tmp_path, monkeypatch):
+    """Host-death path (VERDICT r2 #7): the host evaluator fails, the joiner
+    is blocked mid-reset waiting for a game that will never exist. The CLI
+    must surface the host's error as SystemExit within the grace window
+    (not hang), and must close the abandoned joiner's env so no engine
+    process leaks."""
+    import threading
+    import time as time_mod
+
+    from r2d2_tpu.envs import factory as factory_mod
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.learner_loop import Learner
+
+    cfg = tiny_config(tmp_path)
+    probe = create_env(cfg.env)
+    net = NetworkApply(probe.action_space.n, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    probe.close()
+    learner = Learner(cfg, net)
+    ckpt_a = learner.save(1)
+    ckpt_b = learner.save(2)
+
+    real_create = factory_mod.create_env
+    release = threading.Event()
+    joiner_env = []
+
+    def faulty_create(env_cfg, **kw):
+        if kw.get("is_host"):
+            raise RuntimeError("host engine failed to start")
+        env = real_create(env_cfg, **kw)
+        joiner_env.append(env)
+        orig_reset, orig_close = env.reset, env.close
+
+        def reset(*a, **k):
+            release.wait(timeout=30)   # joiner parked on the dead host
+            return orig_reset(*a, **k)
+
+        def close():
+            release.set()              # closing the env unblocks the joiner
+            env.closed = True
+            return orig_close()
+
+        env.reset = reset
+        env.close = close
+        return env
+
+    monkeypatch.setattr(factory_mod, "create_env", faulty_create)
+
+    from r2d2_tpu.cli.evaluate import main
+    t0 = time_mod.time()
+    with pytest.raises(SystemExit, match="host engine failed to start"):
+        main(["--play", ckpt_a, ckpt_b, "--rounds", "1",
+              "--grace-window", "2", "--straggler-window", "5"])
+    assert time_mod.time() - t0 < 25.0, "CLI hung past the grace window"
+    assert joiner_env and getattr(joiner_env[0], "closed", False), (
+        "abandoned joiner's env was not closed")
+
+
 def test_evaluate_checkpoint_sweep(tmp_path):
     cfg = tiny_config(tmp_path, **{"replay.learning_starts": 60,
                                    "runtime.save_interval": 2})

@@ -1,0 +1,102 @@
+"""System-level learnability proof (VERDICT r2 #2).
+
+The reference's only acceptance test is the Atari Boxing learning curve
+(/root/reference/README.md:38-40) — unreproducible here while the game
+engines cannot be installed. This is its hermetic stand-in: train the full
+actor→replay→learner loop on the deterministic FakeR2D2Env (the target
+action is visible in every frame, so the oracle return is episode_len=120
+and a uniform-random policy expects episode_len/action_dim=20) and assert
+the greedy policy's evaluation return lands a large multiple above random.
+
+The training run executes in a subprocess on a plain single-device CPU
+backend: under the suite's 8-virtual-device pin (conftest.py) the same
+budget takes ~3x the wall time on one physical core for no extra coverage —
+the virtual mesh matters for the sharding tests, not this one.
+
+Budget calibration (round 3, single CPU core): 2400 learner steps at
+gamma=0.99 trains in ~2 minutes and reaches returns of 79-86 across seeds
+(~4x random); the 3x assertion leaves margin. gamma=0.99 over the default
+0.997 shortens the credit-assignment horizon to match the env's reactive
+reward — with 0.997 the same budget only reaches ~2.8x.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+RANDOM_EXPECTATION = 120 / 6      # episode_len / action_dim
+ORACLE = 120.0                    # +1 every step
+TRAIN_STEPS = 2400
+EVAL_SEEDS = (123, 456, 789)
+
+
+def learn_config(save_dir: str):
+    from r2d2_tpu.config import Config
+    return Config().replace(**{
+        "env.game_name": "Fake",
+        "env.frame_height": 32, "env.frame_width": 32, "env.frame_stack": 2,
+        "network.hidden_dim": 32, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 4000, "replay.block_length": 20,
+        "replay.batch_size": 16, "replay.learning_starts": 500,
+        "actor.num_actors": 2, "actor.actor_update_interval": 50,
+        "optim.lr": 1e-3, "optim.gamma": 0.99,
+        "runtime.save_dir": save_dir, "runtime.save_interval": 0,
+        "runtime.steps_per_dispatch": 8,
+        "runtime.weight_publish_interval": 5,
+        "runtime.log_interval": 30.0,
+    })
+
+
+def greedy_return(net, params, env_cfg, seed: int) -> float:
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.envs.factory import create_env
+    env = create_env(env_cfg, seed=seed)
+    policy = ActorPolicy(net, params, epsilon=0.0, seed=seed)
+    obs = env.reset()
+    policy.observe_reset(obs)
+    total, done = 0.0, False
+    while not done:
+        action, _, _ = policy.act()
+        obs, reward, done, _ = env.step(action)
+        policy.observe(obs, action)
+        total += reward
+    env.close()
+    return total
+
+
+def _train_and_eval(save_dir: str) -> dict:
+    from r2d2_tpu.runtime.orchestrator import train
+    cfg = learn_config(save_dir)
+    stacks = train(cfg, max_training_steps=TRAIN_STEPS, max_seconds=900,
+                   actor_mode="thread")
+    learner = stacks[0].learner
+    returns = [greedy_return(stacks[0].net, learner.train_state.params,
+                             cfg.env, seed) for seed in EVAL_SEEDS]
+    return {"training_steps": int(learner.training_steps), "returns": returns}
+
+
+def test_full_system_improves_policy(tmp_path):
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=1100)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["training_steps"] >= TRAIN_STEPS
+
+    returns = result["returns"]
+    mean_return = sum(returns) / len(returns)
+    # every seed clears 2x random; the mean clears 3x
+    assert min(returns) >= 2.0 * RANDOM_EXPECTATION, returns
+    assert mean_return >= 3.0 * RANDOM_EXPECTATION, returns
+    assert mean_return <= ORACLE
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    print(json.dumps(_train_and_eval(sys.argv[1])))

@@ -207,6 +207,24 @@ def test_device_replay_ring_overwrite(rng):
                            tree_after_b0[2**spec.tree_layers // 2 - 1 :][: spec.seqs_per_block])
 
 
+def test_ring_accountant_mirrors_device_pointer(rng):
+    """RingAccountant (the single host-side ring authority) must advance
+    with the identical wrap rule as the compiled pointer in
+    ReplayState.block_ptr — the invariant that makes the Learner's host
+    mirror safe (it never reads the device pointer)."""
+    from r2d2_tpu.replay.structs import RingAccountant
+
+    spec = make_spec(num_blocks=3)
+    state = replay_init(spec)
+    ring = RingAccountant(spec.num_blocks)
+    for blk in _fill_blocks(spec, 7, rng):   # wraps the 3-slot ring twice
+        state = replay_add(spec, state, blk)
+        ring.advance(int(np.asarray(blk.learning_steps).sum()))
+        assert ring.ptr == int(state.block_ptr)
+        assert ring.buffer_steps == int(replay_size(state))
+    assert ring.total_adds == 7
+
+
 def test_sample_distribution_follows_priorities(rng):
     """Stratified sampling must draw high-priority sequences more often."""
     spec = make_spec(batch_size=64)
@@ -258,7 +276,7 @@ def test_host_replay_guard_survives_full_ring_lap(rng):
     batch, snapshot = host.sample()
     for blk in _fill_blocks(spec, spec.num_blocks, rng):  # full lap
         host.add(blk)
-    assert host.block_ptr == 3  # pointer is back where it was
+    assert host.ring.ptr == 3  # pointer is back where it was
     tree_before = host.tree.copy()
     host.update_priorities(batch.idxes, np.full(spec.batch_size, 99.0), snapshot)
     np.testing.assert_array_equal(host.tree, tree_before)
