@@ -63,9 +63,20 @@ PEAK_FLOPS_BY_KIND = (
 def init_backend_or_die():
     """Initialize the JAX backend up front with actionable diagnostics —
     round 1 died with a bare 'Unable to initialize backend' when the remote
-    TPU tunnel was wedged by an earlier hard-killed process."""
+    TPU tunnel was wedged by an earlier hard-killed process. A wedged
+    tunnel can also make discovery HANG rather than fail (observed round
+    3), so a watchdog prints the guidance to stderr while we wait — the
+    driver's eventual timeout then leaves a diagnosis in the log tail."""
+    import threading
+
     import jax
 
+    watchdog = threading.Timer(90.0, lambda: print(
+        "bench: backend discovery has been stuck for 90s — the remote-TPU "
+        "tunnel is likely wedged by an earlier hard-killed process.\n"
+        + BACKEND_GUIDANCE, file=sys.stderr, flush=True))
+    watchdog.daemon = True
+    watchdog.start()
     try:
         devs = jax.devices()
     except RuntimeError as e:
@@ -76,6 +87,8 @@ def init_backend_or_die():
             + BACKEND_GUIDANCE,
             file=sys.stderr)
         sys.exit(1)
+    finally:
+        watchdog.cancel()
     print(f"backend: {devs[0].platform} x{len(devs)} "
           f"({devs[0].device_kind})", file=sys.stderr)
     return devs
@@ -191,6 +204,12 @@ def measure_path(step, ts, rs, label: str, steps_per_dispatch: int = 1,
 
 
 def main() -> None:
+    # Route any JAX_PLATFORMS request through jax.config BEFORE backend
+    # discovery: with a wedged remote-TPU tunnel, the env var alone does not
+    # stop the accelerator plugin from hanging discovery (it filters after
+    # plugin init) — a JAX_PLATFORMS=cpu bench run must never touch it.
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
     devs = init_backend_or_die()
     on_tpu = devs[0].platform not in ("cpu",)
     smoke = bool(os.environ.get("R2D2_BENCH_SMOKE"))
@@ -318,7 +337,7 @@ def main() -> None:
     # measurements each round. matrix['f32_spd1'] is always populated (a
     # failed base measurement exits in part 1), so the max is never empty.
     default_label = (f"{'bf16' if cfg.network.bf16 else 'f32'}"
-                     f"_spd{cfg.runtime.steps_per_dispatch}")
+                     f"_spd{cfg.runtime.resolved_steps_per_dispatch()}")
     best_label = max((k for k, v in matrix.items() if v is not None),
                      key=lambda k: matrix[k])
     measured_label = (default_label if matrix.get(default_label) is not None

@@ -23,13 +23,18 @@ from r2d2_tpu.models.network import NetworkApply, initial_hidden
 
 
 class ActorPolicy:
-    def __init__(self, net: NetworkApply, params, epsilon: float, seed: int = 0):
+    def __init__(self, net: NetworkApply, params, epsilon: float, seed: int = 0,
+                 copy_updates: bool = True):
         self.net = net
         self.epsilon = float(epsilon)
         self.action_dim = net.action_dim
         self.rng = np.random.default_rng(seed)
         self._cpu = jax.devices("cpu")[0]
-        self.params = jax.device_put(params, self._cpu)
+        # copy_updates=False: the transport hands over freshly-owned buffers
+        # (WeightSubscriber.poll materializes a new copy per poll), so the
+        # defensive copy in _pin would be a second full-tree copy per refresh
+        self._copy_updates = copy_updates
+        self.params = self._pin(params, copy=True)  # initial params: unknown owner
 
         def step_fn(params, stacked_obs, last_action, hidden):
             # stacked_obs: (H, W, stack) f32 in [0,1]; last_action: () int32
@@ -61,8 +66,20 @@ class ActorPolicy:
         self.stacked[..., -1] = np.asarray(obs, np.float32) / 255.0
         self.last_action = np.int32(action)
 
+    def _pin(self, params, copy: bool):
+        """CPU-resident params, REALLY copied when ``copy``. ``device_put``
+        alone is wrong for in-process aliases: to the same device it is a
+        no-op, and when the source is the learner's train_state — whose
+        buffers are donated by the next fused step — the alias dies with it
+        (observed as 'Buffer has been deleted or donated' in a
+        single-process CPU run)."""
+        if copy:
+            params = jax.tree_util.tree_map(
+                lambda x: np.array(x, copy=True), params)
+        return jax.device_put(params, self._cpu)
+
     def update_params(self, params) -> None:
-        self.params = jax.device_put(params, self._cpu)
+        self.params = self._pin(params, copy=self._copy_updates)
 
     def step(self) -> Tuple[int, np.ndarray, np.ndarray]:
         """Greedy action + Q-values + packed hidden *after* this step; the

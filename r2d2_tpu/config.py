@@ -93,6 +93,17 @@ class ReplayConfig:
     # Where replay lives: "device" = HBM-resident jitted path (the TPU-native
     # design), "host" = numpy + native C++ sum-tree feeder (reference-style).
     placement: str = "device"
+    # Gather sampled obs windows with the pallas scalar-prefetch kernel
+    # (ops/pallas_kernels.py gather_rows_pallas): "on", "off", or "auto"
+    # (pallas iff the backend is TPU — 2.6x the XLA gather there, BENCH_r03).
+    pallas_sample_gather: str = "auto"
+    # Reverb-style rate limiter: pause block ingestion (back-pressuring
+    # actors through the bounded feeder queue) once
+    # env_steps > learning_starts + ratio * train_steps. Pins the
+    # data-collection : learning ratio so training dynamics do not depend
+    # on the actors/learner scheduling balance of the host. 0 = unthrottled
+    # (the reference's behavior: actors free-run, worker.py:528).
+    max_env_steps_per_train_step: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -174,12 +185,20 @@ class RuntimeConfig:
     # Fused train steps per device dispatch (lax.scan). >1 amortizes host
     # dispatch latency; weight publish / checkpoint cadence coarsens to
     # dispatch boundaries. 1 = reference-faithful per-step cadence.
-    # Default 16 = the measured winner of the BENCH_r03 matrix (+28% over
-    # per-step dispatch on TPU v5e; identical math — same RNG chain and
-    # target-sync schedule). Publishes still land every
-    # ceil(interval/16)*16 steps, far fresher than the reference actors'
+    # -1 = auto: 16 on TPU (the measured winner of the BENCH_r03 matrix,
+    # +28% over per-step dispatch on v5e; identical math — same RNG chain
+    # and target-sync schedule), 1 elsewhere (the XLA:CPU lowering of the
+    # scanned step runs ~12x slower per step than the unrolled jit —
+    # measured round 3, PERF.md). Publishes still land every
+    # ceil(interval/k)*k steps, far fresher than the reference actors'
     # 400-step pull cadence (worker.py:568).
-    steps_per_dispatch: int = 16
+    steps_per_dispatch: int = -1
+
+    def resolved_steps_per_dispatch(self) -> int:
+        if self.steps_per_dispatch > 0:
+            return self.steps_per_dispatch
+        import jax
+        return 16 if jax.default_backend() == "tpu" else 1
     prefetch_batches: int = 4        # learner-side batch prefetch depth (ref worker.py:302)
     test_epsilon: float = 0.01
     seed: int = 0

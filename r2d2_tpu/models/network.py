@@ -105,6 +105,63 @@ class DuelingHead(nn.Module):
         return q.astype(jnp.float32)
 
 
+def _block_orthogonal_init(num_blocks: int):
+    """Per-gate orthogonal recurrent init, concatenated — the same
+    distribution as flax's per-gate ``recurrent_kernel_init=orthogonal()``
+    (one semi-orthogonal (H, num_blocks*H) draw would correlate gates)."""
+    base = nn.initializers.orthogonal()
+
+    def init(key, shape, dtype=jnp.float32):
+        rows, cols = shape
+        block = cols // num_blocks
+        keys = jax.random.split(key, num_blocks)
+        return jnp.concatenate(
+            [base(k, (rows, block), dtype) for k in keys], axis=1)
+
+    return init
+
+
+class HoistedLSTM(nn.Module):
+    """LSTM over a (B, T, D) sequence with the input projection hoisted out
+    of the time scan.
+
+    One LSTM step is ``gates = x_t @ Wi + h @ Wh + b``. The ``x @ Wi`` term
+    has no serial dependency, so it is computed for the WHOLE window as one
+    (B*T, D) x (D, 4H) MXU matmul before the scan; the scan body keeps only
+    the (B, H) x (H, 4H) recurrent matmul — shrinking the work on the
+    55-step serial dependency chain ~3x at the reference scale (D=1042,
+    H=512). Identical math to ``nn.OptimizedLSTMCell`` (gate order i,f,g,o,
+    sigmoid/sigmoid/tanh/sigmoid, c'=f*c+i*g, h'=o*tanh(c')), verified
+    param-for-param in tests/test_network.py. Replaces the reference's
+    cuDNN ``nn.LSTM`` (/root/reference/model.py:33)."""
+
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, xs):
+        # carry: (c, h) each (B, H); xs: (B, T, D)
+        hidden = self.features
+        x_proj = nn.Dense(4 * hidden, use_bias=False, dtype=self.dtype,
+                          name="input_proj")(xs)              # (B, T, 4H)
+        w_rec = self.param("recurrent_kernel", _block_orthogonal_init(4),
+                           (hidden, 4 * hidden))
+        bias = self.param("bias", nn.initializers.zeros, (4 * hidden,))
+        w_rec = w_rec.astype(self.dtype)
+        bias = bias.astype(self.dtype)
+
+        def step(carry, xp):                                  # xp: (B, 4H)
+            c, h = carry
+            gates = xp + h @ w_rec + bias
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            new_c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+            new_h = nn.sigmoid(o) * jnp.tanh(new_c)
+            return (new_c, new_h), new_h
+
+        carry, outputs = jax.lax.scan(step, carry, x_proj.swapaxes(0, 1))
+        return carry, outputs.swapaxes(0, 1)                  # (B, T, H)
+
+
 class R2D2Network(nn.Module):
     """The full recurrent Q-network.
 
@@ -141,15 +198,9 @@ class R2D2Network(nn.Module):
             [latent, last_action_seq.astype(dtype)], axis=-1
         )
 
-        # Time-batched LSTM via nn.scan over axis 1 (ref model.py:33 —
-        # torch nn.LSTM batch_first).
-        cell = nn.scan(
-            nn.OptimizedLSTMCell,
-            variable_broadcast="params",
-            split_rngs={"params": False},
-            in_axes=1,
-            out_axes=1,
-        )(features=cfg.hidden_dim, dtype=dtype, name="lstm")
+        # Time-batched LSTM with the input projection hoisted out of the
+        # scan (ref model.py:33 — torch nn.LSTM batch_first).
+        cell = HoistedLSTM(features=cfg.hidden_dim, dtype=dtype, name="lstm")
         carry = unpack_hidden(hidden.astype(dtype))
         carry, outputs = cell(carry, rnn_in)
 

@@ -97,13 +97,13 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
     return planar.transpose(0, 1, 3, 4, 2)                   # (B, T, H, W, K)
 
 
-def resolve_pallas_obs_decode(setting: str) -> bool:
-    """Resolve the OptimConfig.pallas_obs_decode tri-state: "on", "off", or
-    "auto" = pallas iff the default backend is TPU (the measured winner
-    there — BENCH_r03 — while Mosaic cannot compile for CPU/GPU backends).
-    Accepts legacy bools (checkpoints/configs serialized before the
-    tri-state existed) and their CLI string spellings
-    (--optim.pallas_obs_decode=true coerces to the literal string "true")."""
+def resolve_pallas_setting(setting, field: str = "pallas setting") -> bool:
+    """Resolve a pallas tri-state config knob: "on", "off", or "auto" =
+    pallas iff the default backend is TPU (the measured winner there —
+    BENCH_r03 — while Mosaic cannot compile for CPU/GPU backends). Accepts
+    legacy bools (configs serialized before the tri-state existed) and
+    their CLI string spellings (--optim.pallas_obs_decode=true coerces to
+    the literal string "true")."""
     if isinstance(setting, bool):
         return setting
     lowered = str(setting).lower()
@@ -114,7 +114,11 @@ def resolve_pallas_obs_decode(setting: str) -> bool:
     if lowered in ("off", "false", "0", "no"):
         return False
     raise ValueError(
-        f"pallas_obs_decode must be 'on', 'off', or 'auto'; got {setting!r}")
+        f"{field} must be 'on', 'off', or 'auto'; got {setting!r}")
+
+
+def resolve_pallas_obs_decode(setting) -> bool:
+    return resolve_pallas_setting(setting, "pallas_obs_decode")
 
 
 def stack_frames(obs: jnp.ndarray, seq_window: int, frame_stack: int,
@@ -123,3 +127,75 @@ def stack_frames(obs: jnp.ndarray, seq_window: int, frame_stack: int,
     if use_pallas:
         return stack_frames_pallas(obs, seq_window, frame_stack)
     return stack_frames_reference(obs, seq_window, frame_stack)
+
+
+# ---------------------------------------------------------------------------
+# Replay-sample window gather (the learner-side obs slice of
+# /root/reference/worker.py:140-166, which the reference runs as a
+# 128-iteration Python loop in the buffer process).
+
+
+def gather_rows_reference(ring: jnp.ndarray, block_idx: jnp.ndarray,
+                          start: jnp.ndarray, window: int) -> jnp.ndarray:
+    """vmapped dynamic-slice twin — correct everywhere, but XLA lowers the
+    batched start indices to a generic uint8 gather that measures ~5.5 ms
+    at the production shape on TPU v5e (BENCH_r03 analysis)."""
+    def one(b, t0):
+        return jax.lax.dynamic_slice(
+            ring[b], (t0, 0, 0), (window,) + ring.shape[2:])
+    return jax.vmap(one)(block_idx, start)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def gather_rows_pallas(ring: jnp.ndarray, block_idx: jnp.ndarray,
+                       start: jnp.ndarray, window: int,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Scalar-prefetch row gather: out[i] = ring[block_idx[i],
+    start[i] : start[i]+window].
+
+    One program per sampled sequence. The prefetched block index drives the
+    input BlockSpec, so each program's whole ring row is DMA'd into VMEM
+    and the dynamic window offset becomes a VMEM slice. Reads amplify by
+    row_len/window (~7x at the production shape) but stay sequential DMAs —
+    measured 2.15 ms vs the 5.5 ms XLA gather (2.6x). The exact-read
+    variants lose: per-frame blocks pay too many small DMAs (2.8 ms), and
+    a raw HBM->HBM async copy is rejected by Mosaic (window slices must be
+    tile-aligned; H=84 is not)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_rows, row_len, height, width = ring.shape
+    batch = block_idx.shape[0]
+
+    def kernel(bi_ref, st_ref, in_ref, out_ref):
+        i = pl.program_id(0)
+        out_ref[0] = in_ref[0, pl.dslice(st_ref[i], window)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch,),
+        in_specs=[pl.BlockSpec(
+            (1, row_len, height, width),
+            lambda i, bi, st: (bi[i], 0, 0, 0),
+        )],
+        out_specs=pl.BlockSpec(
+            (1, window, height, width),
+            lambda i, bi, st: (i, 0, 0, 0),
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, window, height, width), ring.dtype),
+        interpret=interpret,
+    )(block_idx, start, ring)
+
+
+def gather_rows(ring: jnp.ndarray, block_idx: jnp.ndarray, start: jnp.ndarray,
+                window: int, use_pallas: bool = False) -> jnp.ndarray:
+    """Dispatch: pallas on TPU when requested, vmapped dynamic-slice
+    otherwise."""
+    if use_pallas:
+        return gather_rows_pallas(ring, block_idx, start, window)
+    return gather_rows_reference(ring, block_idx, start, window)

@@ -48,9 +48,14 @@ class ReplaySpec:
     batch_size: int
     prio_exponent: float
     is_exponent: float
+    # resolved at spec construction (ReplayConfig.pallas_sample_gather
+    # tri-state): device-path sampling gathers obs windows with the pallas
+    # kernel instead of the XLA gather
+    pallas_gather: bool = False
 
     @classmethod
     def from_config(cls, cfg: Config) -> "ReplaySpec":
+        from r2d2_tpu.ops.pallas_kernels import resolve_pallas_setting
         return cls(
             num_blocks=cfg.num_blocks,
             seqs_per_block=cfg.seqs_per_block,
@@ -65,6 +70,8 @@ class ReplaySpec:
             batch_size=cfg.replay.batch_size,
             prio_exponent=cfg.replay.prio_exponent,
             is_exponent=cfg.replay.importance_sampling_exponent,
+            pallas_gather=resolve_pallas_setting(
+                cfg.replay.pallas_sample_gather, "pallas_sample_gather"),
         )
 
     @property

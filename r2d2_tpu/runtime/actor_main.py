@@ -41,11 +41,16 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
     fresh = sub.poll()
     if fresh is not None:
         params = fresh
-    policy = ActorPolicy(net, params, epsilon, seed=seed)
+    # copy_updates=False: WeightSubscriber.poll materializes a fresh copy
+    # per poll already — the policy may own those buffers directly
+    policy = ActorPolicy(net, params, epsilon, seed=seed, copy_updates=False)
+
+    from r2d2_tpu.runtime.feeder import put_patient
 
     try:
         run_actor(cfg, env, policy,
-                  block_sink=lambda b: queue.put(b, timeout=60.0),
+                  block_sink=lambda b: put_patient(
+                      queue, b, stop_event.is_set),
                   weight_poll=sub.poll,
                   should_stop=stop_event.is_set)
     finally:

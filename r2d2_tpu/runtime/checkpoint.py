@@ -61,9 +61,20 @@ def load_checkpoint_config(path: str):
 def restore_checkpoint(path: str, template: Optional[Dict[str, Any]] = None
                        ) -> Dict[str, Any]:
     ckptr = ocp.PyTreeCheckpointer()
-    if template is not None:
-        return ckptr.restore(os.path.abspath(path), item=template)
-    return ckptr.restore(os.path.abspath(path))
+    try:
+        if template is not None:
+            return ckptr.restore(os.path.abspath(path), item=template)
+        return ckptr.restore(os.path.abspath(path))
+    except (ValueError, KeyError, TypeError) as e:
+        # orbax structure mismatches surface as opaque tree errors; name the
+        # most likely cause (the checkpoint predates an architecture change
+        # — e.g. the round-3 LSTM param-tree rename) and the escape hatch
+        raise ValueError(
+            f"checkpoint at {path!r} does not match the current network's "
+            "parameter tree — it was likely saved by an older architecture "
+            "revision (parameter names/shapes changed). Re-train, or "
+            "restore with an explicitly matching template.\n"
+            f"original error: {type(e).__name__}: {e}") from e
 
 
 def load_pretrain(path: str, params_template):

@@ -14,6 +14,22 @@ from typing import List, Optional
 from r2d2_tpu.replay.structs import Block
 
 
+def put_patient(q, block: Block, should_stop, poll: float = 0.5) -> bool:
+    """Blocking put that survives indefinite back-pressure (the rate
+    limiter deliberately parks actors here) but still honors the stop
+    signal. Returns False iff stopped before the block was accepted.
+    Module-level because process-mode actors receive the raw (picklable)
+    mp.Queue, not the BlockQueue wrapper — one implementation serves both
+    (actor_main imports this; BlockQueue.put_patient delegates)."""
+    while not should_stop():
+        try:
+            q.put(block, timeout=poll)
+            return True
+        except queue_mod.Full:
+            continue
+    return False
+
+
 class BlockQueue:
     """Works in both modes: mp.Queue for process actors, queue.Queue for
     thread actors (hermetic tests)."""
@@ -28,6 +44,9 @@ class BlockQueue:
 
     def put(self, block: Block, timeout: Optional[float] = None) -> None:
         self._q.put(block, timeout=timeout)
+
+    def put_patient(self, block: Block, should_stop, poll: float = 0.5) -> bool:
+        return put_patient(self._q, block, should_stop, poll)
 
     def drain(self, max_items: int = 16) -> List[Block]:
         """Non-blocking drain of up to max_items blocks."""

@@ -67,16 +67,12 @@ class Learner:
         if self.host_mode:
             # dispatch amortization needs the device-resident replay (each
             # host-mode step consumes one host-sampled batch); degrade
-            # rather than reject, since >1 is the config default. Warn only
-            # for a non-default value — that one was asked for explicitly.
-            # (warning, not info: nothing configures logging, so only the
-            # stdlib lastResort handler [WARNING+] makes this visible)
-            import dataclasses as dc
+            # rather than reject. Warn only for an explicitly-set value > 1
+            # (the -1 auto default resolves silently). (warning, not info:
+            # nothing configures logging, so only the stdlib lastResort
+            # handler [WARNING+] makes this visible)
             import logging
-            spd_default = next(
-                f.default for f in dc.fields(cfg.runtime)
-                if f.name == "steps_per_dispatch")
-            if cfg.runtime.steps_per_dispatch not in (1, spd_default):
+            if cfg.runtime.steps_per_dispatch > 1:
                 logging.getLogger(__name__).warning(
                     "replay.placement='host': ignoring "
                     "runtime.steps_per_dispatch=%d (host mode trains one "
@@ -95,7 +91,7 @@ class Learner:
             self._bg_threads: list = []
         else:
             self.replay_state = replay_init(self.spec)
-            self._k = max(1, cfg.runtime.steps_per_dispatch)
+            self._k = cfg.runtime.resolved_steps_per_dispatch()
             if self._k > 1:
                 self._step_fn = make_multi_learner_step(
                     net, self.spec, cfg.optim, cfg.network.use_double, self._k)
@@ -118,6 +114,13 @@ class Learner:
                      else RingAccountant(self.spec.num_blocks))
         self.env_steps = resumed_env_steps
         self._host_step = int(self.train_state.step)
+        # Rate-limiter baselines: the collect:learn budget is measured from
+        # THIS process's starting point, not from step/env-step zero — a
+        # resumed run restores large cumulative counters while its replay
+        # ring restarts empty, and an absolute comparison would pause
+        # ingestion forever (training could never start).
+        self._ratio_env_base = self.env_steps
+        self._ratio_step_base = self._host_step
         self._pending_losses: list = []   # device scalars, flushed lazily
 
     # -- ingestion --
@@ -137,7 +140,23 @@ class Learner:
         self.metrics.on_block(learning, None if np.isnan(ret) else ret)
         self.metrics.set_buffer_size(self.ring.buffer_steps)
 
+    @property
+    def ingestion_paused(self) -> bool:
+        """Rate limiter (replay.max_env_steps_per_train_step): true when
+        data collection is far enough ahead of learning that ingestion
+        should wait. Leaving blocks in the bounded feeder queue
+        back-pressures the actors (they park in put()), pinning the
+        collect:learn ratio independently of host scheduling."""
+        ratio = self.cfg.replay.max_env_steps_per_train_step
+        if ratio <= 0:
+            return False
+        budget = (self.cfg.replay.learning_starts
+                  + ratio * max(self._host_step - self._ratio_step_base, 1))
+        return self.env_steps - self._ratio_env_base >= budget
+
     def drain(self, queue, max_items: int = 32) -> int:
+        if self.ingestion_paused:
+            return 0
         blocks = queue.drain(max_items)
         for blk in blocks:
             self.ingest(blk)

@@ -85,7 +85,8 @@ class PlayerStack:
 
         def loop(env=env, policy=policy, reader_id=i):
             run_actor(cfg, env, policy,
-                      block_sink=lambda b: self.queue.put(b, timeout=60.0),
+                      block_sink=lambda b: self.queue.put_patient(
+                          b, self._stop.is_set),
                       weight_poll=lambda: self.store.poll(reader_id),
                       should_stop=self._stop.is_set)
 

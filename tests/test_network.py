@@ -97,6 +97,49 @@ def test_dueling_mean_advantage_invariance(small_net):
     np.testing.assert_allclose(np.asarray(q), np.asarray(q_shift), atol=1e-4)
 
 
+def test_hoisted_lstm_matches_flax_optimized_cell():
+    """HoistedLSTM (input projection outside the scan) must reproduce
+    nn.OptimizedLSTMCell exactly given the same weights: map flax's
+    per-gate i{comp}/h{comp} params onto the concatenated [i,f,g,o] layout
+    and compare the full unrolled outputs and final carry."""
+    import flax.linen as nn
+
+    from r2d2_tpu.models.network import HoistedLSTM
+
+    B, T, D, H = 3, 11, 10, 8
+    key = jax.random.PRNGKey(42)
+    xs = jax.random.normal(key, (B, T, D))
+    c0 = jax.random.normal(jax.random.fold_in(key, 1), (B, H))
+    h0 = jax.random.normal(jax.random.fold_in(key, 2), (B, H))
+
+    cell = nn.OptimizedLSTMCell(features=H)
+    cell_params = cell.init(jax.random.PRNGKey(0), (c0, h0), xs[:, 0])
+
+    scan_cell = nn.scan(
+        nn.OptimizedLSTMCell, variable_broadcast="params",
+        split_rngs={"params": False}, in_axes=1, out_axes=1)(features=H)
+    (c_ref, h_ref), out_ref = scan_cell.apply(cell_params, (c0, h0), xs)
+
+    p = cell_params["params"]
+    gates = ["i", "f", "g", "o"]
+    hoisted_params = {"params": {
+        "input_proj": {"kernel": jnp.concatenate(
+            [p[f"i{g}"]["kernel"] for g in gates], axis=1)},
+        "recurrent_kernel": jnp.concatenate(
+            [p[f"h{g}"]["kernel"] for g in gates], axis=1),
+        "bias": jnp.concatenate([p[f"h{g}"]["bias"] for g in gates]),
+    }}
+    lstm = HoistedLSTM(features=H)
+    (c_got, h_got), out_got = lstm.apply(hoisted_params, (c0, h0), xs)
+
+    np.testing.assert_allclose(np.asarray(out_got), np.asarray(out_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_got), np.asarray(c_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h_got), np.asarray(h_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_non_dueling_head():
     cfg = NetworkConfig(hidden_dim=16, cnn_out_dim=32, use_dueling=False)
     spec, params = init_network(
