@@ -7,8 +7,10 @@ configuration (batch 128 sequences, burn-in 40 / learning 10 / n-step 5,
 84x84x4 frames, cnn_out 1024, LSTM 512, dueling on, double off;
 /root/reference/config.py).
 
-Three measurements (VERDICT r2 #1/#3):
+Four measurements (VERDICT r2 #1/#3 + the round-3 kernels):
   1. obs-decode A/B at the base config: XLA gather vs the pallas VMEM kernel;
+  1b. replay sample-gather A/B: the scalar-prefetch pallas row gather vs the
+     XLA batched-dynamic-slice gather, inside the full fused step;
   2. the perf matrix {f32, bf16} x {steps_per_dispatch 1, 4, 16} on the
      default decode path — the reference's amp analog (config.py:35) and the
      host-dispatch amortization the reference cannot do (it pays a Ray RPC
@@ -250,16 +252,17 @@ def main() -> None:
     flops_per_step = model_flops_per_step(cfg, action_dim, use_double)
     peak = peak_flops(devs[0].device_kind) if on_tpu else 0.0
 
-    def build_step(use_pallas: bool, bf16: bool, spd: int):
+    def build_step(use_pallas: bool, bf16: bool, spd: int, step_spec=None):
         opt = dataclasses.replace(
             cfg.optim, pallas_obs_decode="on" if use_pallas else "off")
         netcfg = dataclasses.replace(cfg.network, bf16=bf16)
         from r2d2_tpu.models import NetworkApply
         net_b = NetworkApply(action_dim, netcfg, cfg.env.frame_stack,
                              cfg.env.frame_height, cfg.env.frame_width)
+        step_spec = step_spec or spec
         if spd == 1:
-            return make_learner_step(net_b, spec, opt, use_double)
-        return make_multi_learner_step(net_b, spec, opt, use_double, spd)
+            return make_learner_step(net_b, step_spec, opt, use_double)
+        return make_multi_learner_step(net_b, step_spec, opt, use_double, spd)
 
     results = {}
 
@@ -296,6 +299,21 @@ def main() -> None:
     # default decode path for the matrix (auto: pallas on TPU)
     default_pallas = (resolve_pallas_obs_decode(cfg.optim.pallas_obs_decode)
                       and results.get("pallas_decode") is not None)
+
+    # --- 1b. sample-gather A/B (gather_rows_pallas vs the XLA gather) ----
+    # Part 1 ran with spec.pallas_gather auto-resolved (pallas on TPU); one
+    # extra measurement with the gather forced off isolates its effect on
+    # the full fused step.
+    if on_tpu and not smoke and spec.pallas_gather:
+        spec_xla_gather = dataclasses.replace(spec, pallas_gather=False)
+        step = build_step(default_pallas, bf16=False, spd=1,
+                          step_spec=spec_xla_gather)
+        sps, ts, rs = measure_path(step, ts, rs, "xla_gather")
+        results["xla_gather"] = sps * spec.batch_size
+        results["pallas_gather"] = (results["pallas_decode"] if default_pallas
+                                    else results["xla_decode"])
+    else:
+        results["xla_gather"] = results["pallas_gather"] = None
 
     # --- 2. perf matrix {f32, bf16} x {steps_per_dispatch 1, 4, 16} -----
     matrix = {}
@@ -354,6 +372,9 @@ def main() -> None:
         "xla_decode": results["xla_decode"] and round(results["xla_decode"], 1),
         "pallas_decode": (results["pallas_decode"]
                           and round(results["pallas_decode"], 1)),
+        "xla_gather": results["xla_gather"] and round(results["xla_gather"], 1),
+        "pallas_gather": (results["pallas_gather"]
+                          and round(results["pallas_gather"], 1)),
         "matrix": {k: v and round(v, 1) for k, v in matrix.items()},
     }
     if peak:

@@ -306,6 +306,21 @@ _SCALAR_ANNOTATIONS = {"bool": bool, "int": int, "float": float, "str": str}
 
 
 def _coerce(key: str, value: str, annotation: str) -> Any:
+    if "Tuple[Tuple[int, int, int], ...]" in str(annotation):
+        # conv-pyramid syntax: triples of out_channels,kernel,stride joined
+        # by ';' — e.g. --network.conv_layers=8,4,2;16,3,1
+        try:
+            layers = tuple(
+                tuple(int(x) for x in triple.split(","))
+                for triple in value.split(";") if triple)
+        except ValueError:
+            layers = ()
+        if not layers or any(len(t) != 3 for t in layers):
+            raise SystemExit(
+                f"invalid value {value!r} for {key!r}: expected "
+                "';'-separated out_channels,kernel,stride triples, e.g. "
+                "8,4,2;16,3,1")
+        return layers
     target_type = _SCALAR_ANNOTATIONS.get(str(annotation).replace("Optional[str]", "str"))
     if target_type is None:
         raise SystemExit(

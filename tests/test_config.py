@@ -59,4 +59,15 @@ def test_bad_numeric_override_is_friendly():
     with pytest.raises(SystemExit):
         parse_overrides(Config(), ["--replay.batch_size=abc"])
     with pytest.raises(SystemExit):
+        # python-tuple syntax is rejected with the triple-syntax hint
         parse_overrides(Config(), ["--network.conv_layers=((16,4,2),)"])
+
+
+def test_conv_layers_cli_override():
+    """Conv pyramids are CLI-settable as ';'-joined triples — needed to run
+    small-frame configs (the Nature pyramid shrinks a 32x32 frame to 0) from
+    the command line."""
+    cfg = parse_overrides(Config(), ["--network.conv_layers=8,4,2;16,3,1"])
+    assert cfg.network.conv_layers == ((8, 4, 2), (16, 3, 1))
+    with pytest.raises(SystemExit):
+        parse_overrides(Config(), ["--network.conv_layers=8,4;16,3,1"])

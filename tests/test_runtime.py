@@ -270,6 +270,38 @@ def test_end_to_end_training_slice(tmp_path):
     assert log.exists()
 
 
+def test_put_patient_blocks_until_space_and_honors_stop():
+    """The patient put survives back-pressure (a full queue) until space
+    appears, and gives up promptly when the stop signal fires."""
+    import threading
+    import time as time_mod
+
+    from r2d2_tpu.runtime.feeder import BlockQueue
+
+    q = BlockQueue(maxsize=1, use_mp=False)
+    assert q.put_patient("a", should_stop=lambda: False, poll=0.05)
+
+    # full queue: put_patient parks until a consumer drains
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(
+            q.put_patient("b", should_stop=lambda: False, poll=0.05)))
+    t.start()
+    time_mod.sleep(0.2)
+    assert t.is_alive() and not done          # parked, not failed
+    # drain exactly one: a full drain races the just-woken producer, which
+    # can slip "b" in between two get_nowait calls
+    assert q.drain(max_items=1) == ["a"]
+    t.join(timeout=5.0)
+    assert done == [True] and q.drain() == ["b"]
+
+    # full queue + stop: returns False instead of blocking forever
+    q.put_patient("c", should_stop=lambda: False, poll=0.05)
+    t0 = time_mod.time()
+    assert q.put_patient("d", should_stop=lambda: True, poll=0.05) is False
+    assert time_mod.time() - t0 < 1.0
+
+
 def test_rate_limiter_pauses_and_resumes_ingestion(tmp_path):
     """replay.max_env_steps_per_train_step pins the collect:learn ratio:
     ingestion pauses once env_steps exceed learning_starts + ratio *
