@@ -160,8 +160,16 @@ class MeshConfig:
     sharding) are first-class. A 1x1 mesh degrades to single-chip.
     """
 
-    dp: int = -1   # -1: use all remaining devices
+    # 1 = single-chip (default); N>1 = dp-shard the learner over N chips;
+    # -1 = all available devices. The runtime Learner builds the shard_map
+    # step + sharded replay whenever the resolved mesh is wider than one
+    # device (runtime/learner_loop.py).
+    dp: int = 1
     mp: int = 1
+
+    def resolved_dp(self, n_devices: int) -> int:
+        mp = max(self.mp, 1)
+        return self.dp if self.dp > 0 else max(n_devices // mp, 1)
     # Multi-host: initialize jax.distributed (DCN) before mesh construction.
     multihost: bool = False
     coordinator_address: Optional[str] = None

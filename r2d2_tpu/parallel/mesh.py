@@ -30,7 +30,13 @@ def make_mesh(cfg: Optional[MeshConfig] = None, max_devices: Optional[int] = Non
     devices = jax.devices()
     if max_devices is not None:
         devices = devices[:max_devices]
+    # single resolution + validation point — the Learner's sharded-path
+    # gate uses the same resolved_dp, so gate and mesh cannot disagree
     mp = max(cfg.mp, 1)
-    dp = cfg.dp if cfg.dp > 0 else len(devices) // mp
+    dp = cfg.resolved_dp(len(devices))
+    if dp * mp > len(devices):
+        raise ValueError(
+            f"mesh.dp={cfg.dp} x mesh.mp={cfg.mp} needs {dp * mp} devices "
+            f"but only {len(devices)} are available")
     devices = np.asarray(devices[: dp * mp]).reshape(dp, mp)
     return Mesh(devices, ("dp", "mp"))

@@ -64,6 +64,7 @@ class Learner:
                 params=params,
                 target_params=jax.tree_util.tree_map(np.copy, params))
         self.host_mode = cfg.replay.placement == "host"
+        self.mesh = None
         if self.host_mode:
             # dispatch amortization needs the device-resident replay (each
             # host-mode step consumes one host-sampled batch); degrade
@@ -90,14 +91,47 @@ class Learner:
             self._bg_stop = threading.Event()
             self._bg_threads: list = []
         else:
-            self.replay_state = replay_init(self.spec)
-            self._k = cfg.runtime.resolved_steps_per_dispatch()
-            if self._k > 1:
-                self._step_fn = make_multi_learner_step(
-                    net, self.spec, cfg.optim, cfg.network.use_double, self._k)
+            dp = cfg.mesh.resolved_dp(len(jax.devices()))
+            # gate on dp alone: the sharded step shards and pmeans over
+            # 'dp' only — an mp>1, dp=1 mesh would pay the shard_map
+            # machinery (broadcast adds, replicated compute) for zero
+            # parallelism until tensor sharding actually lands
+            if dp > 1:
+                # dp-sharded learner (SURVEY §5.8): replay sharded
+                # chip-per-shard, per-shard prioritized sampling, gradient
+                # pmean over ICI. Blocks round-robin across shards.
+                from r2d2_tpu.parallel import (
+                    make_mesh, make_sharded_learner_step, sharded_replay_init)
+                from r2d2_tpu.parallel.sharded import make_sharded_replay_add
+                self.mesh = make_mesh(cfg.mesh)
+                self._dp = self.mesh.shape["dp"]
+                self._next_shard = 0
+                self.replay_state = sharded_replay_init(self.spec, self.mesh)
+                self._step_fn = make_sharded_learner_step(
+                    net, self.spec, cfg.optim, cfg.network.use_double,
+                    self.mesh)
+                self._sharded_add = make_sharded_replay_add(
+                    self.spec, self.mesh)
+                # scan-of-shard_map dispatch batching is not wired yet; the
+                # per-step dispatch cost is amortized across dp chips anyway
+                if cfg.runtime.steps_per_dispatch > 1:
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "mesh.dp=%d: ignoring runtime.steps_per_dispatch=%d "
+                        "(dispatch batching over the sharded step is not "
+                        "implemented; training runs one fused step per "
+                        "dispatch)", dp, cfg.runtime.steps_per_dispatch)
+                self._k = 1
             else:
-                self._step_fn = make_learner_step(
-                    net, self.spec, cfg.optim, cfg.network.use_double)
+                self.replay_state = replay_init(self.spec)
+                self._k = cfg.runtime.resolved_steps_per_dispatch()
+                if self._k > 1:
+                    self._step_fn = make_multi_learner_step(
+                        net, self.spec, cfg.optim, cfg.network.use_double,
+                        self._k)
+                else:
+                    self._step_fn = make_learner_step(
+                        net, self.spec, cfg.optim, cfg.network.use_double)
 
         self.metrics = metrics or TrainMetrics(player_idx, cfg.runtime.save_dir)
         self.publish: Optional[Callable] = None   # wired by orchestrator
@@ -110,8 +144,14 @@ class Learner:
         # block, and replay_add advances the device pointer with the
         # identical wrap rule (asserted in tests/test_replay.py).
         from r2d2_tpu.replay.structs import RingAccountant
-        self.ring = (self.host_replay.ring if self.host_mode
-                     else RingAccountant(self.spec.num_blocks))
+        if self.host_mode:
+            self.ring = self.host_replay.ring
+        else:
+            # round-robin feeding visits the dp shards' ring slots in a
+            # single global order — one accountant over dp * num_blocks
+            # slots mirrors every shard's compiled pointer exactly
+            self.ring = RingAccountant(
+                self.spec.num_blocks * (self._dp if self.mesh else 1))
         self.env_steps = resumed_env_steps
         self._host_step = int(self.train_state.step)
         # Rate-limiter baselines: the collect:learn budget is measured from
@@ -133,7 +173,13 @@ class Learner:
         if self.host_mode:
             self.host_replay.add(block)   # advances the shared accountant
         else:
-            self.replay_state = replay_add(self.spec, self.replay_state, block)
+            if self.mesh is not None:
+                self.replay_state = self._sharded_add(
+                    self.replay_state, block, self._next_shard)
+                self._next_shard = (self._next_shard + 1) % self._dp
+            else:
+                self.replay_state = replay_add(
+                    self.spec, self.replay_state, block)
             self.ring.advance(learning)
         self.env_steps += learning
         ret = float(np.asarray(block.sum_reward))
@@ -164,7 +210,12 @@ class Learner:
 
     @property
     def ready(self) -> bool:
-        """Training gate (ref worker.py:214-218, config.learning_starts)."""
+        """Training gate (ref worker.py:214-218, config.learning_starts).
+        Under a dp mesh every shard must also hold at least one block —
+        per-shard prioritized sampling over an empty tree yields NaN
+        importance weights."""
+        if self.mesh is not None and self.ring.total_adds < self._dp:
+            return False
         return self.ring.buffer_steps >= self.cfg.replay.learning_starts
 
     @property

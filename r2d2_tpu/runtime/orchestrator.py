@@ -158,6 +158,16 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
     state). Blocking — the reference's train.py never returns either
     (train.py:60-66); here max_training_steps / max_seconds bound the run."""
     assert actor_mode in ("thread", "process")
+    if cfg.mesh.multihost:
+        # DCN bring-up BEFORE any backend use, so jax.devices() sees the
+        # whole slice (SURVEY §5.8; validated by the two-process loopback
+        # dryrun in parallel/multihost_dryrun.py). Every host runs this
+        # same train() as an SPMD controller; rank-aware orchestration
+        # (per-host actor ownership, rank-0-only checkpointing) is not yet
+        # implemented — single-host meshes are the supported production
+        # topology today.
+        from r2d2_tpu.parallel import init_distributed
+        init_distributed(cfg.mesh)
     num_players = cfg.multiplayer.num_players if cfg.multiplayer.enabled else 1
 
     # probe env for the action dim (ref worker.py:259 creates a throwaway env)
