@@ -83,20 +83,23 @@ def make_sharded_replay_add(spec: ReplaySpec, mesh: Mesh):
 
 
 def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
-                              optim: OptimConfig, use_double: bool, mesh: Mesh):
-    """The dp-sharded fused step. Same contract as make_learner_step."""
+                              optim: OptimConfig, use_double: bool, mesh: Mesh,
+                              steps_per_dispatch: int = 1):
+    """The dp-sharded fused step. Same contract as make_learner_step.
+
+    ``steps_per_dispatch`` > 1 scans K per-shard steps inside the shard_map
+    body (pmean in the scan body is legal under shard_map), so one host
+    dispatch buys K sharded training steps — the same amortization
+    make_multi_learner_step gives the single-chip path, with identical
+    math (same RNG chain, same target-sync schedule; equivalence tested in
+    tests/test_parallel.py). Metrics come back stacked (K,) per dispatch.
+    """
     loss_fn = make_loss_fn(net, spec, optim, use_double)
     tx = make_optimizer(optim)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    k = steps_per_dispatch
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(), P("dp")), out_specs=(P(), P("dp"), P()),
-        check_vma=False)
-    def step(train_state: TrainState, replay_global: ReplayState):
-        replay_state = _shard0(replay_global)
-        my = jax.lax.axis_index("dp")
-
+    def one_step(train_state: TrainState, replay_state: ReplayState, my):
         key, sample_base = jax.random.split(train_state.key)
         sample_key = jax.random.fold_in(sample_base, my)
         batch = replay_sample(spec, replay_state, sample_key)
@@ -133,7 +136,26 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
         train_state = train_state.replace(
             params=params, target_params=target_params,
             opt_state=opt_state, step=new_step, key=key)
-        return train_state, _unshard0(replay_state), metrics
+        return train_state, replay_state, metrics
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P("dp")), out_specs=(P(), P("dp"), P()),
+        check_vma=False)
+    def step(train_state: TrainState, replay_global: ReplayState):
+        replay_state = _shard0(replay_global)
+        my = jax.lax.axis_index("dp")
+        if k == 1:
+            ts, rs, metrics = one_step(train_state, replay_state, my)
+        else:
+            def body(carry, _):
+                ts, rs = carry
+                ts, rs, m = one_step(ts, rs, my)
+                return (ts, rs), m
+
+            (ts, rs), metrics = jax.lax.scan(
+                body, (train_state, replay_state), None, length=k)
+        return ts, _unshard0(rs), metrics
 
     return jax.jit(step, donate_argnums=(0, 1))
 

@@ -92,6 +92,7 @@ class Learner:
             self._bg_threads: list = []
         else:
             dp = cfg.mesh.resolved_dp(len(jax.devices()))
+            self._k = cfg.runtime.resolved_steps_per_dispatch()
             # gate on dp alone: the sharded step shards and pmeans over
             # 'dp' only — an mp>1, dp=1 mesh would pay the shard_map
             # machinery (broadcast adds, replicated compute) for zero
@@ -101,30 +102,19 @@ class Learner:
                 # chip-per-shard, per-shard prioritized sampling, gradient
                 # pmean over ICI. Blocks round-robin across shards.
                 from r2d2_tpu.parallel import (
-                    make_mesh, make_sharded_learner_step, sharded_replay_init)
-                from r2d2_tpu.parallel.sharded import make_sharded_replay_add
+                    make_mesh, make_sharded_learner_step,
+                    make_sharded_replay_add, sharded_replay_init)
                 self.mesh = make_mesh(cfg.mesh)
                 self._dp = self.mesh.shape["dp"]
                 self._next_shard = 0
                 self.replay_state = sharded_replay_init(self.spec, self.mesh)
                 self._step_fn = make_sharded_learner_step(
                     net, self.spec, cfg.optim, cfg.network.use_double,
-                    self.mesh)
+                    self.mesh, steps_per_dispatch=self._k)
                 self._sharded_add = make_sharded_replay_add(
                     self.spec, self.mesh)
-                # scan-of-shard_map dispatch batching is not wired yet; the
-                # per-step dispatch cost is amortized across dp chips anyway
-                if cfg.runtime.steps_per_dispatch > 1:
-                    import logging
-                    logging.getLogger(__name__).warning(
-                        "mesh.dp=%d: ignoring runtime.steps_per_dispatch=%d "
-                        "(dispatch batching over the sharded step is not "
-                        "implemented; training runs one fused step per "
-                        "dispatch)", dp, cfg.runtime.steps_per_dispatch)
-                self._k = 1
             else:
                 self.replay_state = replay_init(self.spec)
-                self._k = cfg.runtime.resolved_steps_per_dispatch()
                 if self._k > 1:
                     self._step_fn = make_multi_learner_step(
                         net, self.spec, cfg.optim, cfg.network.use_double,
@@ -195,6 +185,13 @@ class Learner:
         collect:learn ratio independently of host scheduling."""
         ratio = self.cfg.replay.max_env_steps_per_train_step
         if ratio <= 0:
+            return False
+        # Never pause while the training gate is closed: ingestion is the
+        # only thing that can open it (learning_starts fill, and under a dp
+        # mesh one block per shard), so pausing there would livelock —
+        # drain() returns 0 forever while ready waits for a block that can
+        # never arrive.
+        if not self.ready:
             return False
         budget = (self.cfg.replay.learning_starts
                   + ratio * max(self._host_step - self._ratio_step_base, 1))

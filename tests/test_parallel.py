@@ -107,6 +107,47 @@ def test_sharded_matches_single_chip_exactly(mesh4, rng):
                                np.asarray(rs_b.tree)[0], rtol=1e-5)
 
 
+def test_sharded_multi_step_matches_single_steps(mesh4, rng):
+    """K scanned sharded steps per dispatch == K single-step dispatches:
+    same RNG chain, same params, same trees, metrics stacked (K,). This is
+    the dp-mesh analog of the single-chip steps_per_dispatch equivalence."""
+    spec = make_spec(batch_size=8)
+    net, _ = _net(spec)
+    blocks = _fill_blocks(spec, 8, rng)
+    add = make_sharded_replay_add(spec, mesh4)
+
+    def prep():
+        ts = create_train_state(jax.random.PRNGKey(3), net, OPT)
+        rs = sharded_replay_init(spec, mesh4)
+        for i, blk in enumerate(blocks):
+            rs = add(rs, blk, i % 4)
+        return ts, rs
+
+    k = 3
+    step1 = make_sharded_learner_step(net, spec, OPT, use_double=True,
+                                      mesh=mesh4)
+    stepk = make_sharded_learner_step(net, spec, OPT, use_double=True,
+                                      mesh=mesh4, steps_per_dispatch=k)
+
+    ts_a, rs_a = prep()
+    losses_a = []
+    for _ in range(k):
+        ts_a, rs_a, m = step1(ts_a, rs_a)
+        losses_a.append(float(m["loss"]))
+
+    ts_b, rs_b = prep()
+    ts_b, rs_b, m_b = stepk(ts_b, rs_b)
+
+    assert np.asarray(m_b["loss"]).shape == (k,)
+    np.testing.assert_allclose(losses_a, np.asarray(m_b["loss"]), rtol=1e-5)
+    assert int(ts_b.step) == k
+    for a, b in zip(jax.tree_util.tree_leaves(ts_a.params),
+                    jax.tree_util.tree_leaves(ts_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rs_a.tree), np.asarray(rs_b.tree),
+                               rtol=1e-5)
+
+
 def test_eight_device_full_mesh_compiles(rng):
     """The full 8-device dryrun the driver will exercise via
     __graft_entry__.dryrun_multichip."""

@@ -391,6 +391,43 @@ def test_rate_limiter_survives_resume(tmp_path):
     assert resumed.ingestion_paused
 
 
+def test_rate_limiter_never_pauses_before_dp_gate_opens(tmp_path):
+    """Regression (round-3 review): under a dp mesh the ready gate also
+    waits for one block per shard. The limiter must not pause ingestion
+    while that gate is closed — the budget can be exhausted after shard 0's
+    block, and pausing there would starve shard 1 forever (drain() returns
+    0, ready stays False, training never starts: livelock)."""
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.feeder import BlockQueue
+    from r2d2_tpu.runtime.learner_loop import Learner
+
+    from tests.test_replay import _fill_blocks
+
+    # one 20-step block already exceeds budget = learning_starts(10) + 2.0
+    cfg = tiny_config(tmp_path, **{
+        "mesh.dp": 2, "replay.learning_starts": 10,
+        "replay.max_env_steps_per_train_step": 2.0,
+        "env.frame_height": 12, "env.frame_width": 12,
+        "network.hidden_dim": 8})
+    probe = create_env(cfg.env)
+    net = NetworkApply(probe.action_space.n, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    probe.close()
+    learner = Learner(cfg, net)
+
+    q = BlockQueue(use_mp=False)
+    for blk in _fill_blocks(learner.spec, 2, np.random.default_rng(0)):
+        q.put(blk)
+
+    assert learner.drain(q, max_items=1) == 1    # shard 0 filled
+    assert not learner.ready                     # shard 1 still empty
+    assert not learner.ingestion_paused          # must keep accepting
+    assert learner.drain(q, max_items=1) == 1    # shard 1 filled
+    assert learner.ready                         # training can start
+    assert learner.ingestion_paused              # NOW the ratio applies
+
+
 def test_end_to_end_process_mode(tmp_path):
     """The production actor topology (VERDICT r2 #4): spawned actor
     processes feeding the learner over mp.Queue with shared-memory weight
