@@ -29,7 +29,10 @@ class ActorPolicy:
         self.epsilon = float(epsilon)
         self.action_dim = net.action_dim
         self.rng = np.random.default_rng(seed)
-        self._cpu = jax.devices("cpu")[0]
+        # local_devices, not devices: under a multihost (jax.distributed)
+        # job jax.devices() is the GLOBAL list and index 0 is another
+        # process's non-addressable device on every rank but 0
+        self._cpu = jax.local_devices(backend="cpu")[0]
         # copy_updates=False: the transport hands over freshly-owned buffers
         # (WeightSubscriber.poll materializes a new copy per poll), so the
         # defensive copy in _pin would be a second full-tree copy per refresh

@@ -5,7 +5,8 @@
     python -m r2d2_tpu.cli.train --multiplayer.enabled=true  # self-play stacks
 
 Extra (non-config) flags:
-    --actor-mode=thread|process   actor execution mode (default process)
+    --actor-mode=thread|process   actor execution mode (default: process;
+                                  multihost jobs support thread only)
     --max-steps=N                 stop after N learner steps
     --max-seconds=S               wall-clock bound
 """
@@ -20,7 +21,7 @@ def main(argv=None) -> None:
     from r2d2_tpu.utils import pin_platform
     pin_platform()
     argv = list(sys.argv[1:] if argv is None else argv)
-    actor_mode, max_steps, max_seconds = "process", None, None
+    actor_mode, max_steps, max_seconds = None, None, None
     rest = []
     for arg in argv:
         if arg.startswith("--actor-mode="):
@@ -37,8 +38,20 @@ def main(argv=None) -> None:
         print(" | ".join(f"{k}={v}" for k, v in record.items() if v is not None),
               flush=True)
 
+    if cfg.mesh.multihost and cfg.mesh.num_processes > 1:
+        # multi-controller pod: run this same CLI on every host with its
+        # own --mesh.process_id; the lockstep loop keeps dispatch cadences
+        # identical across processes (parallel/multihost.py). Thread-mode
+        # actors are the only (and default) mode there — an explicit
+        # conflicting --actor-mode raises rather than being ignored.
+        from r2d2_tpu.parallel.multihost import train_multihost
+        train_multihost(cfg, max_training_steps=max_steps,
+                        max_seconds=max_seconds,
+                        actor_mode=actor_mode or "thread", log_fn=log)
+        return
+
     train(cfg, max_training_steps=max_steps, max_seconds=max_seconds,
-          actor_mode=actor_mode, log_fn=log)
+          actor_mode=actor_mode or "process", log_fn=log)
 
 
 if __name__ == "__main__":

@@ -20,4 +20,14 @@ __all__ = [
     "make_mesh", "init_distributed",
     "make_sharded_learner_step", "make_sharded_replay_add",
     "sharded_replay_init", "sharded_buffer_steps",
+    "train_multihost",
 ]
+
+
+def __getattr__(name):
+    # lazy: multihost pulls in the runtime stack; don't tax `import
+    # r2d2_tpu.parallel` for the common single-host case
+    if name == "train_multihost":
+        from r2d2_tpu.parallel.multihost import train_multihost
+        return train_multihost
+    raise AttributeError(name)

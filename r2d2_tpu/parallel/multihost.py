@@ -1,0 +1,460 @@
+"""Rank-aware multi-controller training over a multi-host mesh.
+
+This is the production DCN scaling path (SURVEY §5.8): every process
+(host) is one JAX controller over its local chips; ``jax.distributed``
+stitches them into one global mesh whose 'dp' axis spans all chips. The
+reference has no analog — its scaling unit is one learner process on half
+a GPU (/root/reference/worker.py:251), and scaling actors beyond one
+machine would need a Ray cluster it never configures.
+
+Design:
+
+  * **Each host owns its own actors** (Ape-X ε ladder over the GLOBAL
+    actor index), its own feeder queue, and its own weight store. Blocks
+    feed only the host's local replay shards — zero cross-host experience
+    traffic; the gradient ``pmean`` inside the sharded step is the only
+    per-step DCN collective.
+  * **Lockstep by construction.** Multi-controller JAX requires every
+    process to enter the same compiled programs in the same order. Every
+    loop iteration dispatches exactly one ``lockstep_ingest`` program
+    (per-shard conditional ring-writes + psum'd global counters + stop
+    consensus), reads back its REPLICATED outputs (identical on every
+    host by construction), and — iff those say ready — dispatches exactly
+    one sharded train step. Every control-flow decision derives from
+    replicated values, so every host takes the same branch; host-local
+    timing (queue depth, sleeps, signals) only changes iteration *data*,
+    never dispatch *order*.
+  * **Stop consensus**: each host contributes a local stop flag (signal,
+    deadline) to the ingest program; the psum makes any host's stop
+    everyone's stop on the same iteration — no host is left blocked in a
+    collective whose peers exited.
+  * **Rank 0 de-duplicates side effects**: checkpoints and metrics logs
+    (params are replicated bit-identically everywhere, so this loses
+    nothing).
+
+Scope: thread-mode actors, device replay placement, single player, fresh
+start (no resume) — the combinations a multi-host pod actually trains
+with. Unsupported combinations raise immediately.
+
+Demo / validation (two loopback controllers, virtual CPU devices):
+
+    python -m r2d2_tpu.parallel.multihost            # launcher
+"""
+
+import functools
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from r2d2_tpu.config import Config, apex_epsilon
+from r2d2_tpu.replay.structs import Block, ReplaySpec, empty_block_np
+
+
+def make_lockstep_ingest(spec: ReplaySpec, mesh):
+    """One jitted program per loop iteration: conditional per-shard block
+    writes, global counters, and stop consensus.
+
+    Inputs (global shapes, 'dp'-sharded): replay state; cum_env (dp,) i32
+    cumulative ingested learning-steps per shard; blocks stacked with a
+    leading dp axis (each host fills only its local shards' rows — at most
+    one valid row per host per iteration); valid (dp,) i32; stop (dp,) i32.
+    Outputs: new state, new cum_env, and a dict of REPLICATED scalars:
+    buffer_steps (live steps in the ring), filled_shards (shards holding
+    data — the dp ready-gate), env_steps (cumulative), stop (>0 = any
+    host requested stop).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from r2d2_tpu.parallel.sharded import _shard0, _unshard0
+    from r2d2_tpu.replay.device_replay import replay_add
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
+        out_specs=(P("dp"), P("dp"), P()),
+        check_vma=False)
+    def ingest(state, cum_env, blocks, valid, stop):
+        local = _shard0(state)
+        blk = jax.tree_util.tree_map(lambda x: x[0], blocks)
+        local = jax.lax.cond(
+            valid[0] > 0, lambda s: replay_add(spec, s, blk),
+            lambda s: s, local)
+        added = jnp.where(valid[0] > 0, blk.learning_steps.sum(), 0)
+        cum = cum_env[0] + added.astype(jnp.int32)
+        my_steps = local.learning_steps.sum()
+        info = {
+            "buffer_steps": jax.lax.psum(my_steps, "dp"),
+            "filled_shards": jax.lax.psum(
+                (my_steps > 0).astype(jnp.int32), "dp"),
+            "env_steps": jax.lax.psum(cum, "dp"),
+            "stop": jax.lax.psum(stop[0], "dp"),
+        }
+        return _unshard0(local), cum[None], info
+
+    return jax.jit(ingest, donate_argnums=(0, 1))
+
+
+class HostFeed:
+    """Builds each iteration's global ingest operands from process-local
+    blocks: a (dp,)-leading stacked Block whose rows are zeros except this
+    host's round-robin target shard, plus the valid/stop flag vectors.
+    Every leaf goes through ``jax.make_array_from_process_local_data`` so
+    no host ever needs another host's data."""
+
+    def __init__(self, spec: ReplaySpec, mesh):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.spec = spec
+        self.sharding = NamedSharding(mesh, P("dp"))
+        devs = mesh.devices.reshape(-1)   # (dp,) — mp==1 asserted by caller
+        me = jax.process_index()
+        self.local_rows = [i for i, d in enumerate(devs)
+                           if d.process_index == me]
+        if not self.local_rows:
+            raise ValueError(
+                f"process {me} owns no mesh shards — mesh.dp must cover "
+                f"every participating host's devices")
+        lo, hi = self.local_rows[0], self.local_rows[-1]
+        if self.local_rows != list(range(lo, hi + 1)):
+            raise NotImplementedError(
+                "non-contiguous per-process mesh rows are not supported "
+                f"(process {me} owns {self.local_rows})")
+        self.local_dp = len(self.local_rows)
+        self._zero = empty_block_np(spec)
+        self._rr = 0
+        # the all-zero (blocks, valid, stop) triple for block=None, stop=0
+        # iterations, built once: ingest_fn does not donate these operands,
+        # so reusing them avoids a full zero-block allocation + H2D
+        # transfer per no-op iteration (the pre-ready fill phase spins on
+        # exactly these)
+        self._noop = self._build(None, 0)
+
+
+    def build(self, block: Optional[Block], stop_flag: int):
+        """Returns (blocks, valid, stop) global arrays for lockstep_ingest.
+        ``block`` lands in the next local shard (round-robin); None = no-op
+        iteration (all-invalid rows, reused from the prebuilt triple)."""
+        if block is None and not stop_flag:
+            return self._noop
+        return self._build(block, stop_flag)
+
+    def _build(self, block: Optional[Block], stop_flag: int):
+        import jax
+
+        stacked = {}
+        target = self._rr
+        for name, zero in self._zero.items():
+            rows = np.broadcast_to(
+                zero[None], (self.local_dp,) + zero.shape).copy()
+            if block is not None:
+                rows[target] = np.asarray(getattr(block, name))
+            stacked[name] = jax.make_array_from_process_local_data(
+                self.sharding, rows)
+        valid = np.zeros((self.local_dp,), np.int32)
+        if block is not None:
+            valid[target] = 1
+            self._rr = (self._rr + 1) % self.local_dp
+        stop = np.full((self.local_dp,), int(stop_flag), np.int32)
+        return (Block(**stacked),
+                jax.make_array_from_process_local_data(self.sharding, valid),
+                jax.make_array_from_process_local_data(self.sharding, stop))
+
+
+def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
+                    max_seconds: Optional[float] = None,
+                    actor_mode: str = "thread",
+                    log_fn: Callable[[dict], None] = None) -> dict:
+    """The rank-aware ``train()``: run this same function on every host of
+    the pod (SPMD controllers). Blocks until done; returns a summary dict
+    {step, env_steps, buffer_steps, params} for this process.
+    """
+    import jax
+
+    if actor_mode != "thread":
+        raise NotImplementedError(
+            "multihost training runs thread-mode actors (each controller "
+            "hosts its own fleet in-process); spawned-process actors are "
+            "not wired — pass --actor-mode=thread")
+    if cfg.multiplayer.enabled:
+        raise NotImplementedError(
+            "multihost + multiplayer population training is not supported: "
+            "each player's stack is an independent mesh job — launch one "
+            "multihost job per player instead")
+    if cfg.replay.placement != "device":
+        raise NotImplementedError(
+            "multihost training requires replay.placement='device'")
+    if cfg.runtime.resume or cfg.runtime.pretrain:
+        raise NotImplementedError(
+            "multihost resume/warm-start is not wired yet (rank-consistent "
+            "restore ordering); start fresh or train single-host")
+
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.learner.train_step import create_train_state
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.parallel.mesh import init_distributed, make_mesh
+    from r2d2_tpu.parallel.sharded import (
+        make_sharded_learner_step, sharded_replay_init)
+    from r2d2_tpu.runtime.actor_loop import run_actor
+    from r2d2_tpu.runtime.checkpoint import save_checkpoint
+    from r2d2_tpu.runtime.feeder import BlockQueue
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+    from r2d2_tpu.runtime.weights import InProcWeightStore
+
+    init_distributed(cfg.mesh)
+    rank, nprocs = jax.process_index(), jax.process_count()
+
+    spec = ReplaySpec.from_config(cfg)
+    probe = create_env(cfg.env, seed=cfg.runtime.seed)
+    action_dim = probe.action_space.n
+    probe.close()
+    net = NetworkApply(action_dim, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+
+    # identical seed on every host -> identical initial params; the pmean'd
+    # updates keep them identical forever (tested single-host; the loopback
+    # demo asserts it cross-process)
+    ts = create_train_state(jax.random.PRNGKey(cfg.runtime.seed), net,
+                            cfg.optim)
+    mesh = make_mesh(cfg.mesh)
+    if mesh.shape["mp"] != 1:
+        raise NotImplementedError("multihost mp>1 is not supported")
+    dp = mesh.shape["dp"]
+    rs = sharded_replay_init(spec, mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    cum_env = jax.device_put(np.zeros((dp,), np.int32),
+                             NamedSharding(mesh, P("dp")))
+
+    k = cfg.runtime.resolved_steps_per_dispatch()
+    step_fn = make_sharded_learner_step(
+        net, spec, cfg.optim, cfg.network.use_double, mesh,
+        steps_per_dispatch=k)
+    ingest_fn = make_lockstep_ingest(spec, mesh)
+    feed = HostFeed(spec, mesh)
+
+    # -- local actors (this host's share of the global fleet) --
+    stop = threading.Event()
+    store = InProcWeightStore(ts.params)
+    queue = BlockQueue(use_mp=False)
+    n_local = cfg.actor.num_actors
+    threads: List[threading.Thread] = []
+    for i in range(n_local):
+        gidx = rank * n_local + i
+        eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
+                           cfg.actor.eps_alpha)
+        seed = cfg.runtime.seed + 100 * gidx
+        env = create_env(cfg.env, seed=seed, name=f"h{rank}a{i}")
+        policy = ActorPolicy(net, ts.params, eps, seed=seed)
+
+        def loop(env=env, policy=policy, reader_id=i):
+            run_actor(cfg, env, policy,
+                      block_sink=lambda b: queue.put_patient(b, stop.is_set),
+                      weight_poll=lambda: store.poll(reader_id),
+                      should_stop=stop.is_set)
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"actor-h{rank}-{i}")
+        t.start()
+        threads.append(t)
+
+    metrics = TrainMetrics(0, cfg.runtime.save_dir) if rank == 0 else None
+    max_steps = max_training_steps or cfg.optim.training_steps
+    deadline = time.time() + max_seconds if max_seconds else None
+    rt = cfg.runtime
+    ratio = cfg.replay.max_env_steps_per_train_step
+    step_count = 0
+    paused = False
+    pending_losses: list = []
+    last_log = time.time()
+    info = {"buffer_steps": 0, "env_steps": 0, "filled_shards": 0}
+
+    def flush_losses():
+        if pending_losses and metrics is not None:
+            for arr in jax.device_get(pending_losses):
+                for loss in np.atleast_1d(arr):
+                    metrics.on_train_step(float(loss))
+        pending_losses.clear()
+
+    import os
+    debug = bool(os.environ.get("R2D2_MH_DEBUG"))
+    it = 0
+    try:
+        while step_count < max_steps:
+            it += 1
+            local_stop = int(stop.is_set()
+                             or (deadline is not None
+                                 and time.time() > deadline))
+            block = None
+            if not paused:
+                drained = queue.drain(1)
+                block = drained[0] if drained else None
+            rs, cum_env, dev_info = ingest_fn(rs, cum_env,
+                                              *feed.build(block, local_stop))
+            info = {kk: int(v) for kk, v in jax.device_get(dev_info).items()}
+            if debug:
+                print(f"[mh rank={rank} it={it}] step={step_count} "
+                      f"block={block is not None} {info}", flush=True)
+            if metrics is not None and block is not None:
+                ret = float(np.asarray(block.sum_reward))
+                metrics.on_block(0, None if np.isnan(ret) else ret)
+            if info["stop"] > 0:
+                break
+
+            # every decision below uses only replicated values -> every
+            # host takes the same branch (the lockstep invariant)
+            ready = (info["filled_shards"] == dp
+                     and info["buffer_steps"] >= cfg.replay.learning_starts)
+            paused = bool(
+                ready and ratio > 0
+                and info["env_steps"] >= cfg.replay.learning_starts
+                    + ratio * max(step_count, 1))
+            if ready:
+                prev = step_count
+                ts, rs, m = step_fn(ts, rs)
+                step_count += k
+                if metrics is not None:   # only rank 0 flushes; don't
+                    pending_losses.append(m["loss"])   # accumulate elsewhere
+                boundary = lambda iv: iv and step_count // iv > prev // iv
+                if boundary(rt.weight_publish_interval):
+                    store.publish(ts.params)
+                if rank == 0 and boundary(rt.save_interval):
+                    save_checkpoint(
+                        rt.save_dir, cfg.env.game_name,
+                        step_count // rt.save_interval, 0, ts.params,
+                        ts.opt_state, ts.target_params, step_count,
+                        info["env_steps"], config_json=cfg.to_json())
+            else:
+                time.sleep(0.01)
+
+            if metrics is not None:
+                now = time.time()
+                if now - last_log >= rt.log_interval:
+                    flush_losses()
+                    metrics.env_steps = info["env_steps"]
+                    metrics.set_buffer_size(info["buffer_steps"])
+                    record = metrics.log(now - last_log)
+                    if log_fn:
+                        log_fn({"rank": rank, **record})
+                    last_log = now
+        flush_losses()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+
+    return {"step": step_count, "env_steps": info["env_steps"],
+            "buffer_steps": info["buffer_steps"], "params": ts.params}
+
+
+# ---------------------------------------------------------------------------
+# Loopback demo/validation: N controller processes on one machine, virtual
+# CPU devices, fake env — the full rank-aware loop end-to-end (the test in
+# tests/test_parallel.py runs this).
+
+def _demo_config(save_dir: str) -> "Config":
+    return Config().replace(**{
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 4, "replay.learning_starts": 60,
+        "actor.num_actors": 1,
+        "runtime.save_dir": save_dir, "runtime.save_interval": 4,
+        "runtime.log_interval": 2.0, "runtime.weight_publish_interval": 2,
+        "runtime.steps_per_dispatch": 2,
+        "mesh.multihost": True,
+    })
+
+
+def _demo_worker(process_id: int, num_processes: int, coordinator: str,
+                 devices_per_process: int, save_dir: str,
+                 max_steps: int) -> None:
+    from r2d2_tpu.utils.platform import pin_cpu_platform
+    pin_cpu_platform(devices_per_process)
+    import jax
+
+    n_global = num_processes * devices_per_process
+    cfg = _demo_config(save_dir).replace(**{
+        "mesh.coordinator_address": coordinator,
+        "mesh.num_processes": num_processes, "mesh.process_id": process_id,
+        "mesh.dp": n_global,
+    })
+    out = train_multihost(cfg, max_training_steps=max_steps, max_seconds=240)
+    # params must be bit-identical across this process's shards
+    leaf = jax.tree_util.tree_leaves(out["params"])[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+    print(f"[proc {process_id}] multihost train ok: step={out['step']} "
+          f"env_steps={out['env_steps']} "
+          f"param_digest={float(np.abs(shards[0]).sum()):.6f}", flush=True)
+
+
+def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
+                save_dir: str = "/tmp/r2d2_multihost_demo",
+                max_steps: int = 8, timeout: float = 300.0) -> None:
+    """Spawn the loopback controllers (mirrors multihost_dryrun.launch)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = f"127.0.0.1:{port}"
+    procs = [subprocess.Popen([
+        sys.executable, "-m", "r2d2_tpu.parallel.multihost",
+        f"--process-id={pid}", f"--num-processes={num_processes}",
+        f"--coordinator={coordinator}",
+        f"--devices-per-process={devices_per_process}",
+        f"--save-dir={save_dir}", f"--max-steps={max_steps}",
+    ]) for pid in range(num_processes)]
+    deadline = time.time() + timeout
+    rcs = []
+    try:
+        for p in procs:
+            try:
+                rcs.append(p.wait(timeout=max(1.0, deadline - time.time())))
+            except subprocess.TimeoutExpired:
+                rcs.append(None)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(rc != 0 for rc in rcs):
+        raise SystemExit(
+            f"multihost train demo failed: worker rcs={rcs} (None = timed "
+            f"out after {timeout:.0f}s and was killed)")
+    print(f"multihost train demo: {num_processes} controllers x "
+          f"{devices_per_process} devices ok")
+
+
+def main(argv=None) -> None:
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--num-processes", type=int, default=2)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--devices-per-process", type=int, default=2)
+    p.add_argument("--save-dir", default="/tmp/r2d2_multihost_demo")
+    p.add_argument("--max-steps", type=int, default=8)
+    args = p.parse_args(argv)
+    if args.process_id is None:
+        launch_demo(args.num_processes, args.devices_per_process,
+                    args.save_dir, args.max_steps)
+    else:
+        _demo_worker(args.process_id, args.num_processes, args.coordinator,
+                     args.devices_per_process, args.save_dir, args.max_steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,11 +26,29 @@ def _ckpt_dir(save_dir: str, game: str, index: int, player: int) -> str:
     return os.path.abspath(os.path.join(save_dir, f"{game}{index}_player{player}"))
 
 
+def _solo_checkpointer() -> ocp.Checkpointer:
+    """A checkpointer whose barrier set is ONLY the calling process.
+
+    Under a multi-controller job (jax.process_count() > 1) orbax's default
+    save synchronizes across every process — but the lockstep multihost
+    trainer (parallel/multihost.py) checkpoints on rank 0 only, and the
+    other ranks never enter the save, so the default barrier deadlocks
+    (observed: loopback demo wedged at the first save boundary)."""
+    if jax.process_count() > 1:
+        me = jax.process_index()
+        return ocp.Checkpointer(
+            ocp.PyTreeCheckpointHandler(),
+            multiprocessing_options=ocp.options.MultiprocessingOptions(
+                primary_host=me, active_processes={me},
+                barrier_sync_key_prefix=f"solo{me}"))
+    return ocp.PyTreeCheckpointer()
+
+
 def save_checkpoint(save_dir: str, game: str, index: int, player: int,
                     params, opt_state, target_params, step: int,
                     env_steps: int, config_json: Optional[str] = None) -> str:
     path = _ckpt_dir(save_dir, game, index, player)
-    ckptr = ocp.PyTreeCheckpointer()
+    ckptr = _solo_checkpointer()
     payload = {
         "params": jax.device_get(params),
         "target_params": jax.device_get(target_params),

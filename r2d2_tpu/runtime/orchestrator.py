@@ -162,21 +162,19 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
         # DCN bring-up BEFORE any backend use, so jax.devices() sees the
         # whole slice (SURVEY §5.8; validated by the two-process loopback
         # dryrun in parallel/multihost_dryrun.py). Every host runs this
-        # same train() as an SPMD controller; rank-aware orchestration
-        # (per-host actor ownership, lockstep dispatch cadence, rank-0-only
-        # checkpointing) is not yet implemented — single-host meshes are the
-        # supported production topology today, so refuse multi-process
-        # training loudly rather than letting per-host Learners dispatch
-        # collective programs at diverging cadences (deadlock/corruption
-        # under multi-controller JAX).
+        # same train() as an SPMD controller. This single-controller loop
+        # dispatches at its own cadence, which multi-controller JAX cannot
+        # tolerate — multi-process jobs must use the rank-aware lockstep
+        # loop instead (parallel/multihost.py; cli/train.py routes there
+        # automatically).
         if cfg.mesh.num_processes > 1:
             raise NotImplementedError(
-                "mesh.multihost training with num_processes > 1 is not yet "
-                "supported by train(): every process would need to enter "
-                "the sharded add/step programs in lockstep. Use a "
-                "single-host mesh (mesh.dp <= local chips), or the "
-                "multihost bring-up dryrun (parallel/multihost_dryrun.py) "
-                "to validate DCN connectivity.")
+                "mesh.multihost training with num_processes > 1 must go "
+                "through r2d2_tpu.parallel.multihost.train_multihost (the "
+                "lockstep multi-controller loop; cli/train.py routes there "
+                "automatically) — this single-controller train() would "
+                "dispatch collective programs at diverging per-host "
+                "cadences.")
         from r2d2_tpu.parallel import init_distributed
         init_distributed(cfg.mesh)
     num_players = cfg.multiplayer.num_players if cfg.multiplayer.enabled else 1
