@@ -65,6 +65,10 @@ class NetworkConfig:
     conv_layers: Tuple[Tuple[int, int, int], ...] = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
     # bf16 matmul/conv compute on TPU (replaces torch.cuda.amp, ref config.py:35).
     bf16: bool = False
+    # lax.scan unroll factor for the LSTM time scan (identical math; >1
+    # trades compile time for fewer sequential loop boundaries on the
+    # 55-step serial chain). Set from measurement — see PERF.md.
+    scan_unroll: int = 1
 
 
 @dataclass(frozen=True)

@@ -137,6 +137,9 @@ class HoistedLSTM(nn.Module):
 
     features: int
     dtype: jnp.dtype = jnp.float32
+    # lax.scan unroll factor: >1 trades compile time/code size for fewer
+    # loop-iteration boundaries on the serial chain (NetworkConfig.scan_unroll)
+    unroll: int = 1
 
     @nn.compact
     def __call__(self, carry, xs):
@@ -158,7 +161,8 @@ class HoistedLSTM(nn.Module):
             new_h = nn.sigmoid(o) * jnp.tanh(new_c)
             return (new_c, new_h), new_h
 
-        carry, outputs = jax.lax.scan(step, carry, x_proj.swapaxes(0, 1))
+        carry, outputs = jax.lax.scan(step, carry, x_proj.swapaxes(0, 1),
+                                      unroll=self.unroll)
         return carry, outputs.swapaxes(0, 1)                  # (B, T, H)
 
 
@@ -200,7 +204,8 @@ class R2D2Network(nn.Module):
 
         # Time-batched LSTM with the input projection hoisted out of the
         # scan (ref model.py:33 — torch nn.LSTM batch_first).
-        cell = HoistedLSTM(features=cfg.hidden_dim, dtype=dtype, name="lstm")
+        cell = HoistedLSTM(features=cfg.hidden_dim, dtype=dtype,
+                           unroll=cfg.scan_unroll, name="lstm")
         carry = unpack_hidden(hidden.astype(dtype))
         carry, outputs = cell(carry, rnn_in)
 

@@ -1,0 +1,48 @@
+"""Shared loopback launcher for multi-controller validation runs.
+
+One implementation of the subtle part — spawn N worker processes against an
+ephemeral-port coordinator, wait on one shared deadline, and kill survivors
+on ANY exit path (a crashed coordinator process would otherwise leave its
+peer blocked in jax.distributed.initialize as an orphan) — used by both the
+bring-up dryrun (multihost_dryrun.py) and the full lockstep-training demo
+(multihost.py).
+"""
+
+import socket
+import subprocess
+import sys
+import time
+from typing import Callable, List
+
+
+def pick_coordinator() -> str:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return f"127.0.0.1:{s.getsockname()[1]}"
+
+
+def run_loopback_workers(worker_argv: Callable[[int, str], List[str]],
+                         num_processes: int, timeout: float,
+                         label: str) -> None:
+    """``worker_argv(process_id, coordinator)`` returns the full argv for one
+    worker. Raises SystemExit naming ``label`` if any worker fails or times
+    out (timed-out workers are killed)."""
+    coordinator = pick_coordinator()
+    procs = [subprocess.Popen(worker_argv(pid, coordinator))
+             for pid in range(num_processes)]
+    deadline = time.time() + timeout
+    rcs = []
+    try:
+        for p in procs:
+            try:
+                rcs.append(p.wait(timeout=max(1.0, deadline - time.time())))
+            except subprocess.TimeoutExpired:
+                rcs.append(None)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(rc != 0 for rc in rcs):
+        raise SystemExit(
+            f"{label} failed: worker rcs={rcs} (None = timed out after "
+            f"{timeout:.0f}s and was killed)")

@@ -32,9 +32,10 @@ Design:
     (params are replicated bit-identically everywhere, so this loses
     nothing).
 
-Scope: thread-mode actors, device replay placement, single player, fresh
-start (no resume) — the combinations a multi-host pod actually trains
-with. Unsupported combinations raise immediately.
+Scope: thread-mode actors, device replay placement, single player — the
+combination a multi-host pod actually trains with. Resume/warm-start work
+rank-consistently (every controller restores the same checkpoint file
+from the shared filesystem). Unsupported combinations raise immediately.
 
 Demo / validation (two loopback controllers, virtual CPU devices):
 
@@ -42,6 +43,7 @@ Demo / validation (two loopback controllers, virtual CPU devices):
 """
 
 import functools
+import os
 import threading
 import time
 from typing import Callable, List, Optional
@@ -189,10 +191,10 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     if cfg.replay.placement != "device":
         raise NotImplementedError(
             "multihost training requires replay.placement='device'")
-    if cfg.runtime.resume or cfg.runtime.pretrain:
-        raise NotImplementedError(
-            "multihost resume/warm-start is not wired yet (rank-consistent "
-            "restore ordering); start fresh or train single-host")
+    if cfg.runtime.resume and cfg.runtime.pretrain:
+        raise ValueError(
+            "runtime.resume and runtime.pretrain are mutually exclusive — "
+            "resume restores the full training state")
 
     from r2d2_tpu.actor.policy import ActorPolicy
     from r2d2_tpu.envs.factory import create_env
@@ -222,6 +224,21 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     # demo asserts it cross-process)
     ts = create_train_state(jax.random.PRNGKey(cfg.runtime.seed), net,
                             cfg.optim)
+    resumed_env = 0
+    if cfg.runtime.resume:
+        # every rank restores the SAME checkpoint file (shared filesystem,
+        # the normal pod setup): identical host values on every controller,
+        # so lockstep and cross-host param equality hold from step one —
+        # the same property the fresh-init path gets from the shared seed.
+        # The replay ring restarts empty, as in single-host resume.
+        from r2d2_tpu.runtime.checkpoint import resume_training_state
+        ts, resumed_env = resume_training_state(cfg.runtime.resume, ts)
+    elif cfg.runtime.pretrain:
+        from r2d2_tpu.runtime.checkpoint import load_pretrain
+        params = load_pretrain(cfg.runtime.pretrain, ts.params)
+        ts = ts.replace(
+            params=params,
+            target_params=jax.tree_util.tree_map(np.copy, params))
     mesh = make_mesh(cfg.mesh)
     if mesh.shape["mp"] != 1:
         raise NotImplementedError("multihost mp>1 is not supported")
@@ -240,6 +257,21 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
 
     # -- local actors (this host's share of the global fleet) --
     stop = threading.Event()
+    # SIGTERM/SIGINT land on the stop event, which feeds the next
+    # iteration's local_stop flag into the psum consensus — the signaled
+    # host keeps dispatching until every controller agrees to stop on the
+    # SAME iteration, instead of abandoning peers mid-collective (they
+    # would wedge until the jax.distributed heartbeat timeout).
+    import signal
+    prev_handlers = {}
+    if threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            stop.set()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(sig, _on_signal)
+            except (ValueError, OSError):
+                pass
     store = InProcWeightStore(ts.params)
     queue = BlockQueue(use_mp=False)
     n_local = cfg.actor.num_actors
@@ -268,8 +300,9 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     deadline = time.time() + max_seconds if max_seconds else None
     rt = cfg.runtime
     ratio = cfg.replay.max_env_steps_per_train_step
-    step_count = 0
-    paused = False
+    step_count = int(ts.step)   # nonzero after resume; max_steps is cumulative
+    step_base = step_count      # rate-limiter budget counts from THIS process's
+    paused = False              # start (info.env_steps restarts at 0 with the ring)
     pending_losses: list = []
     last_log = time.time()
     info = {"buffer_steps": 0, "env_steps": 0, "filled_shards": 0}
@@ -281,7 +314,6 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                     metrics.on_train_step(float(loss))
         pending_losses.clear()
 
-    import os
     debug = bool(os.environ.get("R2D2_MH_DEBUG"))
     it = 0
     try:
@@ -313,7 +345,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             paused = bool(
                 ready and ratio > 0
                 and info["env_steps"] >= cfg.replay.learning_starts
-                    + ratio * max(step_count, 1))
+                    + ratio * max(step_count - step_base, 1))
             if ready:
                 prev = step_count
                 ts, rs, m = step_fn(ts, rs)
@@ -328,7 +360,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                         rt.save_dir, cfg.env.game_name,
                         step_count // rt.save_interval, 0, ts.params,
                         ts.opt_state, ts.target_params, step_count,
-                        info["env_steps"], config_json=cfg.to_json())
+                        resumed_env + info["env_steps"],
+                        config_json=cfg.to_json())
             else:
                 time.sleep(0.01)
 
@@ -336,7 +369,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 now = time.time()
                 if now - last_log >= rt.log_interval:
                     flush_losses()
-                    metrics.env_steps = info["env_steps"]
+                    metrics.env_steps = resumed_env + info["env_steps"]
                     metrics.set_buffer_size(info["buffer_steps"])
                     record = metrics.log(now - last_log)
                     if log_fn:
@@ -345,10 +378,15 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         flush_losses()
     finally:
         stop.set()
+        for sig, handler in prev_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
         for t in threads:
             t.join(timeout=5.0)
 
-    return {"step": step_count, "env_steps": info["env_steps"],
+    return {"step": step_count, "env_steps": resumed_env + info["env_steps"],
             "buffer_steps": info["buffer_steps"], "params": ts.params}
 
 
@@ -377,7 +415,7 @@ def _demo_config(save_dir: str) -> "Config":
 
 def _demo_worker(process_id: int, num_processes: int, coordinator: str,
                  devices_per_process: int, save_dir: str,
-                 max_steps: int) -> None:
+                 max_steps: int, resume: str = "") -> None:
     from r2d2_tpu.utils.platform import pin_cpu_platform
     pin_cpu_platform(devices_per_process)
     import jax
@@ -387,55 +425,69 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
         "mesh.coordinator_address": coordinator,
         "mesh.num_processes": num_processes, "mesh.process_id": process_id,
         "mesh.dp": n_global,
+        **({"runtime.resume": resume} if resume else {}),
     })
     out = train_multihost(cfg, max_training_steps=max_steps, max_seconds=240)
-    # params must be bit-identical across this process's shards
-    leaf = jax.tree_util.tree_leaves(out["params"])[0]
-    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
-    for s in shards[1:]:
-        np.testing.assert_array_equal(shards[0], s)
+
+    # Bit-exactness evidence, asserted in two layers: every local shard of
+    # every leaf identical within this process here, and the full-tree
+    # digest identical ACROSS processes by launch_demo (the cross-host
+    # invariant README advertises).
+    import hashlib
+    import json
+    digest = hashlib.sha256()
+    for path, leaf in sorted(
+            jax.tree_util.tree_flatten_with_path(out["params"])[0],
+            key=lambda kv: str(kv[0])):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+        digest.update(str(path).encode())
+        digest.update(np.ascontiguousarray(shards[0]).tobytes())
+    with open(os.path.join(save_dir, f"params_digest_r{process_id}.json"),
+              "w") as f:
+        json.dump({"step": out["step"], "sha256": digest.hexdigest()}, f)
     print(f"[proc {process_id}] multihost train ok: step={out['step']} "
-          f"env_steps={out['env_steps']} "
-          f"param_digest={float(np.abs(shards[0]).sum()):.6f}", flush=True)
+          f"env_steps={out['env_steps']} sha256={digest.hexdigest()[:16]}",
+          flush=True)
 
 
 def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
                 save_dir: str = "/tmp/r2d2_multihost_demo",
-                max_steps: int = 8, timeout: float = 300.0) -> None:
-    """Spawn the loopback controllers (mirrors multihost_dryrun.launch)."""
-    import socket
-    import subprocess
+                max_steps: int = 8, timeout: float = 300.0,
+                resume: str = "") -> None:
+    """Spawn the loopback controllers and assert the final params came out
+    BIT-IDENTICAL across hosts (each worker writes a digest file covering
+    every param leaf; divergence anywhere fails the launch)."""
+    import glob
+    import json
     import sys
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    coordinator = f"127.0.0.1:{port}"
-    procs = [subprocess.Popen([
-        sys.executable, "-m", "r2d2_tpu.parallel.multihost",
-        f"--process-id={pid}", f"--num-processes={num_processes}",
-        f"--coordinator={coordinator}",
-        f"--devices-per-process={devices_per_process}",
-        f"--save-dir={save_dir}", f"--max-steps={max_steps}",
-    ]) for pid in range(num_processes)]
-    deadline = time.time() + timeout
-    rcs = []
-    try:
-        for p in procs:
-            try:
-                rcs.append(p.wait(timeout=max(1.0, deadline - time.time())))
-            except subprocess.TimeoutExpired:
-                rcs.append(None)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    if any(rc != 0 for rc in rcs):
+    from r2d2_tpu.parallel.loopback import run_loopback_workers
+
+    for stale in glob.glob(os.path.join(save_dir, "params_digest_r*.json")):
+        os.remove(stale)
+    run_loopback_workers(
+        lambda pid, coordinator: [
+            sys.executable, "-m", "r2d2_tpu.parallel.multihost",
+            f"--process-id={pid}", f"--num-processes={num_processes}",
+            f"--coordinator={coordinator}",
+            f"--devices-per-process={devices_per_process}",
+            f"--save-dir={save_dir}", f"--max-steps={max_steps}",
+            f"--resume={resume}",
+        ], num_processes, timeout, "multihost train demo")
+
+    digests = []
+    for pid in range(num_processes):
+        with open(os.path.join(save_dir, f"params_digest_r{pid}.json")) as f:
+            digests.append(json.load(f))
+    if any(d != digests[0] for d in digests[1:]):
         raise SystemExit(
-            f"multihost train demo failed: worker rcs={rcs} (None = timed "
-            f"out after {timeout:.0f}s and was killed)")
+            f"multihost train demo: params DIVERGED across controllers: "
+            f"{digests}")
     print(f"multihost train demo: {num_processes} controllers x "
-          f"{devices_per_process} devices ok")
+          f"{devices_per_process} devices ok, params bit-identical "
+          f"across hosts", flush=True)
 
 
 def main(argv=None) -> None:
@@ -447,13 +499,15 @@ def main(argv=None) -> None:
     p.add_argument("--devices-per-process", type=int, default=2)
     p.add_argument("--save-dir", default="/tmp/r2d2_multihost_demo")
     p.add_argument("--max-steps", type=int, default=8)
+    p.add_argument("--resume", default="")
     args = p.parse_args(argv)
     if args.process_id is None:
         launch_demo(args.num_processes, args.devices_per_process,
-                    args.save_dir, args.max_steps)
+                    args.save_dir, args.max_steps, resume=args.resume)
     else:
         _demo_worker(args.process_id, args.num_processes, args.coordinator,
-                     args.devices_per_process, args.save_dir, args.max_steps)
+                     args.devices_per_process, args.save_dir, args.max_steps,
+                     resume=args.resume)
 
 
 if __name__ == "__main__":

@@ -13,10 +13,7 @@ a GPU; it has no analog, /root/reference/worker.py:251).
 """
 
 import argparse
-import socket
-import subprocess
 import sys
-import time
 
 
 def _worker(process_id: int, num_processes: int, coordinator: str,
@@ -48,38 +45,15 @@ def _worker(process_id: int, num_processes: int, coordinator: str,
 
 def launch(num_processes: int = 2, devices_per_process: int = 4,
            timeout: float = 300.0) -> None:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    coordinator = f"127.0.0.1:{port}"
+    from r2d2_tpu.parallel.loopback import run_loopback_workers
 
-    procs = []
-    for pid in range(num_processes):
-        procs.append(subprocess.Popen([
+    run_loopback_workers(
+        lambda pid, coordinator: [
             sys.executable, "-m", "r2d2_tpu.parallel.multihost_dryrun",
             f"--process-id={pid}", f"--num-processes={num_processes}",
             f"--coordinator={coordinator}",
             f"--devices-per-process={devices_per_process}",
-        ]))
-    # One shared deadline; kill survivors on ANY exit path (a crashed
-    # coordinator process would otherwise leave its peer blocked in
-    # jax.distributed.initialize as an orphan).
-    deadline = time.time() + timeout
-    rcs = []
-    try:
-        for p in procs:
-            try:
-                rcs.append(p.wait(timeout=max(1.0, deadline - time.time())))
-            except subprocess.TimeoutExpired:
-                rcs.append(None)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    if any(rc != 0 for rc in rcs):
-        raise SystemExit(
-            f"multihost dryrun failed: worker rcs={rcs} (None = timed out "
-            f"after {timeout:.0f}s and was killed)")
+        ], num_processes, timeout, "multihost dryrun")
     print(f"multihost dryrun: {num_processes} processes x "
           f"{devices_per_process} devices ok")
 

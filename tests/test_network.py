@@ -139,6 +139,15 @@ def test_hoisted_lstm_matches_flax_optimized_cell():
     np.testing.assert_allclose(np.asarray(h_got), np.asarray(h_ref),
                                rtol=1e-5, atol=1e-6)
 
+    # scan_unroll is a schedule knob, not a math change — bitwise-identical
+    # outputs for any unroll factor (incl. one that doesn't divide T=11)
+    for unroll in (4, 11):
+        (c_u, h_u), out_u = HoistedLSTM(features=H, unroll=unroll).apply(
+            hoisted_params, (c0, h0), xs)
+        np.testing.assert_array_equal(np.asarray(out_u), np.asarray(out_got))
+        np.testing.assert_array_equal(np.asarray(c_u), np.asarray(c_got))
+        np.testing.assert_array_equal(np.asarray(h_u), np.asarray(h_got))
+
 
 def test_non_dueling_head():
     cfg = NetworkConfig(hidden_dim=16, cnn_out_dim=32, use_dueling=False)

@@ -184,3 +184,11 @@ def test_multihost_lockstep_training(tmp_path):
     assert int(ck["env_steps"]) > 0
     # rank 0's metrics stream exists with the reference-format log
     assert (tmp_path / "mh" / "train_player0.log").exists()
+
+    # rank-consistent resume: every controller restores the same checkpoint
+    # and the pod continues to the new (cumulative) budget
+    launch_demo(num_processes=2, devices_per_process=2, save_dir=save_dir,
+                max_steps=12, timeout=280.0, resume=ckpts[-1][1])
+    ck2 = restore_checkpoint(list_checkpoints(save_dir, "Fake", 0)[-1][1])
+    assert int(ck2["step"]) == 12
+    assert int(ck2["env_steps"]) > int(ck["env_steps"])
