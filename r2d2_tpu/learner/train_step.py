@@ -218,6 +218,10 @@ def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
     (/root/reference/worker.py:299-306) minus Ray. Returns
     (train_state, metrics) — priorities in metrics["priorities"] go back to
     the host tree asynchronously, guarded by HostReplay's staleness check.
+
+    Sharding-agnostic by design: under committed (device_put) inputs the
+    compiled program follows THEIR shardings, which is how the tensor-
+    parallel path reuses this exact step (parallel/tensor_parallel.py).
     """
     loss_fn = make_loss_fn(net, spec, optim, use_double)
     tx = make_optimizer(optim)

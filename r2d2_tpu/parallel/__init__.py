@@ -15,11 +15,16 @@ from r2d2_tpu.parallel.sharded import (
     sharded_replay_init,
     sharded_buffer_steps,
 )
+from r2d2_tpu.parallel.tensor_parallel import (
+    make_tp_external_batch_step,
+    state_shardings,
+)
 
 __all__ = [
     "make_mesh", "init_distributed",
     "make_sharded_learner_step", "make_sharded_replay_add",
     "sharded_replay_init", "sharded_buffer_steps",
+    "make_tp_external_batch_step", "state_shardings",
     "train_multihost",
 ]
 

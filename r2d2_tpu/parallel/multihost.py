@@ -191,11 +191,6 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     if cfg.replay.placement != "device":
         raise NotImplementedError(
             "multihost training requires replay.placement='device'")
-    if cfg.runtime.resume and cfg.runtime.pretrain:
-        raise ValueError(
-            "runtime.resume and runtime.pretrain are mutually exclusive — "
-            "resume restores the full training state")
-
     from r2d2_tpu.actor.policy import ActorPolicy
     from r2d2_tpu.envs.factory import create_env
     from r2d2_tpu.learner.train_step import create_train_state
@@ -204,7 +199,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     from r2d2_tpu.parallel.sharded import (
         make_sharded_learner_step, sharded_replay_init)
     from r2d2_tpu.runtime.actor_loop import run_actor
-    from r2d2_tpu.runtime.checkpoint import save_checkpoint
+    from r2d2_tpu.runtime.checkpoint import apply_restore, save_checkpoint
     from r2d2_tpu.runtime.feeder import BlockQueue
     from r2d2_tpu.runtime.metrics import TrainMetrics
     from r2d2_tpu.runtime.weights import InProcWeightStore
@@ -224,21 +219,14 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     # demo asserts it cross-process)
     ts = create_train_state(jax.random.PRNGKey(cfg.runtime.seed), net,
                             cfg.optim)
-    resumed_env = 0
-    if cfg.runtime.resume:
-        # every rank restores the SAME checkpoint file (shared filesystem,
-        # the normal pod setup): identical host values on every controller,
-        # so lockstep and cross-host param equality hold from step one —
-        # the same property the fresh-init path gets from the shared seed.
-        # The replay ring restarts empty, as in single-host resume.
-        from r2d2_tpu.runtime.checkpoint import resume_training_state
-        ts, resumed_env = resume_training_state(cfg.runtime.resume, ts)
-    elif cfg.runtime.pretrain:
-        from r2d2_tpu.runtime.checkpoint import load_pretrain
-        params = load_pretrain(cfg.runtime.pretrain, ts.params)
-        ts = ts.replace(
-            params=params,
-            target_params=jax.tree_util.tree_map(np.copy, params))
+    # Resume/warm-start: every rank restores the SAME checkpoint file
+    # (shared filesystem, the normal pod setup): identical host values on
+    # every controller, so lockstep and cross-host param equality hold
+    # from step one — the same property the fresh-init path gets from the
+    # shared seed. The replay ring restarts empty, as in single-host
+    # resume. apply_restore is the one shared restore policy (also the
+    # single-host Learner's), so the two paths cannot diverge.
+    ts, resumed_env = apply_restore(cfg.runtime, ts)
     mesh = make_mesh(cfg.mesh)
     if mesh.shape["mp"] != 1:
         raise NotImplementedError("multihost mp>1 is not supported")
@@ -435,6 +423,7 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
     # invariant README advertises).
     import hashlib
     import json
+    os.makedirs(save_dir, exist_ok=True)   # no checkpoint may have created it
     digest = hashlib.sha256()
     for path, leaf in sorted(
             jax.tree_util.tree_flatten_with_path(out["params"])[0],

@@ -128,6 +128,26 @@ def resume_training_state(path: str, train_state):
     return new_state, int(restored["env_steps"])
 
 
+def apply_restore(runtime_cfg, train_state) -> Tuple[Any, int]:
+    """The one resume/warm-start policy, shared by the single-host Learner
+    and the multihost lockstep trainer (so the rank-sensitive details —
+    mutual exclusion, the pretrain target-params copy — cannot diverge).
+    Returns ``(train_state, resumed_env_steps)``; a no-op without
+    runtime.resume/pretrain."""
+    if runtime_cfg.resume and runtime_cfg.pretrain:
+        raise ValueError(
+            "runtime.resume and runtime.pretrain are mutually exclusive — "
+            "resume restores the full training state")
+    if runtime_cfg.resume:
+        return resume_training_state(runtime_cfg.resume, train_state)
+    if runtime_cfg.pretrain:
+        params = load_pretrain(runtime_cfg.pretrain, train_state.params)
+        return train_state.replace(
+            params=params,
+            target_params=jax.tree_util.tree_map(np.copy, params)), 0
+    return train_state, 0
+
+
 def list_checkpoints(save_dir: str, game: str, player: int
                      ) -> List[Tuple[int, str]]:
     """Sorted (index, path) pairs, the eval sweep's iteration order

@@ -34,8 +34,7 @@ from r2d2_tpu.models.network import NetworkApply
 from r2d2_tpu.replay.device_replay import replay_add, replay_init
 from r2d2_tpu.replay.host_replay import HostReplay
 from r2d2_tpu.replay.structs import Block, ReplaySpec
-from r2d2_tpu.runtime.checkpoint import (
-    load_pretrain, resume_training_state, save_checkpoint)
+from r2d2_tpu.runtime.checkpoint import apply_restore, save_checkpoint
 from r2d2_tpu.runtime.metrics import TrainMetrics
 
 
@@ -50,19 +49,8 @@ class Learner:
         key = jax.random.PRNGKey(seed + 1000 * player_idx)
 
         self.train_state = create_train_state(key, net, cfg.optim)
-        resumed_env_steps = 0
-        if cfg.runtime.resume:
-            if cfg.runtime.pretrain:
-                raise ValueError(
-                    "runtime.resume and runtime.pretrain are mutually "
-                    "exclusive — resume restores the full training state")
-            self.train_state, resumed_env_steps = resume_training_state(
-                cfg.runtime.resume, self.train_state)
-        elif cfg.runtime.pretrain:
-            params = load_pretrain(cfg.runtime.pretrain, self.train_state.params)
-            self.train_state = self.train_state.replace(
-                params=params,
-                target_params=jax.tree_util.tree_map(np.copy, params))
+        self.train_state, resumed_env_steps = apply_restore(
+            cfg.runtime, self.train_state)
         self.host_mode = cfg.replay.placement == "host"
         self.mesh = None
         if self.host_mode:
