@@ -57,10 +57,6 @@ def state_shardings(train_state: TrainState, mesh: Mesh,
         train_state)
 
 
-def batch_shardings(batch: SampleBatch, mesh: Mesh):
-    """Batch-dim sharding over 'dp' for every SampleBatch field."""
-    return jax.tree_util.tree_map(
-        lambda _: NamedSharding(mesh, P("dp")), batch)
 
 
 def make_tp_external_batch_step(net: NetworkApply, spec: ReplaySpec,
@@ -75,12 +71,19 @@ def make_tp_external_batch_step(net: NetworkApply, spec: ReplaySpec,
     propagates them through the whole fwd/bwd, inserting the
     all-gathers/reduce-scatters TP needs. The sharding lives entirely in
     the placement functions; that is the whole point."""
+    dp = mesh.shape["dp"]
+    if spec.batch_size % dp:
+        raise ValueError(
+            f"replay.batch_size={spec.batch_size} is not divisible by the "
+            f"mesh dp={dp} — the batch axis cannot shard evenly")
     step = make_external_batch_step(net, spec, optim, use_double)
-
+    batch_sharding = NamedSharding(mesh, P("dp"))   # device_put broadcasts
+                                                    # one sharding over the
+                                                    # whole batch pytree
     def place_state(ts: TrainState) -> TrainState:
         return jax.device_put(ts, state_shardings(ts, mesh, min_shard_width))
 
     def place_batch(batch: SampleBatch) -> SampleBatch:
-        return jax.device_put(batch, batch_shardings(batch, mesh))
+        return jax.device_put(batch, batch_sharding)
 
     return step, place_state, place_batch

@@ -71,20 +71,38 @@ class Learner:
             self._bg_error: Optional[BaseException] = None
             self.replay_state = None
             self.host_replay = HostReplay(self.spec, seed=seed)
-            self._step_fn = make_external_batch_step(
-                net, self.spec, cfg.optim, cfg.network.use_double)
+            if cfg.mesh.mp > 1:
+                # tensor parallelism (parallel/tensor_parallel.py): the
+                # SAME external-batch step with params feature-sharded
+                # over 'mp' and the batch over 'dp' — GSPMD inserts the
+                # collectives. place_batch runs in the prefetch thread.
+                from r2d2_tpu.parallel import make_mesh
+                from r2d2_tpu.parallel.tensor_parallel import (
+                    make_tp_external_batch_step)
+                tp_mesh = make_mesh(cfg.mesh)
+                self._step_fn, place_state, self._place_batch = (
+                    make_tp_external_batch_step(
+                        net, self.spec, cfg.optim, cfg.network.use_double,
+                        tp_mesh))
+                self.train_state = place_state(self.train_state)
+            else:
+                self._step_fn = make_external_batch_step(
+                    net, self.spec, cfg.optim, cfg.network.use_double)
+                self._place_batch = jax.device_put
             self._prefetch_q: queue_mod.Queue = queue_mod.Queue(
                 maxsize=max(1, cfg.runtime.prefetch_batches))
             self._writeback_q: queue_mod.Queue = queue_mod.Queue(maxsize=64)
             self._bg_stop = threading.Event()
             self._bg_threads: list = []
         else:
+            if cfg.mesh.mp > 1:
+                raise NotImplementedError(
+                    "mesh.mp > 1 with replay.placement='device' is not "
+                    "wired (the fused on-device-replay step shards over "
+                    "'dp' only); tensor parallelism runs via "
+                    "replay.placement='host' (parallel/tensor_parallel.py)")
             dp = cfg.mesh.resolved_dp(len(jax.devices()))
             self._k = cfg.runtime.resolved_steps_per_dispatch()
-            # gate on dp alone: the sharded step shards and pmeans over
-            # 'dp' only — an mp>1, dp=1 mesh would pay the shard_map
-            # machinery (broadcast adds, replicated compute) for zero
-            # parallelism until tensor sharding actually lands
             if dp > 1:
                 # dp-sharded learner (SURVEY §5.8): replay sharded
                 # chip-per-shard, per-shard prioritized sampling, gradient
@@ -215,7 +233,7 @@ class Learner:
             try:
                 while not self._bg_stop.is_set():
                     batch, snapshot = self.host_replay.sample()
-                    dev = jax.device_put(batch)
+                    dev = self._place_batch(batch)
                     while not self._bg_stop.is_set():
                         try:
                             self._prefetch_q.put((dev, snapshot), timeout=0.5)
