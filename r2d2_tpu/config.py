@@ -212,6 +212,11 @@ class RuntimeConfig:
         import jax
         return 16 if jax.default_backend() == "tpu" else 1
     prefetch_batches: int = 4        # learner-side batch prefetch depth (ref worker.py:302)
+    # Process-mode experience transport: native shared-memory MPMC ring
+    # (one memcpy per side — the plasma-store equivalent, shm_feeder.py);
+    # falls back to mp.Queue (pickle through a pipe) if the C++ toolchain
+    # is unavailable or the flag is off.
+    shm_transport: bool = True
     test_epsilon: float = 0.01
     seed: int = 0
     profile_dir: str = ""            # non-empty: write jax.profiler traces here

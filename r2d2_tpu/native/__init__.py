@@ -45,6 +45,38 @@ def _load() -> ctypes.CDLL:
 
 _LIB = _load()
 
+_RING_SO = os.path.join(_DIR, "libshmring.so")
+_RING_LIB = None
+
+
+def ring_lib() -> ctypes.CDLL:
+    """Lazy-loaded binding for the native shm MPMC ring (shm_ring.cc)."""
+    global _RING_LIB
+    if _RING_LIB is None:
+        if not os.path.exists(_RING_SO) or (
+                os.path.getmtime(_RING_SO) <
+                os.path.getmtime(os.path.join(_DIR, "shm_ring.cc"))):
+            _build()
+        lib = ctypes.CDLL(_RING_SO)
+        lib.ring_required_bytes.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.ring_required_bytes.restype = ctypes.c_uint64
+        lib.ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                  ctypes.c_uint64]
+        lib.ring_size.argtypes = [ctypes.c_void_p]
+        lib.ring_size.restype = ctypes.c_uint64
+        lib.ring_recover_stalled.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ring_recover_stalled.restype = ctypes.c_uint64
+        lib.ring_reserve_push.argtypes = [ctypes.c_void_p]
+        lib.ring_reserve_push.restype = ctypes.c_int64
+        lib.ring_commit_push.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ring_reserve_pop.argtypes = [ctypes.c_void_p]
+        lib.ring_reserve_pop.restype = ctypes.c_int64
+        lib.ring_commit_pop.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ring_payload_offset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ring_payload_offset.restype = ctypes.c_uint64
+        _RING_LIB = lib
+    return _RING_LIB
+
 
 class NativeSumTree:
     """API-compatible with the numpy twin in ops/sum_tree.py."""

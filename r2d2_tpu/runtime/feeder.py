@@ -9,6 +9,7 @@ back-pressures actors instead of exhausting host RAM.
 
 import multiprocessing as mp
 import queue as queue_mod
+import subprocess
 from typing import List, Optional
 
 from r2d2_tpu.replay.structs import Block
@@ -31,11 +32,27 @@ def put_patient(q, block: Block, should_stop, poll: float = 0.5) -> bool:
 
 
 class BlockQueue:
-    """Works in both modes: mp.Queue for process actors, queue.Queue for
-    thread actors (hermetic tests)."""
+    """Works in all modes: the native shm ring (shm_feeder.py) or mp.Queue
+    for process actors, queue.Queue for thread actors (hermetic tests).
+
+    ``shm_spec``: pass the ReplaySpec to use the native shared-memory
+    transport (one memcpy per side instead of pickling through a pipe); if
+    the native toolchain is unavailable the queue degrades to mp.Queue with
+    a warning. close() releases/unlinks the shm region (owner side)."""
 
     def __init__(self, maxsize: int = 64, use_mp: bool = True,
-                 ctx: Optional[mp.context.BaseContext] = None):
+                 ctx: Optional[mp.context.BaseContext] = None,
+                 shm_spec=None):
+        if use_mp and shm_spec is not None:
+            try:
+                from r2d2_tpu.runtime.shm_feeder import ShmBlockRing
+                self._q = ShmBlockRing(shm_spec, maxsize)
+                return
+            except (ImportError, OSError, subprocess.CalledProcessError) as e:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "native shm transport unavailable (%s); falling back "
+                    "to mp.Queue", e)
         if use_mp:
             ctx = ctx or mp.get_context("spawn")
             self._q = ctx.Queue(maxsize=maxsize)
@@ -60,3 +77,15 @@ class BlockQueue:
 
     def get(self, timeout: Optional[float] = None) -> Block:
         return self._q.get(timeout=timeout)
+
+    def close(self) -> None:
+        closer = getattr(self._q, "close", None)
+        if closer is not None:
+            closer()
+
+    def recover_stalled(self) -> int:
+        """Free ring slots wedged by a crashed producer (shm transport
+        only; no-op otherwise). The supervisor calls this after reaping a
+        dead actor process."""
+        fn = getattr(self._q, "recover_stalled", None)
+        return fn() if fn is not None else 0

@@ -103,7 +103,9 @@ class PlayerStack:
         self._ctx = mp.get_context("spawn")
         self.publisher = WeightPublisher(self.learner.train_state.params)
         self.learner.publish = self.publisher.publish
-        self.queue = BlockQueue(use_mp=True, ctx=self._ctx)
+        self.queue = BlockQueue(
+            use_mp=True, ctx=self._ctx,
+            shm_spec=self.learner.spec if cfg.runtime.shm_transport else None)
         self._stop = stop_event
         for i in range(cfg.actor.num_actors):
             self._spawn_process_actor(i)
@@ -139,6 +141,22 @@ class PlayerStack:
             if not p.is_alive():
                 self._spawn_process_actor(i)
                 restarted += 1
+        if restarted and self.processes:
+            # a producer that died between reserve and commit would wedge
+            # the shm ring. Schedule reclamation for AFTER the slot-grace
+            # window: an immediate attempt would find the wedged slot not
+            # yet stale (recover_stalled's 5s grace protects live writers)
+            # and — with restarted==0 on every later tick — never retry.
+            self._recover_after = time.time() + 6.0
+        if (getattr(self, "_recover_after", None) is not None
+                and time.time() >= self._recover_after):
+            self._recover_after = None
+            freed = self.queue.recover_stalled()
+            if freed:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "recovered %d shm ring slot(s) wedged by crashed "
+                    "actor(s)", freed)
         return restarted
 
     def close(self) -> None:
@@ -149,6 +167,13 @@ class PlayerStack:
             p.join(timeout=5.0)
             if p.is_alive():
                 p.terminate()
+        # join thread actors too: a daemon actor thread still inside an XLA
+        # compile when the interpreter exits dies with a C++ abort
+        # ("FATAL: exception not rethrown") — harmless but alarming noise
+        for t in self.threads:
+            t.join(timeout=5.0)
+        if self.queue is not None:
+            self.queue.close()   # releases/unlinks the shm ring (owner)
 
 
 def train(cfg: Config, *, max_training_steps: Optional[int] = None,

@@ -24,6 +24,20 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 
+def untrack_attached_shm(shm: shared_memory.SharedMemory) -> None:
+    """De-register an ATTACHED segment from this process's resource
+    tracker. On Python < 3.13 attaching registers the segment too, and a
+    child's tracker UNLINKS it when that child exits — which would destroy
+    the parent's live segment under actor restarts
+    (``SharedMemory(track=False)`` only exists from 3.13). Shared by the
+    weight subscriber and the shm block ring (shm_feeder.py)."""
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+
+
 def _flatten(params) -> Tuple[np.ndarray, Any]:
     flat, unravel = ravel_pytree(params)
     return np.asarray(jax.device_get(flat), np.float32), unravel
@@ -70,6 +84,7 @@ class WeightSubscriber:
         flat, self._unravel = _flatten(template)
         self.num_weights = flat.shape[0]
         self.shm = shared_memory.SharedMemory(name=name)
+        untrack_attached_shm(self.shm)
         self._version = np.ndarray((1,), np.uint64, self.shm.buf, 0)
         self._payload = np.ndarray((self.num_weights,), np.float32, self.shm.buf, 8)
         self.last_version = 0

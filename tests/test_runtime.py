@@ -430,10 +430,11 @@ def test_rate_limiter_never_pauses_before_dp_gate_opens(tmp_path):
 
 def test_end_to_end_process_mode(tmp_path):
     """The production actor topology (VERDICT r2 #4): spawned actor
-    processes feeding the learner over mp.Queue with shared-memory weight
-    subscription (the reference's deployed mode is Ray actors,
-    worker.py:502-591 + train.py:36-43). Asserts the learner trains from
-    process-produced blocks and that close() leaves no orphan processes."""
+    processes feeding the learner over the native shm block ring with
+    shared-memory weight subscription (the reference's deployed mode is Ray
+    actors over plasma, worker.py:502-591 + train.py:36-43). Asserts the
+    learner trains from process-produced blocks and that close() leaves no
+    orphan processes."""
     import time as time_mod
 
     cfg = tiny_config(tmp_path, **{"runtime.save_interval": 0})
@@ -441,8 +442,18 @@ def test_end_to_end_process_mode(tmp_path):
                    actor_mode="process")
     learner = stacks[0].learner
     assert learner.training_steps >= 10
-    # blocks crossed the process boundary (mp.Queue) and filled the buffer
+    # blocks crossed the process boundary and filled the buffer — through
+    # the native shm ring when the toolchain is present (default transport)
     assert learner.env_steps >= cfg.replay.learning_starts
+    try:
+        from r2d2_tpu.native import ring_lib
+        ring_lib()   # probes the actual native build, not just the import
+        native_ok = True
+    except Exception:
+        native_ok = False
+    if native_ok:
+        from r2d2_tpu.runtime.shm_feeder import ShmBlockRing
+        assert isinstance(stacks[0].queue._q, ShmBlockRing)
     procs = stacks[0].processes
     assert len(procs) == cfg.actor.num_actors
     deadline = time_mod.time() + 10.0
