@@ -121,6 +121,19 @@ def _block_orthogonal_init(num_blocks: int):
     return init
 
 
+def lstm_cell_step(xp, c, h, w_rec, bias):
+    """One LSTM step given the precomputed input projection ``xp`` =
+    x_t @ Wi. THE cell math (gate order i,f,g,o; sigmoid/sigmoid/tanh/
+    sigmoid) — shared by the in-chip scan (HoistedLSTM) and the
+    sequence-parallel pipelined scan (parallel/sequence_parallel.py), so
+    the two cannot diverge."""
+    gates = xp + h @ w_rec + bias
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    new_c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+    new_h = nn.sigmoid(o) * jnp.tanh(new_c)
+    return new_c, new_h
+
+
 class HoistedLSTM(nn.Module):
     """LSTM over a (B, T, D) sequence with the input projection hoisted out
     of the time scan.
@@ -154,11 +167,7 @@ class HoistedLSTM(nn.Module):
         bias = bias.astype(self.dtype)
 
         def step(carry, xp):                                  # xp: (B, 4H)
-            c, h = carry
-            gates = xp + h @ w_rec + bias
-            i, f, g, o = jnp.split(gates, 4, axis=-1)
-            new_c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
-            new_h = nn.sigmoid(o) * jnp.tanh(new_c)
+            new_c, new_h = lstm_cell_step(xp, carry[0], carry[1], w_rec, bias)
             return (new_c, new_h), new_h
 
         carry, outputs = jax.lax.scan(step, carry, x_proj.swapaxes(0, 1),

@@ -25,14 +25,17 @@ __all__ = [
     "make_sharded_learner_step", "make_sharded_replay_add",
     "sharded_replay_init", "sharded_buffer_steps",
     "make_tp_external_batch_step", "state_shardings",
-    "train_multihost",
+    "train_multihost", "make_sp_lstm",
 ]
 
 
 def __getattr__(name):
-    # lazy: multihost pulls in the runtime stack; don't tax `import
+    # lazy: these pull in the runtime/model stacks; don't tax `import
     # r2d2_tpu.parallel` for the common single-host case
     if name == "train_multihost":
         from r2d2_tpu.parallel.multihost import train_multihost
         return train_multihost
+    if name == "make_sp_lstm":
+        from r2d2_tpu.parallel.sequence_parallel import make_sp_lstm
+        return make_sp_lstm
     raise AttributeError(name)
