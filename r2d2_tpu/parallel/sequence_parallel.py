@@ -127,10 +127,13 @@ def make_sp_lstm(mesh: Mesh, microbatches: int):
             raise ValueError(f"T={T} not divisible by sp={S}")
         if B % M:
             raise ValueError(f"B={B} not divisible by microbatches={M}")
-        # the pipeline buffers are allocated in the compute dtype; a f32
-        # stored carry under a bf16 policy would otherwise surface as an
+        # everything runs in x_proj's compute dtype (matching HoistedLSTM's
+        # astype of the cell weights under a bf16 policy): f32 stored
+        # carry/params would otherwise promote the gates and surface as an
         # opaque dtype mismatch inside the fori_loop body
         carry0 = carry0.astype(x_proj.dtype)
+        w_rec = w_rec.astype(x_proj.dtype)
+        bias = bias.astype(x_proj.dtype)
         return run(w_rec, bias, x_proj, carry0)
 
     return jax.jit(wrapped)
