@@ -354,7 +354,9 @@ def main() -> None:
     # matrix is attached so the defaults can be re-validated against the
     # measurements each round. matrix['f32_spd1'] is always populated (a
     # failed base measurement exits in part 1), so the max is never empty.
-    default_label = (f"{'bf16' if cfg.network.bf16 else 'f32'}"
+    from r2d2_tpu.ops.pallas_kernels import resolve_pallas_setting
+    bf16_resolved = resolve_pallas_setting(cfg.network.bf16, "network.bf16")
+    default_label = (f"{'bf16' if bf16_resolved else 'f32'}"
                      f"_spd{cfg.runtime.resolved_steps_per_dispatch()}")
     best_label = max((k for k, v in matrix.items() if v is not None),
                      key=lambda k: matrix[k])

@@ -25,6 +25,17 @@ from r2d2_tpu.models.network import NetworkApply, initial_hidden
 class ActorPolicy:
     def __init__(self, net: NetworkApply, params, epsilon: float, seed: int = 0,
                  copy_updates: bool = True):
+        # Actors infer on host CPUs, where bf16 is emulated and slower —
+        # force the f32 compute policy regardless of the learner's
+        # (params are f32 storage under either policy, so the weight
+        # exchange is unchanged; the reference's amp is learner-only too,
+        # worker.py:309 vs the actors' plain CPU model worker.py:509).
+        if net.config.bf16:
+            import dataclasses
+            h, w, s = net.obs_hw
+            net = NetworkApply(net.action_dim,
+                               dataclasses.replace(net.config, bf16=False),
+                               s, h, w)
         self.net = net
         self.epsilon = float(epsilon)
         self.action_dim = net.action_dim

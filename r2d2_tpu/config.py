@@ -63,8 +63,18 @@ class NetworkConfig:
     use_double: bool = False
     # Conv torso: (out_channels, kernel, stride) triples — Nature DQN.
     conv_layers: Tuple[Tuple[int, int, int], ...] = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
-    # bf16 matmul/conv compute on TPU (replaces torch.cuda.amp, ref config.py:35).
-    bf16: bool = False
+    # bf16 activation/compute policy (replaces torch.cuda.amp, ref
+    # config.py:35; f32 params and f32 Q outputs either way). Tri-state:
+    # "auto" (default) = bf16 iff the backend is TPU — the measured winner
+    # there (+28% once the obs decode emits bf16 natively, PERF.md) —
+    # while CPU keeps f32 (bf16 is emulated and slower). "on"/"off" force.
+    # The MXU already multiplies in bf16 under f32 (default precision);
+    # the policy additionally halves activation bytes, which is where the
+    # win comes from. Loss parity vs f32 is tolerance-tested. Typed str
+    # like the sibling pallas tri-states so --network.bf16=off works from
+    # the CLI (resolve_pallas_setting still accepts legacy bools from old
+    # serialized configs).
+    bf16: str = "auto"
     # lax.scan unroll factor for the LSTM time scan (identical math; >1
     # trades compile time for fewer sequential loop boundaries on the
     # 55-step serial chain). Set from measurement — see PERF.md.

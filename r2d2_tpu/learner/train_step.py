@@ -85,8 +85,13 @@ def _unrolled_q(net: NetworkApply, spec: ReplaySpec, params,
     (-1 encodes the null action as zeros), then the full-window unroll from
     the stored hidden state. Returns (B, T, A) f32 Q-values."""
     from r2d2_tpu.ops.pallas_kernels import stack_frames
+    # decode directly into the network's compute dtype: under the bf16
+    # policy this skips materializing the 4x-larger f32 obs intermediate
+    # that XLA would cast at the conv boundary anyway (PERF.md profile:
+    # that transpose+cast copy was ~2.5 ms/step)
     stacked = stack_frames(batch.obs, spec.seq_window, spec.frame_stack,
-                           use_pallas=use_pallas)
+                           use_pallas=use_pallas,
+                           out_dtype=net.module.compute_dtype)
     last_action = jax.nn.one_hot(batch.last_action, net.action_dim,
                                  dtype=jnp.float32)
     q, _ = net.module.apply(params, stacked, last_action, batch.hidden)

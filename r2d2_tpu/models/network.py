@@ -237,6 +237,15 @@ class NetworkApply:
 
     def __init__(self, action_dim: int, config: NetworkConfig,
                  frame_stack: int, frame_height: int, frame_width: int):
+        # Resolve the bf16 tri-state here — ONE place — so the module and
+        # every consumer of .config see a concrete bool ("auto" = bf16 iff
+        # the default backend is TPU, the measured winner there: +28% with
+        # the native-dtype decode, PERF.md; CPU backends keep f32, where
+        # bf16 is emulated and slower).
+        from r2d2_tpu.ops.pallas_kernels import resolve_pallas_setting
+        import dataclasses
+        config = dataclasses.replace(
+            config, bf16=resolve_pallas_setting(config.bf16, "network.bf16"))
         self.action_dim = action_dim
         self.config = config
         self.obs_hw = (frame_height, frame_width, frame_stack)

@@ -43,16 +43,23 @@ from r2d2_tpu.ops.indexing import frame_stack_indices
 
 
 def stack_frames_reference(obs: jnp.ndarray, seq_window: int,
-                           frame_stack: int) -> jnp.ndarray:
-    """jnp twin: gather + transpose + normalize (XLA-lowered)."""
+                           frame_stack: int,
+                           out_dtype=jnp.float32) -> jnp.ndarray:
+    """jnp twin: gather + transpose + normalize (XLA-lowered).
+    ``out_dtype``: emit in the network's compute dtype — normalization
+    always happens in f32 and rounds once at the end, so a bf16 output is
+    bit-identical to XLA's own f32→bf16 cast at the conv boundary (which
+    the MXU's default precision inserts anyway); emitting it here skips
+    materializing the 4x-larger f32 intermediate."""
     fsi = frame_stack_indices(seq_window, frame_stack)       # (T, K)
     stacked = obs[:, fsi]                                     # (B, T, K, H, W)
-    return stacked.transpose(0, 1, 3, 4, 2).astype(jnp.float32) / 255.0
+    out = stacked.transpose(0, 1, 3, 4, 2).astype(jnp.float32) / 255.0
+    return out.astype(out_dtype)
 
 
-def _stack_kernel(frame_stack: int, in_ref, out_ref):
+def _stack_kernel(frame_stack: int, out_dtype, in_ref, out_ref):
     # in_ref: (1, T+K-1, H, W) uint8 (whole row, revisited across t);
-    # out_ref: (1, 1, K, H, W) f32 — this program's timestep slab.
+    # out_ref: (1, 1, K, H, W) out_dtype — this program's timestep slab.
     from jax.experimental import pallas as pl
 
     t = pl.program_id(1)
@@ -60,14 +67,17 @@ def _stack_kernel(frame_stack: int, in_ref, out_ref):
     for k in range(frame_stack):
         frame = in_ref[0, pl.dslice(t + k, 1)]               # (1, H, W) u8
         # Mosaic can't lower uint8 -> float32 directly (BENCH_r02 failure);
-        # widen through int32 first, which it can, then convert.
+        # widen through int32 first, which it can, then convert. The
+        # normalization rounds once from f32 into out_dtype — identical to
+        # XLA's own cast at the conv boundary under a bf16 policy.
         widened = frame[0].astype(jnp.int32).astype(jnp.float32)
-        out_ref[0, 0, k] = widened * inv
+        out_ref[0, 0, k] = (widened * inv).astype(out_dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
-                        interpret: bool = False) -> jnp.ndarray:
+                        interpret: bool = False,
+                        out_dtype=jnp.float32) -> jnp.ndarray:
     """Pallas implementation; ``interpret=True`` runs it on any backend
     (tests use it on the CPU mesh)."""
     from jax.experimental import pallas as pl
@@ -76,7 +86,7 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
     batch, row_len, height, width = obs.shape
     assert row_len >= seq_window + frame_stack - 1
 
-    kernel = functools.partial(_stack_kernel, frame_stack)
+    kernel = functools.partial(_stack_kernel, frame_stack, out_dtype)
     planar = pl.pallas_call(
         kernel,
         grid=(batch, seq_window),
@@ -91,7 +101,7 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (batch, seq_window, frame_stack, height, width), jnp.float32),
+            (batch, seq_window, frame_stack, height, width), out_dtype),
         interpret=interpret,
     )(obs)
     return planar.transpose(0, 1, 3, 4, 2)                   # (B, T, H, W, K)
@@ -122,11 +132,14 @@ def resolve_pallas_obs_decode(setting) -> bool:
 
 
 def stack_frames(obs: jnp.ndarray, seq_window: int, frame_stack: int,
-                 use_pallas: bool = False) -> jnp.ndarray:
+                 use_pallas: bool = False,
+                 out_dtype=jnp.float32) -> jnp.ndarray:
     """Dispatch: pallas on TPU when requested, jnp otherwise."""
     if use_pallas:
-        return stack_frames_pallas(obs, seq_window, frame_stack)
-    return stack_frames_reference(obs, seq_window, frame_stack)
+        return stack_frames_pallas(obs, seq_window, frame_stack,
+                                   out_dtype=out_dtype)
+    return stack_frames_reference(obs, seq_window, frame_stack,
+                                  out_dtype=out_dtype)
 
 
 # ---------------------------------------------------------------------------

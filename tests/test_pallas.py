@@ -35,6 +35,23 @@ def test_stack_frames_pallas_matches_reference(rng):
     assert got.max() <= 1.0 and got.min() >= 0.0
 
 
+def test_stack_frames_bf16_output(rng):
+    """out_dtype=bf16 (the bf16-policy decode): both twins normalize in f32
+    and round ONCE at the end, so kernel and reference agree bit-exactly
+    and match an explicit f32->bf16 cast of the f32 result."""
+    B, T, K, H, W = 2, 5, 3, 12, 16
+    obs = jnp.asarray(rng.integers(0, 255, (B, T + K - 1, H, W)), jnp.uint8)
+    ref_f32 = stack_frames_reference(obs, T, K)
+    ref_bf16 = np.asarray(stack_frames_reference(obs, T, K,
+                                                 out_dtype=jnp.bfloat16))
+    got = np.asarray(stack_frames_pallas(obs, T, K, True,
+                                         out_dtype=jnp.bfloat16))
+    assert got.dtype == jnp.bfloat16 and ref_bf16.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(got, ref_bf16)
+    np.testing.assert_array_equal(
+        ref_bf16, np.asarray(ref_f32.astype(jnp.bfloat16)))
+
+
 def test_stack_frames_reference_window_semantics(rng):
     """out[b, t, :, :, k] must be frame t+k (the learner-side obs_idx gather,
     ref worker.py:310,330)."""
