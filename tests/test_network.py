@@ -218,6 +218,32 @@ def test_online_positions_and_mask():
     assert mask[0, 2] == 1.0 and mask[0, 3] == 0.0
 
 
+def test_actor_policy_forces_f32_under_bf16(rng):
+    """Actors infer on host CPUs where bf16 is emulated: given a learner
+    net with the bf16 policy forced on, ActorPolicy must rebuild itself
+    f32 — and the learner's params (f32 storage under either policy) must
+    drive it unchanged."""
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.models.network import NetworkApply
+
+    cfg = NetworkConfig(hidden_dim=16, cnn_out_dim=32, bf16="on",
+                        conv_layers=((8, 4, 2), (16, 3, 1)))
+    net = NetworkApply(4, cfg, 2, 20, 20)
+    assert net.config.bf16 is True          # forced on, resolved concrete
+    params = net.init(jax.random.PRNGKey(0))
+    # params are f32 storage even under the bf16 compute policy
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert leaf.dtype == jnp.float32
+
+    policy = ActorPolicy(net, params, epsilon=0.0, seed=0)
+    assert policy.net.config.bf16 is False  # rebuilt f32 for CPU inference
+    policy.observe_reset(np.asarray(rng.integers(0, 255, (20, 20)), np.uint8))
+    action, q, hidden = policy.act()
+    assert 0 <= int(action) < 4
+    assert np.asarray(q).dtype == np.float32
+    assert np.isfinite(np.asarray(q)).all()
+
+
 def test_frame_stack_indices():
     idx = frame_stack_indices(5, 4)
     assert idx.shape == (5, 4)
