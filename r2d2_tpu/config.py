@@ -79,6 +79,14 @@ class NetworkConfig:
     # trades compile time for fewer sequential loop boundaries on the
     # 55-step serial chain). Set from measurement — see PERF.md.
     scan_unroll: int = 1
+    # Rewrite the first conv as the EXACT conv over a 2x2 space-to-depth
+    # input (kernel/stride halved, channels x4): the frame stack's 4
+    # channels waste most of the MXU's input lanes otherwise. "on"/"off"
+    # ONLY — no "auto": the setting changes the parameter layout, so a
+    # backend-dependent resolution would build incompatible param trees on
+    # heterogeneous hosts (TPU learner vs CPU actors/eval). Checkpoints
+    # are per-setting. Default off pending TPU measurement — see PERF.md.
+    space_to_depth: str = "off"
 
 
 @dataclass(frozen=True)

@@ -56,19 +56,40 @@ def initial_hidden(batch_size: int, hidden_dim: int, dtype=jnp.float32) -> jnp.n
     return jnp.zeros((batch_size, 2, hidden_dim), dtype=dtype)
 
 
+def space_to_depth_2x2(x: jnp.ndarray) -> jnp.ndarray:
+    """(B, H, W, C) -> (B, H/2, W/2, 4C); channel index (dh*2 + dw)*C + c."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+
+
 class ConvTorso(nn.Module):
     """Nature-DQN feature extractor (ref model.py:22-31), NHWC.
 
     Input: (B, H, W, stack) normalized f32/bf16. Output: (B, cnn_out_dim).
+
+    ``space_to_depth``: rewrite the FIRST conv as the mathematically
+    identical conv over a 2x2 space-to-depth input — kernel/stride halved,
+    input channels x4 (stack 4 -> 16). The first conv's tiny channel count
+    otherwise wastes most of the MXU's 128 input lanes; the transform is
+    EXACT (same linear map, weights re-indexed — parity-tested), it only
+    changes the parameter layout, so checkpoints are specific to the
+    setting like any architecture field. Requires even H/W/kernel/stride
+    on layer 0 (validated by NetworkApply).
     """
 
     cnn_out_dim: int
     conv_layers: Sequence[Tuple[int, int, int]]
     dtype: jnp.dtype
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        for features, kernel, stride in self.conv_layers:
+        for i, (features, kernel, stride) in enumerate(self.conv_layers):
+            if i == 0 and self.space_to_depth:
+                x = space_to_depth_2x2(x)
+                kernel //= 2
+                stride //= 2
             # VALID padding matches torch Conv2d's default zero-pad=0.
             x = nn.Conv(
                 features,
@@ -204,7 +225,9 @@ class R2D2Network(nn.Module):
         # Torso over the flattened (B*T) frame batch — one big conv batch is
         # the MXU-friendly shape (vs per-step convs inside the scan).
         flat = obs_seq.astype(dtype).reshape(batch * seq, *obs_seq.shape[2:])
-        latent = ConvTorso(cfg.cnn_out_dim, cfg.conv_layers, dtype, name="torso")(flat)
+        latent = ConvTorso(cfg.cnn_out_dim, cfg.conv_layers, dtype,
+                           space_to_depth=bool(cfg.space_to_depth),
+                           name="torso")(flat)
         latent = latent.reshape(batch, seq, cfg.cnn_out_dim)
 
         rnn_in = jnp.concatenate(
@@ -244,8 +267,26 @@ class NetworkApply:
         # bf16 is emulated and slower).
         from r2d2_tpu.ops.pallas_kernels import resolve_pallas_setting
         import dataclasses
+        if str(config.space_to_depth).lower() == "auto":
+            # unlike the compute-only tri-states, this knob changes the
+            # PARAMETER LAYOUT — a backend-dependent resolution would build
+            # incompatible param trees on heterogeneous hosts (TPU learner
+            # vs CPU-pinned actor processes / eval). Explicit only.
+            raise ValueError(
+                "network.space_to_depth must be 'on' or 'off' ('auto' is "
+                "not allowed: the setting changes the parameter layout, so "
+                "it must resolve identically on every host)")
         config = dataclasses.replace(
-            config, bf16=resolve_pallas_setting(config.bf16, "network.bf16"))
+            config, bf16=resolve_pallas_setting(config.bf16, "network.bf16"),
+            space_to_depth=resolve_pallas_setting(
+                config.space_to_depth, "network.space_to_depth"))
+        if config.space_to_depth:
+            _, k0, s0 = config.conv_layers[0]
+            if frame_height % 2 or frame_width % 2 or k0 % 2 or s0 % 2:
+                raise ValueError(
+                    "network.space_to_depth requires even frame dims and an "
+                    f"even first-conv kernel/stride; got {frame_height}x"
+                    f"{frame_width}, kernel {k0}, stride {s0}")
         self.action_dim = action_dim
         self.config = config
         self.obs_hw = (frame_height, frame_width, frame_stack)

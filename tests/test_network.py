@@ -218,6 +218,58 @@ def test_online_positions_and_mask():
     assert mask[0, 2] == 1.0 and mask[0, 3] == 0.0
 
 
+def test_space_to_depth_is_exact(rng):
+    """network.space_to_depth rewrites the first conv as the SAME linear
+    map over a 2x2 space-to-depth input: with the standard conv's weights
+    re-indexed into the transformed layout, outputs must match to float
+    tolerance (same sums, different association order)."""
+    from r2d2_tpu.models.network import ConvTorso
+
+    B, H, W, C = 4, 84, 84, 4
+    layers = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+    x = jnp.asarray(rng.uniform(0, 1, (B, H, W, C)), jnp.float32)
+
+    std = ConvTorso(64, layers, jnp.float32)
+    p_std = std.init(jax.random.PRNGKey(0), x)
+    want = std.apply(p_std, x)
+
+    # remap conv1: w2[ph, pw, (dh*2+dw)*C + c, o] = w[2ph+dh, 2pw+dw, c, o]
+    w = p_std["params"]["Conv_0"]["kernel"]            # (8, 8, C, 32)
+    w2 = (w.reshape(4, 2, 4, 2, C, 32)
+           .transpose(0, 2, 1, 3, 4, 5)
+           .reshape(4, 4, 4 * C, 32))
+    p_s2d = jax.tree_util.tree_map(lambda v: v, p_std)
+    p_s2d["params"]["Conv_0"]["kernel"] = w2
+
+    s2d = ConvTorso(64, layers, jnp.float32, space_to_depth=True)
+    got = s2d.apply(p_s2d, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    # shape contract: param layout differs, output does not
+    init_shapes = jax.tree_util.tree_map(
+        lambda v: v.shape, s2d.init(jax.random.PRNGKey(1), x))
+    assert init_shapes["params"]["Conv_0"]["kernel"] == (4, 4, 16, 32)
+    assert got.shape == want.shape
+
+    # full-network smoke through the config knob + validation error path
+    from r2d2_tpu.models.network import NetworkApply
+    cfg = NetworkConfig(hidden_dim=16, cnn_out_dim=32, space_to_depth="on")
+    net = NetworkApply(4, cfg, 4, 84, 84)
+    params = net.init(jax.random.PRNGKey(2))
+    obs = jnp.asarray(rng.uniform(0, 1, (2, 3, 84, 84, 4)), jnp.float32)
+    la = jnp.zeros((2, 3, 4), jnp.float32)
+    from r2d2_tpu.models import initial_hidden
+    q, _ = net.apply(params, obs, la, initial_hidden(2, 16))
+    assert np.isfinite(np.asarray(q)).all()
+    with pytest.raises(ValueError, match="space_to_depth"):
+        NetworkApply(4, cfg, 4, 83, 84)
+    # "auto" is rejected: a layout-changing knob must resolve identically
+    # on every host (review finding — heterogeneous-backend param trees)
+    with pytest.raises(ValueError, match="auto"):
+        NetworkApply(4, NetworkConfig(space_to_depth="auto"), 4, 84, 84)
+
+
 def test_actor_policy_forces_f32_under_bf16(rng):
     """Actors infer on host CPUs where bf16 is emulated: given a learner
     net with the bf16 policy forced on, ActorPolicy must rebuild itself
