@@ -87,6 +87,12 @@ class ConvTorso(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         for i, (features, kernel, stride) in enumerate(self.conv_layers):
             if i == 0 and self.space_to_depth:
+                if kernel % 2 or stride % 2:
+                    raise ValueError(
+                        f"space_to_depth needs an even first-conv "
+                        f"kernel/stride (got {kernel}/{stride}) — an odd "
+                        "value would silently change the architecture "
+                        "instead of being the exact rewrite")
                 x = space_to_depth_2x2(x)
                 kernel //= 2
                 stride //= 2
@@ -102,6 +108,33 @@ class ConvTorso(nn.Module):
         x = x.reshape(x.shape[0], -1)
         x = nn.Dense(self.cnn_out_dim, dtype=self.dtype)(x)
         return x
+
+
+def convert_params_space_to_depth(params, frame_stack: int):
+    """Migrate a standard-layout checkpoint to the space_to_depth layout:
+    re-index the first conv's kernel (2k, 2k, C, O) -> (k, k, 4C, O) with
+    w'[ph, pw, (dh*2+dw)*C + c, o] = w[2ph+dh, 2pw+dw, c, o] — the exact
+    transform ConvTorso applies to the input, so the converted checkpoint
+    computes identical outputs (parity-tested). Use when flipping
+    network.space_to_depth on for a warm start from an off-layout run."""
+    import flax
+    params = flax.core.unfreeze(params) if hasattr(params, "unfreeze") else \
+        jax.tree_util.tree_map(lambda x: x, params)
+    torso = params["params"]["torso"]
+    w = jnp.asarray(torso["Conv_0"]["kernel"])
+    kh, kw, c, o = w.shape
+    if c != frame_stack:
+        raise ValueError(
+            f"first conv kernel has {c} input channels; expected the "
+            f"standard layout's frame_stack={frame_stack} — already "
+            "converted?")
+    if kh % 2 or kw % 2:
+        raise ValueError(f"first conv kernel {kh}x{kw} must be even")
+    torso["Conv_0"]["kernel"] = (
+        w.reshape(kh // 2, 2, kw // 2, 2, c, o)
+         .transpose(0, 2, 1, 3, 4, 5)
+         .reshape(kh // 2, kw // 2, 4 * c, o))
+    return params
 
 
 class DuelingHead(nn.Module):
@@ -219,6 +252,15 @@ class R2D2Network(nn.Module):
         hidden: jnp.ndarray,        # (B, 2, hidden_dim) packed
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         cfg = self.config
+        if not isinstance(cfg.space_to_depth, bool):
+            # unresolved tri-state string: bool("off") is True — a silent
+            # architecture inversion. Direct R2D2Network constructions must
+            # go through NetworkApply (which resolves and validates) or
+            # pass a concrete bool.
+            raise ValueError(
+                "R2D2Network requires a resolved (bool) "
+                f"config.space_to_depth, got {cfg.space_to_depth!r} — "
+                "construct via NetworkApply, which resolves the tri-state")
         dtype = self.compute_dtype
         batch, seq = obs_seq.shape[0], obs_seq.shape[1]
 
@@ -226,7 +268,7 @@ class R2D2Network(nn.Module):
         # the MXU-friendly shape (vs per-step convs inside the scan).
         flat = obs_seq.astype(dtype).reshape(batch * seq, *obs_seq.shape[2:])
         latent = ConvTorso(cfg.cnn_out_dim, cfg.conv_layers, dtype,
-                           space_to_depth=bool(cfg.space_to_depth),
+                           space_to_depth=cfg.space_to_depth,
                            name="torso")(flat)
         latent = latent.reshape(batch, seq, cfg.cnn_out_dim)
 

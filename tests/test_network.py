@@ -252,16 +252,27 @@ def test_space_to_depth_is_exact(rng):
     assert init_shapes["params"]["Conv_0"]["kernel"] == (4, 4, 16, 32)
     assert got.shape == want.shape
 
-    # full-network smoke through the config knob + validation error path
-    from r2d2_tpu.models.network import NetworkApply
-    cfg = NetworkConfig(hidden_dim=16, cnn_out_dim=32, space_to_depth="on")
-    net = NetworkApply(4, cfg, 4, 84, 84)
-    params = net.init(jax.random.PRNGKey(2))
+    # full-network parity through the config knob: a standard-layout
+    # checkpoint migrated by convert_params_space_to_depth must produce
+    # identical Q-values from the s2d network
+    from r2d2_tpu.models.network import (
+        NetworkApply, convert_params_space_to_depth)
+    base_cfg = NetworkConfig(hidden_dim=16, cnn_out_dim=32)
+    net_off = NetworkApply(4, base_cfg, 4, 84, 84)
+    params_off = net_off.init(jax.random.PRNGKey(2))
     obs = jnp.asarray(rng.uniform(0, 1, (2, 3, 84, 84, 4)), jnp.float32)
     la = jnp.zeros((2, 3, 4), jnp.float32)
     from r2d2_tpu.models import initial_hidden
-    q, _ = net.apply(params, obs, la, initial_hidden(2, 16))
-    assert np.isfinite(np.asarray(q)).all()
+    q_off, _ = net_off.apply(params_off, obs, la, initial_hidden(2, 16))
+
+    cfg = NetworkConfig(hidden_dim=16, cnn_out_dim=32, space_to_depth="on")
+    net = NetworkApply(4, cfg, 4, 84, 84)
+    params_on = convert_params_space_to_depth(params_off, frame_stack=4)
+    q_on, _ = net.apply(params_on, obs, la, initial_hidden(2, 16))
+    np.testing.assert_allclose(np.asarray(q_on), np.asarray(q_off),
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="already converted"):
+        convert_params_space_to_depth(params_on, frame_stack=4)
     with pytest.raises(ValueError, match="space_to_depth"):
         NetworkApply(4, cfg, 4, 83, 84)
     # "auto" is rejected: a layout-changing knob must resolve identically
