@@ -252,10 +252,16 @@ def main() -> None:
     flops_per_step = model_flops_per_step(cfg, action_dim, use_double)
     peak = peak_flops(devs[0].device_kind) if on_tpu else 0.0
 
-    def build_step(use_pallas: bool, bf16: bool, spd: int, step_spec=None):
+    def build_step(use_pallas: bool, bf16: bool, spd: int, step_spec=None,
+                   s2d: bool = False):
         opt = dataclasses.replace(
             cfg.optim, pallas_obs_decode="on" if use_pallas else "off")
-        netcfg = dataclasses.replace(cfg.network, bf16=bf16)
+        # s2d=True forces the rewrite on; otherwise the SHIPPED default
+        # applies, so the matrix keeps describing the defaults if the
+        # space_to_depth default ever flips
+        netcfg = dataclasses.replace(
+            cfg.network, bf16=bf16,
+            space_to_depth="on" if s2d else cfg.network.space_to_depth)
         from r2d2_tpu.models import NetworkApply
         net_b = NetworkApply(action_dim, netcfg, cfg.env.frame_stack,
                              cfg.env.frame_height, cfg.env.frame_width)
@@ -345,6 +351,40 @@ def main() -> None:
                   f"model flops = {100*mfu:.1f}% of {peak/1e12:.0f} TFLOP/s "
                   "bf16 peak", file=sys.stderr)
 
+    # --- 2b. space_to_depth A/B at the bf16_spd16 policy (the current
+    # shipped TPU default; compare against that cell specifically) --------
+    # The exact first-conv rewrite (network.space_to_depth) targets the
+    # MXU's input-lane underutilization on the 4-channel frame stack. The
+    # knob changes the param layout so its default stays explicit
+    # ('off'/'on'); this cell measures what flipping it would buy so the
+    # default can follow measurement (params differ, so this uses a fresh
+    # train state — the throughput comparison is unaffected).
+    if on_tpu and not smoke:
+        try:
+            from r2d2_tpu.models import NetworkApply
+            opt_default = dataclasses.replace(
+                cfg.optim,
+                pallas_obs_decode="on" if default_pallas else "off")
+            s2d_cfg = dataclasses.replace(cfg.network, bf16=True,
+                                          space_to_depth="on")
+            s2d_net = NetworkApply(action_dim, s2d_cfg, cfg.env.frame_stack,
+                                   cfg.env.frame_height, cfg.env.frame_width)
+            # ONE net builds both the train state and the step, so their
+            # param trees cannot drift
+            ts_s2d = create_train_state(jax.random.PRNGKey(1), s2d_net,
+                                        cfg.optim)
+            step = make_multi_learner_step(s2d_net, spec, opt_default,
+                                           use_double, 16)
+            sps, _ts2, rs = measure_path(step, ts_s2d, rs, "bf16_spd16_s2d",
+                                         steps_per_dispatch=16)
+            matrix["bf16_spd16_s2d"] = sps * spec.batch_size
+        except Exception as e:   # never kill the bench for the extra cell
+            matrix["bf16_spd16_s2d"] = None
+            print(f"[bf16_spd16_s2d] FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    else:
+        matrix["bf16_spd16_s2d"] = None
+
     # --- report ----------------------------------------------------------
     # primary metric: what the SHIPPED defaults actually run — default
     # decode path, NetworkConfig.bf16, RuntimeConfig.steps_per_dispatch —
@@ -356,8 +396,11 @@ def main() -> None:
     # failed base measurement exits in part 1), so the max is never empty.
     from r2d2_tpu.ops.pallas_kernels import resolve_pallas_setting
     bf16_resolved = resolve_pallas_setting(cfg.network.bf16, "network.bf16")
+    s2d_default = resolve_pallas_setting(cfg.network.space_to_depth,
+                                         "network.space_to_depth")
     default_label = (f"{'bf16' if bf16_resolved else 'f32'}"
-                     f"_spd{cfg.runtime.resolved_steps_per_dispatch()}")
+                     f"_spd{cfg.runtime.resolved_steps_per_dispatch()}"
+                     f"{'_s2d' if s2d_default else ''}")
     best_label = max((k for k, v in matrix.items() if v is not None),
                      key=lambda k: matrix[k])
     measured_label = (default_label if matrix.get(default_label) is not None
