@@ -54,6 +54,48 @@ from r2d2_tpu.config import Config, apex_epsilon
 from r2d2_tpu.replay.structs import Block, ReplaySpec, empty_block_np
 
 
+class LocalActorFleet:
+    """One host's actor threads with PlayerStack-style supervision.
+
+    Restarts are purely host-local (they touch no collective state, so
+    lockstep is unaffected) and must NEVER propagate an exception into the
+    lockstep learner loop — a host crashing mid-collective abandons every
+    peer until the jax.distributed heartbeat timeout, exactly the failure
+    the stop consensus exists to prevent. A failed respawn is logged and
+    retried on the next supervision tick instead."""
+
+    def __init__(self, spawn_fn: Callable[[int], threading.Thread], n: int,
+                 restart_dead: bool, stop: threading.Event):
+        self._spawn = spawn_fn
+        self._restart = restart_dead
+        self._stop = stop
+        self.threads: List[threading.Thread] = [spawn_fn(i) for i in range(n)]
+
+    def supervise(self) -> int:
+        """Respawn dead threads; returns the number restarted (logged)."""
+        import logging
+        if not self._restart or self._stop.is_set():
+            return 0
+        restarted = 0
+        for i, t in enumerate(self.threads):
+            if not t.is_alive():
+                try:
+                    self.threads[i] = self._spawn(i)
+                    restarted += 1
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "actor %d respawn failed; will retry next "
+                        "supervision tick", i)
+        if restarted:
+            logging.getLogger(__name__).warning(
+                "restarted %d dead actor thread(s)", restarted)
+        return restarted
+
+    def join(self, timeout: float = 5.0) -> None:
+        for t in self.threads:
+            t.join(timeout=timeout)
+
+
 def make_lockstep_ingest(spec: ReplaySpec, mesh):
     """One jitted program per loop iteration: conditional per-shard block
     writes, global counters, and stop consensus.
@@ -263,8 +305,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     store = InProcWeightStore(ts.params)
     queue = BlockQueue(use_mp=False)
     n_local = cfg.actor.num_actors
-    threads: List[threading.Thread] = []
-    for i in range(n_local):
+
+    def spawn_actor(i: int) -> threading.Thread:
         gidx = rank * n_local + i
         eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
                            cfg.actor.eps_alpha)
@@ -281,7 +323,10 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         t = threading.Thread(target=loop, daemon=True,
                              name=f"actor-h{rank}-{i}")
         t.start()
-        threads.append(t)
+        return t
+
+    fleet = LocalActorFleet(spawn_actor, n_local,
+                            cfg.runtime.restart_dead_actors, stop)
 
     metrics = TrainMetrics(0, cfg.runtime.save_dir) if rank == 0 else None
     max_steps = max_training_steps or cfg.optim.training_steps
@@ -292,7 +337,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     step_base = step_count      # rate-limiter budget counts from THIS process's
     paused = False              # start (info.env_steps restarts at 0 with the ring)
     pending_losses: list = []
-    last_log = time.time()
+    last_log = last_supervise = time.time()
     info = {"buffer_steps": 0, "env_steps": 0, "filled_shards": 0}
 
     def flush_losses():
@@ -353,16 +398,18 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             else:
                 time.sleep(0.01)
 
-            if metrics is not None:
-                now = time.time()
-                if now - last_log >= rt.log_interval:
-                    flush_losses()
-                    metrics.env_steps = resumed_env + info["env_steps"]
-                    metrics.set_buffer_size(info["buffer_steps"])
-                    record = metrics.log(now - last_log)
-                    if log_fn:
-                        log_fn({"rank": rank, **record})
-                    last_log = now
+            now = time.time()
+            if now - last_supervise >= rt.log_interval:
+                fleet.supervise()   # every host tends its own actor fleet
+                last_supervise = now
+            if metrics is not None and now - last_log >= rt.log_interval:
+                flush_losses()
+                metrics.env_steps = resumed_env + info["env_steps"]
+                metrics.set_buffer_size(info["buffer_steps"])
+                record = metrics.log(now - last_log)
+                if log_fn:
+                    log_fn({"rank": rank, **record})
+                last_log = now
         flush_losses()
     finally:
         stop.set()
@@ -371,8 +418,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 signal.signal(sig, handler)
             except (ValueError, OSError):
                 pass
-        for t in threads:
-            t.join(timeout=5.0)
+        fleet.join(timeout=5.0)
 
     return {"step": step_count, "env_steps": resumed_env + info["env_steps"],
             "buffer_steps": info["buffer_steps"], "params": ts.params}

@@ -261,6 +261,47 @@ def test_multihost_loopback_dryrun():
     launch(num_processes=2, devices_per_process=4, timeout=280.0)
 
 
+def test_local_actor_fleet_supervision():
+    """The multihost trainer's per-host supervision (LocalActorFleet):
+    restarts dead threads with a logged count, never lets a failing spawn
+    escape into the lockstep loop (it would abandon peers mid-collective),
+    and honors the off-switch and the stop event."""
+    import threading
+
+    from r2d2_tpu.parallel.multihost import LocalActorFleet
+
+    def make_spawn(fail_on=()):
+        def spawn(i):
+            if i in fail_on:
+                raise RuntimeError("env creation failed")
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            return t
+        return spawn
+
+    stop = threading.Event()
+    fleet = LocalActorFleet(make_spawn(), 3, restart_dead=True, stop=stop)
+    for t in fleet.threads:
+        t.join()
+    assert fleet.supervise() == 3           # all finished -> all restarted
+
+    # a failing respawn is swallowed (logged), others still restart
+    fleet._spawn = make_spawn(fail_on={1})
+    for t in fleet.threads:
+        t.join()
+    assert fleet.supervise() == 2
+
+    # stop set -> no restarts; off-switch -> no restarts
+    stop.set()
+    assert fleet.supervise() == 0
+    stop2 = threading.Event()
+    fleet2 = LocalActorFleet(make_spawn(), 1, restart_dead=False, stop=stop2)
+    fleet2.threads[0].join()
+    assert fleet2.supervise() == 0
+    fleet.join(timeout=1.0)
+    fleet2.join(timeout=1.0)
+
+
 def test_multihost_lockstep_training(tmp_path):
     """The full rank-aware trainer (parallel/multihost.py): two controller
     processes, each owning its own actors and feeding only its local replay
