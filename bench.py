@@ -25,9 +25,24 @@ baseline estimate used here until a measured reference log is available.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
+Resilience (VERDICT r3 #1): the measurement runs under a supervisor in the
+same file. The supervisor probes backend discovery in a SUBPROCESS with a
+bounded timeout and retries with backoff (a wedged remote-TPU tunnel makes
+`jax.devices()` HANG, not fail — observed rounds 1 and 3), then runs the
+measurement itself as a child with an overall deadline. On persistent
+backend failure it emits the last driver-grade measurement from
+BENCH_CACHE.json with an explicit "stale": true flag and exits 0, so a
+wedged tunnel at driver time degrades the artifact instead of losing the
+round's number. A fresh successful TPU measurement rewrites the cache.
+
 Env knobs (used by tests/test_bench_diag.py):
   R2D2_BENCH_SMOKE=1                 tiny config, xla-decode spd=1 only
   R2D2_BENCH_SIMULATE_DISPATCH_FAILURE=1  raise at first dispatch (diagnostics path)
+  R2D2_BENCH_CHILD=1                 run the measurement directly (no supervisor)
+  R2D2_BENCH_CACHE=path              last-good cache location (default: ./BENCH_CACHE.json)
+  R2D2_BENCH_PROBE_TIMEOUT / _ATTEMPTS / _BACKOFF   discovery retry schedule
+  R2D2_BENCH_CHILD_TIMEOUT           overall measurement deadline (s)
+  R2D2_BENCH_FORCE_CACHE=1           cache even non-TPU results (tests)
 """
 
 import dataclasses
@@ -39,6 +54,13 @@ import time
 import numpy as np
 
 REFERENCE_SEQ_UPDATES_PER_SEC = 640.0  # ~5 train steps/s * batch 128 (see above)
+
+# Child exit code for DIAGNOSED backend failures (wedged tunnel, dispatch
+# failure on a known-good program). The supervisor masks only this code
+# (and signal deaths) with the stale cache — a genuine code crash stays a
+# loud nonzero exit so regressions are never hidden behind last round's
+# number.
+BACKEND_FAILURE_RC = 42
 
 BACKEND_GUIDANCE = (
     "  If this is the remote-TPU tunnel: a previously killed "
@@ -88,7 +110,7 @@ def init_backend_or_die():
             f"  JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')!r}\n"
             + BACKEND_GUIDANCE,
             file=sys.stderr)
-        sys.exit(1)
+        sys.exit(BACKEND_FAILURE_RC)
     finally:
         watchdog.cancel()
     print(f"backend: {devs[0].platform} x{len(devs)} "
@@ -205,7 +227,7 @@ def measure_path(step, ts, rs, label: str, steps_per_dispatch: int = 1,
     return steps_per_sec, ts, rs
 
 
-def main() -> None:
+def run_bench() -> None:
     # Route any JAX_PLATFORMS request through jax.config BEFORE backend
     # discovery: with a wedged remote-TPU tunnel, the env var alone does not
     # stop the accelerator plugin from hanging discovery (it filters after
@@ -294,7 +316,7 @@ def main() -> None:
                 f"  JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')!r}\n"
                 + BACKEND_GUIDANCE,
                 file=sys.stderr)
-            sys.exit(1)
+            sys.exit(BACKEND_FAILURE_RC)
         except Exception as e:  # pallas lowering failure must not kill the bench
             if not use_pallas:
                 raise
@@ -421,6 +443,8 @@ def main() -> None:
         "pallas_gather": (results["pallas_gather"]
                           and round(results["pallas_gather"], 1)),
         "matrix": {k: v and round(v, 1) for k, v in matrix.items()},
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
     }
     if peak:
         steps_per_sec = seq_updates / spec.batch_size
@@ -430,5 +454,192 @@ def main() -> None:
     print(json.dumps(out))
 
 
+# The probe must route any JAX_PLATFORMS request through jax.config BEFORE
+# discovery (same reason as run_bench's pin_platform call): the env var
+# filters after plugin init, so a cpu-pinned probe would still hang on a
+# wedged remote-TPU plugin.
+_PROBE_SCRIPT = (
+    "import sys; from r2d2_tpu.utils import pin_platform; pin_platform(); "
+    "import jax; d = jax.devices(); "
+    "print('probe-ok', d[0].platform, len(d), d[0].device_kind); "
+    "sys.stdout.flush()")
+
+
+def _terminate(proc) -> None:
+    """SIGTERM, grace, then SIGKILL — a hard-killed TPU-holding process is
+    itself a known tunnel-wedger (round 3), so give it a chance to unwind."""
+    import subprocess
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+def probe_backend(timeout: float, active=None) -> bool:
+    """Run backend discovery in a subprocess so a wedged tunnel's HANG is
+    bounded by `timeout` instead of stalling the bench forever. `active`
+    (a dict) exposes the in-flight proc to the supervisor's signal handler."""
+    import subprocess
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SCRIPT],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    if active is not None:
+        active["proc"] = proc
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _terminate(proc)
+        print(f"bench: backend probe hung past {timeout:.0f}s (wedged "
+              "tunnel?)", file=sys.stderr, flush=True)
+        return False
+    ok = proc.returncode == 0 and "probe-ok" in out
+    if not ok:
+        tail = out.strip().splitlines()[-3:] if out.strip() else []
+        print(f"bench: backend probe failed rc={proc.returncode}: "
+              + " | ".join(tail), file=sys.stderr, flush=True)
+    else:
+        print(f"bench: backend probe ok: {out.strip().splitlines()[-1]}",
+              file=sys.stderr, flush=True)
+    return ok
+
+
+def _cache_path() -> str:
+    return os.environ.get(
+        "R2D2_BENCH_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_CACHE.json"))
+
+
+def emit_stale_or_die(reason: str) -> None:
+    """Persistent backend failure: emit the last-good cached measurement
+    flagged stale (rc=0) so the round keeps a number, else rc=1."""
+    try:
+        with open(_cache_path()) as f:
+            cache = json.load(f)
+        out = cache["output"]
+    except (OSError, KeyError, json.JSONDecodeError):
+        print("bench: no last-good cache at "
+              f"{_cache_path()!r} to fall back on.\n" + BACKEND_GUIDANCE,
+              file=sys.stderr)
+        sys.exit(1)
+    out["stale"] = True
+    out["stale_reason"] = reason
+    out["stale_recorded_at"] = cache.get("recorded_at")
+    print("bench: emitting LAST-GOOD measurement (stale=true, recorded "
+          f"{cache.get('recorded_at')}) because: {reason}", file=sys.stderr)
+    print(json.dumps(out))
+    sys.exit(0)
+
+
+def supervise() -> None:
+    """Probe-with-retry, then run the measurement as a deadlined child;
+    fall back to the stale cache on persistent backend failure. Only
+    DIAGNOSED backend failures (BACKEND_FAILURE_RC, signal deaths,
+    timeouts) are masked by the cache — a genuine crash stays nonzero."""
+    import signal
+    import subprocess
+    attempts = int(os.environ.get("R2D2_BENCH_ATTEMPTS", "3"))
+    probe_timeout = float(os.environ.get("R2D2_BENCH_PROBE_TIMEOUT", "120"))
+    backoff = float(os.environ.get("R2D2_BENCH_BACKOFF", "45"))
+    child_timeout = float(os.environ.get("R2D2_BENCH_CHILD_TIMEOUT", "2700"))
+
+    # A driver-side timeout SIGTERMs the SUPERVISOR; without a handler the
+    # in-flight probe or measurement child would be orphaned still holding
+    # the TPU — the exact hard-kill tunnel-wedge this file exists to
+    # prevent. Unwind whichever child is live and still leave a (stale)
+    # number on stdout. Installed BEFORE the probe loop: on a wedged
+    # tunnel the probe/backoff phase alone can outlast a driver timeout.
+    active = {"proc": None}
+
+    def _on_term(signum, frame):
+        if active["proc"] is not None:
+            _terminate(active["proc"])
+        emit_stale_or_die(f"supervisor received signal {signum} "
+                          "(driver timeout?) — children unwound")
+    prev_term = signal.signal(signal.SIGTERM, _on_term)
+
+    def _echo(out: str) -> None:
+        for ln in out.strip().splitlines():
+            if ln.strip():
+                print(ln, file=sys.stderr)
+
+    try:
+        for attempt in range(1, attempts + 1):
+            if probe_backend(probe_timeout, active):
+                break
+            if attempt < attempts:
+                print(f"bench: probe attempt {attempt}/{attempts} failed; "
+                      f"retrying in {backoff:.0f}s", file=sys.stderr,
+                      flush=True)
+                time.sleep(backoff)
+        else:
+            emit_stale_or_die(
+                f"backend discovery failed {attempts}x (timeout "
+                f"{probe_timeout:.0f}s each) — remote-TPU tunnel wedged")
+        active["proc"] = None
+
+        env = dict(os.environ, R2D2_BENCH_CHILD="1")
+        proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                                env=env, stdout=subprocess.PIPE, text=True)
+        active["proc"] = proc
+        try:
+            out, _ = proc.communicate(timeout=child_timeout)
+        except subprocess.TimeoutExpired:
+            _terminate(proc)
+            emit_stale_or_die(
+                f"measurement exceeded the {child_timeout:.0f}s deadline "
+                "(backend likely wedged mid-run)")
+        active["proc"] = None
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+
+    if proc.returncode != 0:
+        _echo(out)
+        if proc.returncode == BACKEND_FAILURE_RC or proc.returncode < 0:
+            emit_stale_or_die(
+                f"measurement child exited rc={proc.returncode} "
+                "(diagnosed backend failure — diagnostics above)")
+        print(f"bench: measurement child CRASHED rc={proc.returncode} — a "
+              "code failure, NOT masking it with the stale cache",
+              file=sys.stderr)
+        sys.exit(proc.returncode)
+
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+    try:
+        result = json.loads(lines[-1])
+    except (IndexError, json.JSONDecodeError):
+        _echo(out)
+        emit_stale_or_die("measurement child emitted no JSON line")
+    for ln in lines[:-1]:             # anything else must not pollute stdout
+        print(ln, file=sys.stderr)
+
+    cacheable = (result.get("platform") == "tpu"
+                 and not os.environ.get("R2D2_BENCH_SMOKE")) or \
+        bool(os.environ.get("R2D2_BENCH_FORCE_CACHE"))
+    if cacheable:
+        tmp = _cache_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                    time.gmtime()),
+                       "output": result}, f, indent=1)
+        os.replace(tmp, _cache_path())
+        print(f"bench: cached last-good measurement to {_cache_path()}",
+              file=sys.stderr)
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("R2D2_BENCH_CHILD"):
+        # The default SIGTERM disposition dies with no cleanup — from the
+        # TPU runtime's view the same abrupt kill as SIGKILL (the known
+        # tunnel-wedger). Raise SystemExit instead so atexit/JAX client
+        # teardown runs when the supervisor unwinds us.
+        import signal
+        signal.signal(signal.SIGTERM, lambda s, f: sys.exit(143))
+        if os.environ.get("R2D2_BENCH_SIMULATE_CRASH"):
+            raise ValueError("simulated measurement-code crash")
+        run_bench()
+    else:
+        supervise()
