@@ -315,6 +315,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         policy = ActorPolicy(net, ts.params, eps, seed=seed)
 
         def loop(env=env, policy=policy, reader_id=i):
+            # run_actor owns env and closes it on every exit
             run_actor(cfg, env, policy,
                       block_sink=lambda b: queue.put_patient(b, stop.is_set),
                       weight_poll=lambda: store.poll(reader_id),

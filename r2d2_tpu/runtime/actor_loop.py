@@ -23,7 +23,25 @@ def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
               weight_poll: Callable, should_stop: Callable[[], bool],
               max_env_steps: Optional[int] = None) -> int:
     """Returns total env steps taken. ``block_sink(block)`` ships a finished
-    block; ``weight_poll()`` returns fresh params or None."""
+    block; ``weight_poll()`` returns fresh params or None.
+
+    OWNS ``env`` from here on: closes it on every exit (clean stop or
+    crash), in ONE place for all spawners — a respawned actor builds a
+    fresh env, and an unclosed predecessor leaks fds/engine handles per
+    restart (round-3 advisor)."""
+    try:
+        return _run_actor(cfg, env, policy, block_sink, weight_poll,
+                          should_stop, max_env_steps)
+    finally:
+        try:
+            env.close()
+        except Exception:
+            pass
+
+
+def _run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
+               weight_poll: Callable, should_stop: Callable[[], bool],
+               max_env_steps: Optional[int] = None) -> int:
     spec = ReplaySpec.from_config(cfg)
     lb = LocalBuffer(spec, policy.action_dim, cfg.optim.gamma,
                      cfg.optim.priority_eta)

@@ -54,5 +54,4 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                   weight_poll=sub.poll,
                   should_stop=stop_event.is_set)
     finally:
-        sub.close()
-        env.close()
+        sub.close()   # env is closed by run_actor (its finally owns it)

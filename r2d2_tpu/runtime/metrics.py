@@ -40,6 +40,8 @@ class TrainMetrics:
         self.training_steps = 0
         self.last_training_steps = 0
         self.sum_loss = 0.0
+        self.dropped_priority_updates = 0
+        self._next_drop_warn = 1
 
     # -- feed points --
 
@@ -57,6 +59,21 @@ class TrainMetrics:
 
     def set_buffer_size(self, size: int) -> None:
         self.buffer_size = int(size)
+
+    def on_dropped_priority_update(self) -> None:
+        """Called when a priority write-back batch is dropped because the
+        async write-back queue is saturated (host placement). Dropping
+        silently degrades PER toward uniform sampling, so make it loud:
+        warn at the first drop and at each 10x milestone after (the stdlib
+        lastResort handler shows WARNING+ even with logging unconfigured)."""
+        self.dropped_priority_updates += 1
+        if self.dropped_priority_updates >= self._next_drop_warn:
+            logging.getLogger(__name__).warning(
+                "player %d: %d priority write-back batch(es) dropped under "
+                "write-back queue backpressure — PER is degrading toward "
+                "uniform sampling; the write-back thread is not keeping up",
+                self.player_idx, self.dropped_priority_updates)
+            self._next_drop_warn *= 10
 
     # -- emission (exact reference key strings, ref worker.py:220-234) --
 
@@ -91,6 +108,7 @@ class TrainMetrics:
             "training_steps": self.training_steps,
             "training_speed": train_speed,
             "loss": mean_loss,
+            "dropped_priority_updates": self.dropped_priority_updates,
         }
         if self._jsonl_path:
             with open(self._jsonl_path, "a") as f:

@@ -50,6 +50,9 @@ class PlayerStack:
         self.learner = Learner(cfg, self.net, player_idx, metrics=self.metrics)
         self.threads: List[threading.Thread] = []
         self.processes: List[mp.Process] = []
+        self._seen_dead: set = set()    # reaped dead process objects
+        self._recover_after: Optional[float] = None   # pending ring recovery
+        self._last_death = 0.0
         self.publisher = None
         self.store = None
         self.queue: Optional[BlockQueue] = None
@@ -84,6 +87,7 @@ class PlayerStack:
                              eps, seed=seed)
 
         def loop(env=env, policy=policy, reader_id=i):
+            # run_actor owns env and closes it on every exit
             run_actor(cfg, env, policy,
                       block_sink=lambda b: self.queue.put_patient(
                           b, self._stop.is_set),
@@ -129,29 +133,59 @@ class PlayerStack:
     def supervise(self) -> int:
         """Restart dead actors (the reference has no failure handling at all
         — a crashed Ray actor silently reduces throughput forever, SURVEY
-        §5.3). Returns the number of restarts performed."""
-        if not self.cfg.runtime.restart_dead_actors or self._stop.is_set():
+        §5.3). Returns the number of restarts performed.
+
+        Shm-ring slot reclamation runs for every NEWLY-detected dead actor
+        process regardless of runtime.restart_dead_actors (round-3 advisor):
+        a producer that died between reserve and commit wedges the ring head
+        slot whether or not it gets respawned, and with restarts off the
+        learner would otherwise starve even with other actors alive."""
+        if self._stop.is_set():
             return 0
+        restart = self.cfg.runtime.restart_dead_actors
         restarted = 0
-        for i, t in enumerate(self.threads):
-            if not t.is_alive():
-                self._spawn_thread_actor(i)
-                restarted += 1
+        if restart:
+            for i, t in enumerate(self.threads):
+                if not t.is_alive():
+                    self._spawn_thread_actor(i)
+                    restarted += 1
+        newly_dead = 0
         for i, p in enumerate(self.processes):
             if not p.is_alive():
-                self._spawn_process_actor(i)
-                restarted += 1
-        if restarted and self.processes:
+                if restart:
+                    # the dead object is replaced immediately, so it can
+                    # never be re-iterated — no dedup bookkeeping needed
+                    newly_dead += 1
+                    self._spawn_process_actor(i)
+                    restarted += 1
+                elif p not in self._seen_dead:
+                    # restarts off: the dead process stays in the list
+                    # forever; _seen_dead (holding the object — no id
+                    # reuse) keeps it from re-scheduling reclamation every
+                    # tick, which would push _recover_after forever into
+                    # the future
+                    self._seen_dead.add(p)
+                    newly_dead += 1
+        if newly_dead:
             # a producer that died between reserve and commit would wedge
             # the shm ring. Schedule reclamation for AFTER the slot-grace
             # window: an immediate attempt would find the wedged slot not
             # yet stale (recover_stalled's 5s grace protects live writers)
-            # and — with restarted==0 on every later tick — never retry.
-            self._recover_after = time.time() + 6.0
-        if (getattr(self, "_recover_after", None) is not None
+            # and — with newly_dead==0 on every later tick — never retry.
+            # Don't PUSH an already-pending pass later: under a
+            # crash-looping actor with a supervise cadence < 6s that would
+            # defer recovery forever (round-4 review).
+            self._last_death = time.time()
+            if self._recover_after is None:
+                self._recover_after = self._last_death + 6.0
+        if (self._recover_after is not None
                 and time.time() >= self._recover_after):
-            self._recover_after = None
             freed = self.queue.recover_stalled()
+            # re-arm when a death landed inside this pass's grace window —
+            # its wedged slot was not yet stale for THIS recover_stalled
+            self._recover_after = (self._last_death + 6.0
+                                   if self._last_death + 6.0 > time.time()
+                                   else None)
             if freed:
                 import logging
                 logging.getLogger(__name__).warning(
