@@ -1,8 +1,16 @@
 """Genetic hyperparameter search CLI (the reference's genetic-branch
 capability, README.md:28-32).
 
-Fitness = mean episode return over the final log intervals of a short
-training slice on the configured env (default Fake, hermetic).
+Two fitness modes (--fitness-mode):
+  * "sync" (default): deterministic synchronous collect:learn slice
+    (tools/sync_train.py) scored by mean greedy eval return — the same
+    genome scores bit-identically, so selection compares genomes, not
+    scheduler noise.
+  * "slice": the threaded production orchestrator for a short slice,
+    scored by mean logged episode return — wall-clock-realistic but
+    scheduler-sensitive; the rate limiter is pinned (--slice-ratio) to
+    bound the noise (unpinned, PERF.md measured 25-86 return on identical
+    invocations).
 
     python -m r2d2_tpu.cli.genetic --population 6 --generations 3 \
         --slice-steps 200 --env.game_name=Fake
@@ -15,10 +23,40 @@ import sys
 import numpy as np
 
 
-def make_slice_eval(base_overrides, slice_steps: int, slice_seconds: float):
+def _ratio_pin(base_overrides, slice_ratio: float):
+    """The ONE pin rule for both fitness modes: pin the rate limiter to
+    ``slice_ratio`` unless the user set --replay.max_env_steps_per_train_step
+    explicitly (including an explicit 0 — a free-run request) or
+    ``slice_ratio`` is 0. Returns ``(user_set_ratio, pin_fn)``."""
+    user_set = any("replay.max_env_steps_per_train_step" in str(o)
+                   for o in base_overrides)
+
+    def pin(cfg):
+        if (slice_ratio > 0 and not user_set
+                and cfg.replay.max_env_steps_per_train_step <= 0):
+            return cfg.replace(
+                **{"replay.max_env_steps_per_train_step": slice_ratio})
+        return cfg
+
+    return user_set, pin
+
+
+def make_slice_eval(base_overrides, slice_steps: int, slice_seconds: float,
+                    slice_ratio: float = 2.0):
+    """``slice_ratio``: fitness slices run with the rate limiter pinned to
+    this collect:learn ratio unless the base config already sets one.
+    Free-running actor threads make the interleaving — and the score — a
+    function of host scheduling luck (PERF.md measured 25-86 return on
+    identical invocations); a pinned ratio makes selection compare
+    genomes, not scheduler noise. 0 disables the pin (measured-noisy).
+    An explicit --replay.max_env_steps_per_train_step override — including
+    an explicit 0 — always wins over the pin."""
     from r2d2_tpu.runtime.orchestrator import train
 
+    _, pin = _ratio_pin(base_overrides, slice_ratio)
+
     def eval_fn(cfg) -> float:
+        cfg = pin(cfg)
         records = []
         try:
             stacks = train(cfg, max_training_steps=slice_steps,
@@ -37,6 +75,37 @@ def make_slice_eval(base_overrides, slice_steps: int, slice_seconds: float):
     return eval_fn
 
 
+def make_sync_eval(base_overrides, slice_steps: int, slice_ratio: float = 2.0,
+                   seed: int = 0, max_seconds: float = None):
+    """Deterministic fitness: synchronous collect:learn at a pinned ratio,
+    scored by mean greedy eval return (tools/sync_train.py). Bit-identical
+    across evaluations of the same genome. Sync collection IS the ratio
+    schedule, so the effective ratio must be >= 1 — rejected up front
+    rather than silently scoring every genome -inf. ``max_seconds`` bounds
+    each genome's wall clock (a timed-out genome scores -inf; note that
+    makes the score host-speed-dependent at the margin)."""
+    from r2d2_tpu.tools.sync_train import sync_fitness
+
+    user_set_ratio, pin = _ratio_pin(base_overrides, slice_ratio)
+    if not user_set_ratio and slice_ratio < 1:
+        raise ValueError(
+            "sync fitness needs a collect:learn ratio >= 1 (sync collection "
+            "IS the ratio schedule): raise --slice-ratio, set "
+            "--replay.max_env_steps_per_train_step >= 1, or use "
+            "--fitness-mode=slice for free-running slices")
+
+    def eval_fn(cfg) -> float:
+        cfg = pin(cfg)
+        try:
+            return sync_fitness(cfg, slice_steps, seed=seed,
+                                max_seconds=max_seconds)
+        except Exception as e:  # invalid genome (e.g. OOM-scale) scores -inf
+            print(f"genome failed: {e}", file=sys.stderr)
+            return float("-inf")
+
+    return eval_fn
+
+
 def main(argv=None) -> None:
     from r2d2_tpu.utils import pin_platform
     pin_platform()
@@ -45,7 +114,18 @@ def main(argv=None) -> None:
     p.add_argument("--population", type=int, default=6)
     p.add_argument("--generations", type=int, default=3)
     p.add_argument("--slice-steps", type=int, default=300)
-    p.add_argument("--slice-seconds", type=float, default=600.0)
+    p.add_argument("--slice-seconds", type=float, default=600.0,
+                   help="wall-clock bound per fitness slice (both modes; a "
+                        "timed-out sync genome scores -inf)")
+    p.add_argument("--slice-ratio", type=float, default=2.0,
+                   help="pin the collect:learn rate limiter during fitness "
+                        "slices (0 disables; default 2.0 — unpinned slices "
+                        "score scheduler noise, see PERF.md)")
+    p.add_argument("--fitness-mode", choices=("sync", "slice"),
+                   default="sync",
+                   help="sync: deterministic single-stream slice scored by "
+                        "greedy eval (bit-reproducible); slice: threaded "
+                        "orchestrator slice (wall-clock-realistic, noisier)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="genetic_results.json")
     args, config_overrides = p.parse_known_args(argv)
@@ -54,8 +134,23 @@ def main(argv=None) -> None:
     from r2d2_tpu.tools.genetic import run_search
 
     base = parse_overrides(Config(), config_overrides)
-    eval_fn = make_slice_eval(config_overrides, args.slice_steps,
-                              args.slice_seconds)
+    if args.fitness_mode == "sync":
+        if base.replay.max_env_steps_per_train_step < 1 and any(
+                "replay.max_env_steps_per_train_step" in o
+                for o in config_overrides):
+            p.error("--fitness-mode=sync needs "
+                    "--replay.max_env_steps_per_train_step >= 1 (sync "
+                    "collection IS the ratio schedule); use "
+                    "--fitness-mode=slice for free-running slices")
+        try:
+            eval_fn = make_sync_eval(config_overrides, args.slice_steps,
+                                     args.slice_ratio, seed=args.seed,
+                                     max_seconds=args.slice_seconds)
+        except ValueError as e:
+            p.error(str(e))
+    else:
+        eval_fn = make_slice_eval(config_overrides, args.slice_steps,
+                                  args.slice_seconds, args.slice_ratio)
 
     def log(gen, result):
         genome, fit = result.best

@@ -106,8 +106,31 @@ def run_search(eval_fn: Callable[[Config], float], *, base: Optional[Config] = N
     history: List[GenerationResult] = []
     n_elite = max(1, int(population * elite_frac))
 
+    def score(g: Genome) -> Tuple[float, bool]:
+        # A genome can be invalid against a user-overridden base (the space
+        # is layout-safe only against the defaults — e.g. learning_steps=16
+        # vs an overridden block_length=20): score it -inf instead of
+        # killing the whole search at Config construction. Returns
+        # (fitness, was_invalid) so an ALL-invalid generation can still
+        # fail loudly below (an eval_fn -inf, e.g. a slice with no
+        # episodes, is legitimate and must not trigger that).
+        try:
+            cfg = genome_to_config(base, g)
+        except ValueError as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "genome invalid against the base config (%s); fitness -inf", e)
+            return float("-inf"), True
+        return float(eval_fn(cfg)), False
+
     for gen in range(generations):
-        fitnesses = [float(eval_fn(genome_to_config(base, g))) for g in genomes]
+        scored = [score(g) for g in genomes]
+        fitnesses = [f for f, _ in scored]
+        if all(invalid for _, invalid in scored):
+            raise ValueError(
+                f"every genome in generation {gen} is invalid against the "
+                "base config — the overridden base conflicts with the whole "
+                "search space; relax the overrides or pass a custom space")
         result = GenerationResult(genomes, fitnesses)
         history.append(result)
         if log_fn:

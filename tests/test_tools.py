@@ -63,6 +63,114 @@ def test_genome_sampling_always_valid():
         assert isinstance(cfg.network.use_dueling, bool)
 
 
+def test_slice_eval_pins_rate_limiter(monkeypatch):
+    """Round-3 review: genetic fitness slices with the rate limiter off
+    score scheduler noise (PERF.md measured 25-86 return on identical
+    invocations). make_slice_eval must pin the collect:learn ratio unless
+    the genome/base config already sets one."""
+    from types import SimpleNamespace
+
+    from r2d2_tpu.cli.genetic import make_slice_eval
+    from r2d2_tpu.runtime import orchestrator as orch_mod
+
+    captured = []
+
+    def fake_train(cfg, **kwargs):
+        captured.append(cfg)
+        return [SimpleNamespace(
+            metrics=SimpleNamespace(num_episodes=0, episode_reward=0.0))]
+
+    monkeypatch.setattr(orch_mod, "train", fake_train)
+    ev = make_slice_eval([], slice_steps=10, slice_seconds=10.0,
+                         slice_ratio=2.0)
+    ev(Config())                                   # default ratio 0 -> pinned
+    assert captured[-1].replay.max_env_steps_per_train_step == 2.0
+    explicit = Config().replace(
+        **{"replay.max_env_steps_per_train_step": 1.5})
+    ev(explicit)                                   # explicit value preserved
+    assert captured[-1].replay.max_env_steps_per_train_step == 1.5
+    ev0 = make_slice_eval([], 10, 10.0, slice_ratio=0.0)
+    ev0(Config())                                  # 0 disables the pin
+    assert captured[-1].replay.max_env_steps_per_train_step == 0.0
+    # an EXPLICIT user 0 (free-run request) wins over the pin even though
+    # it equals the dataclass default
+    ev_user = make_slice_eval(
+        ["--replay.max_env_steps_per_train_step=0"], 10, 10.0,
+        slice_ratio=2.0)
+    ev_user(Config())
+    assert captured[-1].replay.max_env_steps_per_train_step == 0.0
+
+
+def test_sync_eval_rejects_sub_one_ratio_and_bounds_wall_clock(tmp_path):
+    """Round-4 review: sync collection IS the ratio schedule, so a <1
+    effective ratio must be rejected up front (not silently score every
+    genome -inf); and --slice-seconds must bound each sync genome (a
+    timed-out genome scores -inf instead of stalling the generation)."""
+    from r2d2_tpu.cli.genetic import make_sync_eval
+
+    from tests.test_runtime import tiny_config
+
+    with pytest.raises(ValueError, match="ratio >= 1"):
+        make_sync_eval([], slice_steps=10, slice_ratio=0.0)
+
+    # host placement breaks the bit-reproducibility contract: rejected
+    from r2d2_tpu.tools.sync_train import sync_train
+    host_cfg = tiny_config(tmp_path).replace(
+        **{"replay.placement": "host",
+           "replay.max_env_steps_per_train_step": 2.0})
+    with pytest.raises(ValueError, match="placement='device'"):
+        sync_train(host_cfg, 5, 0.4)
+
+    ev = make_sync_eval([], slice_steps=10_000, slice_ratio=2.0,
+                        max_seconds=0.5)
+    assert np.isneginf(ev(tiny_config(tmp_path)))   # timed out -> -inf
+
+
+@pytest.mark.slow
+def test_identical_genome_scores_identically_in_sync_mode(tmp_path):
+    """VERDICT r3 #6 'done' criterion (strengthened): two evaluations of
+    the identical genome don't just land within tolerance — the default
+    sync fitness mode is bit-reproducible, so they are EQUAL."""
+    from r2d2_tpu.cli.genetic import make_sync_eval
+
+    from tests.test_runtime import tiny_config
+
+    cfg = tiny_config(tmp_path)
+    ev = make_sync_eval([], slice_steps=30, slice_ratio=2.0)
+    a, b = ev(cfg), ev(cfg)
+    assert np.isfinite(a) and np.isfinite(b)
+    assert a == b
+
+
+def test_invalid_genome_scores_neg_inf_instead_of_crashing():
+    """A user-overridden base can make sampled genomes invalid (e.g.
+    block_length=20 vs the space's learning_steps=16): the search must
+    score them -inf, not die at Config construction."""
+    base = Config().replace(**{"replay.block_length": 20,
+                               "replay.capacity": 800,
+                               "sequence.learning_steps": 5,
+                               "sequence.burn_in_steps": 4})
+    seen = []
+
+    def fitness(cfg: Config) -> float:
+        seen.append(cfg)
+        return float(cfg.optim.lr)
+
+    history = run_search(fitness, base=base, population=8, generations=2,
+                         seed=3)
+    flat = [f for h in history for f in h.fitnesses]
+    assert any(np.isneginf(f) for f in flat)       # invalid genomes scored
+    assert any(np.isfinite(f) for f in flat)       # valid ones still ran
+    assert seen                                    # eval_fn saw valid configs
+
+    # an ALL-invalid generation (base conflicts with the whole space) must
+    # fail loudly, not return a never-evaluated 'best' genome
+    space = {"sequence.learning_steps": {"choices": (16,)}}   # 20 % 16 != 0
+    with pytest.raises(ValueError, match="every genome"):
+        run_search(fitness, base=base, population=4, generations=1,
+                   seed=0, space=space)
+
+
 def test_run_search_improves_mock_fitness():
     """GA must climb a simple deterministic objective (closer lr to 3e-4 and
     bigger hidden_dim is better)."""
