@@ -132,6 +132,39 @@ def run_tiny_sp_step(n_devices: int) -> float:
     return float(jnp.abs(out).sum())
 
 
+def run_tiny_device_mp_step(mesh) -> float:
+    """One fused DEVICE-replay training step over a ('dp','mp') mesh with
+    mp > 1: replay dp-sharded, wide params feature-sharded over mp, GSPMD
+    collectives inside the sample-in-HBM step (parallel/sharded.py's GSPMD
+    formulation — VERDICT r3 #4). Returns the loss."""
+    import jax
+
+    from r2d2_tpu.learner import create_train_state
+    from r2d2_tpu.parallel import make_sharded_learner_step, sharded_replay_init
+    from r2d2_tpu.parallel.sharded import make_sharded_replay_add
+    from r2d2_tpu.parallel.tensor_parallel import state_shardings
+
+    spec, opt, net = _tiny_setup()
+    ts = create_train_state(jax.random.PRNGKey(1), net, opt)
+    ts = jax.device_put(ts, state_shardings(ts, mesh, min_shard_width=8))
+    rs = sharded_replay_init(spec, mesh)
+    add = make_sharded_replay_add(spec, mesh)
+    rng = np.random.default_rng(0)
+    for d in range(mesh.shape["dp"]):
+        rs = add(rs, _synthetic_block(spec, rng), d)
+    step = make_sharded_learner_step(net, spec, opt, use_double=True,
+                                     mesh=mesh)
+    ts, rs, metrics = step(ts, rs)
+    loss = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss), f"non-finite device-mp loss {loss}"
+    # at least one wide param leaf genuinely sharded across mp
+    sharded = [l for l in jax.tree_util.tree_leaves(ts.params)
+               if l.ndim >= 1
+               and l.addressable_shards[0].data.shape[-1] != l.shape[-1]]
+    assert sharded, "no param leaf sharded over mp in the device-mp dryrun"
+    return loss
+
+
 def run_tiny_tp_step(mesh) -> float:
     """One tensor-parallel training step over a ('dp','mp') mesh: params
     feature-sharded over mp, batch over dp, GSPMD collectives

@@ -95,24 +95,29 @@ class Learner:
             self._bg_stop = threading.Event()
             self._bg_threads: list = []
         else:
-            if cfg.mesh.mp > 1:
-                raise NotImplementedError(
-                    "mesh.mp > 1 with replay.placement='device' is not "
-                    "wired (the fused on-device-replay step shards over "
-                    "'dp' only); tensor parallelism runs via "
-                    "replay.placement='host' (parallel/tensor_parallel.py)")
             dp = cfg.mesh.resolved_dp(len(jax.devices()))
             self._k = cfg.runtime.resolved_steps_per_dispatch()
-            if dp > 1:
+            if dp > 1 or cfg.mesh.mp > 1:
                 # dp-sharded learner (SURVEY §5.8): replay sharded
                 # chip-per-shard, per-shard prioritized sampling, gradient
                 # pmean over ICI. Blocks round-robin across shards.
+                # mp > 1 composes: the same fused step runs manual over dp
+                # and GSPMD-auto over mp, with the TrainState's wide
+                # feature dims sharded over mp (tensor_parallel) and replay
+                # mp-replicated — model sharding stays a mesh-axis change
+                # on the device-replay flagship path (VERDICT r3 #4).
                 from r2d2_tpu.parallel import (
                     make_mesh, make_sharded_learner_step,
                     make_sharded_replay_add, sharded_replay_init)
                 self.mesh = make_mesh(cfg.mesh)
                 self._dp = self.mesh.shape["dp"]
                 self._next_shard = 0
+                if cfg.mesh.mp > 1:
+                    from r2d2_tpu.parallel.tensor_parallel import (
+                        state_shardings)
+                    self.train_state = jax.device_put(
+                        self.train_state,
+                        state_shardings(self.train_state, self.mesh))
                 self.replay_state = sharded_replay_init(self.spec, self.mesh)
                 self._step_fn = make_sharded_learner_step(
                     net, self.spec, cfg.optim, cfg.network.use_double,
@@ -312,7 +317,8 @@ class Learner:
             self._writeback_q.put_nowait(
                 (batch.idxes, m.pop("priorities"), snapshot))
         except queue_mod.Full:
-            m.pop("priorities", None)   # drop under backpressure
+            m.pop("priorities", None)   # drop under backpressure — counted
+            self.metrics.on_dropped_priority_update()
         return m
 
     # -- training --

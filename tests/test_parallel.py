@@ -35,6 +35,7 @@ def test_mesh_shapes(mesh4):
     assert mesh4.shape == {"dp": 4, "mp": 1}
 
 
+@pytest.mark.slow
 def test_sharded_step_replicated_params(mesh4, rng):
     """One sharded step: params stay bit-identical on every chip (the pmean'd
     update is the determinism contract from SURVEY §4)."""
@@ -64,6 +65,7 @@ def test_sharded_step_replicated_params(mesh4, rng):
         np.testing.assert_array_equal(shards[0], s)
 
 
+@pytest.mark.slow
 def test_sharded_matches_single_chip_exactly(mesh4, rng):
     """A dp=1 mesh must reproduce the single-chip fused step exactly — same
     sample stream (both fold_in shard index 0), same updates, same metrics.
@@ -107,6 +109,55 @@ def test_sharded_matches_single_chip_exactly(mesh4, rng):
                                np.asarray(rs_b.tree)[0], rtol=1e-5)
 
 
+@pytest.mark.slow
+def test_device_replay_mp_matches_manual_dp(rng):
+    """VERDICT r3 #4: mesh.mp>1 under the fused device-replay step (the
+    GSPMD formulation) must match the manual shard_map dp path — same RNG
+    chain (fold_in by shard index), same grad mean, same target schedule —
+    while genuinely feature-sharding the wide params over mp. Checked
+    dp=2 x mp=2 vs dp=2 x mp=1 over multiple steps."""
+    from r2d2_tpu.parallel.tensor_parallel import state_shardings
+
+    spec = make_spec(batch_size=8)
+    net, _ = _net(spec)
+    blocks = _fill_blocks(spec, 4, rng)
+
+    def run(mesh, mp_shard, steps=3):
+        ts = create_train_state(jax.random.PRNGKey(7), net, OPT)
+        if mp_shard:
+            ts = jax.device_put(
+                ts, state_shardings(ts, mesh, min_shard_width=8))
+        rs = sharded_replay_init(spec, mesh)
+        add = make_sharded_replay_add(spec, mesh)
+        for i, blk in enumerate(blocks):
+            rs = add(rs, blk, i % mesh.shape["dp"])
+        step = make_sharded_learner_step(net, spec, OPT, use_double=True,
+                                         mesh=mesh)
+        losses = []
+        for _ in range(steps):
+            ts, rs, m = step(ts, rs)
+            losses.append(float(m["loss"]))
+        return ts, rs, losses
+
+    ts_a, rs_a, losses_a = run(make_mesh(MeshConfig(dp=2, mp=1)), False)
+    ts_b, rs_b, losses_b = run(make_mesh(MeshConfig(dp=2, mp=2)), True)
+
+    np.testing.assert_allclose(losses_a, losses_b, rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ts_a.params),
+                    jax.tree_util.tree_leaves(ts_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+    # priorities wrote back identically into the dp-sharded trees
+    np.testing.assert_allclose(np.asarray(rs_a.tree), np.asarray(rs_b.tree),
+                               rtol=1e-5)
+    # wide params are genuinely sharded across mp
+    sharded = [l for l in jax.tree_util.tree_leaves(ts_b.params)
+               if l.ndim >= 1
+               and l.addressable_shards[0].data.shape[-1] != l.shape[-1]]
+    assert sharded, "no param leaf sharded over mp"
+
+
+@pytest.mark.slow
 def test_sharded_multi_step_matches_single_steps(mesh4, rng):
     """K scanned sharded steps per dispatch == K single-step dispatches:
     same RNG chain, same params, same trees, metrics stacked (K,). This is
@@ -148,6 +199,7 @@ def test_sharded_multi_step_matches_single_steps(mesh4, rng):
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_tensor_parallel_matches_unsharded(rng):
     """TP over the 'mp' axis (parallel/tensor_parallel.py): the SAME train
     step jitted under feature-sharded params must (a) actually shard the
@@ -245,6 +297,7 @@ def test_sequence_parallel_lstm_exact(rng):
            jnp.stack([c0, h0]))
 
 
+@pytest.mark.slow
 def test_eight_device_full_mesh_compiles(rng):
     """The full 8-device dryrun the driver will exercise via
     __graft_entry__.dryrun_multichip."""
@@ -252,6 +305,7 @@ def test_eight_device_full_mesh_compiles(rng):
     __graft_entry__.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_multihost_loopback_dryrun():
     """Two separate jax.distributed controller processes over a loopback
     coordinator run one fused dp-sharded step on a global mesh spanning both
@@ -302,6 +356,7 @@ def test_local_actor_fleet_supervision():
     fleet2.join(timeout=1.0)
 
 
+@pytest.mark.slow
 def test_multihost_lockstep_training(tmp_path):
     """The full rank-aware trainer (parallel/multihost.py): two controller
     processes, each owning its own actors and feeding only its local replay
