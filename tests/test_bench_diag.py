@@ -21,6 +21,12 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+# every test here runs bench.py as a subprocess (jax import + smoke train
+# per run): slow tier (VERDICT r3 #5)
+pytestmark = pytest.mark.slow
+
 BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
 
 FAKE_CACHE = {

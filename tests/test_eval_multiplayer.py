@@ -9,6 +9,7 @@ from r2d2_tpu.runtime.orchestrator import train
 from tests.test_runtime import tiny_config
 
 
+@pytest.mark.slow
 def test_multiplayer_population_two_stacks(tmp_path):
     """multiplayer.enabled trains num_players complete stacks concurrently
     (ref train.py:28-45) — each with its own learner, buffer, and log."""
@@ -30,6 +31,7 @@ def test_multiplayer_population_two_stacks(tmp_path):
     assert not np.allclose(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_multiplayer_play_runs_evaluators_concurrently(tmp_path, monkeypatch):
     """--play with N checkpoints must run N evaluators simultaneously (the
     host stays alive while joiners connect — ref test.py:129-144). A barrier
@@ -135,6 +137,7 @@ def test_multiplayer_play_host_death_surfaces_and_closes_joiner(
         "abandoned joiner's env was not closed")
 
 
+@pytest.mark.slow
 def test_evaluate_checkpoint_sweep(tmp_path):
     cfg = tiny_config(tmp_path, **{"replay.learning_starts": 60,
                                    "runtime.save_interval": 2})

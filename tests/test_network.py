@@ -97,6 +97,7 @@ def test_dueling_mean_advantage_invariance(small_net):
     np.testing.assert_allclose(np.asarray(q), np.asarray(q_shift), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_hoisted_lstm_matches_flax_optimized_cell():
     """HoistedLSTM (input projection outside the scan) must reproduce
     nn.OptimizedLSTMCell exactly given the same weights: map flax's
@@ -160,6 +161,7 @@ def test_non_dueling_head():
     assert h.shape == (1, 2, 16)
 
 
+@pytest.mark.slow
 def test_bf16_policy_runs_f32_outputs():
     cfg = NetworkConfig(hidden_dim=16, cnn_out_dim=32, bf16=True)
     spec, params = init_network(
@@ -218,6 +220,7 @@ def test_online_positions_and_mask():
     assert mask[0, 2] == 1.0 and mask[0, 3] == 0.0
 
 
+@pytest.mark.slow
 def test_space_to_depth_is_exact(rng):
     """network.space_to_depth rewrites the first conv as the SAME linear
     map over a 2x2 space-to-depth input: with the standard conv's weights

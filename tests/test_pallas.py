@@ -115,19 +115,31 @@ print("OK")
 """
 
 
+@pytest.mark.slow
 def test_stack_frames_pallas_compiled_on_tpu():
     """Compiled-mode gate (VERDICT r2 #6): real Mosaic lowering at the bench's
     production shape, in a subprocess free of the suite's CPU-platform pin."""
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    # Stage 1: bounded discovery probe. Backend discovery can HANG (not
+    # fail) when the remote-TPU tunnel was wedged by an earlier hard-killed
+    # process — probing first caps that case at 90s instead of spending the
+    # full compile budget (420s measured, round 4) before skipping.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            env=env, capture_output=True, text=True, timeout=90)
+    except subprocess.TimeoutExpired:
+        pytest.skip("backend discovery hung (wedged remote-TPU tunnel?); "
+                    "compiled lowering not testable")
+    if probe.returncode != 0 or probe.stdout.strip() != "tpu":
+        pytest.skip("no TPU backend attached; compiled lowering not testable")
     try:
         proc = subprocess.run(
             [sys.executable, "-c", _COMPILED_CHECK], env=env,
             capture_output=True, text=True, timeout=420)
     except subprocess.TimeoutExpired:
-        # backend discovery can HANG (not fail) when the remote-TPU tunnel
-        # was wedged by an earlier hard-killed process — no TPU is
-        # effectively attached, so the gate skips rather than fails
         pytest.skip("backend discovery hung (wedged remote-TPU tunnel?); "
                     "compiled lowering not testable")
     out = proc.stdout.strip().splitlines()

@@ -66,6 +66,7 @@ def test_learner_step_runs_and_updates(rng):
     assert not np.allclose(np.asarray(rs2.tree), tree_before)
 
 
+@pytest.mark.slow
 def test_double_dqn_target_sync(rng):
     """Target params stay frozen until step % interval == 0, then hard-sync
     (ref worker.py:375-377)."""
@@ -91,6 +92,7 @@ def test_double_dqn_target_sync(rng):
             assert sync
 
 
+@pytest.mark.slow
 def test_loss_decreases_on_fixed_replay(rng):
     """End-to-end training signal: repeated steps on a static buffer must
     drive the TD loss down (the jitted path actually learns)."""
@@ -107,6 +109,7 @@ def test_loss_decreases_on_fixed_replay(rng):
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7, losses
 
 
+@pytest.mark.slow
 def test_loss_matches_naive_ragged_oracle(rng):
     """Golden parity: static-shape masked loss == per-sequence ragged loop."""
     spec = make_spec(batch_size=6)
@@ -150,6 +153,7 @@ def test_loss_matches_naive_ragged_oracle(rng):
     assert float(loss) == pytest.approx(naive_loss, rel=2e-4)
 
 
+@pytest.mark.slow
 def test_multi_step_dispatch_matches_single_steps(rng):
     """K fused steps per dispatch (lax.scan) must reproduce K sequential
     single-step dispatches exactly — same RNG chain, same updates."""
@@ -181,6 +185,7 @@ def test_multi_step_dispatch_matches_single_steps(rng):
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_long_sequence_window_is_config_change(rng):
     """Long-context scaling (SURVEY §5.7): a 4x longer BPTT window — burn-in
     16, learning 20, n-step 4 (window 40 vs the small specs' 12) — is purely
@@ -196,6 +201,7 @@ def test_long_sequence_window_is_config_change(rng):
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow
 def test_bf16_loss_parity_with_f32(rng):
     """bf16 numeric-safety gate (VERDICT r2 #3): from identical params and
     data, the bf16 compute policy's losses must track the f32 trajectory
@@ -230,6 +236,7 @@ def test_bf16_loss_parity_with_f32(rng):
     np.testing.assert_allclose(losses[True], losses[False], rtol=5e-2)
 
 
+@pytest.mark.slow
 def test_bf16_and_double_compile(rng):
     spec = make_spec(batch_size=4)
     cfg = NetworkConfig(hidden_dim=spec.hidden_dim, cnn_out_dim=16,
