@@ -32,10 +32,17 @@ Design:
     (params are replicated bit-identically everywhere, so this loses
     nothing).
 
-Scope: thread-mode actors, device replay placement, single player — the
-combination a multi-host pod actually trains with. Resume/warm-start work
-rank-consistently (every controller restores the same checkpoint file
-from the shared filesystem). Unsupported combinations raise immediately.
+Scope: thread- OR process-mode actors (process mode gives each host a
+spawned CPU-pinned actor fleet fed through the native shm ring, exactly
+like the single-host orchestrator), device replay placement, single
+player. Resume/warm-start work rank-consistently (every controller
+restores the same checkpoint file from the shared filesystem).
+Unsupported combinations raise immediately.
+
+Multiplayer population training composes as ONE MULTIHOST JOB PER PLAYER
+(each player's stack is an independent mesh job; players interact only
+through the game engine's host/join sockets, not through collectives) —
+see README "Multiplayer at pod scale".
 
 Demo / validation (two loopback controllers, virtual CPU devices):
 
@@ -55,45 +62,69 @@ from r2d2_tpu.replay.structs import Block, ReplaySpec, empty_block_np
 
 
 class LocalActorFleet:
-    """One host's actor threads with PlayerStack-style supervision.
+    """One host's actor workers (threads OR spawned processes) with
+    PlayerStack-style supervision.
 
     Restarts are purely host-local (they touch no collective state, so
     lockstep is unaffected) and must NEVER propagate an exception into the
     lockstep learner loop — a host crashing mid-collective abandons every
     peer until the jax.distributed heartbeat timeout, exactly the failure
     the stop consensus exists to prevent. A failed respawn is logged and
-    retried on the next supervision tick instead."""
+    retried on the next supervision tick instead.
 
-    def __init__(self, spawn_fn: Callable[[int], threading.Thread], n: int,
-                 restart_dead: bool, stop: threading.Event):
+    ``queue``: pass the host's BlockQueue when workers are PROCESSES so a
+    producer crash between reserve and commit gets its shm ring slot
+    reclaimed (RingRecoveryScheduler semantics; no-op for thread fleets
+    and non-shm transports)."""
+
+    def __init__(self, spawn_fn: Callable[[int], object], n: int,
+                 restart_dead: bool, stop, queue=None):
+        from r2d2_tpu.runtime.feeder import RingRecoveryScheduler
         self._spawn = spawn_fn
         self._restart = restart_dead
         self._stop = stop
-        self.threads: List[threading.Thread] = [spawn_fn(i) for i in range(n)]
+        self._queue = queue
+        self._ring_recovery = RingRecoveryScheduler()
+        self._seen_dead: set = set()
+        self.threads: List[object] = [spawn_fn(i) for i in range(n)]
+
+    def _respawn(self, i: int):
+        """Respawn wrapper: a failure is logged and retried next tick
+        (never propagated into the lockstep loop — see class docstring)."""
+        import logging
+        try:
+            return self._spawn(i)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "actor %d respawn failed; will retry next supervision "
+                "tick", i)
+            return None
 
     def supervise(self) -> int:
-        """Respawn dead threads; returns the number restarted (logged)."""
+        """Respawn dead workers; returns the number restarted (logged).
+        Ring reclamation runs for newly-dead workers regardless of the
+        restart flag (the wedge exists either way)."""
         import logging
-        if not self._restart or self._stop.is_set():
+
+        from r2d2_tpu.runtime.feeder import supervise_workers
+        if self._stop.is_set():
             return 0
-        restarted = 0
-        for i, t in enumerate(self.threads):
-            if not t.is_alive():
-                try:
-                    self.threads[i] = self._spawn(i)
-                    restarted += 1
-                except Exception:
-                    logging.getLogger(__name__).exception(
-                        "actor %d respawn failed; will retry next "
-                        "supervision tick", i)
+        restarted = supervise_workers(
+            self.threads, self._seen_dead,
+            respawn=self._respawn if self._restart else None,
+            ring=self._ring_recovery if self._queue is not None else None)
+        if self._queue is not None:
+            self._ring_recovery.tick(self._queue)
         if restarted:
             logging.getLogger(__name__).warning(
-                "restarted %d dead actor thread(s)", restarted)
+                "restarted %d dead actor worker(s)", restarted)
         return restarted
 
     def join(self, timeout: float = 5.0) -> None:
         for t in self.threads:
             t.join(timeout=timeout)
+            if t.is_alive() and hasattr(t, "terminate"):   # process worker
+                t.terminate()
 
 
 def make_lockstep_ingest(spec: ReplaySpec, mesh):
@@ -220,11 +251,9 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     """
     import jax
 
-    if actor_mode != "thread":
-        raise NotImplementedError(
-            "multihost training runs thread-mode actors (each controller "
-            "hosts its own fleet in-process); spawned-process actors are "
-            "not wired — pass --actor-mode=thread")
+    if actor_mode not in ("thread", "process"):
+        raise ValueError(f"actor_mode must be 'thread' or 'process', got "
+                         f"{actor_mode!r}")
     if cfg.multiplayer.enabled:
         raise NotImplementedError(
             "multihost + multiplayer population training is not supported: "
@@ -286,7 +315,24 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     feed = HostFeed(spec, mesh)
 
     # -- local actors (this host's share of the global fleet) --
-    stop = threading.Event()
+    # The stop event must be shareable with spawned children in process
+    # mode; both Event kinds serve the lockstep loop identically.
+    n_local = cfg.actor.num_actors
+    publisher = None
+    if actor_mode == "process":
+        import multiprocessing as mp
+        from r2d2_tpu.runtime.actor_main import actor_process_main
+        from r2d2_tpu.runtime.weights import WeightPublisher
+        ctx = mp.get_context("spawn")
+        stop = ctx.Event()
+        publisher = WeightPublisher(ts.params)
+        publish = publisher.publish
+        queue = BlockQueue(
+            use_mp=True, ctx=ctx,
+            shm_spec=spec if cfg.runtime.shm_transport else None)
+    else:
+        stop = threading.Event()
+
     # SIGTERM/SIGINT land on the stop event, which feeds the next
     # iteration's local_stop flag into the psum consensus — the signaled
     # host keeps dispatching until every controller agrees to stop on the
@@ -302,55 +348,78 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 prev_handlers[sig] = signal.signal(sig, _on_signal)
             except (ValueError, OSError):
                 pass
-    store = InProcWeightStore(ts.params)
-    queue = BlockQueue(use_mp=False)
-    n_local = cfg.actor.num_actors
 
-    def spawn_actor(i: int) -> threading.Thread:
-        gidx = rank * n_local + i
-        eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
-                           cfg.actor.eps_alpha)
-        seed = cfg.runtime.seed + 100 * gidx
-        env = create_env(cfg.env, seed=seed, name=f"h{rank}a{i}")
-        policy = ActorPolicy(net, ts.params, eps, seed=seed)
+    if actor_mode == "process":
+        def spawn_actor(i: int):
+            # player_idx=0 / actor_idx=gidx reproduces the thread path's
+            # seed formula (seed + 100*gidx) inside actor_process_main
+            gidx = rank * n_local + i
+            eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
+                               cfg.actor.eps_alpha)
+            p = ctx.Process(
+                target=actor_process_main,
+                args=(cfg.to_dict(), 0, gidx, eps, publisher.name,
+                      queue._q, stop),
+                kwargs=dict(is_host=False, port=cfg.multiplayer.base_port),
+                daemon=True, name=f"actor-h{rank}-{i}")
+            p.start()
+            return p
+    else:
+        store = InProcWeightStore(ts.params)
+        publish = store.publish
+        queue = BlockQueue(use_mp=False)
 
-        def loop(env=env, policy=policy, reader_id=i):
-            # run_actor owns env and closes it on every exit
-            run_actor(cfg, env, policy,
-                      block_sink=lambda b: queue.put_patient(b, stop.is_set),
-                      weight_poll=lambda: store.poll(reader_id),
-                      should_stop=stop.is_set)
+        def spawn_actor(i: int) -> threading.Thread:
+            gidx = rank * n_local + i
+            eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
+                               cfg.actor.eps_alpha)
+            seed = cfg.runtime.seed + 100 * gidx
+            env = create_env(cfg.env, seed=seed, name=f"h{rank}a{i}")
+            policy = ActorPolicy(net, ts.params, eps, seed=seed)
 
-        t = threading.Thread(target=loop, daemon=True,
-                             name=f"actor-h{rank}-{i}")
-        t.start()
-        return t
+            def loop(env=env, policy=policy, reader_id=i):
+                # run_actor owns env and closes it on every exit
+                run_actor(cfg, env, policy,
+                          block_sink=lambda b: queue.put_patient(
+                              b, stop.is_set),
+                          weight_poll=lambda: store.poll(reader_id),
+                          should_stop=stop.is_set)
 
-    fleet = LocalActorFleet(spawn_actor, n_local,
-                            cfg.runtime.restart_dead_actors, stop)
+            t = threading.Thread(target=loop, daemon=True,
+                                 name=f"actor-h{rank}-{i}")
+            t.start()
+            return t
 
-    metrics = TrainMetrics(0, cfg.runtime.save_dir) if rank == 0 else None
-    max_steps = max_training_steps or cfg.optim.training_steps
-    deadline = time.time() + max_seconds if max_seconds else None
-    rt = cfg.runtime
-    ratio = cfg.replay.max_env_steps_per_train_step
-    step_count = int(ts.step)   # nonzero after resume; max_steps is cumulative
-    step_base = step_count      # rate-limiter budget counts from THIS process's
-    paused = False              # start (info.env_steps restarts at 0 with the ring)
-    pending_losses: list = []
-    last_log = last_supervise = time.time()
-    info = {"buffer_steps": 0, "env_steps": 0, "filled_shards": 0}
-
-    def flush_losses():
-        if pending_losses and metrics is not None:
-            for arr in jax.device_get(pending_losses):
-                for loss in np.atleast_1d(arr):
-                    metrics.on_train_step(float(loss))
-        pending_losses.clear()
-
-    debug = bool(os.environ.get("R2D2_MH_DEBUG"))
-    it = 0
+    # fleet construction onward sits inside the try: a spawn failure for
+    # actor k must not orphan the k-1 already-running actor processes on a
+    # live shm ring — the finally unwinds them (round-4 review)
+    fleet = None
     try:
+        fleet = LocalActorFleet(
+            spawn_actor, n_local, cfg.runtime.restart_dead_actors, stop,
+            queue=queue if actor_mode == "process" else None)
+
+        metrics = TrainMetrics(0, cfg.runtime.save_dir) if rank == 0 else None
+        max_steps = max_training_steps or cfg.optim.training_steps
+        deadline = time.time() + max_seconds if max_seconds else None
+        rt = cfg.runtime
+        ratio = cfg.replay.max_env_steps_per_train_step
+        step_count = int(ts.step)  # nonzero after resume; max_steps cumulative
+        step_base = step_count     # rate-limiter budget counts from THIS
+        paused = False             # process's start
+        pending_losses: list = []
+        last_log = last_supervise = time.time()
+        info = {"buffer_steps": 0, "env_steps": 0, "filled_shards": 0}
+
+        def flush_losses():
+            if pending_losses and metrics is not None:
+                for arr in jax.device_get(pending_losses):
+                    for loss in np.atleast_1d(arr):
+                        metrics.on_train_step(float(loss))
+            pending_losses.clear()
+
+        debug = bool(os.environ.get("R2D2_MH_DEBUG"))
+        it = 0
         while step_count < max_steps:
             it += 1
             local_stop = int(stop.is_set()
@@ -388,7 +457,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                     pending_losses.append(m["loss"])   # accumulate elsewhere
                 boundary = lambda iv: iv and step_count // iv > prev // iv
                 if boundary(rt.weight_publish_interval):
-                    store.publish(ts.params)
+                    publish(ts.params)
                 if rank == 0 and boundary(rt.save_interval):
                     save_checkpoint(
                         rt.save_dir, cfg.env.game_name,
@@ -419,7 +488,11 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 signal.signal(sig, handler)
             except (ValueError, OSError):
                 pass
-        fleet.join(timeout=5.0)
+        if fleet is not None:
+            fleet.join(timeout=5.0)
+        if publisher is not None:
+            publisher.close()
+        queue.close()    # releases/unlinks the shm ring (owner side)
 
     return {"step": step_count, "env_steps": resumed_env + info["env_steps"],
             "buffer_steps": info["buffer_steps"], "params": ts.params}
@@ -450,7 +523,8 @@ def _demo_config(save_dir: str) -> "Config":
 
 def _demo_worker(process_id: int, num_processes: int, coordinator: str,
                  devices_per_process: int, save_dir: str,
-                 max_steps: int, resume: str = "") -> None:
+                 max_steps: int, resume: str = "",
+                 actor_mode: str = "thread") -> None:
     from r2d2_tpu.utils.platform import pin_cpu_platform
     pin_cpu_platform(devices_per_process)
     import jax
@@ -462,7 +536,8 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
         "mesh.dp": n_global,
         **({"runtime.resume": resume} if resume else {}),
     })
-    out = train_multihost(cfg, max_training_steps=max_steps, max_seconds=240)
+    out = train_multihost(cfg, max_training_steps=max_steps, max_seconds=240,
+                          actor_mode=actor_mode)
 
     # Bit-exactness evidence, asserted in two layers: every local shard of
     # every leaf identical within this process here, and the full-tree
@@ -491,7 +566,7 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
 def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
                 save_dir: str = "/tmp/r2d2_multihost_demo",
                 max_steps: int = 8, timeout: float = 300.0,
-                resume: str = "") -> None:
+                resume: str = "", actor_mode: str = "thread") -> None:
     """Spawn the loopback controllers and assert the final params came out
     BIT-IDENTICAL across hosts (each worker writes a digest file covering
     every param leaf; divergence anywhere fails the launch)."""
@@ -510,7 +585,7 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
             f"--coordinator={coordinator}",
             f"--devices-per-process={devices_per_process}",
             f"--save-dir={save_dir}", f"--max-steps={max_steps}",
-            f"--resume={resume}",
+            f"--resume={resume}", f"--actor-mode={actor_mode}",
         ], num_processes, timeout, "multihost train demo")
 
     digests = []
@@ -536,14 +611,17 @@ def main(argv=None) -> None:
     p.add_argument("--save-dir", default="/tmp/r2d2_multihost_demo")
     p.add_argument("--max-steps", type=int, default=8)
     p.add_argument("--resume", default="")
+    p.add_argument("--actor-mode", choices=("thread", "process"),
+                   default="thread")
     args = p.parse_args(argv)
     if args.process_id is None:
         launch_demo(args.num_processes, args.devices_per_process,
-                    args.save_dir, args.max_steps, resume=args.resume)
+                    args.save_dir, args.max_steps, resume=args.resume,
+                    actor_mode=args.actor_mode)
     else:
         _demo_worker(args.process_id, args.num_processes, args.coordinator,
                      args.devices_per_process, args.save_dir, args.max_steps,
-                     resume=args.resume)
+                     resume=args.resume, actor_mode=args.actor_mode)
 
 
 if __name__ == "__main__":

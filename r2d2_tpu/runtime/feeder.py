@@ -31,6 +31,80 @@ def put_patient(q, block: Block, should_stop, poll: float = 0.5) -> bool:
     return False
 
 
+class RingRecoveryScheduler:
+    """Schedules ``BlockQueue.recover_stalled`` after actor-process deaths.
+
+    A producer that died between reserve and commit wedges an shm ring
+    slot. Reclamation must run AFTER the slot-grace window (an immediate
+    attempt finds the slot not yet stale — recover_stalled's 5s grace
+    protects live writers) but must not be deferred by further deaths
+    (a crash-looping actor would push it forever), and must re-arm when a
+    death lands inside a pass's grace window. ONE implementation shared by
+    the single-host supervisor (orchestrator.PlayerStack) and the
+    multihost fleet (parallel/multihost.LocalActorFleet)."""
+
+    def __init__(self, grace: float = 6.0):
+        self._grace = grace
+        self._after: Optional[float] = None
+        self._last_death = 0.0
+
+    def on_death(self) -> None:
+        import time
+        self._last_death = time.time()
+        if self._after is None:
+            self._after = self._last_death + self._grace
+
+    def tick(self, queue) -> int:
+        """Run a due reclamation pass against ``queue``; returns slots
+        freed (0 when none due)."""
+        import time
+        if self._after is None or time.time() < self._after:
+            return 0
+        freed = queue.recover_stalled()
+        # re-arm when a death landed inside this pass's grace window — its
+        # wedged slot was not yet stale for the pass that just ran
+        self._after = (self._last_death + self._grace
+                       if self._last_death + self._grace > time.time()
+                       else None)
+        if freed:
+            import logging
+            logging.getLogger(__name__).warning(
+                "recovered %d shm ring slot(s) wedged by crashed actor(s)",
+                freed)
+        return freed
+
+
+def supervise_workers(workers, seen_dead: set, respawn=None,
+                      ring: Optional[RingRecoveryScheduler] = None) -> int:
+    """The ONE dead-worker scan shared by the single-host supervisor
+    (orchestrator.PlayerStack) and the multihost fleet
+    (parallel/multihost.LocalActorFleet).
+
+    ``workers`` is a list of threads or processes (anything with
+    ``is_alive``). Each newly-dead worker notifies ``ring`` when given
+    (shm slot reclamation). With ``respawn``, each dead worker is replaced
+    by ``respawn(i)`` — return None to keep the dead one and retry next
+    tick. Without ``respawn``, ``seen_dead`` (holding the objects — no id
+    reuse) counts a permanently-dead worker exactly once, so it cannot
+    re-schedule reclamation every tick. Returns the number respawned."""
+    restarted = 0
+    for i, w in enumerate(workers):
+        if w.is_alive():
+            continue
+        if respawn is not None:
+            if ring is not None:
+                ring.on_death()
+            new = respawn(i)
+            if new is not None:
+                workers[i] = new
+                restarted += 1
+        elif w not in seen_dead:
+            seen_dead.add(w)
+            if ring is not None:
+                ring.on_death()
+    return restarted
+
+
 class BlockQueue:
     """Works in all modes: the native shm ring (shm_feeder.py) or mp.Queue
     for process actors, queue.Queue for thread actors (hermetic tests).

@@ -50,9 +50,9 @@ class PlayerStack:
         self.learner = Learner(cfg, self.net, player_idx, metrics=self.metrics)
         self.threads: List[threading.Thread] = []
         self.processes: List[mp.Process] = []
+        from r2d2_tpu.runtime.feeder import RingRecoveryScheduler
         self._seen_dead: set = set()    # reaped dead process objects
-        self._recover_after: Optional[float] = None   # pending ring recovery
-        self._last_death = 0.0
+        self._ring_recovery = RingRecoveryScheduler()
         self.publisher = None
         self.store = None
         self.queue: Optional[BlockQueue] = None
@@ -73,7 +73,7 @@ class PlayerStack:
         for i in range(cfg.actor.num_actors):
             self._spawn_thread_actor(i)
 
-    def _spawn_thread_actor(self, i: int) -> None:
+    def _spawn_thread_actor(self, i: int) -> threading.Thread:
         from r2d2_tpu.actor.policy import ActorPolicy
         cfg = self.cfg
         eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
@@ -101,6 +101,7 @@ class PlayerStack:
             self.threads[i] = t
         else:
             self.threads.append(t)
+        return t
 
     def start_actors_processes(self, stop_event) -> None:
         cfg = self.cfg
@@ -114,7 +115,7 @@ class PlayerStack:
         for i in range(cfg.actor.num_actors):
             self._spawn_process_actor(i)
 
-    def _spawn_process_actor(self, i: int) -> None:
+    def _spawn_process_actor(self, i: int) -> mp.Process:
         cfg = self.cfg
         eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
                            cfg.actor.eps_alpha)
@@ -129,6 +130,7 @@ class PlayerStack:
             self.processes[i] = p
         else:
             self.processes.append(p)
+        return p
 
     def supervise(self) -> int:
         """Restart dead actors (the reference has no failure handling at all
@@ -140,57 +142,20 @@ class PlayerStack:
         a producer that died between reserve and commit wedges the ring head
         slot whether or not it gets respawned, and with restarts off the
         learner would otherwise starve even with other actors alive."""
+        from r2d2_tpu.runtime.feeder import supervise_workers
         if self._stop.is_set():
             return 0
         restart = self.cfg.runtime.restart_dead_actors
         restarted = 0
         if restart:
-            for i, t in enumerate(self.threads):
-                if not t.is_alive():
-                    self._spawn_thread_actor(i)
-                    restarted += 1
-        newly_dead = 0
-        for i, p in enumerate(self.processes):
-            if not p.is_alive():
-                if restart:
-                    # the dead object is replaced immediately, so it can
-                    # never be re-iterated — no dedup bookkeeping needed
-                    newly_dead += 1
-                    self._spawn_process_actor(i)
-                    restarted += 1
-                elif p not in self._seen_dead:
-                    # restarts off: the dead process stays in the list
-                    # forever; _seen_dead (holding the object — no id
-                    # reuse) keeps it from re-scheduling reclamation every
-                    # tick, which would push _recover_after forever into
-                    # the future
-                    self._seen_dead.add(p)
-                    newly_dead += 1
-        if newly_dead:
-            # a producer that died between reserve and commit would wedge
-            # the shm ring. Schedule reclamation for AFTER the slot-grace
-            # window: an immediate attempt would find the wedged slot not
-            # yet stale (recover_stalled's 5s grace protects live writers)
-            # and — with newly_dead==0 on every later tick — never retry.
-            # Don't PUSH an already-pending pass later: under a
-            # crash-looping actor with a supervise cadence < 6s that would
-            # defer recovery forever (round-4 review).
-            self._last_death = time.time()
-            if self._recover_after is None:
-                self._recover_after = self._last_death + 6.0
-        if (self._recover_after is not None
-                and time.time() >= self._recover_after):
-            freed = self.queue.recover_stalled()
-            # re-arm when a death landed inside this pass's grace window —
-            # its wedged slot was not yet stale for THIS recover_stalled
-            self._recover_after = (self._last_death + 6.0
-                                   if self._last_death + 6.0 > time.time()
-                                   else None)
-            if freed:
-                import logging
-                logging.getLogger(__name__).warning(
-                    "recovered %d shm ring slot(s) wedged by crashed "
-                    "actor(s)", freed)
+            restarted += supervise_workers(
+                self.threads, self._seen_dead,
+                respawn=self._spawn_thread_actor)
+        restarted += supervise_workers(
+            self.processes, self._seen_dead,
+            respawn=self._spawn_process_actor if restart else None,
+            ring=self._ring_recovery)
+        self._ring_recovery.tick(self.queue)
         return restarted
 
     def close(self) -> None:

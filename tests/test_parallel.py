@@ -385,3 +385,23 @@ def test_multihost_lockstep_training(tmp_path):
     ck2 = restore_checkpoint(list_checkpoints(save_dir, "Fake", 0)[-1][1])
     assert int(ck2["step"]) == 12
     assert int(ck2["env_steps"]) > int(ck["env_steps"])
+
+
+@pytest.mark.slow
+def test_multihost_lockstep_process_actors(tmp_path):
+    """VERDICT r3 #8: the lockstep trainer with SPAWNED-PROCESS actor
+    fleets — each controller hosts CPU-pinned actor processes fed through
+    the shm-ring/mp queue transport — still trains to budget with
+    bit-identical cross-host params (launch_demo's digest check) and
+    rank-0 checkpoints."""
+    from r2d2_tpu.parallel.multihost import launch_demo
+    from r2d2_tpu.runtime.checkpoint import list_checkpoints, restore_checkpoint
+
+    save_dir = str(tmp_path / "mh_proc")
+    launch_demo(num_processes=2, devices_per_process=2, save_dir=save_dir,
+                max_steps=8, timeout=280.0, actor_mode="process")
+    ckpts = list_checkpoints(save_dir, "Fake", player=0)
+    assert ckpts, "rank 0 wrote no checkpoints"
+    ck = restore_checkpoint(ckpts[-1][1])
+    assert int(ck["step"]) == 8
+    assert int(ck["env_steps"]) > 0

@@ -283,22 +283,23 @@ def test_ring_recovery_runs_with_restarts_disabled(tmp_path):
 
     stack.processes = [DeadProc()]
     stack.queue = StubQueue()
+    sched = stack._ring_recovery
     assert stack.supervise() == 0                # no restart...
-    assert stack._recover_after is not None      # ...but recovery scheduled
-    stack._recover_after = 0.0                   # skip the 6s slot grace
+    assert sched._after is not None              # ...but recovery scheduled
+    sched._after = 0.0                           # skip the 6s slot grace
     assert stack.supervise() == 0
     assert stack.queue.recoveries == 1
     # the death was < 6s ago: a follow-up pass re-arms (the slot may not
     # have been stale for the pass that just ran)
-    assert stack._recover_after is not None
-    stack._last_death = 0.0                      # grace has long passed
-    stack._recover_after = 0.0
+    assert sched._after is not None
+    sched._last_death = 0.0                      # grace has long passed
+    sched._after = 0.0
     assert stack.supervise() == 0
     assert stack.queue.recoveries == 2
-    assert stack._recover_after is None          # disarmed
+    assert sched._after is None                  # disarmed
     # the same permanently-dead process must not reschedule every tick
     assert stack.supervise() == 0
-    assert stack._recover_after is None
+    assert sched._after is None
     assert stack.queue.recoveries == 2
 
 
