@@ -407,6 +407,44 @@ def run_bench() -> None:
     else:
         matrix["bf16_spd16_s2d"] = None
 
+    # --- 2c. double-DQN unroll-fusion A/B at the bf16_spd16 policy -------
+    # use_double=True pays a SECOND 55-step recurrent unroll; sequential
+    # (two XLA while-loops) vs interleaved-in-one-scan
+    # (optim.fused_double_unroll, models/network.py dual_sequence_q). The
+    # default config keeps use_double off (reference parity), so this pair
+    # measures the double-DQN configuration's wall and what the fusion buys
+    # — flip the fused_double_unroll default when the _fused cell wins.
+    if on_tpu and not smoke:
+        from r2d2_tpu.models import NetworkApply
+        for label, fused in (("bf16_spd16_double", "off"),
+                             ("bf16_spd16_double_fused", "on")):
+            try:
+                opt_d = dataclasses.replace(
+                    cfg.optim,
+                    pallas_obs_decode="on" if default_pallas else "off",
+                    fused_double_unroll=fused)
+                net_d = NetworkApply(
+                    action_dim,
+                    dataclasses.replace(cfg.network, bf16=True,
+                                        use_double=True),
+                    cfg.env.frame_stack, cfg.env.frame_height,
+                    cfg.env.frame_width)
+                ts_d = create_train_state(jax.random.PRNGKey(1), net_d,
+                                          cfg.optim)
+                step = make_multi_learner_step(net_d, spec, opt_d,
+                                               use_double=True,
+                                               steps_per_dispatch=16)
+                sps, _tsd, rs = measure_path(step, ts_d, rs, label,
+                                             steps_per_dispatch=16)
+                matrix[label] = sps * spec.batch_size
+            except Exception as e:   # never kill the bench for extra cells
+                matrix[label] = None
+                print(f"[{label}] FAILED: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+    else:
+        matrix["bf16_spd16_double"] = None
+        matrix["bf16_spd16_double_fused"] = None
+
     # --- report ----------------------------------------------------------
     # primary metric: what the SHIPPED defaults actually run — default
     # decode path, NetworkConfig.bf16, RuntimeConfig.steps_per_dispatch —
@@ -423,7 +461,10 @@ def run_bench() -> None:
     default_label = (f"{'bf16' if bf16_resolved else 'f32'}"
                      f"_spd{cfg.runtime.resolved_steps_per_dispatch()}"
                      f"{'_s2d' if s2d_default else ''}")
-    best_label = max((k for k, v in matrix.items() if v is not None),
+    # _double cells are a different workload (a second unroll's FLOPs) —
+    # comparable to each other, not to the default config's cells
+    best_label = max((k for k, v in matrix.items()
+                      if v is not None and "_double" not in k),
                      key=lambda k: matrix[k])
     measured_label = (default_label if matrix.get(default_label) is not None
                       else best_label)

@@ -81,6 +81,20 @@ def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
             int(restored.get("env_steps", 0)))
 
 
+def _sweep_worker(cfg_dict: dict, ckpt: str, rounds: int, seed: int):
+    """Checkpoint-sweep worker, run in a spawned CPU-pinned process (the
+    reference's multiprocessing.Pool analog, test.py:23). Module-level so
+    it pickles under the spawn start method; the platform pin must run
+    before any jax import in the child."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
+    from r2d2_tpu.config import Config
+    return evaluate_checkpoint(Config.from_dict(cfg_dict), ckpt, rounds,
+                               seed=seed)
+
+
 def main(argv=None) -> None:
     from r2d2_tpu.utils import pin_platform
     pin_platform()
@@ -222,15 +236,26 @@ def main(argv=None) -> None:
         raise SystemExit(
             f"no checkpoints for game={cfg.env.game_name!r} "
             f"player={args.player} under {cfg.runtime.save_dir!r}")
-    # concurrent sweep (ref test.py:23 uses multiprocessing.Pool(5); here a
-    # thread pool — each worker holds its own env+policy, and the jitted CPU
-    # policy releases the GIL during execution)
-    from concurrent.futures import ThreadPoolExecutor
-    with ThreadPoolExecutor(max_workers=max(1, args.workers)) as pool:
-        results = list(pool.map(
-            lambda item: evaluate_checkpoint(cfg, item[1], args.rounds,
-                                             seed=item[0]),
-            ckpts))
+    # concurrent sweep (ref test.py:23, multiprocessing.Pool(5)): spawned
+    # CPU-pinned worker PROCESSES. A thread pool only parallelizes the
+    # jitted policy half of each rollout — the env-stepping/numpy half is
+    # GIL-bound (round-3 review) — while separate processes parallelize
+    # the whole rollout like the reference does. --workers 1 runs
+    # in-process (no spawn/jax-import cost for small sweeps).
+    if args.workers <= 1 or len(ckpts) == 1:
+        results = [evaluate_checkpoint(cfg, c, args.rounds, seed=i)
+                   for i, c in ckpts]
+    else:
+        import multiprocessing as mp
+        from concurrent.futures import ProcessPoolExecutor
+        from itertools import repeat
+        cfg_dict = cfg.to_dict()
+        with ProcessPoolExecutor(
+                max_workers=min(args.workers, len(ckpts)),
+                mp_context=mp.get_context("spawn")) as pool:
+            results = list(pool.map(
+                _sweep_worker, repeat(cfg_dict), [c for _, c in ckpts],
+                repeat(args.rounds), [i for i, _ in ckpts]))
     rows = []
     for (idx, _), (mean_ret, step, env_steps) in zip(ckpts, results):
         rows.append((idx, step, env_steps, mean_ret))

@@ -146,6 +146,12 @@ class OptimConfig:
     # backend is TPU — the measured winner there, BENCH_r03; the XLA
     # gather path is the correct-everywhere fallback).
     pallas_obs_decode: str = "auto"
+    # Double-DQN only: run the online and target unrolls interleaved in ONE
+    # lax.scan instead of two sequential while-loops (which XLA cannot
+    # overlap) — models/network.py dual_sequence_q. "on"/"off"/"auto"
+    # (auto = TPU). Default off pending the TPU A/B (bench.py measures a
+    # double/double_fused cell pair each round).
+    fused_double_unroll: str = "off"
 
 
 @dataclass(frozen=True)

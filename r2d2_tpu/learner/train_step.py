@@ -103,11 +103,29 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
     """Returns loss(params, target_params, batch) -> (loss, aux). Pure —
     shared by the single-chip jit, the shard_map path, and the tests."""
 
-    from r2d2_tpu.ops.pallas_kernels import resolve_pallas_obs_decode
+    from r2d2_tpu.ops.pallas_kernels import (
+        resolve_pallas_obs_decode, resolve_pallas_setting)
     use_pallas = resolve_pallas_obs_decode(optim.pallas_obs_decode)
+    # double-DQN only: interleave the two unrolls' recurrent chains in one
+    # scan (two sequential while-loops cannot overlap — see
+    # models/network.py dual_sequence_q); identical math, parity-tested
+    fused_dual = use_double and resolve_pallas_setting(
+        optim.fused_double_unroll, "optim.fused_double_unroll")
 
     def loss_fn(params, target_params, batch: SampleBatch):
-        q_online = _unrolled_q(net, spec, params, batch, use_pallas)  # (B,T,A)
+        if fused_dual:
+            from r2d2_tpu.models.network import dual_sequence_q
+            from r2d2_tpu.ops.pallas_kernels import stack_frames
+            stacked = stack_frames(batch.obs, spec.seq_window,
+                                   spec.frame_stack, use_pallas=use_pallas,
+                                   out_dtype=net.module.compute_dtype)
+            last_action = jax.nn.one_hot(batch.last_action, net.action_dim,
+                                         dtype=jnp.float32)
+            q_online, q_target_all = dual_sequence_q(
+                net, params, target_params, stacked, last_action,
+                batch.hidden, batch.hidden)
+        else:
+            q_online = _unrolled_q(net, spec, params, batch, use_pallas)
 
         tpos = target_q_positions(batch.burn_in_steps, batch.learning_steps,
                                   batch.forward_steps, spec.learning, spec.forward)
@@ -119,8 +137,10 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
             jnp.take_along_axis(q_online, tpos[:, :, None], axis=1))  # (B,L,A)
         if use_double:
             a_star = jnp.argmax(q_online_tn, axis=-1)               # (B,L)
-            q_target_all = _unrolled_q(net, spec, target_params, batch,
-                                       use_pallas)
+            if not fused_dual:
+                q_target_all = _unrolled_q(net, spec, target_params, batch,
+                                           use_pallas)
+            q_target_all = jax.lax.stop_gradient(q_target_all)
             q_target_tn = jnp.take_along_axis(q_target_all, tpos[:, :, None], axis=1)
             q_next = jnp.take_along_axis(
                 q_target_tn, a_star[:, :, None], axis=2)[:, :, 0]

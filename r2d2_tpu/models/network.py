@@ -290,6 +290,77 @@ class R2D2Network(nn.Module):
         return q, pack_hidden(carry).astype(jnp.float32)
 
 
+def dual_sequence_q(net: "NetworkApply", params_a, params_b,
+                    obs_seq: jnp.ndarray, last_action_seq: jnp.ndarray,
+                    hidden_a: jnp.ndarray, hidden_b: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Unroll TWO networks (online params_a, target params_b) over the same
+    observation sequence with their recurrent chains interleaved in ONE
+    ``lax.scan``.
+
+    Two separate ``net.apply`` calls lower to two sequential XLA while
+    loops, and XLA cannot overlap across while-loop boundaries — so a
+    double-DQN step pays 2x55 SERIAL recurrent matmuls even though the two
+    chains are independent. Each (B,512)x(512,2048) recurrent matmul is
+    latency-bound, not throughput-bound (PERF.md: batch scaling is flat),
+    so interleaving both chains in one scan body lets the scheduler hide
+    one chain's latency under the other's. Identical math to two applies —
+    the per-chain op sequence is unchanged (parity-tested exactly in
+    tests/test_network.py). Gated by ``optim.fused_double_unroll``; only
+    reachable when use_double is on.
+
+    Targets the serial-LSTM wall of ref worker.py:335-344's three-unroll
+    step (already reduced to two here; this removes the serialization
+    between the remaining two).
+    """
+    cfg = net.config
+    dtype = net.module.compute_dtype
+    batch, seq = obs_seq.shape[0], obs_seq.shape[1]
+
+    flat = obs_seq.astype(dtype).reshape(batch * seq, *obs_seq.shape[2:])
+    torso = ConvTorso(cfg.cnn_out_dim, cfg.conv_layers, dtype,
+                      space_to_depth=cfg.space_to_depth)
+    lat_a = torso.apply({"params": params_a["params"]["torso"]}, flat)
+    lat_b = torso.apply({"params": params_b["params"]["torso"]}, flat)
+    la = last_action_seq.astype(dtype)
+
+    def rnn_in(lat):
+        return jnp.concatenate([lat.reshape(batch, seq, cfg.cnn_out_dim), la],
+                               axis=-1)
+
+    def lstm_bits(p):
+        lp = p["params"]["lstm"]
+        return (jnp.asarray(lp["input_proj"]["kernel"]).astype(dtype),
+                jnp.asarray(lp["recurrent_kernel"]).astype(dtype),
+                jnp.asarray(lp["bias"]).astype(dtype))
+
+    wi_a, wr_a, b_a = lstm_bits(params_a)
+    wi_b, wr_b, b_b = lstm_bits(params_b)
+    xp_a = (rnn_in(lat_a) @ wi_a).swapaxes(0, 1)        # (T, B, 4H)
+    xp_b = (rnn_in(lat_b) @ wi_b).swapaxes(0, 1)
+    ca, ha = unpack_hidden(hidden_a.astype(dtype))
+    cb, hb = unpack_hidden(hidden_b.astype(dtype))
+
+    def step(carry, xs):
+        ca, ha, cb, hb = carry
+        xpa, xpb = xs
+        ca, ha = lstm_cell_step(xpa, ca, ha, wr_a, b_a)
+        cb, hb = lstm_cell_step(xpb, cb, hb, wr_b, b_b)
+        return (ca, ha, cb, hb), (ha, hb)
+
+    _, (out_a, out_b) = jax.lax.scan(step, (ca, ha, cb, hb), (xp_a, xp_b),
+                                     unroll=cfg.scan_unroll)
+
+    head = DuelingHead(net.action_dim, cfg.hidden_dim, cfg.use_dueling, dtype)
+
+    def head_q(params, outs):                            # outs: (T, B, H)
+        q = head.apply({"params": params["params"]["head"]},
+                       outs.swapaxes(0, 1).reshape(batch * seq, cfg.hidden_dim))
+        return q.reshape(batch, seq, net.action_dim)
+
+    return head_q(params_a, out_a), head_q(params_b, out_b)
+
+
 class NetworkApply:
     """Thin convenience binding of jitted apply functions to a network spec.
 

@@ -326,10 +326,18 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         ctx = mp.get_context("spawn")
         stop = ctx.Event()
         publisher = WeightPublisher(ts.params)
+        try:
+            queue = BlockQueue(
+                use_mp=True, ctx=ctx,
+                shm_spec=spec if cfg.runtime.shm_transport else None)
+        except BaseException:
+            # the publisher's /dev/shm segment was already created; don't
+            # leak it past a failed ring bring-up (round-4 review) — the
+            # try/finally that normally owns both starts only at fleet
+            # construction below
+            publisher.close()
+            raise
         publish = publisher.publish
-        queue = BlockQueue(
-            use_mp=True, ctx=ctx,
-            shm_spec=spec if cfg.runtime.shm_transport else None)
     else:
         stop = threading.Event()
 

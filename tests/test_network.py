@@ -63,6 +63,27 @@ def test_unroll_matches_stepwise(small_net):
     np.testing.assert_allclose(np.asarray(h_full), np.asarray(h), atol=1e-5)
 
 
+def test_dual_sequence_q_matches_two_applies(small_net):
+    """The fused double-DQN unroll (one scan interleaving both recurrent
+    chains — models/network.py dual_sequence_q) must match two separate
+    net.apply calls EXACTLY: the per-chain op sequence is unchanged, only
+    the loop structure differs."""
+    from r2d2_tpu.models.network import dual_sequence_q
+
+    spec, params_a = small_net
+    params_b = spec.init(jax.random.PRNGKey(9))       # a distinct target net
+    obs, la = _rand_inputs(jax.random.PRNGKey(3), 3, 7)
+    hid_a = initial_hidden(3, spec.config.hidden_dim)
+    hid_b = jnp.ones_like(hid_a) * 0.1
+
+    q_a_ref, _ = spec.apply(params_a, obs, la, hid_a)
+    q_b_ref, _ = spec.apply(params_b, obs, la, hid_b)
+    q_a, q_b = dual_sequence_q(spec, params_a, params_b, obs, la,
+                               hid_a, hid_b)
+    np.testing.assert_array_equal(np.asarray(q_a), np.asarray(q_a_ref))
+    np.testing.assert_array_equal(np.asarray(q_b), np.asarray(q_b_ref))
+
+
 def test_padding_suffix_does_not_affect_prefix(small_net):
     """Causality: garbage past a sequence's true end leaves the valid prefix
     bit-identical — this is what licenses fixed-window unrolls over ragged

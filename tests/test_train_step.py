@@ -41,6 +41,38 @@ def _filled_replay(spec, rng, n_blocks=3):
     return state
 
 
+def test_fused_double_unroll_matches_sequential(rng):
+    """optim.fused_double_unroll=on (one scan interleaving the online and
+    target chains) must reproduce the sequential two-unroll double-DQN
+    loss, gradients, and priorities exactly — only the loop structure
+    changes (VERDICT r3 #3 forcing mechanism)."""
+    import dataclasses
+
+    spec = make_spec(batch_size=6)
+    net, _ = _net(spec, use_double=True)
+    ts = create_train_state(jax.random.PRNGKey(2), net, OPT)
+    # distinct target params so the target chain is actually exercised
+    target = net.init(jax.random.PRNGKey(77))
+    rs = _filled_replay(spec, rng)
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(5))
+
+    losses, grads_all, prios = [], [], []
+    for fused in ("off", "on"):
+        opt = dataclasses.replace(OPT, fused_double_unroll=fused)
+        loss_fn = make_loss_fn(net, spec, opt, use_double=True)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            ts.params, target, batch)
+        losses.append(float(loss))
+        grads_all.append(grads)
+        prios.append(np.asarray(aux["priorities"]))
+
+    assert losses[0] == losses[1]
+    np.testing.assert_array_equal(prios[0], prios[1])
+    for a, b in zip(jax.tree_util.tree_leaves(grads_all[0]),
+                    jax.tree_util.tree_leaves(grads_all[1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_learner_step_runs_and_updates(rng):
     spec = make_spec(batch_size=8)
     net, params = _net(spec)
