@@ -407,6 +407,36 @@ def run_bench() -> None:
     else:
         matrix["bf16_spd16_s2d"] = None
 
+    # --- 2b2. exact-read pad-gather A/B at the bf16_spd16 policy ---------
+    # replay.pallas_exact_gather pads stored H (84->96) and DMAs only each
+    # sampled window (async copy) instead of the whole ring row (~7x read
+    # amplification). Storage layout changes with the flag, so this cell
+    # builds its own padded replay. A Mosaic rejection here is the
+    # documented dead end (PERF.md); a win flips the default.
+    if on_tpu and not smoke:
+        try:
+            spec_pad = dataclasses.replace(spec, exact_gather=True)
+            rs_pad = replay_init(spec_pad)
+            rng_pad = np.random.default_rng(0)
+            for _ in range(spec_pad.num_blocks):
+                rs_pad = replay_add(spec_pad, rs_pad,
+                                    make_synthetic_block(spec_pad, rng_pad))
+            jax.block_until_ready(rs_pad.tree)
+            step = build_step(default_pallas, bf16=True, spd=16,
+                              step_spec=spec_pad)
+            ts_pg = create_train_state(jax.random.PRNGKey(1), net, cfg.optim)
+            sps, _tspg, rs_pad = measure_path(step, ts_pg, rs_pad,
+                                              "bf16_spd16_exactgather",
+                                              steps_per_dispatch=16)
+            matrix["bf16_spd16_exactgather"] = sps * spec.batch_size
+            del rs_pad
+        except Exception as e:   # never kill the bench for the extra cell
+            matrix["bf16_spd16_exactgather"] = None
+            print(f"[bf16_spd16_exactgather] FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    else:
+        matrix["bf16_spd16_exactgather"] = None
+
     # --- 2c. double-DQN unroll-fusion A/B at the bf16_spd16 policy -------
     # use_double=True pays a SECOND 55-step recurrent unroll; sequential
     # (two XLA while-loops) vs interleaved-in-one-scan

@@ -87,7 +87,10 @@ def _sweep_worker(cfg_dict: dict, ckpt: str, rounds: int, seed: int):
     it pickles under the spawn start method; the platform pin must run
     before any jax import in the child."""
     import os
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # unconditional (not setdefault): an inherited JAX_PLATFORMS=tpu from a
+    # TPU-pinned parent would otherwise have every worker race to open the
+    # single-process libtpu
+    os.environ["JAX_PLATFORMS"] = "cpu"
     from r2d2_tpu.utils import pin_platform
     pin_platform()
     from r2d2_tpu.config import Config

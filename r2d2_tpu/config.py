@@ -119,6 +119,13 @@ class ReplayConfig:
     # (ops/pallas_kernels.py gather_rows_pallas): "on", "off", or "auto"
     # (pallas iff the backend is TPU — 2.6x the XLA gather there, BENCH_r03).
     pallas_sample_gather: str = "auto"
+    # EXACT-read window gather (device placement): pad the stored frame
+    # height to the uint8 tile multiple (84 -> 96) and DMA only each sampled
+    # window via async copy instead of the whole ring row (~7x read
+    # amplification at the reference shape). "on"/"off" — default off
+    # pending the TPU A/B (bench.py measures a pad-gather cell). Requires
+    # pallas_sample_gather; the stored obs layout changes with it.
+    pallas_exact_gather: str = "off"
     # Reverb-style rate limiter: pause block ingestion (back-pressuring
     # actors through the bounded feeder queue) once
     # env_steps > learning_starts + ratio * train_steps. Pins the

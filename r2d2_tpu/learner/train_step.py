@@ -77,23 +77,31 @@ def create_train_state(key: jax.Array, net: NetworkApply, optim: OptimConfig
     )
 
 
-def _unrolled_q(net: NetworkApply, spec: ReplaySpec, params,
-                batch: SampleBatch, use_pallas: bool = False) -> jnp.ndarray:
-    """Decode the storage-format batch and unroll the network: uint8 frame
-    rows → stacked normalized obs (B,T,H,W,K) (fused pallas kernel on TPU,
-    jnp gather elsewhere — ops/pallas_kernels.py), action indices → one-hot
-    (-1 encodes the null action as zeros), then the full-window unroll from
-    the stored hidden state. Returns (B, T, A) f32 Q-values."""
+def _decode_inputs(net: NetworkApply, spec: ReplaySpec, batch: SampleBatch,
+                   use_pallas: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """THE storage→network decode (one place for every unroll path): uint8
+    frame rows → stacked normalized obs (B,T,H,W,K) (fused pallas kernel on
+    TPU, jnp gather elsewhere — ops/pallas_kernels.py; out_height strips
+    any exact-gather storage padding), action indices → one-hot (-1 encodes
+    the null action as zeros). Decodes directly into the network's compute
+    dtype: under the bf16 policy this skips materializing the 4x-larger f32
+    obs intermediate that XLA would cast at the conv boundary anyway
+    (PERF.md profile: that transpose+cast copy was ~2.5 ms/step)."""
     from r2d2_tpu.ops.pallas_kernels import stack_frames
-    # decode directly into the network's compute dtype: under the bf16
-    # policy this skips materializing the 4x-larger f32 obs intermediate
-    # that XLA would cast at the conv boundary anyway (PERF.md profile:
-    # that transpose+cast copy was ~2.5 ms/step)
     stacked = stack_frames(batch.obs, spec.seq_window, spec.frame_stack,
                            use_pallas=use_pallas,
-                           out_dtype=net.module.compute_dtype)
+                           out_dtype=net.module.compute_dtype,
+                           out_height=spec.frame_height)
     last_action = jax.nn.one_hot(batch.last_action, net.action_dim,
                                  dtype=jnp.float32)
+    return stacked, last_action
+
+
+def _unrolled_q(net: NetworkApply, spec: ReplaySpec, params,
+                batch: SampleBatch, use_pallas: bool = False) -> jnp.ndarray:
+    """Decode (see _decode_inputs) and unroll the full window from the
+    stored hidden state. Returns (B, T, A) f32 Q-values."""
+    stacked, last_action = _decode_inputs(net, spec, batch, use_pallas)
     q, _ = net.module.apply(params, stacked, last_action, batch.hidden)
     return q
 
@@ -115,12 +123,8 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
     def loss_fn(params, target_params, batch: SampleBatch):
         if fused_dual:
             from r2d2_tpu.models.network import dual_sequence_q
-            from r2d2_tpu.ops.pallas_kernels import stack_frames
-            stacked = stack_frames(batch.obs, spec.seq_window,
-                                   spec.frame_stack, use_pallas=use_pallas,
-                                   out_dtype=net.module.compute_dtype)
-            last_action = jax.nn.one_hot(batch.last_action, net.action_dim,
-                                         dtype=jnp.float32)
+            stacked, last_action = _decode_inputs(net, spec, batch,
+                                                  use_pallas)
             q_online, q_target_all = dual_sequence_q(
                 net, params, target_params, stacked, last_action,
                 batch.hidden, batch.hidden)

@@ -44,22 +44,31 @@ from r2d2_tpu.ops.indexing import frame_stack_indices
 
 def stack_frames_reference(obs: jnp.ndarray, seq_window: int,
                            frame_stack: int,
-                           out_dtype=jnp.float32) -> jnp.ndarray:
+                           out_dtype=jnp.float32,
+                           out_height=None) -> jnp.ndarray:
     """jnp twin: gather + transpose + normalize (XLA-lowered).
     ``out_dtype``: emit in the network's compute dtype — normalization
     always happens in f32 and rounds once at the end, so a bf16 output is
     bit-identical to XLA's own f32→bf16 cast at the conv boundary (which
     the MXU's default precision inserts anyway); emitting it here skips
-    materializing the 4x-larger f32 intermediate."""
+    materializing the 4x-larger f32 intermediate.
+    ``out_height``: strip sublane padding from exact-gather storage rows
+    (ReplaySpec.stored_frame_height) — the network always sees the true
+    frame height."""
     fsi = frame_stack_indices(seq_window, frame_stack)       # (T, K)
     stacked = obs[:, fsi]                                     # (B, T, K, H, W)
+    if out_height is not None and out_height != obs.shape[2]:
+        stacked = stacked[:, :, :, :out_height, :]
     out = stacked.transpose(0, 1, 3, 4, 2).astype(jnp.float32) / 255.0
     return out.astype(out_dtype)
 
 
-def _stack_kernel(frame_stack: int, out_dtype, in_ref, out_ref):
-    # in_ref: (1, T+K-1, H, W) uint8 (whole row, revisited across t);
-    # out_ref: (1, 1, K, H, W) out_dtype — this program's timestep slab.
+def _stack_kernel(frame_stack: int, out_dtype, out_height: int,
+                  in_ref, out_ref):
+    # in_ref: (1, T+K-1, H_stored, W) uint8 (whole row, revisited across
+    # t); out_ref: (1, 1, K, out_height, W) out_dtype — this program's
+    # timestep slab. out_height < H_stored strips exact-gather sublane
+    # padding (a static sublane-dim slice).
     from jax.experimental import pallas as pl
 
     t = pl.program_id(1)
@@ -70,23 +79,27 @@ def _stack_kernel(frame_stack: int, out_dtype, in_ref, out_ref):
         # widen through int32 first, which it can, then convert. The
         # normalization rounds once from f32 into out_dtype — identical to
         # XLA's own cast at the conv boundary under a bf16 policy.
-        widened = frame[0].astype(jnp.int32).astype(jnp.float32)
+        widened = frame[0, :out_height].astype(jnp.int32).astype(jnp.float32)
         out_ref[0, 0, k] = (widened * inv).astype(out_dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
 def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
                         interpret: bool = False,
-                        out_dtype=jnp.float32) -> jnp.ndarray:
+                        out_dtype=jnp.float32,
+                        out_height=None) -> jnp.ndarray:
     """Pallas implementation; ``interpret=True`` runs it on any backend
-    (tests use it on the CPU mesh)."""
+    (tests use it on the CPU mesh). ``out_height``: emit only the first
+    out_height rows of each (possibly sublane-padded) stored frame."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     batch, row_len, height, width = obs.shape
     assert row_len >= seq_window + frame_stack - 1
+    out_height = height if out_height is None else out_height
 
-    kernel = functools.partial(_stack_kernel, frame_stack, out_dtype)
+    kernel = functools.partial(_stack_kernel, frame_stack, out_dtype,
+                               out_height)
     planar = pl.pallas_call(
         kernel,
         grid=(batch, seq_window),
@@ -96,12 +109,12 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
             memory_space=pltpu.VMEM,
         )],
         out_specs=pl.BlockSpec(
-            (1, 1, frame_stack, height, width),
+            (1, 1, frame_stack, out_height, width),
             lambda b, t: (b, t, 0, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (batch, seq_window, frame_stack, height, width), out_dtype),
+            (batch, seq_window, frame_stack, out_height, width), out_dtype),
         interpret=interpret,
     )(obs)
     return planar.transpose(0, 1, 3, 4, 2)                   # (B, T, H, W, K)
@@ -133,13 +146,14 @@ def resolve_pallas_obs_decode(setting) -> bool:
 
 def stack_frames(obs: jnp.ndarray, seq_window: int, frame_stack: int,
                  use_pallas: bool = False,
-                 out_dtype=jnp.float32) -> jnp.ndarray:
+                 out_dtype=jnp.float32,
+                 out_height=None) -> jnp.ndarray:
     """Dispatch: pallas on TPU when requested, jnp otherwise."""
     if use_pallas:
         return stack_frames_pallas(obs, seq_window, frame_stack,
-                                   out_dtype=out_dtype)
+                                   out_dtype=out_dtype, out_height=out_height)
     return stack_frames_reference(obs, seq_window, frame_stack,
-                                  out_dtype=out_dtype)
+                                  out_dtype=out_dtype, out_height=out_height)
 
 
 # ---------------------------------------------------------------------------
@@ -205,10 +219,59 @@ def gather_rows_pallas(ring: jnp.ndarray, block_idx: jnp.ndarray,
     )(block_idx, start, ring)
 
 
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def gather_rows_exact_pallas(ring: jnp.ndarray, block_idx: jnp.ndarray,
+                             start: jnp.ndarray, window: int,
+                             interpret: bool = False) -> jnp.ndarray:
+    """EXACT-read row gather: one HBM→HBM async copy of just the window
+    slice per sampled sequence — no row amplification (gather_rows_pallas
+    reads the whole ring row, ~7x the window bytes at the production
+    shape).
+
+    Mosaic requires the copied slice's minor dims to be tile-aligned;
+    H=84 was rejected round 3, which is why this variant pairs with
+    ``replay.pallas_exact_gather`` (storage H padded 84→96, the uint8
+    (32, 128) tile's row multiple). Whether the padded copy compiles/wins
+    is a TPU measurement (bench.py's pad-gather cell); interpret mode
+    pins the semantics either way."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_rows, row_len, height, width = ring.shape
+    batch = block_idx.shape[0]
+
+    def kernel(bi_ref, st_ref, hbm_ref, out_ref, sem):
+        i = pl.program_id(0)
+        copy = pltpu.make_async_copy(
+            hbm_ref.at[bi_ref[i], pl.dslice(st_ref[i], window)],
+            out_ref.at[i],
+            sem)
+        copy.start()
+        copy.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, window, height, width), ring.dtype),
+        interpret=interpret,
+    )(block_idx, start, ring)
+
+
 def gather_rows(ring: jnp.ndarray, block_idx: jnp.ndarray, start: jnp.ndarray,
-                window: int, use_pallas: bool = False) -> jnp.ndarray:
-    """Dispatch: pallas on TPU when requested, vmapped dynamic-slice
-    otherwise."""
+                window: int, use_pallas: bool = False,
+                exact_read: bool = False) -> jnp.ndarray:
+    """Dispatch: pallas on TPU when requested (exact_read selects the
+    async-copy window gather), vmapped dynamic-slice otherwise."""
+    if use_pallas and exact_read:
+        return gather_rows_exact_pallas(ring, block_idx, start, window)
     if use_pallas:
         return gather_rows_pallas(ring, block_idx, start, window)
     return gather_rows_reference(ring, block_idx, start, window)

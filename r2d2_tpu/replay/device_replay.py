@@ -36,7 +36,9 @@ def replay_init(spec: ReplaySpec) -> ReplayState:
     n, s, l = spec.num_blocks, spec.seqs_per_block, spec.learning
     return ReplayState(
         tree=jnp.zeros(2**spec.tree_layers - 1, jnp.float32),
-        obs=jnp.zeros((n, spec.obs_row_len, spec.frame_height, spec.frame_width), jnp.uint8),
+        # stored_frame_height: sublane-padded under spec.exact_gather
+        obs=jnp.zeros((n, spec.obs_row_len, spec.stored_frame_height,
+                       spec.frame_width), jnp.uint8),
         last_action=jnp.full((n, spec.la_row_len), -1, jnp.int32),
         hidden=jnp.zeros((n, s, 2, spec.hidden_dim), jnp.float32),
         action=jnp.zeros((n, s, l), jnp.int32),
@@ -63,9 +65,13 @@ def replay_add(spec: ReplaySpec, state: ReplayState, block: Block) -> ReplayStat
     idxes = leaf0 + jnp.arange(spec.seqs_per_block, dtype=jnp.int32)
     tree = tree_update(spec.tree_layers, state.tree, spec.prio_exponent,
                        block.priority, idxes)
+    obs_row = block.obs_row
+    if spec.stored_frame_height != spec.frame_height:
+        obs_row = jnp.pad(obs_row, (
+            (0, 0), (0, spec.stored_frame_height - spec.frame_height), (0, 0)))
     return state.replace(
         tree=tree,
-        obs=state.obs.at[ptr].set(block.obs_row),
+        obs=state.obs.at[ptr].set(obs_row),
         last_action=state.last_action.at[ptr].set(block.last_action_row),
         hidden=state.hidden.at[ptr].set(block.hidden),
         action=state.action.at[ptr].set(block.action),
@@ -95,7 +101,8 @@ def _gather_windows(spec: ReplaySpec, state: ReplayState,
     from r2d2_tpu.ops.pallas_kernels import gather_rows
     obs_len = spec.seq_window + spec.frame_stack - 1
     obs = gather_rows(state.obs, block_idx, window_start, obs_len,
-                      use_pallas=spec.pallas_gather)
+                      use_pallas=spec.pallas_gather,
+                      exact_read=spec.exact_gather)
 
     def one_la(b, t0):
         return jax.lax.dynamic_slice(state.last_action[b], (t0,),

@@ -52,6 +52,14 @@ class ReplaySpec:
     # tri-state): device-path sampling gathers obs windows with the pallas
     # kernel instead of the XLA gather
     pallas_gather: bool = False
+    # ReplayConfig.pallas_exact_gather: pad stored frame height to a
+    # sublane multiple and DMA only the sampled window (exact read,
+    # async-copy kernel — used when pallas_gather is also on; without it
+    # the row gather runs on the padded storage transparently, which is
+    # how the CPU test path exercises the layout). The DEVICE obs ring and
+    # sampled batches carry stored_frame_height rows; blocks, host replay,
+    # and the decoded network input stay at frame_height.
+    exact_gather: bool = False
 
     @classmethod
     def from_config(cls, cfg: Config) -> "ReplaySpec":
@@ -72,7 +80,21 @@ class ReplaySpec:
             is_exponent=cfg.replay.importance_sampling_exponent,
             pallas_gather=resolve_pallas_setting(
                 cfg.replay.pallas_sample_gather, "pallas_sample_gather"),
+            exact_gather=resolve_pallas_setting(
+                cfg.replay.pallas_exact_gather, "pallas_exact_gather"),
         )
+
+    @property
+    def stored_frame_height(self) -> int:
+        """Frame height in the DEVICE obs ring under exact_gather: padded
+        to the uint8 sublane-packing multiple so window slices are
+        tile-aligned for the async-copy DMA; equal to frame_height
+        otherwise. The obs ring is uint8, whose TPU tile is (32, 128) —
+        1-byte values pack 4 rows per 4-byte sublane — so the pad multiple
+        is 32 (84 -> 96), not the f32 tile's 8."""
+        if not self.exact_gather:
+            return self.frame_height
+        return -(-self.frame_height // 32) * 32
 
     @property
     def seq_window(self) -> int:

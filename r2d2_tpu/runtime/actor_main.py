@@ -14,7 +14,10 @@ import os
 def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                        epsilon: float, shm_name: str, queue, stop_event,
                        is_host: bool, port: int) -> None:
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # unconditional (not setdefault): an inherited JAX_PLATFORMS=tpu from a
+    # TPU-pinned parent would otherwise have every actor child race to open
+    # the single-process libtpu — the TPU belongs to the learner alone
+    os.environ["JAX_PLATFORMS"] = "cpu"
     # late imports: only after the platform pin; jax.config route as well —
     # a wedged accelerator plugin can hang discovery despite the env var
     from r2d2_tpu.utils import pin_platform

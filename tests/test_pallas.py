@@ -35,6 +35,32 @@ def test_stack_frames_pallas_matches_reference(rng):
     assert got.max() <= 1.0 and got.min() >= 0.0
 
 
+def test_gather_rows_exact_matches_reference(rng):
+    """The exact-read async-copy gather (interpret mode) returns the same
+    windows as the vmapped dynamic-slice twin."""
+    from r2d2_tpu.ops.pallas_kernels import gather_rows_exact_pallas
+    ring = jnp.asarray(rng.integers(0, 255, (8, 50, 16, 16)), jnp.uint8)
+    bi = jnp.asarray(rng.integers(0, 8, (6,)), jnp.int32)
+    st = jnp.asarray(rng.integers(0, 40, (6,)), jnp.int32)
+    got = gather_rows_exact_pallas(ring, bi, st, 10, True)
+    want = gather_rows_reference(ring, bi, st, 10)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_stack_frames_out_height_strips_padding(rng):
+    """out_height (exact-gather padded storage) strips the sublane pad in
+    both decode twins, matching an unpadded decode exactly."""
+    B, T, K, H, W = 2, 5, 3, 12, 16
+    obs = jnp.asarray(rng.integers(0, 255, (B, T + K - 1, H, W)), jnp.uint8)
+    obs_pad = jnp.pad(obs, ((0, 0), (0, 0), (0, 4), (0, 0)))  # H 12 -> 16
+    want = np.asarray(stack_frames_reference(obs, T, K))
+    got_ref = np.asarray(stack_frames_reference(obs_pad, T, K, out_height=H))
+    got_pl = np.asarray(stack_frames_pallas(obs_pad, T, K, True,
+                                            out_height=H))
+    np.testing.assert_array_equal(got_ref, want)
+    np.testing.assert_allclose(got_pl, want, rtol=2e-7)
+
+
 def test_stack_frames_bf16_output(rng):
     """out_dtype=bf16 (the bf16-policy decode): both twins normalize in f32
     and round ONCE at the end, so kernel and reference agree bit-exactly

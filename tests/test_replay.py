@@ -154,6 +154,46 @@ def _fill_blocks(spec, n, rng, gamma=0.9):
     return blocks
 
 
+def test_exact_gather_padded_storage_is_transparent(rng):
+    """spec.exact_gather pads the stored frame height to the uint8
+    tile-packing multiple (12 -> 32 here; 84 -> 96 at reference scale);
+    the padding must be invisible end-to-end: the same blocks + same
+    sample keys yield batches whose unpadded rows and every other field
+    are IDENTICAL to the unpadded spec's, and the decoded observation
+    (out_height strips the pad) matches exactly."""
+    from r2d2_tpu.ops.pallas_kernels import stack_frames_reference
+
+    spec = make_spec()
+    spec_pad = make_spec(exact_gather=True)
+    assert spec_pad.stored_frame_height == 32 and spec.frame_height == 12
+
+    blocks = _fill_blocks(spec, 3, rng)
+    state, state_pad = replay_init(spec), replay_init(spec_pad)
+    assert state_pad.obs.shape[2] == 32
+    for blk in blocks:
+        state = replay_add(spec, state, blk)
+        state_pad = replay_add(spec_pad, state_pad, blk)
+
+    key = jax.random.PRNGKey(0)
+    batch = replay_sample(spec, state, key)
+    batch_pad = replay_sample(spec_pad, state_pad, key)
+
+    np.testing.assert_array_equal(np.asarray(batch.idxes),
+                                  np.asarray(batch_pad.idxes))
+    np.testing.assert_array_equal(np.asarray(batch.obs),
+                                  np.asarray(batch_pad.obs)[:, :, :12, :])
+    assert (np.asarray(batch_pad.obs)[:, :, 12:, :] == 0).all()
+    np.testing.assert_array_equal(np.asarray(batch.last_action),
+                                  np.asarray(batch_pad.last_action))
+
+    dec = stack_frames_reference(batch.obs, spec.seq_window,
+                                 spec.frame_stack, out_height=12)
+    dec_pad = stack_frames_reference(batch_pad.obs, spec.seq_window,
+                                     spec.frame_stack, out_height=12)
+    assert dec_pad.shape == dec.shape
+    np.testing.assert_array_equal(np.asarray(dec), np.asarray(dec_pad))
+
+
 def test_device_replay_add_sample_consistency(rng):
     """Jitted sample must return exactly the stored windows: cross-check every
     sampled field against direct numpy indexing of the ring state."""
