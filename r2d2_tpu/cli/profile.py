@@ -32,6 +32,10 @@ def main(argv=None) -> None:
         traced_step_count)
 
     trace_dir = args.summarize
+    if trace_dir is not None and config_overrides:
+        p.error(f"unrecognized arguments with --summarize: "
+                f"{config_overrides} (config overrides only apply to "
+                "capture runs)")
     if trace_dir is None:
         cfg = parse_overrides(Config(), config_overrides)
         if not any("replay.capacity" in str(o) for o in config_overrides):

@@ -35,9 +35,12 @@ Design:
 Scope: thread- OR process-mode actors (process mode gives each host a
 spawned CPU-pinned actor fleet fed through the native shm ring, exactly
 like the single-host orchestrator), device replay placement, single
-player. Resume/warm-start work rank-consistently (every controller
-restores the same checkpoint file from the shared filesystem).
-Unsupported combinations raise immediately.
+player, dp x mp meshes (mesh.mp > 1 feature-shards the wide params over
+mp via the GSPMD learner step and GSPMD lockstep ingest; mp must divide
+each host's device count so every dp row stays host-local). Resume/
+warm-start work rank-consistently (every controller restores the same
+checkpoint file from the shared filesystem). Unsupported combinations
+raise immediately.
 
 Multiplayer population training composes as ONE MULTIHOST JOB PER PLAYER
 (each player's stack is an independent mesh job; players interact only
@@ -139,6 +142,11 @@ def make_lockstep_ingest(spec: ReplaySpec, mesh):
     buffer_steps (live steps in the ring), filled_shards (shards holding
     data — the dp ready-gate), env_steps (cumulative), stop (>0 = any
     host requested stop).
+
+    mp > 1 routes to the GSPMD formulation (vmap over the dp-leading
+    state, scalar sums lowering to the allreduces) for the same reason as
+    the learner step: a manual-dp/auto-mp shard_map body fails to
+    partition. Identical contract; the manual path stays for mp == 1.
     """
     import jax
     import jax.numpy as jnp
@@ -147,6 +155,9 @@ def make_lockstep_ingest(spec: ReplaySpec, mesh):
 
     from r2d2_tpu.parallel.sharded import _shard0, _unshard0
     from r2d2_tpu.replay.device_replay import replay_add
+
+    if mesh.shape.get("mp", 1) > 1:
+        return _make_gspmd_lockstep_ingest(spec, mesh)
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -174,6 +185,52 @@ def make_lockstep_ingest(spec: ReplaySpec, mesh):
     return jax.jit(ingest, donate_argnums=(0, 1))
 
 
+def _make_gspmd_lockstep_ingest(spec: ReplaySpec, mesh):
+    """The dp x mp lockstep ingest: same contract as make_lockstep_ingest,
+    expressed without manual collectives (the replay stays dp-sharded /
+    mp-replicated; the scalar reductions become GSPMD allreduces).
+
+    Known trade-off: the vmapped ``lax.cond`` lowers through select, so an
+    invalid row still pays its block write's bandwidth before being
+    discarded — including no-op spin iterations. This cannot be avoided
+    with a second counters-only program: the lockstep invariant requires
+    every host to dispatch the SAME program each iteration, and block
+    presence is host-local state, so program selection may never depend on
+    it. Bounded cost: a few MB per iteration during the fill phase,
+    mp > 1 meshes only."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from r2d2_tpu.replay.device_replay import replay_add
+
+    sharding = NamedSharding(mesh, P("dp"))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def ingest(state, cum_env, blocks, valid, stop):
+        def add_row(s, blk, v):
+            return jax.lax.cond(v > 0, lambda ss: replay_add(spec, ss, blk),
+                                lambda ss: ss, s)
+
+        state = jax.vmap(add_row)(state, blocks, valid)
+        state = jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(x, sharding), state)
+        added = jnp.where(
+            valid > 0,
+            jax.vmap(lambda b: b.learning_steps.sum())(blocks), 0)
+        cum_env = cum_env + added.astype(jnp.int32)
+        my_steps = jax.vmap(lambda s: s.learning_steps.sum())(state)
+        info = {
+            "buffer_steps": my_steps.sum(),
+            "filled_shards": (my_steps > 0).astype(jnp.int32).sum(),
+            "env_steps": cum_env.sum(),
+            "stop": stop.sum(),
+        }
+        return state, cum_env, info
+
+    return ingest
+
+
 class HostFeed:
     """Builds each iteration's global ingest operands from process-local
     blocks: a (dp,)-leading stacked Block whose rows are zeros except this
@@ -187,10 +244,22 @@ class HostFeed:
 
         self.spec = spec
         self.sharding = NamedSharding(mesh, P("dp"))
-        devs = mesh.devices.reshape(-1)   # (dp,) — mp==1 asserted by caller
+        # row ownership: every dp row's devices (its mp columns) must live
+        # on ONE host — blocks are fed host-locally, so an mp-spanning row
+        # would need block data this host never drained
+        rows = mesh.devices.reshape(mesh.shape["dp"], -1)   # (dp, mp)
         me = jax.process_index()
-        self.local_rows = [i for i, d in enumerate(devs)
-                           if d.process_index == me]
+        owners = []
+        for r in range(rows.shape[0]):
+            procs = {d.process_index for d in rows[r]}
+            if len(procs) != 1:
+                raise NotImplementedError(
+                    f"dp row {r} spans processes {sorted(procs)} — with "
+                    "mesh.mp > 1, mp must divide each host's device count "
+                    "so every dp row (and its mp replicas) stays on one "
+                    "host")
+            owners.append(procs.pop())
+        self.local_rows = [r for r, o in enumerate(owners) if o == me]
         if not self.local_rows:
             raise ValueError(
                 f"process {me} owns no mesh shards — mesh.dp must cover "
@@ -299,8 +368,15 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     # single-host Learner's), so the two paths cannot diverge.
     ts, resumed_env = apply_restore(cfg.runtime, ts)
     mesh = make_mesh(cfg.mesh)
-    if mesh.shape["mp"] != 1:
-        raise NotImplementedError("multihost mp>1 is not supported")
+    if mesh.shape["mp"] > 1:
+        # pod-scale tensor parallelism: wide params feature-sharded over
+        # mp, the GSPMD learner step + GSPMD lockstep ingest (both routed
+        # automatically by their factories), replay dp-sharded /
+        # mp-replicated. HostFeed validates that every dp row stays on one
+        # host. Identical init on every rank keeps the mp shards
+        # rank-consistent the same way replication does for mp=1.
+        from r2d2_tpu.parallel.tensor_parallel import state_shardings
+        ts = jax.device_put(ts, state_shardings(ts, mesh))
     dp = mesh.shape["dp"]
     rs = sharded_replay_init(spec, mesh)
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -532,7 +608,7 @@ def _demo_config(save_dir: str) -> "Config":
 def _demo_worker(process_id: int, num_processes: int, coordinator: str,
                  devices_per_process: int, save_dir: str,
                  max_steps: int, resume: str = "",
-                 actor_mode: str = "thread") -> None:
+                 actor_mode: str = "thread", mp: int = 1) -> None:
     from r2d2_tpu.utils.platform import pin_cpu_platform
     pin_cpu_platform(devices_per_process)
     import jax
@@ -541,28 +617,37 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
     cfg = _demo_config(save_dir).replace(**{
         "mesh.coordinator_address": coordinator,
         "mesh.num_processes": num_processes, "mesh.process_id": process_id,
-        "mesh.dp": n_global,
+        "mesh.dp": n_global // mp, "mesh.mp": mp,
         **({"runtime.resume": resume} if resume else {}),
     })
     out = train_multihost(cfg, max_training_steps=max_steps, max_seconds=240,
                           actor_mode=actor_mode)
 
-    # Bit-exactness evidence, asserted in two layers: every local shard of
-    # every leaf identical within this process here, and the full-tree
-    # digest identical ACROSS processes by launch_demo (the cross-host
-    # invariant README advertises).
+    # Bit-exactness evidence, asserted in two layers: replicated leaves'
+    # local shards identical within this process here (mp-SHARDED leaves
+    # carry different slices per device by design, so they digest as the
+    # gathered global array), and the full-tree digest identical ACROSS
+    # processes by launch_demo (the cross-host invariant README
+    # advertises).
     import hashlib
     import json
     os.makedirs(save_dir, exist_ok=True)   # no checkpoint may have created it
+    if cfg.mesh.mp > 1:
+        # the tp run must GENUINELY shard (a silently-replicated "tp" run
+        # would pass every other check)
+        assert any(not l.sharding.is_fully_replicated
+                   for l in jax.tree_util.tree_leaves(out["params"])), \
+            "mp > 1 but every param leaf is replicated"
     digest = hashlib.sha256()
     for path, leaf in sorted(
             jax.tree_util.tree_flatten_with_path(out["params"])[0],
             key=lambda kv: str(kv[0])):
-        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
-        for s in shards[1:]:
-            np.testing.assert_array_equal(shards[0], s)
+        if leaf.sharding.is_fully_replicated:
+            shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+            for s in shards[1:]:
+                np.testing.assert_array_equal(shards[0], s)
         digest.update(str(path).encode())
-        digest.update(np.ascontiguousarray(shards[0]).tobytes())
+        digest.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
     with open(os.path.join(save_dir, f"params_digest_r{process_id}.json"),
               "w") as f:
         json.dump({"step": out["step"], "sha256": digest.hexdigest()}, f)
@@ -574,7 +659,8 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
 def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
                 save_dir: str = "/tmp/r2d2_multihost_demo",
                 max_steps: int = 8, timeout: float = 300.0,
-                resume: str = "", actor_mode: str = "thread") -> None:
+                resume: str = "", actor_mode: str = "thread",
+                mp: int = 1) -> None:
     """Spawn the loopback controllers and assert the final params came out
     BIT-IDENTICAL across hosts (each worker writes a digest file covering
     every param leaf; divergence anywhere fails the launch)."""
@@ -594,6 +680,7 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
             f"--devices-per-process={devices_per_process}",
             f"--save-dir={save_dir}", f"--max-steps={max_steps}",
             f"--resume={resume}", f"--actor-mode={actor_mode}",
+            f"--mp={mp}",
         ], num_processes, timeout, "multihost train demo")
 
     digests = []
@@ -621,15 +708,19 @@ def main(argv=None) -> None:
     p.add_argument("--resume", default="")
     p.add_argument("--actor-mode", choices=("thread", "process"),
                    default="thread")
+    p.add_argument("--mp", type=int, default=1,
+                   help="tensor-parallel axis width (params feature-sharded "
+                        "over mp; must divide devices-per-process)")
     args = p.parse_args(argv)
     if args.process_id is None:
         launch_demo(args.num_processes, args.devices_per_process,
                     args.save_dir, args.max_steps, resume=args.resume,
-                    actor_mode=args.actor_mode)
+                    actor_mode=args.actor_mode, mp=args.mp)
     else:
         _demo_worker(args.process_id, args.num_processes, args.coordinator,
                      args.devices_per_process, args.save_dir, args.max_steps,
-                     resume=args.resume, actor_mode=args.actor_mode)
+                     resume=args.resume, actor_mode=args.actor_mode,
+                     mp=args.mp)
 
 
 if __name__ == "__main__":

@@ -388,6 +388,25 @@ def test_multihost_lockstep_training(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_lockstep_tensor_parallel(tmp_path):
+    """Pod-scale tensor parallelism: two controllers over a dp=2 x mp=2
+    mesh — GSPMD learner step + GSPMD lockstep ingest, wide params
+    genuinely feature-sharded over mp (asserted in-worker), cross-host
+    param digests still bit-identical, rank-0 checkpoints restorable."""
+    from r2d2_tpu.parallel.multihost import launch_demo
+    from r2d2_tpu.runtime.checkpoint import list_checkpoints, restore_checkpoint
+
+    save_dir = str(tmp_path / "mh_tp")
+    launch_demo(num_processes=2, devices_per_process=2, save_dir=save_dir,
+                max_steps=8, timeout=280.0, mp=2)
+    ckpts = list_checkpoints(save_dir, "Fake", player=0)
+    assert ckpts, "rank 0 wrote no checkpoints"
+    ck = restore_checkpoint(ckpts[-1][1])
+    assert int(ck["step"]) == 8
+    assert int(ck["env_steps"]) > 0
+
+
+@pytest.mark.slow
 def test_multihost_lockstep_process_actors(tmp_path):
     """VERDICT r3 #8: the lockstep trainer with SPAWNED-PROCESS actor
     fleets — each controller hosts CPU-pinned actor processes fed through

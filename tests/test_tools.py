@@ -212,11 +212,10 @@ def test_summarize_trace_aggregates_chrome_events(tmp_path):
 def test_profile_capture_end_to_end(tmp_path):
     """capture_step_trace profiles real fused steps at a tiny config and
     the summary contains the jitted step dispatch."""
-    from r2d2_tpu.tools.profile_step import capture_step_trace, summarize_trace
+    from r2d2_tpu.tools.profile_step import (
+        capture_step_trace, summarize_trace, traced_step_count)
 
     from tests.test_runtime import tiny_config
-
-    from r2d2_tpu.tools.profile_step import traced_step_count
 
     cfg = tiny_config(tmp_path)
     out = capture_step_trace(cfg, steps=3, out_dir=str(tmp_path / "trace"))
