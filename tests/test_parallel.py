@@ -405,6 +405,14 @@ def test_multihost_lockstep_tensor_parallel(tmp_path):
     assert int(ck["step"]) == 8
     assert int(ck["env_steps"]) > 0
 
+    # rank-consistent TP resume: every controller restores the same
+    # checkpoint, re-shards it over mp, and the pod continues in lockstep
+    launch_demo(num_processes=2, devices_per_process=2, save_dir=save_dir,
+                max_steps=12, timeout=280.0, mp=2, resume=ckpts[-1][1])
+    ck2 = restore_checkpoint(list_checkpoints(save_dir, "Fake", 0)[-1][1])
+    assert int(ck2["step"]) == 12
+    assert int(ck2["env_steps"]) > int(ck["env_steps"])
+
 
 @pytest.mark.slow
 def test_multihost_lockstep_process_actors(tmp_path):
