@@ -437,6 +437,34 @@ def run_bench() -> None:
     else:
         matrix["bf16_spd16_exactgather"] = None
 
+    # --- 2b3. NHWC-decode A/B at the bf16_spd16 policy -------------------
+    # optim.pallas_decode_layout="nhwc" folds the post-decode layout
+    # transpose (the ~1.6 ms/step HBM copy in the round-3 profile) into
+    # the kernel's in-register relayout. Win -> flip the default; Mosaic
+    # rejection -> documented dead end.
+    if on_tpu and not smoke and default_pallas:
+        try:
+            opt_nhwc = dataclasses.replace(
+                cfg.optim, pallas_obs_decode="on",
+                pallas_decode_layout="nhwc")
+            from r2d2_tpu.models import NetworkApply
+            net_n = NetworkApply(
+                action_dim, dataclasses.replace(cfg.network, bf16=True),
+                cfg.env.frame_stack, cfg.env.frame_height,
+                cfg.env.frame_width)
+            ts_n = create_train_state(jax.random.PRNGKey(1), net_n, cfg.optim)
+            step = make_multi_learner_step(net_n, spec, opt_nhwc,
+                                           use_double, 16)
+            sps, _tsn, rs = measure_path(step, ts_n, rs, "bf16_spd16_nhwc",
+                                         steps_per_dispatch=16)
+            matrix["bf16_spd16_nhwc"] = sps * spec.batch_size
+        except Exception as e:   # never kill the bench for the extra cell
+            matrix["bf16_spd16_nhwc"] = None
+            print(f"[bf16_spd16_nhwc] FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    else:
+        matrix["bf16_spd16_nhwc"] = None
+
     # --- 2c. double-DQN unroll-fusion A/B at the bf16_spd16 policy -------
     # use_double=True pays a SECOND 55-step recurrent unroll; sequential
     # (two XLA while-loops) vs interleaved-in-one-scan

@@ -153,6 +153,13 @@ class OptimConfig:
     # backend is TPU — the measured winner there, BENCH_r03; the XLA
     # gather path is the correct-everywhere fallback).
     pallas_obs_decode: str = "auto"
+    # Pallas decode output layout: "planar" emits (B,T,K,H,W) + an outer
+    # transpose (the measured round-3 design; the transpose is a ~1.6
+    # ms/step HBM layout copy in the profile); "nhwc" interleaves K into
+    # the lane dim in-kernel so the (B,T,H,W,K) contract is a free
+    # reshape. Default planar pending the TPU A/B (bench.py measures an
+    # nhwc-decode cell).
+    pallas_decode_layout: str = "planar"
     # Double-DQN only: run the online and target unrolls interleaved in ONE
     # lax.scan instead of two sequential while-loops (which XLA cannot
     # overlap) — models/network.py dual_sequence_q. "on"/"off"/"auto"

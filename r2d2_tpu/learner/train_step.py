@@ -78,7 +78,8 @@ def create_train_state(key: jax.Array, net: NetworkApply, optim: OptimConfig
 
 
 def _decode_inputs(net: NetworkApply, spec: ReplaySpec, batch: SampleBatch,
-                   use_pallas: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                   use_pallas: bool,
+                   nhwc: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """THE storage→network decode (one place for every unroll path): uint8
     frame rows → stacked normalized obs (B,T,H,W,K) (fused pallas kernel on
     TPU, jnp gather elsewhere — ops/pallas_kernels.py; out_height strips
@@ -91,17 +92,18 @@ def _decode_inputs(net: NetworkApply, spec: ReplaySpec, batch: SampleBatch,
     stacked = stack_frames(batch.obs, spec.seq_window, spec.frame_stack,
                            use_pallas=use_pallas,
                            out_dtype=net.module.compute_dtype,
-                           out_height=spec.frame_height)
+                           out_height=spec.frame_height, nhwc=nhwc)
     last_action = jax.nn.one_hot(batch.last_action, net.action_dim,
                                  dtype=jnp.float32)
     return stacked, last_action
 
 
 def _unrolled_q(net: NetworkApply, spec: ReplaySpec, params,
-                batch: SampleBatch, use_pallas: bool = False) -> jnp.ndarray:
+                batch: SampleBatch, use_pallas: bool = False,
+                nhwc: bool = False) -> jnp.ndarray:
     """Decode (see _decode_inputs) and unroll the full window from the
     stored hidden state. Returns (B, T, A) f32 Q-values."""
-    stacked, last_action = _decode_inputs(net, spec, batch, use_pallas)
+    stacked, last_action = _decode_inputs(net, spec, batch, use_pallas, nhwc)
     q, _ = net.module.apply(params, stacked, last_action, batch.hidden)
     return q
 
@@ -114,6 +116,11 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
     from r2d2_tpu.ops.pallas_kernels import (
         resolve_pallas_obs_decode, resolve_pallas_setting)
     use_pallas = resolve_pallas_obs_decode(optim.pallas_obs_decode)
+    layout = str(optim.pallas_decode_layout).lower()
+    if layout not in ("planar", "nhwc"):
+        raise ValueError("optim.pallas_decode_layout must be 'planar' or "
+                         f"'nhwc'; got {optim.pallas_decode_layout!r}")
+    nhwc = layout == "nhwc"
     # double-DQN only: interleave the two unrolls' recurrent chains in one
     # scan (two sequential while-loops cannot overlap — see
     # models/network.py dual_sequence_q); identical math, parity-tested
@@ -124,12 +131,13 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
         if fused_dual:
             from r2d2_tpu.models.network import dual_sequence_q
             stacked, last_action = _decode_inputs(net, spec, batch,
-                                                  use_pallas)
+                                                  use_pallas, nhwc)
             q_online, q_target_all = dual_sequence_q(
                 net, params, target_params, stacked, last_action,
                 batch.hidden, batch.hidden)
         else:
-            q_online = _unrolled_q(net, spec, params, batch, use_pallas)
+            q_online = _unrolled_q(net, spec, params, batch, use_pallas,
+                                   nhwc)
 
         tpos = target_q_positions(batch.burn_in_steps, batch.learning_steps,
                                   batch.forward_steps, spec.learning, spec.forward)
@@ -143,7 +151,7 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
             a_star = jnp.argmax(q_online_tn, axis=-1)               # (B,L)
             if not fused_dual:
                 q_target_all = _unrolled_q(net, spec, target_params, batch,
-                                           use_pallas)
+                                           use_pallas, nhwc)
             q_target_all = jax.lax.stop_gradient(q_target_all)
             q_target_tn = jnp.take_along_axis(q_target_all, tpos[:, :, None], axis=1)
             q_next = jnp.take_along_axis(

@@ -83,14 +83,41 @@ def _stack_kernel(frame_stack: int, out_dtype, out_height: int,
         out_ref[0, 0, k] = (widened * inv).astype(out_dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _stack_kernel_nhwc(frame_stack: int, out_dtype, out_height: int,
+                       in_ref, out_ref):
+    # NHWC-emitting variant: interleave K into the LANE dim (out lane index
+    # = w*K + k), so the public (B, T, H, W, K) contract is a free reshape
+    # of the kernel output — no post-kernel transpose. The relayout happens
+    # in VMEM registers per timestep (the stack+reshape below) instead of
+    # as an HBM round-trip (the 1.6 ms/step layout copy in the round-3
+    # profile). The lane dim W*K (84*4=336) pads to 384 lanes = 1.14x —
+    # nothing like the 32x of emitting K minor-most as its own dim.
+    # Whether Mosaic lowers the in-register relayout efficiently is the
+    # TPU measurement (bench.py's nhwc-decode cell).
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    inv = jnp.float32(1.0 / 255.0)
+    frames = []
+    for k in range(frame_stack):
+        frame = in_ref[0, pl.dslice(t + k, 1)]               # (1, H, W) u8
+        widened = frame[0, :out_height].astype(jnp.int32).astype(jnp.float32)
+        frames.append((widened * inv).astype(out_dtype))
+    hwk = jnp.stack(frames, axis=-1)                         # (H, W, K)
+    out_ref[0, 0] = hwk.reshape(out_height, -1)              # (H, W*K)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
 def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
                         interpret: bool = False,
                         out_dtype=jnp.float32,
-                        out_height=None) -> jnp.ndarray:
+                        out_height=None,
+                        nhwc: bool = False) -> jnp.ndarray:
     """Pallas implementation; ``interpret=True`` runs it on any backend
     (tests use it on the CPU mesh). ``out_height``: emit only the first
-    out_height rows of each (possibly sublane-padded) stored frame."""
+    out_height rows of each (possibly sublane-padded) stored frame.
+    ``nhwc``: emit the NHWC layout in-kernel (no post-kernel transpose —
+    see _stack_kernel_nhwc); optim.pallas_decode_layout selects it."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -98,9 +125,17 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
     assert row_len >= seq_window + frame_stack - 1
     out_height = height if out_height is None else out_height
 
-    kernel = functools.partial(_stack_kernel, frame_stack, out_dtype,
-                               out_height)
-    planar = pl.pallas_call(
+    if nhwc:
+        kernel = functools.partial(_stack_kernel_nhwc, frame_stack,
+                                   out_dtype, out_height)
+        out_block = (1, 1, out_height, width * frame_stack)
+        out_map = lambda b, t: (b, t, 0, 0)
+    else:
+        kernel = functools.partial(_stack_kernel, frame_stack, out_dtype,
+                                   out_height)
+        out_block = (1, 1, frame_stack, out_height, width)
+        out_map = lambda b, t: (b, t, 0, 0, 0)
+    out = pl.pallas_call(
         kernel,
         grid=(batch, seq_window),
         in_specs=[pl.BlockSpec(
@@ -108,16 +143,25 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
             lambda b, t: (b, 0, 0, 0),   # constant in t: one DMA per row
             memory_space=pltpu.VMEM,
         )],
-        out_specs=pl.BlockSpec(
-            (1, 1, frame_stack, out_height, width),
-            lambda b, t: (b, t, 0, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
+        out_specs=pl.BlockSpec(out_block, out_map,
+                               memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (batch, seq_window, frame_stack, out_height, width), out_dtype),
+            (batch, seq_window) + out_block[2:], out_dtype),
         interpret=interpret,
     )(obs)
-    return planar.transpose(0, 1, 3, 4, 2)                   # (B, T, H, W, K)
+    if nhwc:
+        # lane index = w*K + k, so this reshape is layout-free
+        return out.reshape(batch, seq_window, out_height, width, frame_stack)
+    return out.transpose(0, 1, 3, 4, 2)                      # (B, T, H, W, K)
+
+
+def stack_frames_pallas_nhwc(obs: jnp.ndarray, seq_window: int,
+                             frame_stack: int, interpret: bool = False,
+                             out_dtype=jnp.float32,
+                             out_height=None) -> jnp.ndarray:
+    """NHWC-emitting decode (stack_frames_pallas with nhwc=True)."""
+    return stack_frames_pallas(obs, seq_window, frame_stack, interpret,
+                               out_dtype, out_height, nhwc=True)
 
 
 def resolve_pallas_setting(setting, field: str = "pallas setting") -> bool:
@@ -147,11 +191,14 @@ def resolve_pallas_obs_decode(setting) -> bool:
 def stack_frames(obs: jnp.ndarray, seq_window: int, frame_stack: int,
                  use_pallas: bool = False,
                  out_dtype=jnp.float32,
-                 out_height=None) -> jnp.ndarray:
-    """Dispatch: pallas on TPU when requested, jnp otherwise."""
+                 out_height=None,
+                 nhwc: bool = False) -> jnp.ndarray:
+    """Dispatch: pallas on TPU when requested (``nhwc`` selects the
+    transpose-free NHWC-emitting kernel), jnp otherwise."""
     if use_pallas:
         return stack_frames_pallas(obs, seq_window, frame_stack,
-                                   out_dtype=out_dtype, out_height=out_height)
+                                   out_dtype=out_dtype, out_height=out_height,
+                                   nhwc=nhwc)
     return stack_frames_reference(obs, seq_window, frame_stack,
                                   out_dtype=out_dtype, out_height=out_height)
 

@@ -61,6 +61,31 @@ def test_stack_frames_out_height_strips_padding(rng):
     np.testing.assert_allclose(got_pl, want, rtol=2e-7)
 
 
+def test_stack_frames_nhwc_matches_reference(rng):
+    """The NHWC-emitting decode (K interleaved into the lane dim in-kernel,
+    no post-kernel transpose) matches the reference twin — including with
+    a padded storage height and bf16 output."""
+    from r2d2_tpu.ops.pallas_kernels import stack_frames_pallas_nhwc
+    B, T, K, H, W = 3, 6, 4, 12, 16
+    obs = jnp.asarray(rng.integers(0, 255, (B, T + K - 1 + 2, H, W)),
+                      jnp.uint8)
+    want = np.asarray(stack_frames_reference(obs, T, K))
+    got = np.asarray(stack_frames_pallas_nhwc(obs, T, K, True))
+    assert got.shape == (B, T, H, W, K)
+    np.testing.assert_allclose(got, want, rtol=2e-7)
+
+    obs_pad = jnp.pad(obs, ((0, 0), (0, 0), (0, 4), (0, 0)))
+    got_pad = np.asarray(stack_frames_pallas_nhwc(obs_pad, T, K, True,
+                                                  out_height=H))
+    np.testing.assert_allclose(got_pad, want, rtol=2e-7)
+
+    want_bf16 = np.asarray(stack_frames_reference(obs, T, K,
+                                                  out_dtype=jnp.bfloat16))
+    got_bf16 = np.asarray(stack_frames_pallas_nhwc(obs, T, K, True,
+                                                   out_dtype=jnp.bfloat16))
+    np.testing.assert_array_equal(got_bf16, want_bf16)
+
+
 def test_stack_frames_bf16_output(rng):
     """out_dtype=bf16 (the bf16-policy decode): both twins normalize in f32
     and round ONCE at the end, so kernel and reference agree bit-exactly
