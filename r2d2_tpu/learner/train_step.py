@@ -82,9 +82,9 @@ def _decode_inputs(net: NetworkApply, spec: ReplaySpec, batch: SampleBatch,
                    nhwc: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """THE storage→network decode (one place for every unroll path): uint8
     frame rows → stacked normalized obs (B,T,H,W,K) (fused pallas kernel on
-    TPU, jnp gather elsewhere — ops/pallas_kernels.py; out_height strips
-    any exact-gather storage padding), action indices → one-hot (-1 encodes
-    the null action as zeros). Decodes directly into the network's compute
+    TPU, jnp gather elsewhere — ops/pallas_kernels.py; out_height/out_width
+    strip any exact-gather storage tile padding), action indices → one-hot
+    (-1 encodes the null action as zeros). Decodes directly into the network's compute
     dtype: under the bf16 policy this skips materializing the 4x-larger f32
     obs intermediate that XLA would cast at the conv boundary anyway
     (PERF.md profile: that transpose+cast copy was ~2.5 ms/step)."""
@@ -92,7 +92,8 @@ def _decode_inputs(net: NetworkApply, spec: ReplaySpec, batch: SampleBatch,
     stacked = stack_frames(batch.obs, spec.seq_window, spec.frame_stack,
                            use_pallas=use_pallas,
                            out_dtype=net.module.compute_dtype,
-                           out_height=spec.frame_height, nhwc=nhwc)
+                           out_height=spec.frame_height,
+                           out_width=spec.frame_width, nhwc=nhwc)
     last_action = jax.nn.one_hot(batch.last_action, net.action_dim,
                                  dtype=jnp.float32)
     return stacked, last_action

@@ -45,30 +45,33 @@ from r2d2_tpu.ops.indexing import frame_stack_indices
 def stack_frames_reference(obs: jnp.ndarray, seq_window: int,
                            frame_stack: int,
                            out_dtype=jnp.float32,
-                           out_height=None) -> jnp.ndarray:
+                           out_height=None,
+                           out_width=None) -> jnp.ndarray:
     """jnp twin: gather + transpose + normalize (XLA-lowered).
     ``out_dtype``: emit in the network's compute dtype — normalization
     always happens in f32 and rounds once at the end, so a bf16 output is
     bit-identical to XLA's own f32→bf16 cast at the conv boundary (which
     the MXU's default precision inserts anyway); emitting it here skips
     materializing the 4x-larger f32 intermediate.
-    ``out_height``: strip sublane padding from exact-gather storage rows
-    (ReplaySpec.stored_frame_height) — the network always sees the true
-    frame height."""
+    ``out_height``/``out_width``: strip tile padding from exact-gather
+    storage rows (ReplaySpec.stored_frame_height/_width) — the network
+    always sees the true frame shape."""
     fsi = frame_stack_indices(seq_window, frame_stack)       # (T, K)
     stacked = obs[:, fsi]                                     # (B, T, K, H, W)
     if out_height is not None and out_height != obs.shape[2]:
         stacked = stacked[:, :, :, :out_height, :]
+    if out_width is not None and out_width != obs.shape[3]:
+        stacked = stacked[:, :, :, :, :out_width]
     out = stacked.transpose(0, 1, 3, 4, 2).astype(jnp.float32) / 255.0
     return out.astype(out_dtype)
 
 
 def _stack_kernel(frame_stack: int, out_dtype, out_height: int,
-                  in_ref, out_ref):
-    # in_ref: (1, T+K-1, H_stored, W) uint8 (whole row, revisited across
-    # t); out_ref: (1, 1, K, out_height, W) out_dtype — this program's
-    # timestep slab. out_height < H_stored strips exact-gather sublane
-    # padding (a static sublane-dim slice).
+                  out_width: int, in_ref, out_ref):
+    # in_ref: (1, T+K-1, H_stored, W_stored) uint8 (whole row, revisited
+    # across t); out_ref: (1, 1, K, out_height, out_width) out_dtype —
+    # this program's timestep slab. out_height/out_width < stored strip
+    # exact-gather tile padding (static sublane/lane-dim slices).
     from jax.experimental import pallas as pl
 
     t = pl.program_id(1)
@@ -79,12 +82,13 @@ def _stack_kernel(frame_stack: int, out_dtype, out_height: int,
         # widen through int32 first, which it can, then convert. The
         # normalization rounds once from f32 into out_dtype — identical to
         # XLA's own cast at the conv boundary under a bf16 policy.
-        widened = frame[0, :out_height].astype(jnp.int32).astype(jnp.float32)
+        widened = frame[0, :out_height, :out_width].astype(
+            jnp.int32).astype(jnp.float32)
         out_ref[0, 0, k] = (widened * inv).astype(out_dtype)
 
 
 def _stack_kernel_nhwc(frame_stack: int, out_dtype, out_height: int,
-                       in_ref, out_ref):
+                       out_width: int, in_ref, out_ref):
     # NHWC-emitting variant: interleave K into the LANE dim (out lane index
     # = w*K + k), so the public (B, T, H, W, K) contract is a free reshape
     # of the kernel output — no post-kernel transpose. The relayout happens
@@ -101,39 +105,47 @@ def _stack_kernel_nhwc(frame_stack: int, out_dtype, out_height: int,
     frames = []
     for k in range(frame_stack):
         frame = in_ref[0, pl.dslice(t + k, 1)]               # (1, H, W) u8
-        widened = frame[0, :out_height].astype(jnp.int32).astype(jnp.float32)
-        frames.append((widened * inv).astype(out_dtype))
-    hwk = jnp.stack(frames, axis=-1)                         # (H, W, K)
-    out_ref[0, 0] = hwk.reshape(out_height, -1)              # (H, W*K)
+        widened = frame[0, :out_height, :out_width].astype(
+            jnp.int32).astype(jnp.float32)
+        frames.append(widened * inv)
+    # Stack/reshape in f32: Mosaic lowers minor-dim insertion only for
+    # 32-bit types (a bf16 stack was rejected on v5e — BENCH r4). The
+    # single rounding into out_dtype moves AFTER the relayout, which is
+    # bit-identical (elementwise cast commutes with stack/reshape).
+    hwk = jnp.stack(frames, axis=-1)                         # (H, W, K) f32
+    out_ref[0, 0] = hwk.reshape(out_height, -1).astype(out_dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
 def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
                         interpret: bool = False,
                         out_dtype=jnp.float32,
                         out_height=None,
-                        nhwc: bool = False) -> jnp.ndarray:
+                        nhwc: bool = False,
+                        out_width=None) -> jnp.ndarray:
     """Pallas implementation; ``interpret=True`` runs it on any backend
-    (tests use it on the CPU mesh). ``out_height``: emit only the first
-    out_height rows of each (possibly sublane-padded) stored frame.
-    ``nhwc``: emit the NHWC layout in-kernel (no post-kernel transpose —
-    see _stack_kernel_nhwc); optim.pallas_decode_layout selects it."""
+    (tests use it on the CPU mesh). ``out_height``/``out_width``: emit only
+    the first out_height x out_width pixels of each (possibly tile-padded)
+    stored frame. ``nhwc``: emit the NHWC layout in-kernel (no post-kernel
+    transpose — see _stack_kernel_nhwc); optim.pallas_decode_layout
+    selects it."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     batch, row_len, height, width = obs.shape
     assert row_len >= seq_window + frame_stack - 1
     out_height = height if out_height is None else out_height
+    out_width = width if out_width is None else out_width
 
     if nhwc:
         kernel = functools.partial(_stack_kernel_nhwc, frame_stack,
-                                   out_dtype, out_height)
-        out_block = (1, 1, out_height, width * frame_stack)
+                                   out_dtype, out_height, out_width)
+        out_block = (1, 1, out_height, out_width * frame_stack)
         out_map = lambda b, t: (b, t, 0, 0)
     else:
         kernel = functools.partial(_stack_kernel, frame_stack, out_dtype,
-                                   out_height)
-        out_block = (1, 1, frame_stack, out_height, width)
+                                   out_height, out_width)
+        out_block = (1, 1, frame_stack, out_height, out_width)
         out_map = lambda b, t: (b, t, 0, 0, 0)
     out = pl.pallas_call(
         kernel,
@@ -151,17 +163,20 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
     )(obs)
     if nhwc:
         # lane index = w*K + k, so this reshape is layout-free
-        return out.reshape(batch, seq_window, out_height, width, frame_stack)
+        return out.reshape(batch, seq_window, out_height, out_width,
+                           frame_stack)
     return out.transpose(0, 1, 3, 4, 2)                      # (B, T, H, W, K)
 
 
 def stack_frames_pallas_nhwc(obs: jnp.ndarray, seq_window: int,
                              frame_stack: int, interpret: bool = False,
                              out_dtype=jnp.float32,
-                             out_height=None) -> jnp.ndarray:
+                             out_height=None,
+                             out_width=None) -> jnp.ndarray:
     """NHWC-emitting decode (stack_frames_pallas with nhwc=True)."""
     return stack_frames_pallas(obs, seq_window, frame_stack, interpret,
-                               out_dtype, out_height, nhwc=True)
+                               out_dtype, out_height, nhwc=True,
+                               out_width=out_width)
 
 
 def resolve_pallas_setting(setting, field: str = "pallas setting") -> bool:
@@ -192,15 +207,17 @@ def stack_frames(obs: jnp.ndarray, seq_window: int, frame_stack: int,
                  use_pallas: bool = False,
                  out_dtype=jnp.float32,
                  out_height=None,
-                 nhwc: bool = False) -> jnp.ndarray:
+                 nhwc: bool = False,
+                 out_width=None) -> jnp.ndarray:
     """Dispatch: pallas on TPU when requested (``nhwc`` selects the
     transpose-free NHWC-emitting kernel), jnp otherwise."""
     if use_pallas:
         return stack_frames_pallas(obs, seq_window, frame_stack,
                                    out_dtype=out_dtype, out_height=out_height,
-                                   nhwc=nhwc)
+                                   nhwc=nhwc, out_width=out_width)
     return stack_frames_reference(obs, seq_window, frame_stack,
-                                  out_dtype=out_dtype, out_height=out_height)
+                                  out_dtype=out_dtype, out_height=out_height,
+                                  out_width=out_width)
 
 
 # ---------------------------------------------------------------------------
@@ -275,12 +292,13 @@ def gather_rows_exact_pallas(ring: jnp.ndarray, block_idx: jnp.ndarray,
     reads the whole ring row, ~7x the window bytes at the production
     shape).
 
-    Mosaic requires the copied slice's minor dims to be tile-aligned;
-    H=84 was rejected round 3, which is why this variant pairs with
-    ``replay.pallas_exact_gather`` (storage H padded 84→96, the uint8
-    (32, 128) tile's row multiple). Whether the padded copy compiles/wins
-    is a TPU measurement (bench.py's pad-gather cell); interpret mode
-    pins the semantics either way."""
+    Mosaic requires BOTH minor dims of the copied slice to be
+    tile-aligned: H=84 was rejected round 3, and an H-only pad was
+    rejected round 4 (dim-3 tiling is 128), which is why this variant
+    pairs with ``replay.pallas_exact_gather`` (storage padded 84x84 →
+    96x128, the uint8 (32, 128) tile). Whether the padded copy
+    compiles/wins is a TPU measurement (bench.py's pad-gather cell);
+    interpret mode pins the semantics either way."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 

@@ -36,9 +36,9 @@ def replay_init(spec: ReplaySpec) -> ReplayState:
     n, s, l = spec.num_blocks, spec.seqs_per_block, spec.learning
     return ReplayState(
         tree=jnp.zeros(2**spec.tree_layers - 1, jnp.float32),
-        # stored_frame_height: sublane-padded under spec.exact_gather
+        # stored_frame_height/_width: tile-padded under spec.exact_gather
         obs=jnp.zeros((n, spec.obs_row_len, spec.stored_frame_height,
-                       spec.frame_width), jnp.uint8),
+                       spec.stored_frame_width), jnp.uint8),
         last_action=jnp.full((n, spec.la_row_len), -1, jnp.int32),
         hidden=jnp.zeros((n, s, 2, spec.hidden_dim), jnp.float32),
         action=jnp.zeros((n, s, l), jnp.int32),
@@ -66,9 +66,11 @@ def replay_add(spec: ReplaySpec, state: ReplayState, block: Block) -> ReplayStat
     tree = tree_update(spec.tree_layers, state.tree, spec.prio_exponent,
                        block.priority, idxes)
     obs_row = block.obs_row
-    if spec.stored_frame_height != spec.frame_height:
+    if (spec.stored_frame_height != spec.frame_height
+            or spec.stored_frame_width != spec.frame_width):
         obs_row = jnp.pad(obs_row, (
-            (0, 0), (0, spec.stored_frame_height - spec.frame_height), (0, 0)))
+            (0, 0), (0, spec.stored_frame_height - spec.frame_height),
+            (0, spec.stored_frame_width - spec.frame_width)))
     return state.replace(
         tree=tree,
         obs=state.obs.at[ptr].set(obs_row),

@@ -97,6 +97,24 @@ class ReplaySpec:
         return -(-self.frame_height // 32) * 32
 
     @property
+    def stored_frame_width(self) -> int:
+        """Frame width in the DEVICE obs ring under exact_gather: padded to
+        the 128-lane tile. Mosaic requires BOTH minor dims of an HBM
+        memref slice to be tile-aligned — an H-only pad was rejected on
+        v5e ('slice along dimension 3 must be aligned to tiling (128), but
+        is 84', BENCH r4). The decode strips the padding
+        (stack_frames out_width), so the network still sees frame_width.
+
+        STORAGE COST: the pad grows the whole obs ring 1.74x in HBM
+        (96*128 vs 84*84 bytes per frame at reference scale) — the price
+        of exact window reads. A production-capacity ring sized near the
+        HBM limit can OOM at replay_init with exact_gather on; weigh that
+        against the 7.7x -> 1.74x read-amplification win (PERF.md)."""
+        if not self.exact_gather:
+            return self.frame_width
+        return -(-self.frame_width // 128) * 128
+
+    @property
     def seq_window(self) -> int:
         """Unrolled steps per sampled sequence (ref config.py:51 seq_len)."""
         return self.burn_in + self.learning + self.forward

@@ -61,6 +61,28 @@ def test_stack_frames_out_height_strips_padding(rng):
     np.testing.assert_allclose(got_pl, want, rtol=2e-7)
 
 
+def test_stack_frames_out_width_strips_padding(rng):
+    """out_width (exact-gather lane-tile padding, 84x84 -> 96x128 at
+    reference scale) strips the lane pad in BOTH pallas kernels (planar
+    and nhwc) and the reference twin, matching an unpadded decode
+    exactly."""
+    from r2d2_tpu.ops.pallas_kernels import stack_frames_pallas_nhwc
+    B, T, K, H, W = 2, 5, 3, 12, 12
+    obs = jnp.asarray(rng.integers(0, 255, (B, T + K - 1, H, W)), jnp.uint8)
+    obs_pad = jnp.pad(obs, ((0, 0), (0, 0), (0, 4), (0, 6)))  # -> (16, 18)
+    want = np.asarray(stack_frames_reference(obs, T, K))
+    got_ref = np.asarray(stack_frames_reference(obs_pad, T, K,
+                                                out_height=H, out_width=W))
+    got_pl = np.asarray(stack_frames_pallas(obs_pad, T, K, True,
+                                            out_height=H, out_width=W))
+    got_nhwc = np.asarray(stack_frames_pallas_nhwc(obs_pad, T, K, True,
+                                                   out_height=H, out_width=W))
+    np.testing.assert_array_equal(got_ref, want)
+    np.testing.assert_allclose(got_pl, want, rtol=2e-7)
+    np.testing.assert_allclose(got_nhwc, want, rtol=2e-7)
+    assert got_pl.shape == got_nhwc.shape == (B, T, H, W, K)
+
+
 def test_stack_frames_nhwc_matches_reference(rng):
     """The NHWC-emitting decode (K interleaved into the lane dim in-kernel,
     no post-kernel transpose) matches the reference twin — including with

@@ -155,21 +155,23 @@ def _fill_blocks(spec, n, rng, gamma=0.9):
 
 
 def test_exact_gather_padded_storage_is_transparent(rng):
-    """spec.exact_gather pads the stored frame height to the uint8
-    tile-packing multiple (12 -> 32 here; 84 -> 96 at reference scale);
+    """spec.exact_gather pads the stored frame to the uint8 (32, 128)
+    tile (12x12 -> 32x128 here; 84x84 -> 96x128 at reference scale; both
+    minor dims must be tile-aligned for the async-copy DMA — BENCH r4);
     the padding must be invisible end-to-end: the same blocks + same
     sample keys yield batches whose unpadded rows and every other field
     are IDENTICAL to the unpadded spec's, and the decoded observation
-    (out_height strips the pad) matches exactly."""
+    (out_height/out_width strip the pad) matches exactly."""
     from r2d2_tpu.ops.pallas_kernels import stack_frames_reference
 
     spec = make_spec()
     spec_pad = make_spec(exact_gather=True)
     assert spec_pad.stored_frame_height == 32 and spec.frame_height == 12
+    assert spec_pad.stored_frame_width == 128 and spec.frame_width == 12
 
     blocks = _fill_blocks(spec, 3, rng)
     state, state_pad = replay_init(spec), replay_init(spec_pad)
-    assert state_pad.obs.shape[2] == 32
+    assert state_pad.obs.shape[2:] == (32, 128)
     for blk in blocks:
         state = replay_add(spec, state, blk)
         state_pad = replay_add(spec_pad, state_pad, blk)
@@ -181,15 +183,17 @@ def test_exact_gather_padded_storage_is_transparent(rng):
     np.testing.assert_array_equal(np.asarray(batch.idxes),
                                   np.asarray(batch_pad.idxes))
     np.testing.assert_array_equal(np.asarray(batch.obs),
-                                  np.asarray(batch_pad.obs)[:, :, :12, :])
+                                  np.asarray(batch_pad.obs)[:, :, :12, :12])
     assert (np.asarray(batch_pad.obs)[:, :, 12:, :] == 0).all()
+    assert (np.asarray(batch_pad.obs)[:, :, :, 12:] == 0).all()
     np.testing.assert_array_equal(np.asarray(batch.last_action),
                                   np.asarray(batch_pad.last_action))
 
     dec = stack_frames_reference(batch.obs, spec.seq_window,
                                  spec.frame_stack, out_height=12)
     dec_pad = stack_frames_reference(batch_pad.obs, spec.seq_window,
-                                     spec.frame_stack, out_height=12)
+                                     spec.frame_stack, out_height=12,
+                                     out_width=12)
     assert dec_pad.shape == dec.shape
     np.testing.assert_array_equal(np.asarray(dec), np.asarray(dec_pad))
 
