@@ -5,8 +5,8 @@
     python -m r2d2_tpu.cli.train --multiplayer.enabled=true  # self-play stacks
 
 Extra (non-config) flags:
-    --actor-mode=thread|process   actor execution mode (default: process;
-                                  multihost jobs support thread only)
+    --actor-mode=thread|process   actor execution mode (default: process
+                                  single-host, thread multihost)
     --max-steps=N                 stop after N learner steps
     --max-seconds=S               wall-clock bound
 """
@@ -41,9 +41,9 @@ def main(argv=None) -> None:
     if cfg.mesh.multihost and cfg.mesh.num_processes > 1:
         # multi-controller pod: run this same CLI on every host with its
         # own --mesh.process_id; the lockstep loop keeps dispatch cadences
-        # identical across processes (parallel/multihost.py). Thread-mode
-        # actors are the only (and default) mode there — an explicit
-        # conflicting --actor-mode raises rather than being ignored.
+        # identical across processes (parallel/multihost.py). Defaults to
+        # thread-mode actors there; --actor-mode=process spawns CPU-pinned
+        # actor processes fed through the shm ring instead.
         from r2d2_tpu.parallel.multihost import train_multihost
         train_multihost(cfg, max_training_steps=max_steps,
                         max_seconds=max_seconds,

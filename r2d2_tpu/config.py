@@ -187,9 +187,26 @@ class MultiplayerConfig:
     enabled: bool = False
     num_players: int = 2
     base_port: int = 5060
+    # -1 (default): this process trains the WHOLE population in one job
+    # (the reference's train.py model; single-host orchestrator only).
+    # >= 0: this job trains exactly ONE player of the population — the
+    # per-player-job composition that scales multiplayer to pods (one
+    # multihost job per player; players interact only through the game
+    # engine's host/join sockets, never through collectives — README
+    # "Multiplayer at pod scale"). Player 0's actors host the games on
+    # port(actor_idx); every other player's actor i joins game i.
+    player_id: int = -1
 
     def port(self, actor_idx: int) -> int:
         return self.base_port + actor_idx
+
+    def env_args(self, player_idx: int, actor_idx: int) -> dict:
+        """Host/join wiring for one actor's env (ref train.py:33-38) —
+        shared by the single-host orchestrator and the per-player-job
+        multihost trainer so the two paths cannot drift."""
+        if not self.enabled:
+            return dict(is_host=False, port=self.base_port)
+        return dict(is_host=player_idx == 0, port=self.port(actor_idx))
 
 
 @dataclass(frozen=True)
@@ -290,6 +307,13 @@ class Config:
             )
         if self.sequence.forward_steps < 1:
             raise ValueError("sequence.forward_steps must be >= 1")
+        if self.multiplayer.enabled and not (
+                -1 <= self.multiplayer.player_id
+                < self.multiplayer.num_players):
+            raise ValueError(
+                f"multiplayer.player_id ({self.multiplayer.player_id}) must "
+                f"be -1 (whole population in-process) or in [0, "
+                f"num_players={self.multiplayer.num_players})")
 
     # ---- derived helpers ----
 

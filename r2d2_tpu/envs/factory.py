@@ -31,7 +31,10 @@ def create_env(cfg: EnvConfig, *, clip_rewards: Optional[bool] = None,
     env_id = cfg.env_id
 
     if env_id.startswith("Fake"):
-        env = FakeR2D2Env(height=cfg.frame_height, width=cfg.frame_width, seed=seed)
+        env = FakeR2D2Env(height=cfg.frame_height, width=cfg.frame_width,
+                          seed=seed,
+                          wiring=dict(is_host=is_host, port=port,
+                                      num_players=num_players, name=name))
     elif env_id.startswith("Vizdoom"):
         from r2d2_tpu.envs.vizdoom_env import make_vizdoom
         env = make_vizdoom(

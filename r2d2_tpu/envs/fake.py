@@ -29,14 +29,24 @@ class _DiscreteSpace:
 
 class FakeR2D2Env:
     def __init__(self, action_dim: int = 6, episode_len: int = 120,
-                 height: int = 84, width: int = 84, seed: int = 0):
+                 height: int = 84, width: int = 84, seed: int = 0,
+                 wiring: dict = None):
         self.action_space = _DiscreteSpace(action_dim, seed)
         self.episode_len = episode_len
         self.h, self.w = height, width
         self.seed = seed
+        # multiplayer host/join args the factory resolved for this env —
+        # a real engine would dial these sockets (vizdoom_env.py); the
+        # fake records them so wiring is assertable hermetically
+        self.multiplayer_wiring = dict(wiring or {})
         self._schedule = np.random.default_rng(seed).integers(
             action_dim, size=episode_len + 1)
         self.t = 0
+
+    @property
+    def unwrapped(self):
+        """gym conformance: the innermost env is this env."""
+        return self
 
     def _obs(self) -> np.ndarray:
         """84x84 uint8 frame encoding the current target action as a bright

@@ -51,6 +51,13 @@ class Wrapper:
     def action_space(self):
         return self.env.action_space
 
+    @property
+    def unwrapped(self):
+        """Innermost env (the gym surface tests/tools use to reach
+        backend-specific attributes through the wrapper stack)."""
+        inner = self.env
+        return inner.unwrapped if hasattr(inner, "unwrapped") else inner
+
     def reset(self):
         return self.env.reset()
 

@@ -87,33 +87,61 @@ def _stack_kernel(frame_stack: int, out_dtype, out_height: int,
         out_ref[0, 0, k] = (widened * inv).astype(out_dtype)
 
 
-def _stack_kernel_nhwc(frame_stack: int, out_dtype, out_height: int,
-                       out_width: int, in_ref, out_ref):
-    # NHWC-emitting variant: interleave K into the LANE dim (out lane index
-    # = w*K + k), so the public (B, T, H, W, K) contract is a free reshape
-    # of the kernel output — no post-kernel transpose. The relayout happens
-    # in VMEM registers per timestep (the stack+reshape below) instead of
-    # as an HBM round-trip (the 1.6 ms/step layout copy in the round-3
-    # profile). The lane dim W*K (84*4=336) pads to 384 lanes = 1.14x —
-    # nothing like the 32x of emitting K minor-most as its own dim.
-    # Whether Mosaic lowers the in-register relayout efficiently is the
-    # TPU measurement (bench.py's nhwc-decode cell).
+def _decode_plane(in_ref, t, k, out_height: int, out_width: int):
+    """One frame plane, decoded to normalized f32 (H, W) in registers.
+    Mosaic can't cast uint8 -> f32 directly (BENCH_r02): widen via i32."""
+    from jax.experimental import pallas as pl
+
+    frame = in_ref[0, pl.dslice(t + k, 1)]                   # (1, H, W) u8
+    widened = frame[0, :out_height, :out_width].astype(
+        jnp.int32).astype(jnp.float32)
+    return widened * jnp.float32(1.0 / 255.0)
+
+
+def _stack_kernel_nhwc32(frame_stack: int, out_dtype, out_height: int,
+                         out_width: int, in_ref, out_ref):
+    # NHWC-emitting variant for 32-bit out_dtype: interleave K into the
+    # LANE dim (out lane index = w*K + k) with one strided store per
+    # plane, so the public (B, T, H, W, K) contract is a free reshape of
+    # the kernel output — no post-kernel transpose. The relayout happens
+    # in VMEM registers per timestep instead of as an HBM round-trip (the
+    # 1.6 ms/step layout copy in the round-3 profile). Strided stores are
+    # implemented for 32-bit data only (v5e Mosaic), hence the packed
+    # 16-bit variant below.
     from jax.experimental import pallas as pl
 
     t = pl.program_id(1)
-    inv = jnp.float32(1.0 / 255.0)
-    frames = []
     for k in range(frame_stack):
-        frame = in_ref[0, pl.dslice(t + k, 1)]               # (1, H, W) u8
-        widened = frame[0, :out_height, :out_width].astype(
-            jnp.int32).astype(jnp.float32)
-        frames.append(widened * inv)
-    # Stack/reshape in f32: Mosaic lowers minor-dim insertion only for
-    # 32-bit types (a bf16 stack was rejected on v5e — BENCH r4). The
-    # single rounding into out_dtype moves AFTER the relayout, which is
-    # bit-identical (elementwise cast commutes with stack/reshape).
-    hwk = jnp.stack(frames, axis=-1)                         # (H, W, K) f32
-    out_ref[0, 0] = hwk.reshape(out_height, -1).astype(out_dtype)
+        val = _decode_plane(in_ref, t, k, out_height, out_width)
+        out_ref[0, 0, :, pl.Slice(k, out_width, frame_stack)] = (
+            val.astype(out_dtype))
+
+
+def _stack_kernel_nhwc16(frame_stack: int, out_dtype, out_height: int,
+                         out_width: int, in_ref, out_ref):
+    # NHWC-emitting variant for 16-bit out_dtype (the bf16 policy).
+    # Mosaic rejects every direct 16-bit relayout route on v5e: bf16
+    # minor-dim insertion ("32-bit only"), the (H,W,K)->(H,W*K)
+    # lane-merge reshape, and 16-bit strided stores. The working route
+    # is PAIR PACKING: bitcast each bf16 plane to u16, pack planes
+    # 2p/2p+1 into the low/high halves of one i32 vector, and emit with
+    # 32-bit strided stores into an i32 output at lane j = w*(K/2) + p.
+    # The wrapper's i32 -> out_dtype bitcast appends a trailing dim of 2
+    # indexing [low, high] bits (XLA narrowing convention), so final
+    # bf16 lane l = j*2 + e = w*K + 2p + e = w*K + k — exactly NHWC.
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+    pairs = frame_stack // 2
+    for p in range(pairs):
+        lo = jax.lax.bitcast_convert_type(
+            _decode_plane(in_ref, t, 2 * p, out_height, out_width)
+            .astype(out_dtype), jnp.uint16).astype(jnp.int32)
+        hi = jax.lax.bitcast_convert_type(
+            _decode_plane(in_ref, t, 2 * p + 1, out_height, out_width)
+            .astype(out_dtype), jnp.uint16).astype(jnp.int32)
+        packed = jax.lax.bitwise_or(lo, jax.lax.shift_left(hi, 16))
+        out_ref[0, 0, :, pl.Slice(p, out_width, pairs)] = packed
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
@@ -138,15 +166,31 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
     out_width = width if out_width is None else out_width
 
     if nhwc:
-        kernel = functools.partial(_stack_kernel_nhwc, frame_stack,
-                                   out_dtype, out_height, out_width)
-        out_block = (1, 1, out_height, out_width * frame_stack)
+        itemsize = jnp.dtype(out_dtype).itemsize
+        if itemsize == 2 and frame_stack % 2 == 0:
+            # packed route (see _stack_kernel_nhwc16): i32 storage holding
+            # bf16 pairs; bitcast back outside the kernel (layout-free)
+            kernel = functools.partial(_stack_kernel_nhwc16, frame_stack,
+                                       out_dtype, out_height, out_width)
+            out_block = (1, 1, out_height, out_width * frame_stack // 2)
+            store_dtype = jnp.int32
+        elif itemsize == 4:
+            kernel = functools.partial(_stack_kernel_nhwc32, frame_stack,
+                                       out_dtype, out_height, out_width)
+            out_block = (1, 1, out_height, out_width * frame_stack)
+            store_dtype = out_dtype
+        else:
+            raise NotImplementedError(
+                f"nhwc decode needs a 32-bit out_dtype or a 16-bit one "
+                f"with even frame_stack; got {jnp.dtype(out_dtype).name} "
+                f"with frame_stack={frame_stack}")
         out_map = lambda b, t: (b, t, 0, 0)
     else:
         kernel = functools.partial(_stack_kernel, frame_stack, out_dtype,
                                    out_height, out_width)
         out_block = (1, 1, frame_stack, out_height, out_width)
         out_map = lambda b, t: (b, t, 0, 0, 0)
+        store_dtype = out_dtype
     out = pl.pallas_call(
         kernel,
         grid=(batch, seq_window),
@@ -158,10 +202,14 @@ def stack_frames_pallas(obs: jnp.ndarray, seq_window: int, frame_stack: int,
         out_specs=pl.BlockSpec(out_block, out_map,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (batch, seq_window) + out_block[2:], out_dtype),
+            (batch, seq_window) + out_block[2:], store_dtype),
         interpret=interpret,
     )(obs)
     if nhwc:
+        if store_dtype != out_dtype:
+            # i32 -> (..., 2) out_dtype; index 0 = low 16 bits (XLA
+            # narrowing convention), matching the kernel's pack order
+            out = jax.lax.bitcast_convert_type(out, out_dtype)
         # lane index = w*K + k, so this reshape is layout-free
         return out.reshape(batch, seq_window, out_height, out_width,
                            frame_stack)

@@ -42,10 +42,14 @@ warm-start work rank-consistently (every controller restores the same
 checkpoint file from the shared filesystem). Unsupported combinations
 raise immediately.
 
-Multiplayer population training composes as ONE MULTIHOST JOB PER PLAYER
-(each player's stack is an independent mesh job; players interact only
-through the game engine's host/join sockets, not through collectives) —
-see README "Multiplayer at pod scale".
+Multiplayer population training composes as ONE MULTIHOST JOB PER PLAYER:
+set ``multiplayer.player_id`` on each job (player 0's actors host the
+games, every other player's actor gidx joins game gidx). Each player's
+stack is an independent mesh job; players interact only through the game
+engine's host/join sockets, not through collectives — so there is no
+cross-player lockstep, and any player job can restart independently.
+See README "Multiplayer at pod scale"; the two-job loopback test
+(tests/test_parallel.py) runs two concurrent player jobs end-to-end.
 
 Demo / validation (two loopback controllers, virtual CPU devices):
 
@@ -323,11 +327,15 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     if actor_mode not in ("thread", "process"):
         raise ValueError(f"actor_mode must be 'thread' or 'process', got "
                          f"{actor_mode!r}")
-    if cfg.multiplayer.enabled:
+    if cfg.multiplayer.enabled and cfg.multiplayer.player_id < 0:
         raise NotImplementedError(
-            "multihost + multiplayer population training is not supported: "
-            "each player's stack is an independent mesh job — launch one "
-            "multihost job per player instead")
+            "multihost training runs ONE player's stack per job: set "
+            "multiplayer.player_id to this job's player index and launch "
+            "one multihost job per player (players interact only through "
+            "the game engine's host/join sockets, never through "
+            "collectives — README \"Multiplayer at pod scale\"). "
+            "multiplayer.player_id=-1 (whole population in-process) is the "
+            "single-host orchestrator's mode.")
     if cfg.replay.placement != "device":
         raise NotImplementedError(
             "multihost training requires replay.placement='device'")
@@ -433,19 +441,34 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             except (ValueError, OSError):
                 pass
 
+    # Per-player-job multiplayer (README "Multiplayer at pod scale"): this
+    # job's player index drives the host/join wiring and the seed offset.
+    # pid=0 when multiplayer is off, so every single-player formula below
+    # is unchanged. Game index = the actor's GLOBAL index (player 0's job
+    # hosts games 0..total_actors-1; player p's actor gidx joins game
+    # gidx), so all player jobs must configure the same actor fan-out.
+    pid = cfg.multiplayer.player_id if cfg.multiplayer.enabled else 0
+    # host/join args OBSERVED by this host's envs (thread mode; the fake
+    # env records what the factory resolved) — returned in the summary so
+    # per-player-job launches can assert the wiring end-to-end. Keyed by
+    # actor slot (not appended): supervisor respawns re-record, never
+    # duplicate.
+    observed_wiring = [None] * n_local
+
     if actor_mode == "process":
         def spawn_actor(i: int):
-            # player_idx=0 / actor_idx=gidx reproduces the thread path's
-            # seed formula (seed + 100*gidx) inside actor_process_main
+            # player_idx=pid / actor_idx=gidx reproduces the thread path's
+            # seed formula (seed + 10_000*pid + 100*gidx) inside
+            # actor_process_main
             gidx = rank * n_local + i
             eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
                                cfg.actor.eps_alpha)
             p = ctx.Process(
                 target=actor_process_main,
-                args=(cfg.to_dict(), 0, gidx, eps, publisher.name,
+                args=(cfg.to_dict(), pid, gidx, eps, publisher.name,
                       queue._q, stop),
-                kwargs=dict(is_host=False, port=cfg.multiplayer.base_port),
-                daemon=True, name=f"actor-h{rank}-{i}")
+                kwargs=cfg.multiplayer.env_args(pid, gidx),
+                daemon=True, name=f"actor-p{pid}h{rank}-{i}")
             p.start()
             return p
     else:
@@ -457,8 +480,13 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             gidx = rank * n_local + i
             eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
                                cfg.actor.eps_alpha)
-            seed = cfg.runtime.seed + 100 * gidx
-            env = create_env(cfg.env, seed=seed, name=f"h{rank}a{i}")
+            seed = cfg.runtime.seed + 10_000 * pid + 100 * gidx
+            env = create_env(cfg.env, seed=seed,
+                             num_players=cfg.multiplayer.num_players,
+                             name=f"p{pid}h{rank}a{i}",
+                             **cfg.multiplayer.env_args(pid, gidx))
+            uw = getattr(env, "unwrapped", env)
+            observed_wiring[i] = getattr(uw, "multiplayer_wiring", None)
             policy = ActorPolicy(net, ts.params, eps, seed=seed)
 
             def loop(env=env, policy=policy, reader_id=i):
@@ -483,7 +511,10 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             spawn_actor, n_local, cfg.runtime.restart_dead_actors, stop,
             queue=queue if actor_mode == "process" else None)
 
-        metrics = TrainMetrics(0, cfg.runtime.save_dir) if rank == 0 else None
+        # pid-keyed logs/checkpoints: per-player jobs sharing a filesystem
+        # write train_player{pid}.log and player-pid checkpoint dirs, like
+        # the in-process population path (ref worker.py:35-37)
+        metrics = TrainMetrics(pid, cfg.runtime.save_dir) if rank == 0 else None
         max_steps = max_training_steps or cfg.optim.training_steps
         deadline = time.time() + max_seconds if max_seconds else None
         rt = cfg.runtime
@@ -545,7 +576,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 if rank == 0 and boundary(rt.save_interval):
                     save_checkpoint(
                         rt.save_dir, cfg.env.game_name,
-                        step_count // rt.save_interval, 0, ts.params,
+                        step_count // rt.save_interval, pid, ts.params,
                         ts.opt_state, ts.target_params, step_count,
                         resumed_env + info["env_steps"],
                         config_json=cfg.to_json())
@@ -579,7 +610,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         queue.close()    # releases/unlinks the shm ring (owner side)
 
     return {"step": step_count, "env_steps": resumed_env + info["env_steps"],
-            "buffer_steps": info["buffer_steps"], "params": ts.params}
+            "buffer_steps": info["buffer_steps"], "params": ts.params,
+            "player_id": pid, "actor_wiring": observed_wiring}
 
 
 # ---------------------------------------------------------------------------
@@ -608,7 +640,8 @@ def _demo_config(save_dir: str) -> "Config":
 def _demo_worker(process_id: int, num_processes: int, coordinator: str,
                  devices_per_process: int, save_dir: str,
                  max_steps: int, resume: str = "",
-                 actor_mode: str = "thread", mp: int = 1) -> None:
+                 actor_mode: str = "thread", mp: int = 1,
+                 player_id: int = -1, num_players: int = 2) -> None:
     from r2d2_tpu.utils.platform import pin_cpu_platform
     pin_cpu_platform(devices_per_process)
     import jax
@@ -619,6 +652,9 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
         "mesh.num_processes": num_processes, "mesh.process_id": process_id,
         "mesh.dp": n_global // mp, "mesh.mp": mp,
         **({"runtime.resume": resume} if resume else {}),
+        **({"multiplayer.enabled": True, "multiplayer.player_id": player_id,
+            "multiplayer.num_players": num_players}
+           if player_id >= 0 else {}),
     })
     out = train_multihost(cfg, max_training_steps=max_steps, max_seconds=240,
                           actor_mode=actor_mode)
@@ -650,7 +686,9 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
         digest.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
     with open(os.path.join(save_dir, f"params_digest_r{process_id}.json"),
               "w") as f:
-        json.dump({"step": out["step"], "sha256": digest.hexdigest()}, f)
+        json.dump({"step": out["step"], "sha256": digest.hexdigest(),
+                   "player_id": out["player_id"],
+                   "actor_wiring": out["actor_wiring"]}, f)
     print(f"[proc {process_id}] multihost train ok: step={out['step']} "
           f"env_steps={out['env_steps']} sha256={digest.hexdigest()[:16]}",
           flush=True)
@@ -660,10 +698,14 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
                 save_dir: str = "/tmp/r2d2_multihost_demo",
                 max_steps: int = 8, timeout: float = 300.0,
                 resume: str = "", actor_mode: str = "thread",
-                mp: int = 1) -> None:
+                mp: int = 1, player_id: int = -1,
+                num_players: int = 2) -> list:
     """Spawn the loopback controllers and assert the final params came out
     BIT-IDENTICAL across hosts (each worker writes a digest file covering
-    every param leaf; divergence anywhere fails the launch)."""
+    every param leaf; divergence anywhere fails the launch). Returns the
+    per-rank digest records ({step, sha256, player_id, actor_wiring}).
+    ``player_id >= 0`` runs the job as ONE player of a multiplayer
+    population (README "Multiplayer at pod scale")."""
     import glob
     import json
     import sys
@@ -680,20 +722,25 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
             f"--devices-per-process={devices_per_process}",
             f"--save-dir={save_dir}", f"--max-steps={max_steps}",
             f"--resume={resume}", f"--actor-mode={actor_mode}",
-            f"--mp={mp}",
+            f"--mp={mp}", f"--player-id={player_id}",
+            f"--num-players={num_players}",
         ], num_processes, timeout, "multihost train demo")
 
     digests = []
     for pid in range(num_processes):
         with open(os.path.join(save_dir, f"params_digest_r{pid}.json")) as f:
             digests.append(json.load(f))
-    if any(d != digests[0] for d in digests[1:]):
+    # step + param digest must match on every rank; actor_wiring is
+    # rank-local by design (each host's actors own different game ports)
+    core = [{k: d[k] for k in ("step", "sha256")} for d in digests]
+    if any(c != core[0] for c in core[1:]):
         raise SystemExit(
             f"multihost train demo: params DIVERGED across controllers: "
             f"{digests}")
     print(f"multihost train demo: {num_processes} controllers x "
           f"{devices_per_process} devices ok, params bit-identical "
           f"across hosts", flush=True)
+    return digests
 
 
 def main(argv=None) -> None:
@@ -711,16 +758,22 @@ def main(argv=None) -> None:
     p.add_argument("--mp", type=int, default=1,
                    help="tensor-parallel axis width (params feature-sharded "
                         "over mp; must divide devices-per-process)")
+    p.add_argument("--player-id", type=int, default=-1,
+                   help=">= 0: run this job as ONE player of a multiplayer "
+                        "population (one multihost job per player)")
+    p.add_argument("--num-players", type=int, default=2)
     args = p.parse_args(argv)
     if args.process_id is None:
         launch_demo(args.num_processes, args.devices_per_process,
                     args.save_dir, args.max_steps, resume=args.resume,
-                    actor_mode=args.actor_mode, mp=args.mp)
+                    actor_mode=args.actor_mode, mp=args.mp,
+                    player_id=args.player_id, num_players=args.num_players)
     else:
         _demo_worker(args.process_id, args.num_processes, args.coordinator,
                      args.devices_per_process, args.save_dir, args.max_steps,
                      resume=args.resume, actor_mode=args.actor_mode,
-                     mp=args.mp)
+                     mp=args.mp, player_id=args.player_id,
+                     num_players=args.num_players)
 
 
 if __name__ == "__main__":

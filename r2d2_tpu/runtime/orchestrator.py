@@ -58,11 +58,9 @@ class PlayerStack:
         self.queue: Optional[BlockQueue] = None
 
     def actor_env_args(self, actor_idx: int):
-        """Multiplayer host/join wiring (ref train.py:33-38)."""
-        mpc = self.cfg.multiplayer
-        if not mpc.enabled:
-            return dict(is_host=False, port=mpc.base_port)
-        return dict(is_host=self.player_idx == 0, port=mpc.port(actor_idx))
+        """Multiplayer host/join wiring (ref train.py:33-38; shared with
+        the per-player-job multihost path via MultiplayerConfig.env_args)."""
+        return self.cfg.multiplayer.env_args(self.player_idx, actor_idx)
 
     def start_actors_threads(self, stop: threading.Event) -> None:
         cfg = self.cfg
@@ -242,7 +240,16 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
                 except (ValueError, OSError):
                     pass
 
-        stacks = [PlayerStack(cfg, p, action_dim) for p in range(num_players)]
+        # player_id >= 0: this job runs exactly ONE player of the
+        # population (per-player-job composition — README "Multiplayer at
+        # pod scale"); the player index still feeds the host/join wiring
+        # and seed offsets, so N such jobs reproduce the in-process
+        # population stack-for-stack.
+        if cfg.multiplayer.enabled and cfg.multiplayer.player_id >= 0:
+            player_indices = [cfg.multiplayer.player_id]
+        else:
+            player_indices = list(range(num_players))
+        stacks = [PlayerStack(cfg, p, action_dim) for p in player_indices]
         for st in stacks:
             if actor_mode == "thread":
                 st.start_actors_threads(stop)
