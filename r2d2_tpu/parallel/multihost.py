@@ -641,7 +641,8 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
                  devices_per_process: int, save_dir: str,
                  max_steps: int, resume: str = "",
                  actor_mode: str = "thread", mp: int = 1,
-                 player_id: int = -1, num_players: int = 2) -> None:
+                 player_id: int = -1, num_players: int = 2,
+                 num_actors: int = 1) -> None:
     from r2d2_tpu.utils.platform import pin_cpu_platform
     pin_cpu_platform(devices_per_process)
     import jax
@@ -651,6 +652,7 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
         "mesh.coordinator_address": coordinator,
         "mesh.num_processes": num_processes, "mesh.process_id": process_id,
         "mesh.dp": n_global // mp, "mesh.mp": mp,
+        "actor.num_actors": num_actors,
         **({"runtime.resume": resume} if resume else {}),
         **({"multiplayer.enabled": True, "multiplayer.player_id": player_id,
             "multiplayer.num_players": num_players}
@@ -699,13 +701,18 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
                 max_steps: int = 8, timeout: float = 300.0,
                 resume: str = "", actor_mode: str = "thread",
                 mp: int = 1, player_id: int = -1,
-                num_players: int = 2) -> list:
+                num_players: int = 2, num_actors: int = 1) -> list:
     """Spawn the loopback controllers and assert the final params came out
     BIT-IDENTICAL across hosts (each worker writes a digest file covering
     every param leaf; divergence anywhere fails the launch). Returns the
     per-rank digest records ({step, sha256, player_id, actor_wiring}).
     ``player_id >= 0`` runs the job as ONE player of a multiplayer
-    population (README "Multiplayer at pod scale")."""
+    population (README "Multiplayer at pod scale"); per-player jobs must
+    all configure the same TOTAL actor fan-out (num_processes *
+    num_actors), since the game index is the global actor index.
+    ``actor_wiring`` is observed from the envs in thread actor mode only —
+    process-mode actors build their envs in spawned children, so the
+    records carry None there."""
     import glob
     import json
     import sys
@@ -723,7 +730,7 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
             f"--save-dir={save_dir}", f"--max-steps={max_steps}",
             f"--resume={resume}", f"--actor-mode={actor_mode}",
             f"--mp={mp}", f"--player-id={player_id}",
-            f"--num-players={num_players}",
+            f"--num-players={num_players}", f"--num-actors={num_actors}",
         ], num_processes, timeout, "multihost train demo")
 
     digests = []
@@ -762,18 +769,23 @@ def main(argv=None) -> None:
                    help=">= 0: run this job as ONE player of a multiplayer "
                         "population (one multihost job per player)")
     p.add_argument("--num-players", type=int, default=2)
+    p.add_argument("--num-actors", type=int, default=1,
+                   help="actors per controller; per-player jobs must all "
+                        "match on num_processes * num_actors")
     args = p.parse_args(argv)
     if args.process_id is None:
         launch_demo(args.num_processes, args.devices_per_process,
                     args.save_dir, args.max_steps, resume=args.resume,
                     actor_mode=args.actor_mode, mp=args.mp,
-                    player_id=args.player_id, num_players=args.num_players)
+                    player_id=args.player_id, num_players=args.num_players,
+                    num_actors=args.num_actors)
     else:
         _demo_worker(args.process_id, args.num_processes, args.coordinator,
                      args.devices_per_process, args.save_dir, args.max_steps,
                      resume=args.resume, actor_mode=args.actor_mode,
                      mp=args.mp, player_id=args.player_id,
-                     num_players=args.num_players)
+                     num_players=args.num_players,
+                     num_actors=args.num_actors)
 
 
 if __name__ == "__main__":

@@ -468,12 +468,15 @@ def test_multiplayer_per_player_jobs_loopback(tmp_path):
     """Multiplayer at pod scale (README): TWO INDEPENDENT multihost jobs —
     one per player — run concurrently, coupled only through the game
     engine's host/join sockets (recorded hermetically by the fake env).
-    Player 0's job is itself 2 lockstep controllers (digest-verified by
-    launch_demo); player 1's job is a single controller. Asserts: both
-    jobs train to budget, player 0's actors HOST games at
-    base_port+global_idx, player 1's actors JOIN the same ports, and the
-    two jobs' logs/checkpoints land under per-player names without
-    colliding in the shared save_dir."""
+    Player 0's job is itself 2 lockstep controllers x 1 actor
+    (digest-verified by launch_demo); player 1's job is a single
+    controller x 2 actors — the SAME total fan-out (2), which the
+    composition requires: game index = global actor index, so every
+    hosted game must have exactly one joiner per other player. Asserts:
+    both jobs train to budget, player 0's actors HOST games at
+    base_port+global_idx, player 1's actors JOIN the same two ports, and
+    the two jobs' logs/checkpoints land under per-player names without
+    colliding."""
     from concurrent.futures import ThreadPoolExecutor
 
     from r2d2_tpu.parallel.multihost import launch_demo
@@ -483,9 +486,9 @@ def test_multiplayer_per_player_jobs_loopback(tmp_path):
     d1 = str(tmp_path / "p1")
     with ThreadPoolExecutor(2) as ex:
         f0 = ex.submit(launch_demo, 2, 2, d0, 8, 420.0, "", "thread", 1,
-                       0, 2)   # player 0: two controllers
+                       0, 2, 1)   # player 0: two controllers x 1 actor
         f1 = ex.submit(launch_demo, 1, 2, d1, 8, 420.0, "", "thread", 1,
-                       1, 2)   # player 1: one controller
+                       1, 2, 2)   # player 1: one controller x 2 actors
         dig0, dig1 = f0.result(), f1.result()
 
     # player 0's actors host; global index = rank * n_local + i drives the
@@ -496,11 +499,12 @@ def test_multiplayer_per_player_jobs_loopback(tmp_path):
         (w,) = rec["actor_wiring"]
         assert w["is_host"] is True and w["port"] == base + rank
         assert w["num_players"] == 2
-    # player 1's single controller joins game 0
+    # player 1's two actors join games 0 and 1 — one joiner per hosted game
     (rec1,) = dig1
     assert rec1["player_id"] == 1
-    (w1,) = rec1["actor_wiring"]
-    assert w1["is_host"] is False and w1["port"] == base
+    ports = [w["port"] for w in rec1["actor_wiring"]]
+    assert ports == [base, base + 1]
+    assert all(w["is_host"] is False for w in rec1["actor_wiring"])
 
     # per-player artifacts: player-keyed logs and checkpoints
     import os
