@@ -30,10 +30,14 @@ same file. The supervisor probes backend discovery in a SUBPROCESS with a
 bounded timeout and retries with backoff (a wedged remote-TPU tunnel makes
 `jax.devices()` HANG, not fail — observed rounds 1 and 3), then runs the
 measurement itself as a child with an overall deadline. On persistent
-backend failure it emits the last driver-grade measurement from
-BENCH_CACHE.json with an explicit "stale": true flag and exits 0, so a
+backend failure it prefers THIS RUN's partial results — the child
+checkpoints the matrix after every cell (emit_partial_or_stale, flagged
+"partial": true) — and only then the last driver-grade measurement from
+BENCH_CACHE.json with an explicit "stale": true flag; both exit 0, so a
 wedged tunnel at driver time degrades the artifact instead of losing the
-round's number. A fresh successful TPU measurement rewrites the cache.
+round's number (round 4: a mid-matrix wedge in an optional cell would
+otherwise have discarded nine fresh cells). A fresh successful TPU
+measurement rewrites the cache.
 
 Env knobs (used by tests/test_bench_diag.py):
   R2D2_BENCH_SMOKE=1                 tiny config, xla-decode spd=1 only
@@ -43,6 +47,8 @@ Env knobs (used by tests/test_bench_diag.py):
   R2D2_BENCH_PROBE_TIMEOUT / _ATTEMPTS / _BACKOFF   discovery retry schedule
   R2D2_BENCH_CHILD_TIMEOUT           overall measurement deadline (s)
   R2D2_BENCH_FORCE_CACHE=1           cache even non-TPU results (tests)
+  R2D2_BENCH_PARTIAL=path            mid-run cell snapshot (default: $TMPDIR)
+  R2D2_BENCH_SIMULATE_HANG=1         wedge after the base matrix (tests)
 """
 
 import dataclasses
@@ -287,6 +293,23 @@ def run_bench() -> None:
     flops_per_step = model_flops_per_step(cfg, action_dim, use_double)
     peak = peak_flops(devs[0].device_kind) if on_tpu else 0.0
 
+    # static context for assemble_output — computed up front so every
+    # checkpointed partial snapshot is self-contained
+    from r2d2_tpu.ops.pallas_kernels import resolve_pallas_setting
+    bf16_resolved = resolve_pallas_setting(cfg.network.bf16, "network.bf16")
+    s2d_default = resolve_pallas_setting(cfg.network.space_to_depth,
+                                         "network.space_to_depth")
+    ctx = {
+        "default_label": (f"{'bf16' if bf16_resolved else 'f32'}"
+                          f"_spd{cfg.runtime.resolved_steps_per_dispatch()}"
+                          f"{'_s2d' if s2d_default else ''}"),
+        "batch_size": spec.batch_size,
+        "flops_per_step": flops_per_step,
+        "peak": peak,
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+    }
+
     def build_step(use_pallas: bool, bf16: bool, spd: int, step_spec=None,
                    s2d: bool = False):
         opt = dataclasses.replace(
@@ -306,6 +329,44 @@ def run_bench() -> None:
         return make_multi_learner_step(net_b, step_spec, opt, use_double, spd)
 
     results = {}
+    matrix = {}
+    # R2D2_BENCH_SKIP: comma-separated substrings of optional-cell labels to
+    # skip — the rerun lever when one cell's compile wedges the tunnel
+    # (observed round 4: double_fused hung remote compile for >15 min)
+    skip = [s for s in os.environ.get("R2D2_BENCH_SKIP", "").split(",") if s]
+
+    def skipped(label):
+        if any(s in label for s in skip):
+            print(f"[{label}] skipped via R2D2_BENCH_SKIP", file=sys.stderr)
+            return True
+        return False
+
+    # pre-seed every planned cell as None so a mid-run wedge reports the
+    # never-reached cells in partial_missing instead of omitting them
+    # (a partial artifact must not read as a complete matrix)
+    if smoke:
+        planned = ["f32_spd1"]
+    else:
+        planned = ["f32_spd1", "f32_spd4", "f32_spd16",
+                   "bf16_spd1", "bf16_spd4", "bf16_spd16", "bf16_spd16_s2d",
+                   ("bf16_spd16_rowgather" if spec.exact_gather
+                    else "bf16_spd16_exactgather"),
+                   "bf16_spd16_nhwc", "bf16_spd16_plstm",
+                   "bf16_spd16_double", "bf16_spd16_double_fused"]
+    for label in planned:
+        matrix[label] = None
+
+    def checkpoint():
+        # after every cell: snapshot what's measured so far so a later
+        # wedge costs only the remaining cells (emit_partial_or_stale)
+        try:
+            tmp = _partial_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"results": results, "matrix": matrix, "ctx": ctx},
+                          f)
+            os.replace(tmp, _partial_path())
+        except OSError as e:
+            print(f"bench: partial checkpoint failed: {e}", file=sys.stderr)
 
     # --- 1. decode A/B at the base config (f32, spd=1) ------------------
     first = True
@@ -357,7 +418,7 @@ def run_bench() -> None:
         results["xla_gather"] = results["pallas_gather"] = None
 
     # --- 2. perf matrix {f32, bf16} x {steps_per_dispatch 1, 4, 16} -----
-    matrix = {}
+    checkpoint()
     combos = [(False, 1)] if smoke else [
         (False, 1), (False, 4), (False, 16),
         (True, 1), (True, 4), (True, 16)]
@@ -374,17 +435,25 @@ def run_bench() -> None:
             reused = (results["pallas_decode"] if default_pallas
                       else results["xla_decode"])
             matrix[label] = reused
+            checkpoint()
             print(f"[{label}] = {reused:.1f} seq/s (reused from part-1 A/B)",
                   file=sys.stderr)
             continue
         step = build_step(default_pallas, bf16, spd)
         sps, ts, rs = measure_path(step, ts, rs, label, steps_per_dispatch=spd)
         matrix[label] = sps * spec.batch_size
+        checkpoint()
         if peak:
             mfu = sps * flops_per_step / peak
             print(f"[{label}] ~{sps * flops_per_step / 1e12:.1f} TFLOP/s "
                   f"model flops = {100*mfu:.1f}% of {peak/1e12:.0f} TFLOP/s "
                   "bf16 peak", file=sys.stderr)
+
+    if os.environ.get("R2D2_BENCH_SIMULATE_HANG"):
+        # test hook (test_bench_diag): wedge AFTER the base matrix so the
+        # supervisor's partial fallback has cells to assemble
+        print("bench: simulated mid-run hang", file=sys.stderr, flush=True)
+        time.sleep(100_000)
 
     # --- 2b. space_to_depth A/B at the bf16_spd16 policy (the current
     # shipped TPU default; compare against that cell specifically) --------
@@ -394,7 +463,7 @@ def run_bench() -> None:
     # ('off'/'on'); this cell measures what flipping it would buy so the
     # default can follow measurement (params differ, so this uses a fresh
     # train state — the throughput comparison is unaffected).
-    if on_tpu and not smoke:
+    if on_tpu and not smoke and not skipped("bf16_spd16_s2d"):
         try:
             from r2d2_tpu.models import NetworkApply
             opt_default = dataclasses.replace(
@@ -419,16 +488,22 @@ def run_bench() -> None:
                   file=sys.stderr)
     else:
         matrix["bf16_spd16_s2d"] = None
+    checkpoint()
 
     # --- 2b2. exact-read pad-gather A/B at the bf16_spd16 policy ---------
-    # replay.pallas_exact_gather pads stored H (84->96) and DMAs only each
-    # sampled window (async copy) instead of the whole ring row (~7x read
-    # amplification). Storage layout changes with the flag, so this cell
-    # builds its own padded replay. A Mosaic rejection here is the
-    # documented dead end (PERF.md); a win flips the default.
-    if on_tpu and not smoke:
+    # replay.pallas_exact_gather pads stored frames (84x84 -> 96x128) and
+    # DMAs only each sampled window (async copy) instead of the whole ring
+    # row (~7.7x read amplification). It measured +4.2% and is now the TPU
+    # default ("auto", BENCH r4) — so this cell measures the OTHER side
+    # (exact_gather forced to the opposite of the default spec), keeping
+    # the A/B in every artifact in case a chip generation shifts it.
+    # Storage layout changes with the flag, so this cell builds its own
+    # replay.
+    spec_pad = dataclasses.replace(spec, exact_gather=not spec.exact_gather)
+    ab_label = ("bf16_spd16_exactgather" if spec_pad.exact_gather
+                else "bf16_spd16_rowgather")
+    if on_tpu and not smoke and not skipped(ab_label):
         try:
-            spec_pad = dataclasses.replace(spec, exact_gather=True)
             rs_pad = replay_init(spec_pad)
             rng_pad = np.random.default_rng(0)
             for _ in range(spec_pad.num_blocks):
@@ -438,24 +513,25 @@ def run_bench() -> None:
             step = build_step(default_pallas, bf16=True, spd=16,
                               step_spec=spec_pad)
             ts_pg = create_train_state(jax.random.PRNGKey(1), net, cfg.optim)
-            sps, _tspg, rs_pad = measure_path(step, ts_pg, rs_pad,
-                                              "bf16_spd16_exactgather",
+            sps, _tspg, rs_pad = measure_path(step, ts_pg, rs_pad, ab_label,
                                               steps_per_dispatch=16)
-            matrix["bf16_spd16_exactgather"] = sps * spec.batch_size
+            matrix[ab_label] = sps * spec.batch_size
             del rs_pad
         except Exception as e:   # never kill the bench for the extra cell
-            matrix["bf16_spd16_exactgather"] = None
-            print(f"[bf16_spd16_exactgather] FAILED: {type(e).__name__}: {e}",
+            matrix[ab_label] = None
+            print(f"[{ab_label}] FAILED: {type(e).__name__}: {e}",
                   file=sys.stderr)
     else:
-        matrix["bf16_spd16_exactgather"] = None
+        matrix[ab_label] = None
+    checkpoint()
 
     # --- 2b3. NHWC-decode A/B at the bf16_spd16 policy -------------------
     # optim.pallas_decode_layout="nhwc" folds the post-decode layout
     # transpose (the ~1.6 ms/step HBM copy in the round-3 profile) into
     # the kernel's in-register relayout. Win -> flip the default; Mosaic
     # rejection -> documented dead end.
-    if on_tpu and not smoke and default_pallas:
+    if (on_tpu and not smoke and default_pallas
+            and not skipped("bf16_spd16_nhwc")):
         try:
             opt_nhwc = dataclasses.replace(
                 cfg.optim, pallas_obs_decode="on",
@@ -477,6 +553,39 @@ def run_bench() -> None:
                   file=sys.stderr)
     else:
         matrix["bf16_spd16_nhwc"] = None
+    checkpoint()
+
+    # --- 2b4. fused-pallas-LSTM A/B at the bf16_spd16 policy -------------
+    # network.pallas_lstm runs the 55-step recurrent chain as ONE pallas
+    # kernel (Wh VMEM-resident, f32 scratch carries, custom-VJP backward —
+    # ops/pallas_lstm.py) instead of a lax.scan while-loop, attacking the
+    # profiled per-iteration overhead on the serial chain. Win -> flip the
+    # default; Mosaic rejection -> documented dead end.
+    if (on_tpu and not smoke and default_pallas
+            and not skipped("bf16_spd16_plstm")):
+        try:
+            opt_default = dataclasses.replace(
+                cfg.optim, pallas_obs_decode="on")
+            from r2d2_tpu.models import NetworkApply
+            net_pl = NetworkApply(
+                action_dim, dataclasses.replace(cfg.network, bf16=True,
+                                                pallas_lstm="on"),
+                cfg.env.frame_stack, cfg.env.frame_height,
+                cfg.env.frame_width)
+            ts_pl = create_train_state(jax.random.PRNGKey(1), net_pl,
+                                       cfg.optim)
+            step = make_multi_learner_step(net_pl, spec, opt_default,
+                                           use_double, 16)
+            sps, _tspl, rs = measure_path(step, ts_pl, rs, "bf16_spd16_plstm",
+                                          steps_per_dispatch=16)
+            matrix["bf16_spd16_plstm"] = sps * spec.batch_size
+        except Exception as e:   # never kill the bench for the extra cell
+            matrix["bf16_spd16_plstm"] = None
+            print(f"[bf16_spd16_plstm] FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    else:
+        matrix["bf16_spd16_plstm"] = None
+    checkpoint()
 
     # --- 2c. double-DQN unroll-fusion A/B at the bf16_spd16 policy -------
     # use_double=True pays a SECOND 55-step recurrent unroll; sequential
@@ -489,6 +598,9 @@ def run_bench() -> None:
         from r2d2_tpu.models import NetworkApply
         for label, fused in (("bf16_spd16_double", "off"),
                              ("bf16_spd16_double_fused", "on")):
+            if skipped(label):
+                matrix[label] = None
+                continue
             try:
                 opt_d = dataclasses.replace(
                     cfg.optim,
@@ -524,46 +636,10 @@ def run_bench() -> None:
     # measured_config always describe the same configuration. The full
     # matrix is attached so the defaults can be re-validated against the
     # measurements each round. matrix['f32_spd1'] is always populated (a
-    # failed base measurement exits in part 1), so the max is never empty.
-    from r2d2_tpu.ops.pallas_kernels import resolve_pallas_setting
-    bf16_resolved = resolve_pallas_setting(cfg.network.bf16, "network.bf16")
-    s2d_default = resolve_pallas_setting(cfg.network.space_to_depth,
-                                         "network.space_to_depth")
-    default_label = (f"{'bf16' if bf16_resolved else 'f32'}"
-                     f"_spd{cfg.runtime.resolved_steps_per_dispatch()}"
-                     f"{'_s2d' if s2d_default else ''}")
-    # _double cells are a different workload (a second unroll's FLOPs) —
-    # comparable to each other, not to the default config's cells
-    best_label = max((k for k, v in matrix.items()
-                      if v is not None and "_double" not in k),
-                     key=lambda k: matrix[k])
-    measured_label = (default_label if matrix.get(default_label) is not None
-                      else best_label)
-    seq_updates = matrix[measured_label]
-    out = {
-        "metric": "learner_sequence_updates_per_sec_per_chip",
-        "value": round(seq_updates, 1),
-        "unit": "sequences/s",
-        "vs_baseline": round(seq_updates / REFERENCE_SEQ_UPDATES_PER_SEC, 2),
-        "measured_config": measured_label,
-        "default_config": default_label,
-        "best_config": best_label,
-        "xla_decode": results["xla_decode"] and round(results["xla_decode"], 1),
-        "pallas_decode": (results["pallas_decode"]
-                          and round(results["pallas_decode"], 1)),
-        "xla_gather": results["xla_gather"] and round(results["xla_gather"], 1),
-        "pallas_gather": (results["pallas_gather"]
-                          and round(results["pallas_gather"], 1)),
-        "matrix": {k: v and round(v, 1) for k, v in matrix.items()},
-        "platform": devs[0].platform,
-        "device_kind": devs[0].device_kind,
-    }
-    if peak:
-        steps_per_sec = seq_updates / spec.batch_size
-        out["model_tflops_per_sec"] = round(steps_per_sec * flops_per_step / 1e12, 1)
-        out["mfu_vs_bf16_peak"] = round(
-            steps_per_sec * flops_per_step / peak, 4)
-    print(json.dumps(out))
+    # failed base measurement exits in part 1), so assemble_output never
+    # returns None here. Assembly is shared with the supervisor's
+    # partial-results fallback (assemble_output).
+    print(json.dumps(assemble_output(results, matrix, ctx)))
 
 
 # The probe must route any JAX_PLATFORMS request through jax.config BEFORE
@@ -624,6 +700,103 @@ def _cache_path() -> str:
                      "BENCH_CACHE.json"))
 
 
+def _partial_path() -> str:
+    import tempfile
+    return os.environ.get(
+        "R2D2_BENCH_PARTIAL",
+        os.path.join(tempfile.gettempdir(), "r2d2_bench_partial.json"))
+
+
+def _write_cache(result: dict) -> None:
+    tmp = _cache_path() + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime()),
+                   "output": result}, f, indent=1)
+    os.replace(tmp, _cache_path())
+    print(f"bench: cached last-good measurement to {_cache_path()}",
+          file=sys.stderr)
+
+
+def assemble_output(results: dict, matrix: dict, ctx: dict):
+    """Build the final JSON dict from measured cells + static context.
+    Shared by the measurement child (full run) and the supervisor's
+    partial-results fallback (emit_partial_or_stale), so a wedge in a LATE
+    cell cannot discard the cells already measured this run. Returns None
+    when no comparable cell exists yet."""
+    candidates = {k: v for k, v in matrix.items()
+                  if v is not None and "_double" not in k}
+    if not candidates:
+        return None
+    # _double cells are a different workload (a second unroll's FLOPs) —
+    # comparable to each other, not to the default config's cells
+    best_label = max(candidates, key=candidates.get)
+    default_label = ctx["default_label"]
+    measured_label = (default_label if matrix.get(default_label) is not None
+                      else best_label)
+    seq_updates = matrix[measured_label]
+
+    def _r(key):
+        v = results.get(key)
+        return v and round(v, 1)
+
+    out = {
+        "metric": "learner_sequence_updates_per_sec_per_chip",
+        "value": round(seq_updates, 1),
+        "unit": "sequences/s",
+        "vs_baseline": round(seq_updates / REFERENCE_SEQ_UPDATES_PER_SEC, 2),
+        "measured_config": measured_label,
+        "default_config": default_label,
+        "best_config": best_label,
+        "xla_decode": _r("xla_decode"),
+        "pallas_decode": _r("pallas_decode"),
+        "xla_gather": _r("xla_gather"),
+        "pallas_gather": _r("pallas_gather"),
+        "matrix": {k: v and round(v, 1) for k, v in matrix.items()},
+        "platform": ctx["platform"],
+        "device_kind": ctx["device_kind"],
+    }
+    if ctx.get("peak"):
+        steps_per_sec = seq_updates / ctx["batch_size"]
+        out["model_tflops_per_sec"] = round(
+            steps_per_sec * ctx["flops_per_step"] / 1e12, 1)
+        out["mfu_vs_bf16_peak"] = round(
+            steps_per_sec * ctx["flops_per_step"] / ctx["peak"], 4)
+    return out
+
+
+def emit_partial_or_stale(reason: str) -> None:
+    """A mid-run wedge loses the rest of the matrix, not the cells already
+    measured: prefer THIS RUN's checkpointed partial results over the
+    previous run's cache; fall back to the stale cache (or rc=1) only when
+    nothing measurable was checkpointed."""
+    try:
+        with open(_partial_path()) as f:
+            snap = json.load(f)
+        out = assemble_output(snap["results"], snap["matrix"], snap["ctx"])
+    except (OSError, ValueError, KeyError):
+        out = None
+    if out is None:
+        emit_stale_or_die(reason)
+        return
+    out["partial"] = True
+    out["partial_reason"] = reason
+    out["partial_missing"] = sorted(
+        k for k, v in snap["matrix"].items() if v is None)
+    print("bench: emitting PARTIAL fresh measurement "
+          f"(missing cells: {out['partial_missing']}) because: {reason}",
+          file=sys.stderr)
+    # fresh headline-grade numbers beat an older full run as the next
+    # fallback; a partial missing the default cell does not
+    cacheable = (out["platform"] == "tpu"
+                 and out["measured_config"] == out["default_config"]
+                 and not os.environ.get("R2D2_BENCH_SMOKE"))
+    if cacheable or os.environ.get("R2D2_BENCH_FORCE_CACHE"):
+        _write_cache(out)
+    print(json.dumps(out))
+    sys.exit(0)
+
+
 def emit_stale_or_die(reason: str) -> None:
     """Persistent backend failure: emit the last-good cached measurement
     flagged stale (rc=0) so the round keeps a number, else rc=1."""
@@ -668,8 +841,8 @@ def supervise() -> None:
     def _on_term(signum, frame):
         if active["proc"] is not None:
             _terminate(active["proc"])
-        emit_stale_or_die(f"supervisor received signal {signum} "
-                          "(driver timeout?) — children unwound")
+        emit_partial_or_stale(f"supervisor received signal {signum} "
+                              "(driver timeout?) — children unwound")
     prev_term = signal.signal(signal.SIGTERM, _on_term)
 
     def _echo(out: str) -> None:
@@ -692,6 +865,10 @@ def supervise() -> None:
                 f"{probe_timeout:.0f}s each) — remote-TPU tunnel wedged")
         active["proc"] = None
 
+        try:                      # drop any previous run's partial snapshot
+            os.unlink(_partial_path())
+        except OSError:
+            pass
         env = dict(os.environ, R2D2_BENCH_CHILD="1")
         proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                                 env=env, stdout=subprocess.PIPE, text=True)
@@ -700,7 +877,7 @@ def supervise() -> None:
             out, _ = proc.communicate(timeout=child_timeout)
         except subprocess.TimeoutExpired:
             _terminate(proc)
-            emit_stale_or_die(
+            emit_partial_or_stale(
                 f"measurement exceeded the {child_timeout:.0f}s deadline "
                 "(backend likely wedged mid-run)")
         active["proc"] = None
@@ -710,7 +887,7 @@ def supervise() -> None:
     if proc.returncode != 0:
         _echo(out)
         if proc.returncode == BACKEND_FAILURE_RC or proc.returncode < 0:
-            emit_stale_or_die(
+            emit_partial_or_stale(
                 f"measurement child exited rc={proc.returncode} "
                 "(diagnosed backend failure — diagnostics above)")
         print(f"bench: measurement child CRASHED rc={proc.returncode} — a "
@@ -731,14 +908,11 @@ def supervise() -> None:
                  and not os.environ.get("R2D2_BENCH_SMOKE")) or \
         bool(os.environ.get("R2D2_BENCH_FORCE_CACHE"))
     if cacheable:
-        tmp = _cache_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                                    time.gmtime()),
-                       "output": result}, f, indent=1)
-        os.replace(tmp, _cache_path())
-        print(f"bench: cached last-good measurement to {_cache_path()}",
-              file=sys.stderr)
+        _write_cache(result)
+    try:                          # completed run: the snapshot is obsolete
+        os.unlink(_partial_path())
+    except OSError:
+        pass
     print(json.dumps(result))
 
 

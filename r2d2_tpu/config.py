@@ -87,6 +87,14 @@ class NetworkConfig:
     # heterogeneous hosts (TPU learner vs CPU actors/eval). Checkpoints
     # are per-setting. Default off pending TPU measurement — see PERF.md.
     space_to_depth: str = "off"
+    # Run the LSTM time scan as ONE fused pallas kernel (ops/pallas_lstm.py):
+    # Wh resident in VMEM across all T steps, h/c carried in f32 scratch,
+    # custom-VJP backward kernel — attacks the per-iteration while-loop
+    # overhead on the serial recurrent chain (the profiled wall, PERF.md).
+    # Tri-state like the sibling pallas knobs; compute-only (no parameter
+    # layout change), tolerance-parity-tested vs the lax.scan path.
+    # Default "off" pending the TPU A/B (bench cell bf16_spd16_plstm).
+    pallas_lstm: str = "off"
 
 
 @dataclass(frozen=True)
@@ -119,13 +127,17 @@ class ReplayConfig:
     # (ops/pallas_kernels.py gather_rows_pallas): "on", "off", or "auto"
     # (pallas iff the backend is TPU — 2.6x the XLA gather there, BENCH_r03).
     pallas_sample_gather: str = "auto"
-    # EXACT-read window gather (device placement): pad the stored frame
-    # height to the uint8 tile multiple (84 -> 96) and DMA only each sampled
-    # window via async copy instead of the whole ring row (~7x read
-    # amplification at the reference shape). "on"/"off" — default off
-    # pending the TPU A/B (bench.py measures a pad-gather cell). Requires
-    # pallas_sample_gather; the stored obs layout changes with it.
-    pallas_exact_gather: str = "off"
+    # EXACT-read window gather (device placement): pad the stored frame to
+    # the uint8 tile (84x84 -> 96x128) and DMA only each sampled window via
+    # async copy instead of the whole ring row (7.7x read amplification at
+    # the reference shape -> 1.74x). Measured WINNER on v5e: +4.2% on the
+    # full fused step (90.7 vs 87.0 steps/s, BENCH r4) — hence "auto"
+    # (= on iff TPU, like the sibling knobs). THE TRADE: storage also grows
+    # 1.74x (5.7 vs 3.3 GiB obs ring at the default 500k capacity), so a
+    # ring sized near the HBM limit (~>1M frames on a 16 GiB chip) can OOM
+    # at replay_init — set "off" there and keep the row-gather's 2.6x win.
+    # Requires pallas_sample_gather; the stored obs layout changes with it.
+    pallas_exact_gather: str = "auto"
     # Reverb-style rate limiter: pause block ingestion (back-pressuring
     # actors through the bounded feeder queue) once
     # env_steps > learning_starts + ratio * train_steps. Pins the

@@ -207,6 +207,12 @@ class HoistedLSTM(nn.Module):
     # lax.scan unroll factor: >1 trades compile time/code size for fewer
     # loop-iteration boundaries on the serial chain (NetworkConfig.scan_unroll)
     unroll: int = 1
+    # Fused pallas time-scan (ops/pallas_lstm.py) instead of lax.scan —
+    # NetworkConfig.pallas_lstm, resolved. Identical math (the kernel folds
+    # bias into the hoisted projection; tolerance-parity-tested).
+    use_pallas: bool = False
+    # interpret-mode flag for the pallas path (CPU test mesh only)
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, carry, xs):
@@ -219,6 +225,16 @@ class HoistedLSTM(nn.Module):
         bias = self.param("bias", nn.initializers.zeros, (4 * hidden,))
         w_rec = w_rec.astype(self.dtype)
         bias = bias.astype(self.dtype)
+
+        if self.use_pallas and xs.shape[1] > 1:
+            from r2d2_tpu.ops.pallas_lstm import lstm_scan_pallas
+            # T=1 (the actor's step) stays on the scan path: a one-step
+            # kernel dispatch has nothing to fuse.
+            xpb = (x_proj + bias).swapaxes(0, 1)              # (T, B, 4H)
+            hseq, (c_fin, h_fin) = lstm_scan_pallas(
+                xpb, w_rec, carry[0], carry[1],
+                interpret=self.pallas_interpret)
+            return (c_fin, h_fin), hseq.swapaxes(0, 1)
 
         def step(carry, xp):                                  # xp: (B, 4H)
             new_c, new_h = lstm_cell_step(xp, carry[0], carry[1], w_rec, bias)
@@ -278,8 +294,12 @@ class R2D2Network(nn.Module):
 
         # Time-batched LSTM with the input projection hoisted out of the
         # scan (ref model.py:33 — torch nn.LSTM batch_first).
+        from r2d2_tpu.ops.pallas_kernels import resolve_pallas_setting
         cell = HoistedLSTM(features=cfg.hidden_dim, dtype=dtype,
-                           unroll=cfg.scan_unroll, name="lstm")
+                           unroll=cfg.scan_unroll,
+                           use_pallas=resolve_pallas_setting(
+                               cfg.pallas_lstm, "network.pallas_lstm"),
+                           name="lstm")
         carry = unpack_hidden(hidden.astype(dtype))
         carry, outputs = cell(carry, rnn_in)
 

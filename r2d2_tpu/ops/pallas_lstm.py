@@ -1,0 +1,323 @@
+"""Fused Pallas LSTM time-scan — the whole recurrent chain in ONE kernel.
+
+Why: the profiled wall of the learner step is the 55-step serial LSTM
+chain (PERF.md "Known remaining headroom"). Under `lax.scan` each step is
+a separate XLA while-loop iteration: the (B, H) x (H, 4H) recurrent
+matmul plus its gate math pay a loop-boundary's worth of overhead —
+fusion breaks, carry round-trips, and the slice-start DMAs staging the
+hoisted input projection (the ~2.1 ms span family in the captured
+round-3 profile) — every iteration, ~165 times per train step (online
+fwd + bwd + target fwd). Measured: ~72 us per iteration against ~10 us
+of arithmetic.
+
+This kernel runs the scan as a single Pallas grid over T:
+
+* `Wh` is DMA'd into VMEM once (constant index map → revisiting
+  optimization) and stays resident for all T steps.
+* `h`/`c` live in f32 VMEM scratch across grid iterations — the carry
+  never round-trips HBM.
+* The per-step input projection block streams in, and the outputs
+  (h sequence + saved activations for the backward pass) stream out,
+  through Pallas's pipelined DMA — overlapping with the matmul instead
+  of serializing as while-loop boundary copies.
+
+The backward pass is a second kernel running the grid in REVERSE
+(index maps `i -> T-1-i`), carrying `dh`/`dc` in scratch and
+accumulating `dWh` in a revisited f32 output block; both wrapped in
+`jax.custom_vjp`. Saved residuals are the post-activation gates and the
+c sequence (streamed out by the forward kernel) — no recomputation
+matmul in the backward step, matching XLA autodiff's op count.
+
+Numerics: the matmul feeds the MXU in the compute dtype with f32
+accumulation; gate math and carries are f32 throughout, rounding once
+into the storage dtype per step — at least as accurate as the
+`lax.scan` path, which carries bf16 under the bf16 policy (tolerance-
+and loss-parity-tested like the bf16 policy itself).
+
+Replaces the serial-chain half of the reference's cuDNN `nn.LSTM`
+(/root/reference/model.py:33); the input projection half is already
+hoisted into one big MXU matmul by `models/network.py HoistedLSTM`.
+Gated by `network.pallas_lstm` (tri-state, default "off" until the TPU
+A/B lands — bench cell `bf16_spd16_plstm`).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def lstm_scan_reference(xpb: jnp.ndarray, wh: jnp.ndarray,
+                        c0: jnp.ndarray, h0: jnp.ndarray):
+    """jnp twin (lax.scan) — the test oracle and non-TPU fallback.
+
+    ``xpb``: (T, B, 4H) input projection WITH bias already folded in;
+    ``wh``: (H, 4H); ``c0``/``h0``: (B, H). Gate order i, f, g, o —
+    identical to models/network.py lstm_cell_step.
+    Returns (h_seq (T, B, H), (c_fin, h_fin)).
+    """
+
+    def step(carry, xp):
+        c, h = carry
+        gates = xp + h @ wh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (c, h), h
+
+    (c, h), hs = jax.lax.scan(step, (c0, h0), xpb)
+    return hs, (c, h)
+
+
+def _cell_math(hidden: int, xpb_ref, wh_ref, h_s, c_s):
+    """One LSTM step on the f32 VMEM carries; returns the gate activations
+    and new carries (all f32 registers). Shared by the residual-saving and
+    lean forward kernels so they cannot diverge."""
+    cd = wh_ref.dtype
+    gates = xpb_ref[0].astype(jnp.float32) + jax.lax.dot_general(
+        h_s[:].astype(cd), wh_ref[:],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    i_g = jax.nn.sigmoid(gates[:, :hidden])
+    f_g = jax.nn.sigmoid(gates[:, hidden:2 * hidden])
+    g_g = jnp.tanh(gates[:, 2 * hidden:3 * hidden])
+    o_g = jax.nn.sigmoid(gates[:, 3 * hidden:])
+    c_new = f_g * c_s[:] + i_g * g_g
+    h_new = o_g * jnp.tanh(c_new)
+    c_s[:] = c_new
+    h_s[:] = h_new
+    return i_g, f_g, g_g, o_g, c_new, h_new
+
+
+def _fwd_kernel(hidden: int, xpb_ref, wh_ref, c0_ref, h0_ref,
+                hseq_ref, cseq_ref, acts_ref, h_s, c_s):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0_ref[:].astype(jnp.float32)
+        c_s[:] = c0_ref[:].astype(jnp.float32)
+
+    i_g, f_g, g_g, o_g, c_new, h_new = _cell_math(
+        hidden, xpb_ref, wh_ref, h_s, c_s)
+    out_dtype = hseq_ref.dtype
+    hseq_ref[0] = h_new.astype(out_dtype)
+    cseq_ref[0] = c_new.astype(out_dtype)
+    acts_ref[0] = jnp.concatenate([i_g, f_g, g_g, o_g],
+                                  axis=1).astype(out_dtype)
+
+
+def _fwd_kernel_lean(hidden: int, nsteps: int, xpb_ref, wh_ref, c0_ref,
+                     h0_ref, hseq_ref, cfin_ref, h_s, c_s):
+    # forward-only variant: no backward residuals — the target-network
+    # unrolls (and any other non-differentiated call) must not pay the
+    # (T, B, 5H) HBM write traffic of cseq + acts they will never read
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0_ref[:].astype(jnp.float32)
+        c_s[:] = c0_ref[:].astype(jnp.float32)
+
+    _, _, _, _, c_new, h_new = _cell_math(hidden, xpb_ref, wh_ref, h_s, c_s)
+    hseq_ref[0] = h_new.astype(hseq_ref.dtype)
+
+    @pl.when(t == nsteps - 1)
+    def _():
+        cfin_ref[:] = c_new.astype(cfin_ref.dtype)
+
+
+def _fwd_call(xpb, wh, c0, h0, interpret, save_residuals=True):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nsteps, batch, gdim = xpb.shape
+    hidden = gdim // 4
+    dtype = xpb.dtype
+    if save_residuals:
+        kernel = functools.partial(_fwd_kernel, hidden)
+        out_specs = [
+            pl.BlockSpec((1, batch, hidden), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, batch, hidden), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, batch, gdim), lambda t: (t, 0, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((nsteps, batch, hidden), dtype),
+            jax.ShapeDtypeStruct((nsteps, batch, hidden), dtype),
+            jax.ShapeDtypeStruct((nsteps, batch, gdim), dtype),
+        ]
+    else:
+        kernel = functools.partial(_fwd_kernel_lean, hidden, nsteps)
+        out_specs = [
+            pl.BlockSpec((1, batch, hidden), lambda t: (t, 0, 0)),
+            pl.BlockSpec((batch, hidden), lambda t: (0, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((nsteps, batch, hidden), dtype),
+            jax.ShapeDtypeStruct((batch, hidden), dtype),
+        ]
+    return pl.pallas_call(
+        kernel,
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((1, batch, gdim), lambda t: (t, 0, 0)),
+            pl.BlockSpec((hidden, gdim), lambda t: (0, 0)),
+            pl.BlockSpec((batch, hidden), lambda t: (0, 0)),
+            pl.BlockSpec((batch, hidden), lambda t: (0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((batch, hidden), jnp.float32),
+            pltpu.VMEM((batch, hidden), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xpb, wh, c0, h0)
+
+
+def _bwd_kernel(hidden: int, nsteps: int,
+                dhseq_ref, acts_ref, cseq_ref, cprev_ref, hprev_ref,
+                wht_ref, c0_ref, h0_ref, dcfin_ref, dhfin_ref,
+                dxpb_ref, dwh_ref, dc0_ref, dh0_ref, dh_s, dc_s):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    t = nsteps - 1 - i
+
+    @pl.when(i == 0)
+    def _():
+        dh_s[:] = dhfin_ref[:].astype(jnp.float32)
+        dc_s[:] = dcfin_ref[:].astype(jnp.float32)
+        dwh_ref[:] = jnp.zeros_like(dwh_ref)
+
+    acts = acts_ref[0].astype(jnp.float32)
+    i_g = acts[:, :hidden]
+    f_g = acts[:, hidden:2 * hidden]
+    g_g = acts[:, 2 * hidden:3 * hidden]
+    o_g = acts[:, 3 * hidden:]
+    # at t == 0 the t-1 blocks are clamped re-reads of t == 0; select the
+    # initial carries instead (both operands resident in VMEM).
+    first = t == 0
+    c_prev = jnp.where(first, c0_ref[:].astype(jnp.float32),
+                       cprev_ref[0].astype(jnp.float32))
+    h_prev = jnp.where(first, h0_ref[:].astype(jnp.float32),
+                       hprev_ref[0].astype(jnp.float32))
+
+    dh_total = dhseq_ref[0].astype(jnp.float32) + dh_s[:]
+    tanh_c = jnp.tanh(cseq_ref[0].astype(jnp.float32))
+    do = dh_total * tanh_c
+    dc = dc_s[:] + dh_total * o_g * (1.0 - tanh_c * tanh_c)
+    di = dc * g_g
+    dg = dc * i_g
+    df = dc * c_prev
+    # pre-activation gate grads (sigmoid' = s(1-s); tanh' = 1-t^2)
+    dgates = jnp.concatenate([
+        di * i_g * (1.0 - i_g),
+        df * f_g * (1.0 - f_g),
+        dg * (1.0 - g_g * g_g),
+        do * o_g * (1.0 - o_g),
+    ], axis=1)                                            # (B, 4H) f32
+    dxpb_ref[0] = dgates.astype(dxpb_ref.dtype)
+
+    cd = wht_ref.dtype
+    dg_cd = dgates.astype(cd)
+    dh_s[:] = jax.lax.dot_general(
+        dg_cd, wht_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # transpose in f32 (32-bit sublane/lane transpose is the supported
+    # Mosaic path on v5e), cast to the MXU dtype after
+    dwh_ref[:] += jax.lax.dot_general(
+        h_prev.T.astype(cd), dg_cd, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dc_s[:] = dc * f_g
+
+    @pl.when(i == nsteps - 1)
+    def _():
+        # after the t == 0 update, the scratches hold d h_{-1} / d c_{-1}
+        dh0_ref[:] = dh_s[:]
+        dc0_ref[:] = dc_s[:]
+
+
+def _bwd_call(wh, c0, h0, hseq, cseq, acts, dhseq, dcfin, dhfin, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nsteps, batch, gdim = acts.shape
+    hidden = gdim // 4
+    wht = wh.T                                            # (4H, H)
+
+    def rev(t_idx):
+        return lambda i: (t_idx(i), 0, 0)
+
+    last = nsteps - 1
+    prev = lambda i: jnp.maximum(last - 1 - i, 0)
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, hidden, nsteps),
+        grid=(nsteps,),
+        in_specs=[
+            pl.BlockSpec((1, batch, hidden), rev(lambda i: last - i)),   # dhseq
+            pl.BlockSpec((1, batch, gdim), rev(lambda i: last - i)),     # acts
+            pl.BlockSpec((1, batch, hidden), rev(lambda i: last - i)),   # c_t
+            pl.BlockSpec((1, batch, hidden), rev(prev)),                 # c_{t-1}
+            pl.BlockSpec((1, batch, hidden), rev(prev)),                 # h_{t-1}
+            pl.BlockSpec((gdim, hidden), lambda i: (0, 0)),              # Wh^T
+            pl.BlockSpec((batch, hidden), lambda i: (0, 0)),             # c0
+            pl.BlockSpec((batch, hidden), lambda i: (0, 0)),             # h0
+            pl.BlockSpec((batch, hidden), lambda i: (0, 0)),             # dc_fin
+            pl.BlockSpec((batch, hidden), lambda i: (0, 0)),             # dh_fin
+        ],
+        out_specs=[
+            pl.BlockSpec((1, batch, gdim), rev(lambda i: last - i)),     # dxpb
+            pl.BlockSpec((hidden, gdim), lambda i: (0, 0)),              # dWh
+            pl.BlockSpec((batch, hidden), lambda i: (0, 0)),             # dc0
+            pl.BlockSpec((batch, hidden), lambda i: (0, 0)),             # dh0
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nsteps, batch, gdim), dhseq.dtype),
+            jax.ShapeDtypeStruct((hidden, gdim), jnp.float32),
+            jax.ShapeDtypeStruct((batch, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((batch, hidden), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((batch, hidden), jnp.float32),
+            pltpu.VMEM((batch, hidden), jnp.float32),
+        ],
+        interpret=interpret,
+    )(dhseq, acts, cseq, cseq, hseq, wht, c0, h0, dcfin, dhfin)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _lstm_scan(interpret, xpb, wh, c0, h0):
+    # the NON-differentiated path (target-network unrolls): lean kernel,
+    # no residual traffic. Under jax.grad, _lstm_scan_fwd runs instead.
+    hseq, cfin = _fwd_call(xpb, wh, c0, h0, interpret, save_residuals=False)
+    return hseq, (cfin, hseq[-1])
+
+
+def _lstm_scan_fwd(interpret, xpb, wh, c0, h0):
+    hseq, cseq, acts = _fwd_call(xpb, wh, c0, h0, interpret)
+    out = (hseq, (cseq[-1], hseq[-1]))
+    return out, (wh, c0, h0, hseq, cseq, acts)
+
+
+def _lstm_scan_bwd(interpret, res, cts):
+    wh, c0, h0, hseq, cseq, acts = res
+    dhseq, (dcfin, dhfin) = cts
+    dxpb, dwh, dc0, dh0 = _bwd_call(
+        wh, c0, h0, hseq, cseq, acts, dhseq, dcfin, dhfin, interpret)
+    return (dxpb, dwh.astype(wh.dtype), dc0.astype(c0.dtype),
+            dh0.astype(h0.dtype))
+
+
+_lstm_scan.defvjp(_lstm_scan_fwd, _lstm_scan_bwd)
+
+
+def lstm_scan_pallas(xpb: jnp.ndarray, wh: jnp.ndarray, c0: jnp.ndarray,
+                     h0: jnp.ndarray, interpret: bool = False):
+    """Fused-kernel LSTM scan (differentiable). Same signature/returns as
+    ``lstm_scan_reference``; ``interpret=True`` runs both kernels on any
+    backend (the CPU test mesh)."""
+    return _lstm_scan(interpret, xpb, wh, c0, h0)

@@ -40,9 +40,14 @@ FAKE_CACHE = {
 
 
 def _run_bench(extra_env, timeout=600):
+    import tempfile
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env.update({"JAX_PLATFORMS": "cpu", "R2D2_BENCH_SMOKE": "1",
-                "R2D2_BENCH_BACKOFF": "0"})
+                "R2D2_BENCH_BACKOFF": "0",
+                # isolate the partial-snapshot file from concurrent benches
+                "R2D2_BENCH_PARTIAL": os.path.join(
+                    tempfile.mkdtemp(prefix="bench_partial_"),
+                    "partial.json")})
     env.update(extra_env)
     return subprocess.run([sys.executable, BENCH], env=env,
                           capture_output=True, text=True, timeout=timeout)
@@ -134,7 +139,8 @@ def test_supervisor_sigterm_unwinds_child_and_emits_stale(tmp_path):
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env.update({"JAX_PLATFORMS": "cpu", "R2D2_BENCH_SMOKE": "1",
                 "R2D2_BENCH_BACKOFF": "0",
-                "R2D2_BENCH_CACHE": str(cache)})
+                "R2D2_BENCH_CACHE": str(cache),
+                "R2D2_BENCH_PARTIAL": str(tmp_path / "partial.json")})
     proc = subprocess.Popen([sys.executable, BENCH], env=env,
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                             text=True)
@@ -163,3 +169,26 @@ def test_successful_run_records_cache(tmp_path):
     saved = json.loads(cache.read_text())
     assert saved["output"] == out
     assert saved["recorded_at"]
+
+
+def test_mid_run_wedge_emits_partial_results(tmp_path):
+    """A wedge AFTER cells have been measured must surface THIS run's
+    fresh partial results (flagged partial=true), not last round's stale
+    cache — a round-4 wedge in an optional late cell would otherwise have
+    discarded nine fresh cells."""
+    cache = tmp_path / "cache.json"
+    cache.write_text(json.dumps(FAKE_CACHE))
+    proc = _run_bench({"R2D2_BENCH_SIMULATE_HANG": "1",
+                       "R2D2_BENCH_CHILD_TIMEOUT": "120",
+                       "R2D2_BENCH_CACHE": str(cache),
+                       "R2D2_BENCH_PARTIAL": str(tmp_path / "partial.json")},
+                      timeout=300)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out.get("partial") is True
+    assert "deadline" in out["partial_reason"]
+    assert out["matrix"]["f32_spd1"] is not None      # the measured cell
+    assert out["value"] == out["matrix"][out["measured_config"]]
+    assert "stale" not in out                         # fresh, not cached
+    # smoke runs are not cache-worthy: the old cache must survive intact
+    assert json.loads(cache.read_text()) == FAKE_CACHE

@@ -221,3 +221,112 @@ def test_stack_frames_pallas_compiled_on_tpu():
     assert proc.returncode == 0, (
         f"compiled pallas check failed (rc={proc.returncode}):\n{proc.stderr[-4000:]}")
     assert out and out[-1] == "OK"
+
+
+# ---------------------------------------------------------------------------
+# Fused LSTM time-scan (ops/pallas_lstm.py)
+
+
+def _lstm_inputs(rng, T=7, B=8, H=128, dtype=jnp.float32):
+    xpb = jnp.asarray(rng.standard_normal((T, B, 4 * H)), dtype)
+    wh = jnp.asarray(rng.standard_normal((H, 4 * H)) * 0.1, dtype)
+    c0 = jnp.asarray(rng.standard_normal((B, H)), dtype)
+    h0 = jnp.asarray(rng.standard_normal((B, H)), dtype)
+    return xpb, wh, c0, h0
+
+
+def test_lstm_scan_pallas_forward_matches_reference(rng):
+    """f32 interpret-mode forward is bit-exact vs the lax.scan twin (the
+    kernel's f32 carry + f32 gate math reproduce the scan exactly when
+    nothing is rounded)."""
+    from r2d2_tpu.ops.pallas_lstm import (lstm_scan_pallas,
+                                          lstm_scan_reference)
+    args = _lstm_inputs(rng)
+    hs_r, (cf_r, hf_r) = lstm_scan_reference(*args)
+    hs_p, (cf_p, hf_p) = lstm_scan_pallas(*args, interpret=True)
+    np.testing.assert_array_equal(np.asarray(hs_p), np.asarray(hs_r))
+    np.testing.assert_array_equal(np.asarray(cf_p), np.asarray(cf_r))
+    np.testing.assert_array_equal(np.asarray(hf_p), np.asarray(hf_r))
+
+
+def test_lstm_scan_pallas_grads_match_reference(rng):
+    """custom-VJP backward kernel vs jax.grad of the scan twin, for every
+    input — including the final-carry cotangents (the loss reads c_fin and
+    h_fin so dcfin/dhfin are non-zero)."""
+    from r2d2_tpu.ops.pallas_lstm import (lstm_scan_pallas,
+                                          lstm_scan_reference)
+    args = _lstm_inputs(rng)
+    T, B, H = args[0].shape[0], args[0].shape[1], args[1].shape[0]
+    w = jnp.asarray(rng.standard_normal((T, B, H)), jnp.float32)
+
+    def loss(fn, args):
+        hs, (c, h) = fn(*args)
+        return jnp.sum(hs * w) + jnp.sum(c * 1.3) + jnp.sum(h * 0.7)
+
+    g_ref = jax.grad(lambda a: loss(lstm_scan_reference, a))(args)
+    g_pal = jax.grad(lambda a: loss(
+        lambda *a: lstm_scan_pallas(*a, interpret=True), a))(args)
+    for name, a, b in zip(("dxpb", "dwh", "dc0", "dh0"), g_ref, g_pal):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-6, rtol=2e-6, err_msg=name)
+
+
+def test_lstm_scan_pallas_unused_carry_grads(rng):
+    """When the loss ignores the final carry JAX feeds zero cotangents for
+    it; the kernel must still produce the right dxpb/dwh."""
+    from r2d2_tpu.ops.pallas_lstm import (lstm_scan_pallas,
+                                          lstm_scan_reference)
+    args = _lstm_inputs(rng, T=4, B=8, H=128)
+
+    def loss(fn, args):
+        hs, _ = fn(*args)
+        return jnp.sum(hs ** 2)
+
+    g_ref = jax.grad(lambda a: loss(lstm_scan_reference, a))(args)
+    g_pal = jax.grad(lambda a: loss(
+        lambda *a: lstm_scan_pallas(*a, interpret=True), a))(args)
+    for name, a, b in zip(("dxpb", "dwh", "dc0", "dh0"), g_ref, g_pal):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-6, rtol=2e-6, err_msg=name)
+
+
+def test_hoisted_lstm_pallas_path_matches_scan(rng):
+    """HoistedLSTM(use_pallas=True) plumbing — bias folding, axis swaps,
+    carry order — against the default scan path, same params. The bias
+    fold changes one f32 addition order, hence allclose not array_equal."""
+    from r2d2_tpu.models.network import HoistedLSTM
+    B, T, D, H = 4, 6, 48, 128
+    xs = jnp.asarray(rng.standard_normal((B, T, D)), jnp.float32)
+    carry = (jnp.asarray(rng.standard_normal((B, H)), jnp.float32),
+             jnp.asarray(rng.standard_normal((B, H)), jnp.float32))
+    scan_cell = HoistedLSTM(features=H)
+    params = scan_cell.init(jax.random.PRNGKey(0), carry, xs)
+    # make the bias nonzero so the fold is actually exercised
+    params = jax.tree_util.tree_map(lambda x: x, params)
+    params["params"]["bias"] = jnp.asarray(
+        rng.standard_normal((4 * H,)) * 0.1, jnp.float32)
+    (c_s, h_s), out_s = scan_cell.apply(params, carry, xs)
+    pallas_cell = HoistedLSTM(features=H, use_pallas=True,
+                              pallas_interpret=True)
+    (c_p, h_p), out_p = pallas_cell.apply(params, carry, xs)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_s),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(c_p), np.asarray(c_s),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_p), np.asarray(h_s),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_hoisted_lstm_pallas_single_step_falls_back(rng):
+    """T=1 (the actor's step shape) must stay on the scan path — the
+    pallas kernel is a sequence fusion, not a step dispatch."""
+    from r2d2_tpu.models.network import HoistedLSTM
+    B, D, H = 4, 48, 128
+    xs = jnp.asarray(rng.standard_normal((B, 1, D)), jnp.float32)
+    carry = (jnp.zeros((B, H)), jnp.zeros((B, H)))
+    cell = HoistedLSTM(features=H, use_pallas=True, pallas_interpret=False)
+    params = cell.init(jax.random.PRNGKey(0), carry, xs)
+    # pallas_interpret=False would fail to compile on CPU if the kernel
+    # were (wrongly) taken; succeeding proves the fallback
+    (_, _), out = cell.apply(params, carry, xs)
+    assert out.shape == (B, 1, H)

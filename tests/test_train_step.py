@@ -285,3 +285,49 @@ def test_bf16_and_double_compile(rng):
     step = make_learner_step(net16, spec, OPT, use_double=True)
     ts, rs, m = step(ts, rs)
     assert np.isfinite(float(m["loss"]))
+
+
+@pytest.mark.slow
+def test_pallas_lstm_loss_parity_with_scan(rng, monkeypatch):
+    """network.pallas_lstm numeric-safety gate (same contract as the bf16
+    gate above): from identical params and data, the fused-kernel LSTM
+    path's losses must track the lax.scan trajectory within tolerance
+    across parameter updates. Runs the kernel in interpret mode on the CPU
+    mesh (monkeypatched — the config knob itself resolves to the compiled
+    kernel, TPU-only)."""
+    import dataclasses
+
+    from r2d2_tpu.ops import pallas_lstm as pl_mod
+
+    real = pl_mod.lstm_scan_pallas
+    monkeypatch.setattr(
+        pl_mod, "lstm_scan_pallas",
+        lambda xpb, wh, c0, h0, interpret=False: real(xpb, wh, c0, h0,
+                                                      interpret=True))
+    spec = make_spec(batch_size=8)
+
+    def build(plstm: str):
+        cfg = NetworkConfig(hidden_dim=spec.hidden_dim, cnn_out_dim=16,
+                            pallas_lstm=plstm,
+                            conv_layers=((8, 4, 2), (16, 3, 1)))
+        return init_network(jax.random.PRNGKey(0), A, cfg,
+                            frame_stack=spec.frame_stack,
+                            frame_height=spec.frame_height,
+                            frame_width=spec.frame_width)[0]
+
+    losses = {}
+    for plstm in ("off", "on"):
+        net = build(plstm)
+        ts = create_train_state(jax.random.PRNGKey(1), net, OPT)
+        rs = _filled_replay(spec, np.random.default_rng(0))
+        step = make_learner_step(net, spec, OPT, use_double=False)
+        run = []
+        for _ in range(10):
+            ts, rs, m = step(ts, rs)
+            run.append(float(m["loss"]))
+        losses[plstm] = run
+
+    # f32 config: only the bias-fold addition order and matmul accumulation
+    # differ — the first step must agree tightly, the trajectory closely
+    assert losses["on"][0] == pytest.approx(losses["off"][0], rel=1e-4)
+    np.testing.assert_allclose(losses["on"], losses["off"], rtol=1e-2)
