@@ -1,0 +1,9 @@
+"""CLI alias: ``python -m r2d2_tpu.cli.chip_checks`` — see
+r2d2_tpu/tools/chip_checks.py (on-chip pallas kernel compile+parity gate)."""
+
+import sys
+
+from r2d2_tpu.tools.chip_checks import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,174 @@
+"""On-chip pallas kernel gate: compile + parity-check every kernel on the
+REAL Mosaic pipeline in one command.
+
+    python -m r2d2_tpu.cli.chip_checks            # all kernels
+    python -m r2d2_tpu.cli.chip_checks --only lstm
+
+Interpret-mode tests (the CPU suite) pin each kernel's semantics but
+cannot catch Mosaic lowering rejections — historically the dominant
+failure class (uint8->f32 cast, non-tile-aligned HBM slices, bf16
+minor-dim insertion, strided-store width: all discovered only on chip).
+This gate runs each kernel at a small but TILE-FAITHFUL shape (every
+constraint the production shape exercises — uint8 (32,128) storage
+tiles, 84x84 true frames under padded storage, bf16 compute — is
+preserved) and checks bit/tolerance parity against the jnp twin, so a
+lowering regression surfaces in minutes instead of mid-bench.
+
+Exit code: 0 = all pass, 1 = any FAIL (error text printed per kernel).
+"""
+
+import sys
+import time
+
+
+def _check(name, fn):
+    t0 = time.time()
+    try:
+        fn()
+    except Exception as e:  # noqa: BLE001 — report and continue
+        msg = str(e).splitlines()[0][:300] if str(e) else type(e).__name__
+        print(f"FAIL {name} ({time.time()-t0:.1f}s): {type(e).__name__}: "
+              f"{msg}")
+        return False
+    print(f"PASS {name} ({time.time()-t0:.1f}s)")
+    return True
+
+
+def run_chip_checks(only: str = "") -> int:
+    # route JAX_PLATFORMS through jax.config BEFORE backend discovery —
+    # the env var alone filters only after the (possibly wedged) axon
+    # plugin initializes, so a JAX_PLATFORMS=cpu invocation would still
+    # hang on a wedged tunnel (the exact failure this gate diagnoses)
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    print(f"backend: {devs[0].platform} ({devs[0].device_kind})")
+    if devs[0].platform == "cpu":
+        print("chip_checks needs an accelerator backend (pallas kernels "
+              "do not lower on CPU); the CPU suite's interpret-mode tests "
+              "cover semantics", file=sys.stderr)
+        return 2
+
+    rng = np.random.default_rng(0)
+    checks = []
+
+    def add(name, fn):
+        if only in name:
+            checks.append((name, fn))
+
+    # --- obs decode (stack_frames), standard + padded-storage strip ------
+    def decode():
+        from r2d2_tpu.ops.pallas_kernels import (stack_frames_pallas,
+                                                 stack_frames_reference)
+        obs = jnp.asarray(rng.integers(0, 255, (4, 60, 84, 84)), jnp.uint8)
+        for dtype in (jnp.float32, jnp.bfloat16):
+            got = stack_frames_pallas(obs, 55, 4, out_dtype=dtype)
+            want = stack_frames_reference(obs, 55, 4, out_dtype=dtype)
+            np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                          np.asarray(want, np.float32))
+    add("decode", decode)
+
+    def decode_padded():
+        from r2d2_tpu.ops.pallas_kernels import (stack_frames_pallas,
+                                                 stack_frames_reference)
+        obs = jnp.asarray(rng.integers(0, 255, (2, 60, 96, 128)), jnp.uint8)
+        got = stack_frames_pallas(obs, 55, 4, out_dtype=jnp.bfloat16,
+                                  out_height=84, out_width=84)
+        want = stack_frames_reference(obs, 55, 4, out_dtype=jnp.bfloat16,
+                                      out_height=84, out_width=84)
+        np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                      np.asarray(want, np.float32))
+    add("decode_padded_strip", decode_padded)
+
+    # --- replay window gathers ------------------------------------------
+    def row_gather():
+        from r2d2_tpu.ops.pallas_kernels import (gather_rows_pallas,
+                                                 gather_rows_reference)
+        ring = jnp.asarray(rng.integers(0, 255, (8, 60, 84, 84)), jnp.uint8)
+        bi = jnp.asarray(rng.integers(0, 8, (16,)), jnp.int32)
+        st = jnp.asarray(rng.integers(0, 5, (16,)), jnp.int32)
+        got = gather_rows_pallas(ring, bi, st, 55)
+        want = gather_rows_reference(ring, bi, st, 55)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    add("row_gather", row_gather)
+
+    def exact_gather():
+        from r2d2_tpu.ops.pallas_kernels import (gather_rows_exact_pallas,
+                                                 gather_rows_reference)
+        # padded-storage tile shape (96, 128): the Mosaic alignment this
+        # kernel exists for
+        ring = jnp.asarray(rng.integers(0, 255, (8, 60, 96, 128)), jnp.uint8)
+        bi = jnp.asarray(rng.integers(0, 8, (16,)), jnp.int32)
+        st = jnp.asarray(rng.integers(0, 5, (16,)), jnp.int32)
+        got = gather_rows_exact_pallas(ring, bi, st, 55)
+        want = gather_rows_reference(ring, bi, st, 55)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    add("exact_gather", exact_gather)
+
+    # --- fused LSTM scan: lean fwd, residual fwd, and the bwd kernel -----
+    def lstm():
+        from r2d2_tpu.ops.pallas_lstm import (lstm_scan_pallas,
+                                              lstm_scan_reference)
+        T, B, H = 55, 16, 512
+        for dtype, tol in ((jnp.float32, 0.0), (jnp.bfloat16, 0.05)):
+            xpb = jnp.asarray(rng.standard_normal((T, B, 4 * H)), dtype)
+            wh = jnp.asarray(rng.standard_normal((H, 4 * H)) * 0.05, dtype)
+            c0 = jnp.asarray(rng.standard_normal((B, H)), dtype)
+            h0 = jnp.asarray(rng.standard_normal((B, H)), dtype)
+            hs_p, (cf_p, hf_p) = lstm_scan_pallas(xpb, wh, c0, h0)
+            hs_r, (cf_r, hf_r) = lstm_scan_reference(xpb, wh, c0, h0)
+            np.testing.assert_allclose(
+                np.asarray(hs_p, np.float32), np.asarray(hs_r, np.float32),
+                atol=tol, rtol=tol)
+
+            def loss(fn, a):
+                hs, (c, h) = fn(*a)
+                return (jnp.sum(hs.astype(jnp.float32) ** 2)
+                        + jnp.sum(c.astype(jnp.float32))
+                        + jnp.sum(h.astype(jnp.float32)))
+
+            g_p = jax.grad(lambda a: loss(lstm_scan_pallas, a))(
+                (xpb, wh, c0, h0))
+            g_r = jax.grad(lambda a: loss(lstm_scan_reference, a))(
+                (xpb, wh, c0, h0))
+            for name, a, b in zip(("dxpb", "dwh", "dc0", "dh0"), g_p, g_r):
+                a = np.asarray(a, np.float32)
+                b = np.asarray(b, np.float32)
+                assert np.isfinite(a).all(), f"{name} not finite"
+                denom = max(np.abs(b).max(), 1e-3)
+                gap = np.abs(a - b).max() / denom
+                gtol = 1e-4 if dtype == jnp.float32 else 0.25
+                assert gap < gtol, f"{name} rel gap {gap:.4f} > {gtol}"
+    add("lstm_scan", lstm)
+
+    if not checks:
+        print(f"no checks match --only={only!r}", file=sys.stderr)
+        return 2
+    ok = all([_check(name, fn) for name, fn in checks])
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    only = ""
+    for a in argv:
+        if a.startswith("--only="):
+            only = a.split("=", 1)[1]
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        else:
+            print(f"unknown arg {a!r} (supported: --only=SUBSTR)",
+                  file=sys.stderr)
+            return 2
+    return run_chip_checks(only)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

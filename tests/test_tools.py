@@ -241,3 +241,10 @@ def test_run_search_improves_mock_fitness():
     # elitism: best fitness is monotonically non-decreasing
     bests = [h.best[1] for h in history]
     assert all(b2 >= b1 - 1e-9 for b1, b2 in zip(bests, bests[1:]))
+
+
+def test_chip_checks_refuses_cpu_backend():
+    """The on-chip pallas gate must refuse loudly on CPU (kernels do not
+    lower there) instead of failing kernel-by-kernel."""
+    from r2d2_tpu.tools.chip_checks import run_chip_checks
+    assert run_chip_checks() == 2
