@@ -34,7 +34,10 @@ Design:
 
 Scope: thread- OR process-mode actors (process mode gives each host a
 spawned CPU-pinned actor fleet fed through the native shm ring, exactly
-like the single-host orchestrator), device replay placement, single
+like the single-host orchestrator), device OR host replay placement
+(host = one reference-style CPU HostReplay per process feeding the GSPMD
+external-batch step per-step, with a tiny psum consensus program instead
+of lockstep_ingest — make_lockstep_consensus), single
 player, dp x mp meshes (mesh.mp > 1 feature-shards the wide params over
 mp via the GSPMD learner step and GSPMD lockstep ingest; mp must divide
 each host's device count so every dp row stays host-local). Resume/
@@ -235,6 +238,70 @@ def _make_gspmd_lockstep_ingest(spec: ReplaySpec, mesh):
     return ingest
 
 
+def owned_dp_rows(mesh) -> List[int]:
+    """dp rows whose devices (all mp columns) live on THIS process.
+    Host-local data (experience blocks, host-replay batches) can only feed
+    rows this process owns, so an mp-spanning row is a hard scope error."""
+    import jax
+
+    rows = mesh.devices.reshape(mesh.shape["dp"], -1)   # (dp, mp)
+    me = jax.process_index()
+    owners = []
+    for r in range(rows.shape[0]):
+        procs = {d.process_index for d in rows[r]}
+        if len(procs) != 1:
+            raise NotImplementedError(
+                f"dp row {r} spans processes {sorted(procs)} — with "
+                "mesh.mp > 1, mp must divide each host's device count "
+                "so every dp row (and its mp replicas) stays on one "
+                "host")
+        owners.append(procs.pop())
+    return [r for r, o in enumerate(owners) if o == me]
+
+
+def _local_dp_values(arr) -> np.ndarray:
+    """This process's rows of a dp-sharded 1-D array, in global-index order
+    (= the order this process supplied them to
+    ``make_array_from_process_local_data``). mp-replicated shards of the
+    same dp row are deduplicated by index."""
+    shards = {}
+    for s in arr.addressable_shards:
+        start = s.index[0].start or 0
+        shards.setdefault(start, np.asarray(s.data))
+    return np.concatenate([shards[k] for k in sorted(shards)])
+
+
+def make_lockstep_consensus(mesh):
+    """The host-replay twin of lockstep_ingest's counter/stop outputs: a
+    tiny psum program every iteration. Each process contributes
+    [buffer_steps, env_steps, ready, stop] ONCE (on its first owned dp
+    row; zero rows elsewhere); the psum over dp returns the same sums on
+    every host, so every control-flow decision downstream is replicated —
+    the lockstep invariant with no device replay involved."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("dp"))
+    local_rows = owned_dp_rows(mesh)
+
+    @jax.jit
+    def psum_rows(x):                                       # (dp, 4) int32
+        return shard_map(lambda v: jax.lax.psum(v, "dp"),
+                         mesh=mesh, in_specs=P("dp"), out_specs=P())(x)
+
+    def consense(buffer_steps: int, env_steps: int, ready: bool,
+                 stop_flag: int) -> dict:
+        rows = np.zeros((len(local_rows), 4), np.int32)
+        rows[0] = (buffer_steps, env_steps, int(bool(ready)), int(stop_flag))
+        x = jax.make_array_from_process_local_data(sharding, rows)
+        out = np.asarray(jax.device_get(psum_rows(x))).reshape(-1, 4)[0]
+        return {"buffer_steps": int(out[0]), "env_steps": int(out[1]),
+                "ready_procs": int(out[2]), "stop": int(out[3])}
+
+    return consense
+
+
 class HostFeed:
     """Builds each iteration's global ingest operands from process-local
     blocks: a (dp,)-leading stacked Block whose rows are zeros except this
@@ -249,21 +316,10 @@ class HostFeed:
         self.spec = spec
         self.sharding = NamedSharding(mesh, P("dp"))
         # row ownership: every dp row's devices (its mp columns) must live
-        # on ONE host — blocks are fed host-locally, so an mp-spanning row
-        # would need block data this host never drained
-        rows = mesh.devices.reshape(mesh.shape["dp"], -1)   # (dp, mp)
+        # on ONE host — blocks are fed host-locally (owned_dp_rows raises
+        # on an mp-spanning row)
+        self.local_rows = owned_dp_rows(mesh)
         me = jax.process_index()
-        owners = []
-        for r in range(rows.shape[0]):
-            procs = {d.process_index for d in rows[r]}
-            if len(procs) != 1:
-                raise NotImplementedError(
-                    f"dp row {r} spans processes {sorted(procs)} — with "
-                    "mesh.mp > 1, mp must divide each host's device count "
-                    "so every dp row (and its mp replicas) stays on one "
-                    "host")
-            owners.append(procs.pop())
-        self.local_rows = [r for r, o in enumerate(owners) if o == me]
         if not self.local_rows:
             raise ValueError(
                 f"process {me} owns no mesh shards — mesh.dp must cover "
@@ -336,9 +392,10 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             "collectives — README \"Multiplayer at pod scale\"). "
             "multiplayer.player_id=-1 (whole population in-process) is the "
             "single-host orchestrator's mode.")
-    if cfg.replay.placement != "device":
-        raise NotImplementedError(
-            "multihost training requires replay.placement='device'")
+    if cfg.replay.placement not in ("device", "host"):
+        raise ValueError(
+            f"unknown replay.placement {cfg.replay.placement!r}")
+    host_mode = cfg.replay.placement == "host"
     from r2d2_tpu.actor.policy import ActorPolicy
     from r2d2_tpu.envs.factory import create_env
     from r2d2_tpu.learner.train_step import create_train_state
@@ -386,17 +443,61 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         from r2d2_tpu.parallel.tensor_parallel import state_shardings
         ts = jax.device_put(ts, state_shardings(ts, mesh))
     dp = mesh.shape["dp"]
-    rs = sharded_replay_init(spec, mesh)
     from jax.sharding import NamedSharding, PartitionSpec as P
-    cum_env = jax.device_put(np.zeros((dp,), np.int32),
-                             NamedSharding(mesh, P("dp")))
+    if host_mode:
+        # Host-placement lockstep (the reference-style CPU replay under the
+        # multi-controller loop): each process owns ONE HostReplay fed by
+        # its own actors (dp = independent per-host data, like the device
+        # path's per-shard rings); every iteration dispatches the tiny
+        # consensus psum instead of lockstep_ingest, and — iff the
+        # replicated outputs say ready — every process samples its share
+        # of the global batch, assembles it dp-sharded, and dispatches the
+        # SAME GSPMD external-batch step (gradients reduce over the global
+        # batch automatically). Priority write-back stays host-local, with
+        # HostReplay's monotonic staleness guard intact. Per-step dispatch
+        # (k=1): sampling happens on the host between steps, so there is
+        # no k-step scan to fuse — same as the single-host host path.
+        from r2d2_tpu.learner.train_step import make_external_batch_step
+        from r2d2_tpu.replay.host_replay import HostReplay
+        if spec.batch_size % dp:
+            raise ValueError(
+                f"replay.batch_size={spec.batch_size} is not divisible by "
+                f"mesh dp={dp} — the batch axis cannot shard evenly")
+        local_rows_n = len(owned_dp_rows(mesh))
+        local_batch = spec.batch_size * local_rows_n // dp
+        # per-rank seed: each host's replay samples ITS OWN distribution
+        host_replay = HostReplay(spec, seed=cfg.runtime.seed + 7919 * rank)
+        consense = make_lockstep_consensus(mesh)
+        ext_step = make_external_batch_step(net, spec, cfg.optim,
+                                            cfg.network.use_double)
+        batch_sharding = NamedSharding(mesh, P("dp"))
+        if mesh.shape["mp"] == 1:
+            # replicate the state across the mesh (mp > 1 already placed
+            # feature-sharded above); identical host values on every rank
+            ts = jax.device_put(ts, NamedSharding(mesh, P()))
+        env_local = 0
+        if cfg.runtime.steps_per_dispatch > 1:
+            # same warning the single-host host path emits: sampling
+            # happens on the host between steps, so there is no k-step
+            # scan to fuse
+            import logging
+            logging.getLogger(__name__).warning(
+                "runtime.steps_per_dispatch=%d is ignored under "
+                "replay.placement='host' (host sampling is per-step)",
+                cfg.runtime.steps_per_dispatch)
+        k = 1
+        rs = None
+    else:
+        rs = sharded_replay_init(spec, mesh)
+        cum_env = jax.device_put(np.zeros((dp,), np.int32),
+                                 NamedSharding(mesh, P("dp")))
 
-    k = cfg.runtime.resolved_steps_per_dispatch()
-    step_fn = make_sharded_learner_step(
-        net, spec, cfg.optim, cfg.network.use_double, mesh,
-        steps_per_dispatch=k)
-    ingest_fn = make_lockstep_ingest(spec, mesh)
-    feed = HostFeed(spec, mesh)
+        k = cfg.runtime.resolved_steps_per_dispatch()
+        step_fn = make_sharded_learner_step(
+            net, spec, cfg.optim, cfg.network.use_double, mesh,
+            steps_per_dispatch=k)
+        ingest_fn = make_lockstep_ingest(spec, mesh)
+        feed = HostFeed(spec, mesh)
 
     # -- local actors (this host's share of the global fleet) --
     # The stop event must be shareable with spawned children in process
@@ -544,9 +645,21 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             if not paused:
                 drained = queue.drain(1)
                 block = drained[0] if drained else None
-            rs, cum_env, dev_info = ingest_fn(rs, cum_env,
-                                              *feed.build(block, local_stop))
-            info = {kk: int(v) for kk, v in jax.device_get(dev_info).items()}
+            if host_mode:
+                if block is not None:
+                    host_replay.add(block)
+                    # learning_steps.sum(), not block_length: partial
+                    # blocks (episode boundaries) carry zero-step slots —
+                    # same accounting as lockstep_ingest's device path
+                    env_local += int(np.sum(np.asarray(
+                        block.learning_steps)))
+                info = consense(len(host_replay), env_local,
+                                len(host_replay) > 0, local_stop)
+            else:
+                rs, cum_env, dev_info = ingest_fn(
+                    rs, cum_env, *feed.build(block, local_stop))
+                info = {kk: int(v)
+                        for kk, v in jax.device_get(dev_info).items()}
             if debug:
                 print(f"[mh rank={rank} it={it}] step={step_count} "
                       f"block={block is not None} {info}", flush=True)
@@ -558,15 +671,31 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
 
             # every decision below uses only replicated values -> every
             # host takes the same branch (the lockstep invariant)
-            ready = (info["filled_shards"] == dp
-                     and info["buffer_steps"] >= cfg.replay.learning_starts)
+            if host_mode:
+                ready = (info["ready_procs"] == nprocs
+                         and info["buffer_steps"]
+                         >= cfg.replay.learning_starts)
+            else:
+                ready = (info["filled_shards"] == dp
+                         and info["buffer_steps"]
+                         >= cfg.replay.learning_starts)
             paused = bool(
                 ready and ratio > 0
                 and info["env_steps"] >= cfg.replay.learning_starts
                     + ratio * max(step_count - step_base, 1))
             if ready:
                 prev = step_count
-                ts, rs, m = step_fn(ts, rs)
+                if host_mode:
+                    batch_np, snapshot = host_replay.sample(local_batch)
+                    gbatch = jax.tree_util.tree_map(
+                        lambda a: jax.make_array_from_process_local_data(
+                            batch_sharding, np.asarray(a)), batch_np)
+                    ts, m = ext_step(ts, gbatch)
+                    host_replay.update_priorities(
+                        batch_np.idxes, _local_dp_values(m["priorities"]),
+                        snapshot)
+                else:
+                    ts, rs, m = step_fn(ts, rs)
                 step_count += k
                 if metrics is not None:   # only rank 0 flushes; don't
                     pending_losses.append(m["loss"])   # accumulate elsewhere
@@ -642,7 +771,7 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
                  max_steps: int, resume: str = "",
                  actor_mode: str = "thread", mp: int = 1,
                  player_id: int = -1, num_players: int = 2,
-                 num_actors: int = 1) -> None:
+                 num_actors: int = 1, placement: str = "device") -> None:
     from r2d2_tpu.utils.platform import pin_cpu_platform
     pin_cpu_platform(devices_per_process)
     import jax
@@ -653,6 +782,7 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
         "mesh.num_processes": num_processes, "mesh.process_id": process_id,
         "mesh.dp": n_global // mp, "mesh.mp": mp,
         "actor.num_actors": num_actors,
+        "replay.placement": placement,
         **({"runtime.resume": resume} if resume else {}),
         **({"multiplayer.enabled": True, "multiplayer.player_id": player_id,
             "multiplayer.num_players": num_players}
@@ -701,7 +831,8 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
                 max_steps: int = 8, timeout: float = 300.0,
                 resume: str = "", actor_mode: str = "thread",
                 mp: int = 1, player_id: int = -1,
-                num_players: int = 2, num_actors: int = 1) -> list:
+                num_players: int = 2, num_actors: int = 1,
+                placement: str = "device") -> list:
     """Spawn the loopback controllers and assert the final params came out
     BIT-IDENTICAL across hosts (each worker writes a digest file covering
     every param leaf; divergence anywhere fails the launch). Returns the
@@ -731,6 +862,7 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
             f"--resume={resume}", f"--actor-mode={actor_mode}",
             f"--mp={mp}", f"--player-id={player_id}",
             f"--num-players={num_players}", f"--num-actors={num_actors}",
+            f"--placement={placement}",
         ], num_processes, timeout, "multihost train demo")
 
     digests = []
@@ -772,20 +904,25 @@ def main(argv=None) -> None:
     p.add_argument("--num-actors", type=int, default=1,
                    help="actors per controller; per-player jobs must all "
                         "match on num_processes * num_actors")
+    p.add_argument("--placement", choices=("device", "host"),
+                   default="device",
+                   help="replay placement: device = HBM rings + lockstep "
+                        "ingest; host = per-process CPU HostReplay + "
+                        "consensus psum + external-batch step")
     args = p.parse_args(argv)
     if args.process_id is None:
         launch_demo(args.num_processes, args.devices_per_process,
                     args.save_dir, args.max_steps, resume=args.resume,
                     actor_mode=args.actor_mode, mp=args.mp,
                     player_id=args.player_id, num_players=args.num_players,
-                    num_actors=args.num_actors)
+                    num_actors=args.num_actors, placement=args.placement)
     else:
         _demo_worker(args.process_id, args.num_processes, args.coordinator,
                      args.devices_per_process, args.save_dir, args.max_steps,
                      resume=args.resume, actor_mode=args.actor_mode,
                      mp=args.mp, player_id=args.player_id,
                      num_players=args.num_players,
-                     num_actors=args.num_actors)
+                     num_actors=args.num_actors, placement=args.placement)
 
 
 if __name__ == "__main__":

@@ -155,18 +155,24 @@ def run_chip_checks(only: str = "") -> int:
 
 
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = list(sys.argv[1:] if argv is None else argv)
     only = ""
-    for a in argv:
+    i = 0
+    while i < len(argv):
+        a = argv[i]
         if a.startswith("--only="):
             only = a.split("=", 1)[1]
+        elif a == "--only" and i + 1 < len(argv):
+            i += 1
+            only = argv[i]
         elif a in ("-h", "--help"):
             print(__doc__)
             return 0
         else:
-            print(f"unknown arg {a!r} (supported: --only=SUBSTR)",
+            print(f"unknown arg {a!r} (supported: --only SUBSTR)",
                   file=sys.stderr)
             return 2
+        i += 1
     return run_chip_checks(only)
 
 
