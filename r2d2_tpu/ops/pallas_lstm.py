@@ -104,8 +104,12 @@ def _fwd_kernel(hidden: int, xpb_ref, wh_ref, c0_ref, h0_ref,
     out_dtype = hseq_ref.dtype
     hseq_ref[0] = h_new.astype(out_dtype)
     cseq_ref[0] = c_new.astype(out_dtype)
-    acts_ref[0] = jnp.concatenate([i_g, f_g, g_g, o_g],
-                                  axis=1).astype(out_dtype)
+    # four static lane-slice stores, not a lane concat — slice writes at
+    # tile-multiple offsets are the Mosaic-safe lowering
+    acts_ref[0, :, :hidden] = i_g.astype(out_dtype)
+    acts_ref[0, :, hidden:2 * hidden] = f_g.astype(out_dtype)
+    acts_ref[0, :, 2 * hidden:3 * hidden] = g_g.astype(out_dtype)
+    acts_ref[0, :, 3 * hidden:] = o_g.astype(out_dtype)
 
 
 def _fwd_kernel_lean(hidden: int, nsteps: int, xpb_ref, wh_ref, c0_ref,
@@ -213,17 +217,21 @@ def _bwd_kernel(hidden: int, nsteps: int,
     di = dc * g_g
     dg = dc * i_g
     df = dc * c_prev
-    # pre-activation gate grads (sigmoid' = s(1-s); tanh' = 1-t^2)
-    dgates = jnp.concatenate([
-        di * i_g * (1.0 - i_g),
-        df * f_g * (1.0 - f_g),
-        dg * (1.0 - g_g * g_g),
-        do * o_g * (1.0 - o_g),
-    ], axis=1)                                            # (B, 4H) f32
-    dxpb_ref[0] = dgates.astype(dxpb_ref.dtype)
+    # pre-activation gate grads (sigmoid' = s(1-s); tanh' = 1-t^2),
+    # written as four static lane-slice stores into the dxpb output block
+    # (no lane concat — see the forward kernel), then read back whole for
+    # the two dots. The readback rounds through the storage dtype, which
+    # is the same rounding the dots' cast to the MXU dtype applies anyway.
+    out_dtype = dxpb_ref.dtype
+    dxpb_ref[0, :, :hidden] = (di * i_g * (1.0 - i_g)).astype(out_dtype)
+    dxpb_ref[0, :, hidden:2 * hidden] = (
+        df * f_g * (1.0 - f_g)).astype(out_dtype)
+    dxpb_ref[0, :, 2 * hidden:3 * hidden] = (
+        dg * (1.0 - g_g * g_g)).astype(out_dtype)
+    dxpb_ref[0, :, 3 * hidden:] = (do * o_g * (1.0 - o_g)).astype(out_dtype)
 
     cd = wht_ref.dtype
-    dg_cd = dgates.astype(cd)
+    dg_cd = dxpb_ref[0].astype(cd)
     dh_s[:] = jax.lax.dot_general(
         dg_cd, wht_ref[:], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
