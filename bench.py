@@ -455,39 +455,36 @@ def run_bench() -> None:
         print("bench: simulated mid-run hang", file=sys.stderr, flush=True)
         time.sleep(100_000)
 
-    # --- 2b. space_to_depth A/B at the bf16_spd16 policy (the current
-    # shipped TPU default; compare against that cell specifically) --------
-    # The exact first-conv rewrite (network.space_to_depth) targets the
-    # MXU's input-lane underutilization on the 4-channel frame stack. The
-    # knob changes the param layout so its default stays explicit
-    # ('off'/'on'); this cell measures what flipping it would buy so the
-    # default can follow measurement (params differ, so this uses a fresh
-    # train state — the throughput comparison is unaffected).
-    if on_tpu and not smoke and not skipped("bf16_spd16_s2d"):
+    # --- 2b. fused-pallas-LSTM A/B at the bf16_spd16 policy -------------
+    # network.pallas_lstm runs the 55-step recurrent chain as ONE pallas
+    # kernel (Wh VMEM-resident, f32 scratch carries, custom-VJP backward —
+    # ops/pallas_lstm.py) instead of a lax.scan while-loop, attacking the
+    # profiled per-iteration overhead on the serial chain. Win -> flip the
+    # default; Mosaic rejection -> documented dead end.
+    if (on_tpu and not smoke and default_pallas
+            and not skipped("bf16_spd16_plstm")):
         try:
-            from r2d2_tpu.models import NetworkApply
             opt_default = dataclasses.replace(
-                cfg.optim,
-                pallas_obs_decode="on" if default_pallas else "off")
-            s2d_cfg = dataclasses.replace(cfg.network, bf16=True,
-                                          space_to_depth="on")
-            s2d_net = NetworkApply(action_dim, s2d_cfg, cfg.env.frame_stack,
-                                   cfg.env.frame_height, cfg.env.frame_width)
-            # ONE net builds both the train state and the step, so their
-            # param trees cannot drift
-            ts_s2d = create_train_state(jax.random.PRNGKey(1), s2d_net,
-                                        cfg.optim)
-            step = make_multi_learner_step(s2d_net, spec, opt_default,
+                cfg.optim, pallas_obs_decode="on")
+            from r2d2_tpu.models import NetworkApply
+            net_pl = NetworkApply(
+                action_dim, dataclasses.replace(cfg.network, bf16=True,
+                                                pallas_lstm="on"),
+                cfg.env.frame_stack, cfg.env.frame_height,
+                cfg.env.frame_width)
+            ts_pl = create_train_state(jax.random.PRNGKey(1), net_pl,
+                                       cfg.optim)
+            step = make_multi_learner_step(net_pl, spec, opt_default,
                                            use_double, 16)
-            sps, _ts2, rs = measure_path(step, ts_s2d, rs, "bf16_spd16_s2d",
-                                         steps_per_dispatch=16)
-            matrix["bf16_spd16_s2d"] = sps * spec.batch_size
+            sps, _tspl, rs = measure_path(step, ts_pl, rs, "bf16_spd16_plstm",
+                                          steps_per_dispatch=16)
+            matrix["bf16_spd16_plstm"] = sps * spec.batch_size
         except Exception as e:   # never kill the bench for the extra cell
-            matrix["bf16_spd16_s2d"] = None
-            print(f"[bf16_spd16_s2d] FAILED: {type(e).__name__}: {e}",
+            matrix["bf16_spd16_plstm"] = None
+            print(f"[bf16_spd16_plstm] FAILED: {type(e).__name__}: {e}",
                   file=sys.stderr)
     else:
-        matrix["bf16_spd16_s2d"] = None
+        matrix["bf16_spd16_plstm"] = None
     checkpoint()
 
     # --- 2b2. exact-read pad-gather A/B at the bf16_spd16 policy ---------
@@ -525,12 +522,53 @@ def run_bench() -> None:
         matrix[ab_label] = None
     checkpoint()
 
-    # --- 2b3. NHWC-decode A/B at the bf16_spd16 policy -------------------
+    # --- 2b3. space_to_depth A/B at the bf16_spd16 policy (the current
+    # shipped TPU default; compare against that cell specifically) --------
+    # The exact first-conv rewrite (network.space_to_depth) targets the
+    # MXU's input-lane underutilization on the 4-channel frame stack. The
+    # knob changes the param layout so its default stays explicit
+    # ('off'/'on'); this cell measures what flipping it would buy so the
+    # default can follow measurement (params differ, so this uses a fresh
+    # train state — the throughput comparison is unaffected).
+    if on_tpu and not smoke and not skipped("bf16_spd16_s2d"):
+        try:
+            from r2d2_tpu.models import NetworkApply
+            opt_default = dataclasses.replace(
+                cfg.optim,
+                pallas_obs_decode="on" if default_pallas else "off")
+            s2d_cfg = dataclasses.replace(cfg.network, bf16=True,
+                                          space_to_depth="on")
+            s2d_net = NetworkApply(action_dim, s2d_cfg, cfg.env.frame_stack,
+                                   cfg.env.frame_height, cfg.env.frame_width)
+            # ONE net builds both the train state and the step, so their
+            # param trees cannot drift
+            ts_s2d = create_train_state(jax.random.PRNGKey(1), s2d_net,
+                                        cfg.optim)
+            step = make_multi_learner_step(s2d_net, spec, opt_default,
+                                           use_double, 16)
+            sps, _ts2, rs = measure_path(step, ts_s2d, rs, "bf16_spd16_s2d",
+                                         steps_per_dispatch=16)
+            matrix["bf16_spd16_s2d"] = sps * spec.batch_size
+        except Exception as e:   # never kill the bench for the extra cell
+            matrix["bf16_spd16_s2d"] = None
+            print(f"[bf16_spd16_s2d] FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    else:
+        matrix["bf16_spd16_s2d"] = None
+    checkpoint()
+
+    # --- 2b4. NHWC-decode A/B at the bf16_spd16 policy -------------------
     # optim.pallas_decode_layout="nhwc" folds the post-decode layout
     # transpose (the ~1.6 ms/step HBM copy in the round-3 profile) into
     # the kernel's in-register relayout. Win -> flip the default; Mosaic
     # rejection -> documented dead end.
+    # default-SKIPPED: four distinct Mosaic rejections settled this as a
+    # dead end on the current stack (PERF.md), and its compile-helper
+    # crash ("HTTP 500: tpu_compile_helper subprocess exit code 1") is
+    # the suspected poisoner of the round-4 tunnel wedge. Re-enable with
+    # R2D2_BENCH_NHWC=1 when the Mosaic version changes.
     if (on_tpu and not smoke and default_pallas
+            and os.environ.get("R2D2_BENCH_NHWC")
             and not skipped("bf16_spd16_nhwc")):
         try:
             opt_nhwc = dataclasses.replace(
@@ -553,38 +591,6 @@ def run_bench() -> None:
                   file=sys.stderr)
     else:
         matrix["bf16_spd16_nhwc"] = None
-    checkpoint()
-
-    # --- 2b4. fused-pallas-LSTM A/B at the bf16_spd16 policy -------------
-    # network.pallas_lstm runs the 55-step recurrent chain as ONE pallas
-    # kernel (Wh VMEM-resident, f32 scratch carries, custom-VJP backward —
-    # ops/pallas_lstm.py) instead of a lax.scan while-loop, attacking the
-    # profiled per-iteration overhead on the serial chain. Win -> flip the
-    # default; Mosaic rejection -> documented dead end.
-    if (on_tpu and not smoke and default_pallas
-            and not skipped("bf16_spd16_plstm")):
-        try:
-            opt_default = dataclasses.replace(
-                cfg.optim, pallas_obs_decode="on")
-            from r2d2_tpu.models import NetworkApply
-            net_pl = NetworkApply(
-                action_dim, dataclasses.replace(cfg.network, bf16=True,
-                                                pallas_lstm="on"),
-                cfg.env.frame_stack, cfg.env.frame_height,
-                cfg.env.frame_width)
-            ts_pl = create_train_state(jax.random.PRNGKey(1), net_pl,
-                                       cfg.optim)
-            step = make_multi_learner_step(net_pl, spec, opt_default,
-                                           use_double, 16)
-            sps, _tspl, rs = measure_path(step, ts_pl, rs, "bf16_spd16_plstm",
-                                          steps_per_dispatch=16)
-            matrix["bf16_spd16_plstm"] = sps * spec.batch_size
-        except Exception as e:   # never kill the bench for the extra cell
-            matrix["bf16_spd16_plstm"] = None
-            print(f"[bf16_spd16_plstm] FAILED: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-    else:
-        matrix["bf16_spd16_plstm"] = None
     checkpoint()
 
     # --- 2c. double-DQN unroll-fusion A/B at the bf16_spd16 policy -------
