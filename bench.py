@@ -461,31 +461,39 @@ def run_bench() -> None:
     # ops/pallas_lstm.py) instead of a lax.scan while-loop, attacking the
     # profiled per-iteration overhead on the serial chain. Win -> flip the
     # default; Mosaic rejection -> documented dead end.
-    if (on_tpu and not smoke and default_pallas
-            and not skipped("bf16_spd16_plstm")):
-        try:
-            opt_default = dataclasses.replace(
-                cfg.optim, pallas_obs_decode="on")
-            from r2d2_tpu.models import NetworkApply
-            net_pl = NetworkApply(
-                action_dim, dataclasses.replace(cfg.network, bf16=True,
-                                                pallas_lstm="on"),
-                cfg.env.frame_stack, cfg.env.frame_height,
-                cfg.env.frame_width)
-            ts_pl = create_train_state(jax.random.PRNGKey(1), net_pl,
-                                       cfg.optim)
-            step = make_multi_learner_step(net_pl, spec, opt_default,
-                                           use_double, 16)
-            sps, _tspl, rs = measure_path(step, ts_pl, rs, "bf16_spd16_plstm",
-                                          steps_per_dispatch=16)
-            matrix["bf16_spd16_plstm"] = sps * spec.batch_size
-        except Exception as e:   # never kill the bench for the extra cell
-            matrix["bf16_spd16_plstm"] = None
-            print(f"[bf16_spd16_plstm] FAILED: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-    else:
-        matrix["bf16_spd16_plstm"] = None
-    checkpoint()
+    # R2D2_BENCH_PLSTM_BT: comma-separated block_t values to sweep
+    # (timesteps per kernel grid iteration; must divide seq_window=55)
+    plstm_bts = [int(v) for v in os.environ.get(
+        "R2D2_BENCH_PLSTM_BT", "1,5").split(",") if v]
+    for bt in plstm_bts:
+        label = ("bf16_spd16_plstm" if bt == 1
+                 else f"bf16_spd16_plstm_bt{bt}")
+        if (on_tpu and not smoke and default_pallas
+                and not skipped(label)):
+            try:
+                opt_default = dataclasses.replace(
+                    cfg.optim, pallas_obs_decode="on")
+                from r2d2_tpu.models import NetworkApply
+                net_pl = NetworkApply(
+                    action_dim, dataclasses.replace(
+                        cfg.network, bf16=True, pallas_lstm="on",
+                        pallas_lstm_block=bt),
+                    cfg.env.frame_stack, cfg.env.frame_height,
+                    cfg.env.frame_width)
+                ts_pl = create_train_state(jax.random.PRNGKey(1), net_pl,
+                                           cfg.optim)
+                step = make_multi_learner_step(net_pl, spec, opt_default,
+                                               use_double, 16)
+                sps, _tspl, rs = measure_path(step, ts_pl, rs, label,
+                                              steps_per_dispatch=16)
+                matrix[label] = sps * spec.batch_size
+            except Exception as e:   # never kill the bench for extra cells
+                matrix[label] = None
+                print(f"[{label}] FAILED: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+        else:
+            matrix[label] = None
+        checkpoint()
 
     # --- 2b2. exact-read pad-gather A/B at the bf16_spd16 policy ---------
     # replay.pallas_exact_gather pads stored frames (84x84 -> 96x128) and

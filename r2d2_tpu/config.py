@@ -95,6 +95,11 @@ class NetworkConfig:
     # layout change), tolerance-parity-tested vs the lax.scan path.
     # Default "off" pending the TPU A/B (bench cell bf16_spd16_plstm).
     pallas_lstm: str = "off"
+    # Timesteps per grid iteration of the fused LSTM kernel (must divide
+    # the unroll length; 55 -> 1, 5, 11). >1 amortizes per-iteration
+    # grid/DMA bookkeeping against bigger VMEM blocks — a chip
+    # measurement (bench.py sweeps the plstm cells).
+    pallas_lstm_block: int = 1
 
 
 @dataclass(frozen=True)

@@ -211,6 +211,8 @@ class HoistedLSTM(nn.Module):
     # NetworkConfig.pallas_lstm, resolved. Identical math (the kernel folds
     # bias into the hoisted projection; tolerance-parity-tested).
     use_pallas: bool = False
+    # timesteps per kernel grid iteration (NetworkConfig.pallas_lstm_block)
+    pallas_block_t: int = 1
     # interpret-mode flag for the pallas path (CPU test mesh only)
     pallas_interpret: bool = False
 
@@ -233,7 +235,8 @@ class HoistedLSTM(nn.Module):
             xpb = (x_proj + bias).swapaxes(0, 1)              # (T, B, 4H)
             hseq, (c_fin, h_fin) = lstm_scan_pallas(
                 xpb, w_rec, carry[0], carry[1],
-                interpret=self.pallas_interpret)
+                interpret=self.pallas_interpret,
+                block_t=self.pallas_block_t)
             return (c_fin, h_fin), hseq.swapaxes(0, 1)
 
         def step(carry, xp):                                  # xp: (B, 4H)
@@ -299,6 +302,7 @@ class R2D2Network(nn.Module):
                            unroll=cfg.scan_unroll,
                            use_pallas=resolve_pallas_setting(
                                cfg.pallas_lstm, "network.pallas_lstm"),
+                           pallas_block_t=cfg.pallas_lstm_block,
                            name="lstm")
         carry = unpack_hidden(hidden.astype(dtype))
         carry, outputs = cell(carry, rnn_in)

@@ -372,3 +372,41 @@ def test_lstm_scan_pallas_bf16_tracks_reference(rng):
         # the bf16 reference chain rules out elementwise equality)
         denom = max(np.abs(b).max(), 1e-3)
         assert np.abs(a - b).max() / denom < 0.25, name
+
+
+def test_lstm_scan_pallas_block_t_matches_reference(rng):
+    """block_t > 1 (several timesteps per grid iteration) must be exactly
+    the same computation: bit-exact f32 forward across block boundaries,
+    grads to f32 epsilon — including the in-block h_prev recomputation
+    (o*tanh(c)) and the block-boundary carry handoff."""
+    from r2d2_tpu.ops.pallas_lstm import (lstm_scan_pallas,
+                                          lstm_scan_reference)
+    args = _lstm_inputs(rng, T=10, B=8, H=128)
+    hs_r, (cf_r, hf_r) = lstm_scan_reference(*args)
+    w = jnp.asarray(rng.standard_normal(hs_r.shape), jnp.float32)
+
+    def loss(fn, a):
+        hs, (c, h) = fn(*a)
+        return jnp.sum(hs * w) + jnp.sum(c * 1.3) + jnp.sum(h * 0.7)
+
+    g_ref = jax.grad(lambda a: loss(lstm_scan_reference, a))(args)
+    for bt in (2, 5, 10):
+        hs_p, (cf_p, hf_p) = lstm_scan_pallas(*args, interpret=True,
+                                              block_t=bt)
+        np.testing.assert_array_equal(np.asarray(hs_p), np.asarray(hs_r),
+                                      err_msg=f"block_t={bt}")
+        np.testing.assert_array_equal(np.asarray(cf_p), np.asarray(cf_r))
+        g_pal = jax.grad(lambda a: loss(
+            lambda *x: lstm_scan_pallas(*x, interpret=True, block_t=bt),
+            a))(args)
+        for name, a, b in zip(("dxpb", "dwh", "dc0", "dh0"), g_ref, g_pal):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-6, rtol=3e-6,
+                                       err_msg=f"{name} block_t={bt}")
+
+
+def test_lstm_scan_pallas_block_t_must_divide(rng):
+    from r2d2_tpu.ops.pallas_lstm import lstm_scan_pallas
+    args = _lstm_inputs(rng, T=7, B=8, H=128)
+    with pytest.raises(ValueError, match="divide"):
+        lstm_scan_pallas(*args, interpret=True, block_t=3)
