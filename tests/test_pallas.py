@@ -374,6 +374,7 @@ def test_lstm_scan_pallas_bf16_tracks_reference(rng):
         assert np.abs(a - b).max() / denom < 0.25, name
 
 
+@pytest.mark.slow
 def test_lstm_scan_pallas_block_t_matches_reference(rng):
     """block_t > 1 (several timesteps per grid iteration) must be exactly
     the same computation: bit-exact f32 forward across block boundaries,

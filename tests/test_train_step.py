@@ -41,6 +41,7 @@ def _filled_replay(spec, rng, n_blocks=3):
     return state
 
 
+@pytest.mark.slow
 def test_fused_double_unroll_matches_sequential(rng):
     """optim.fused_double_unroll=on (one scan interleaving the online and
     target chains) must reproduce the sequential two-unroll double-DQN
