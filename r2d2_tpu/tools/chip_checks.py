@@ -117,34 +117,41 @@ def run_chip_checks(only: str = "") -> int:
                                               lstm_scan_reference)
         T, B, H = 55, 16, 512
         for dtype, tol in ((jnp.float32, 0.0), (jnp.bfloat16, 0.05)):
-            xpb = jnp.asarray(rng.standard_normal((T, B, 4 * H)), dtype)
-            wh = jnp.asarray(rng.standard_normal((H, 4 * H)) * 0.05, dtype)
-            c0 = jnp.asarray(rng.standard_normal((B, H)), dtype)
-            h0 = jnp.asarray(rng.standard_normal((B, H)), dtype)
-            hs_p, (cf_p, hf_p) = lstm_scan_pallas(xpb, wh, c0, h0)
-            hs_r, (cf_r, hf_r) = lstm_scan_reference(xpb, wh, c0, h0)
-            np.testing.assert_allclose(
-                np.asarray(hs_p, np.float32), np.asarray(hs_r, np.float32),
-                atol=tol, rtol=tol)
+            for bt in (1, 5):        # the bench-swept block_t values
+                xpb = jnp.asarray(rng.standard_normal((T, B, 4 * H)), dtype)
+                wh = jnp.asarray(rng.standard_normal((H, 4 * H)) * 0.05,
+                                 dtype)
+                c0 = jnp.asarray(rng.standard_normal((B, H)), dtype)
+                h0 = jnp.asarray(rng.standard_normal((B, H)), dtype)
+                hs_p, (cf_p, hf_p) = lstm_scan_pallas(xpb, wh, c0, h0,
+                                                      block_t=bt)
+                hs_r, (cf_r, hf_r) = lstm_scan_reference(xpb, wh, c0, h0)
+                np.testing.assert_allclose(
+                    np.asarray(hs_p, np.float32),
+                    np.asarray(hs_r, np.float32), atol=tol, rtol=tol)
 
-            def loss(fn, a):
-                hs, (c, h) = fn(*a)
-                return (jnp.sum(hs.astype(jnp.float32) ** 2)
-                        + jnp.sum(c.astype(jnp.float32))
-                        + jnp.sum(h.astype(jnp.float32)))
+                def loss(fn, a):
+                    hs, (c, h) = fn(*a)
+                    return (jnp.sum(hs.astype(jnp.float32) ** 2)
+                            + jnp.sum(c.astype(jnp.float32))
+                            + jnp.sum(h.astype(jnp.float32)))
 
-            g_p = jax.grad(lambda a: loss(lstm_scan_pallas, a))(
-                (xpb, wh, c0, h0))
-            g_r = jax.grad(lambda a: loss(lstm_scan_reference, a))(
-                (xpb, wh, c0, h0))
-            for name, a, b in zip(("dxpb", "dwh", "dc0", "dh0"), g_p, g_r):
-                a = np.asarray(a, np.float32)
-                b = np.asarray(b, np.float32)
-                assert np.isfinite(a).all(), f"{name} not finite"
-                denom = max(np.abs(b).max(), 1e-3)
-                gap = np.abs(a - b).max() / denom
-                gtol = 1e-4 if dtype == jnp.float32 else 0.25
-                assert gap < gtol, f"{name} rel gap {gap:.4f} > {gtol}"
+                g_p = jax.grad(lambda a: loss(
+                    lambda *x: lstm_scan_pallas(*x, block_t=bt), a))(
+                        (xpb, wh, c0, h0))
+                g_r = jax.grad(lambda a: loss(lstm_scan_reference, a))(
+                    (xpb, wh, c0, h0))
+                for name, a, b in zip(("dxpb", "dwh", "dc0", "dh0"),
+                                      g_p, g_r):
+                    a = np.asarray(a, np.float32)
+                    b = np.asarray(b, np.float32)
+                    assert np.isfinite(a).all(), \
+                        f"{name} not finite (block_t={bt})"
+                    denom = max(np.abs(b).max(), 1e-3)
+                    gap = np.abs(a - b).max() / denom
+                    gtol = 1e-4 if dtype == jnp.float32 else 0.25
+                    assert gap < gtol, \
+                        f"{name} rel gap {gap:.4f} > {gtol} (block_t={bt})"
     add("lstm_scan", lstm)
 
     if not checks:
