@@ -332,3 +332,31 @@ def test_pallas_lstm_loss_parity_with_scan(rng, monkeypatch):
     # differ — the first step must agree tightly, the trajectory closely
     assert losses["on"][0] == pytest.approx(losses["off"][0], rel=1e-4)
     np.testing.assert_allclose(losses["on"], losses["off"], rtol=1e-2)
+
+
+def test_exact_gather_train_step_loss_parity(rng):
+    """The padded-storage layout (replay.pallas_exact_gather — the TPU
+    default since BENCH r4) must be invisible to TRAINING, not just to
+    sampling: from identical params and identically-filled replays, the
+    fused step's loss trajectory on padded storage is bit-identical to
+    the unpadded spec's (the decode strips the pad before any math)."""
+    import dataclasses
+
+    spec = make_spec(batch_size=8)
+    spec_pad = dataclasses.replace(spec, exact_gather=True)
+    assert spec_pad.stored_frame_width == 128
+
+    net, _ = _net(spec)
+    losses = {}
+    for label, sp in (("plain", spec), ("padded", spec_pad)):
+        ts = create_train_state(jax.random.PRNGKey(3), net, OPT)
+        rs = replay_init(sp)
+        for blk in _fill_blocks(spec, 3, np.random.default_rng(0)):
+            rs = replay_add(sp, rs, blk)
+        step = make_learner_step(net, sp, OPT, use_double=False)
+        run = []
+        for _ in range(3):
+            ts, rs, m = step(ts, rs)
+            run.append(float(m["loss"]))
+        losses[label] = run
+    assert losses["padded"] == losses["plain"]
