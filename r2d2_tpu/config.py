@@ -100,6 +100,10 @@ class NetworkConfig:
     # grid/DMA bookkeeping against bigger VMEM blocks — a chip
     # measurement (bench.py sweeps the plstm cells).
     pallas_lstm_block: int = 1
+    # Debug/dryrun only: run the fused-LSTM kernel in pallas interpret
+    # mode (works on any backend, slow) — how the driver's multichip
+    # dryrun executes the kernel's exact semantics without a TPU.
+    pallas_lstm_interpret: bool = False
 
 
 @dataclass(frozen=True)

@@ -303,6 +303,7 @@ class R2D2Network(nn.Module):
                            use_pallas=resolve_pallas_setting(
                                cfg.pallas_lstm, "network.pallas_lstm"),
                            pallas_block_t=cfg.pallas_lstm_block,
+                           pallas_interpret=cfg.pallas_lstm_interpret,
                            name="lstm")
         carry = unpack_hidden(hidden.astype(dtype))
         carry, outputs = cell(carry, rnn_in)
