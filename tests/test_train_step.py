@@ -289,27 +289,17 @@ def test_bf16_and_double_compile(rng):
 
 
 @pytest.mark.slow
-def test_pallas_lstm_loss_parity_with_scan(rng, monkeypatch):
+def test_pallas_lstm_loss_parity_with_scan(rng):
     """network.pallas_lstm numeric-safety gate (same contract as the bf16
     gate above): from identical params and data, the fused-kernel LSTM
     path's losses must track the lax.scan trajectory within tolerance
-    across parameter updates. Runs the kernel in interpret mode on the CPU
-    mesh (monkeypatched — the config knob itself resolves to the compiled
-    kernel, TPU-only)."""
-    import dataclasses
-
-    from r2d2_tpu.ops import pallas_lstm as pl_mod
-
-    real = pl_mod.lstm_scan_pallas
-    monkeypatch.setattr(
-        pl_mod, "lstm_scan_pallas",
-        lambda xpb, wh, c0, h0, interpret=False: real(xpb, wh, c0, h0,
-                                                      interpret=True))
+    across parameter updates. Runs the kernel in interpret mode on the
+    CPU mesh via the debug flag (network.pallas_lstm_interpret)."""
     spec = make_spec(batch_size=8)
 
     def build(plstm: str):
         cfg = NetworkConfig(hidden_dim=spec.hidden_dim, cnn_out_dim=16,
-                            pallas_lstm=plstm,
+                            pallas_lstm=plstm, pallas_lstm_interpret=True,
                             conv_layers=((8, 4, 2), (16, 3, 1)))
         return init_network(jax.random.PRNGKey(0), A, cfg,
                             frame_stack=spec.frame_stack,
