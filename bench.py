@@ -344,14 +344,16 @@ def run_bench() -> None:
     # pre-seed every planned cell as None so a mid-run wedge reports the
     # never-reached cells in partial_missing instead of omitting them
     # (a partial artifact must not read as a complete matrix)
+    # the gather A/B cell measures whichever side is NOT the default spec
+    spec_pad = dataclasses.replace(spec, exact_gather=not spec.exact_gather)
+    ab_label = ("bf16_spd16_exactgather" if spec_pad.exact_gather
+                else "bf16_spd16_rowgather")
     if smoke:
         planned = ["f32_spd1"]
     else:
         planned = ["f32_spd1", "f32_spd4", "f32_spd16",
                    "bf16_spd1", "bf16_spd4", "bf16_spd16", "bf16_spd16_s2d",
-                   ("bf16_spd16_rowgather" if spec.exact_gather
-                    else "bf16_spd16_exactgather"),
-                   "bf16_spd16_nhwc", "bf16_spd16_plstm",
+                   ab_label, "bf16_spd16_nhwc", "bf16_spd16_plstm",
                    "bf16_spd16_double", "bf16_spd16_double_fused"]
     for label in planned:
         matrix[label] = None
@@ -504,9 +506,6 @@ def run_bench() -> None:
     # the A/B in every artifact in case a chip generation shifts it.
     # Storage layout changes with the flag, so this cell builds its own
     # replay.
-    spec_pad = dataclasses.replace(spec, exact_gather=not spec.exact_gather)
-    ab_label = ("bf16_spd16_exactgather" if spec_pad.exact_gather
-                else "bf16_spd16_rowgather")
     if on_tpu and not smoke and not skipped(ab_label):
         try:
             rs_pad = replay_init(spec_pad)

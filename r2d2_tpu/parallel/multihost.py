@@ -295,7 +295,7 @@ def make_lockstep_consensus(mesh):
         rows = np.zeros((len(local_rows), 4), np.int32)
         rows[0] = (buffer_steps, env_steps, int(bool(ready)), int(stop_flag))
         x = jax.make_array_from_process_local_data(sharding, rows)
-        out = np.asarray(jax.device_get(psum_rows(x))).reshape(-1, 4)[0]
+        out = np.asarray(psum_rows(x)).reshape(-1, 4)[0]
         return {"buffer_steps": int(out[0]), "env_steps": int(out[1]),
                 "ready_procs": int(out[2]), "stop": int(out[3])}
 
@@ -486,7 +486,6 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 "replay.placement='host' (host sampling is per-step)",
                 cfg.runtime.steps_per_dispatch)
         k = 1
-        rs = None
     else:
         rs = sharded_replay_init(spec, mesh)
         cum_env = jax.device_put(np.zeros((dp,), np.int32),
