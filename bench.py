@@ -7,7 +7,7 @@ configuration (batch 128 sequences, burn-in 40 / learning 10 / n-step 5,
 84x84x4 frames, cnn_out 1024, LSTM 512, dueling on, double off;
 /root/reference/config.py).
 
-Four measurements (VERDICT r2 #1/#3 + the round-3 kernels):
+Measurements (VERDICT r2 #1/#3 + the rounds-3/4 kernels):
   1. obs-decode A/B at the base config: XLA gather vs the pallas VMEM kernel;
   1b. replay sample-gather A/B: the scalar-prefetch pallas row gather vs the
      XLA batched-dynamic-slice gather, inside the full fused step;
@@ -15,6 +15,10 @@ Four measurements (VERDICT r2 #1/#3 + the round-3 kernels):
      default decode path — the reference's amp analog (config.py:35) and the
      host-dispatch amortization the reference cannot do (it pays a Ray RPC
      per step by construction, worker.py:303);
+  2b. optional A/B cells, ordered by information value: the fused pallas
+     LSTM scan (block_t sweep), the gather variant opposite the shipped
+     default, space_to_depth, NHWC decode (default-skipped dead end), and
+     the double-DQN unroll-fusion pair;
   3. an analytic model-FLOPs/s estimate against the chip's peak (MFU).
 
 vs_baseline: the reference publishes NO numbers (BASELINE.json "published":
