@@ -27,7 +27,7 @@ TPU-native re-design rather than translation:
   1-device run pays nothing) — model parallelism is a mesh-axis change.
 """
 
-from typing import Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import flax.linen as nn
 import jax
