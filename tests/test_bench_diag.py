@@ -192,3 +192,24 @@ def test_mid_run_wedge_emits_partial_results(tmp_path):
     assert "stale" not in out                         # fresh, not cached
     # smoke runs are not cache-worthy: the old cache must survive intact
     assert json.loads(cache.read_text()) == FAKE_CACHE
+
+
+def test_partial_results_refresh_cache_when_forced(tmp_path):
+    """The cacheable-partial branch: a partial carrying the headline cell
+    may replace the older cache as the next fallback (gated to TPU +
+    default-cell-measured in production; R2D2_BENCH_FORCE_CACHE exercises
+    it here)."""
+    cache = tmp_path / "cache.json"
+    cache.write_text(json.dumps(FAKE_CACHE))
+    proc = _run_bench({"R2D2_BENCH_SIMULATE_HANG": "1",
+                       "R2D2_BENCH_CHILD_TIMEOUT": "120",
+                       "R2D2_BENCH_FORCE_CACHE": "1",
+                       "R2D2_BENCH_CACHE": str(cache),
+                       "R2D2_BENCH_PARTIAL": str(tmp_path / "partial.json")},
+                      timeout=300)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out.get("partial") is True
+    saved = json.loads(cache.read_text())
+    assert saved["output"] == out            # fresh partial replaced the
+    assert saved["output"]["partial"] is True  # 2026-01-01 FAKE_CACHE entry
