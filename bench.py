@@ -334,16 +334,67 @@ def run_bench() -> None:
 
     results = {}
     matrix = {}
+    # cell_status: a parallel per-cell map so a partial/stale artifact is
+    # self-describing without PERF.md context — "not-run" (wedge before the
+    # cell), "ok", "ok-reused", "carried" (resume pass kept a prior
+    # measurement), "anomaly" (value kept but implausible — transient
+    # tunnel stall or early block_until_ready), "mosaic-reject",
+    # "failed:<Type>", or "skipped:<reason>". Bare null cells were
+    # indistinguishable across those cases (VERDICT r4).
+    cell_status = {}
     # R2D2_BENCH_SKIP: comma-separated substrings of optional-cell labels to
     # skip — the rerun lever when one cell's compile wedges the tunnel
     # (observed round 4: double_fused hung remote compile for >15 min)
     skip = [s for s in os.environ.get("R2D2_BENCH_SKIP", "").split(",") if s]
 
     def skipped(label):
+        if cell_status.get(label) == "carried":
+            return True
         if any(s in label for s in skip):
             print(f"[{label}] skipped via R2D2_BENCH_SKIP", file=sys.stderr)
+            cell_status[label] = "skipped:R2D2_BENCH_SKIP"
             return True
         return False
+
+    def record(label, seq_per_sec):
+        """Record a measured cell, classifying implausible values so they
+        never read as clean measurements (round-4 f32_spd4=245 lesson)."""
+        matrix[label] = seq_per_sec
+        st = "ok"
+        base = matrix.get("f32_spd1")
+        if base and seq_per_sec < 0.3 * base:
+            st = "anomaly"
+            print(f"[{label}] ANOMALY: {seq_per_sec:.1f} seq/s < 0.3x the "
+                  f"f32_spd1 base ({base:.1f}) — transient tunnel stall "
+                  "suspected; disregard this cell", file=sys.stderr)
+        if peak:
+            mfu = seq_per_sec / spec.batch_size * flops_per_step / peak
+            if mfu > 0.9:
+                st = "anomaly"
+                print(f"[{label}] ANOMALY: implied MFU {mfu:.2f} — early "
+                      "block_until_ready suspected (round-3 hazard); "
+                      "disregard this cell", file=sys.stderr)
+        cell_status[label] = st
+
+    def record_fail(label, e):
+        matrix[label] = None
+        msg = str(e)
+        cell_status[label] = ("mosaic-reject"
+                              if "osaic" in msg or "osaic" in type(e).__name__
+                              else f"failed:{type(e).__name__}")
+        print(f"[{label}] FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+
+    def mark_skip(label, reason):
+        # don't clobber a more specific status (R2D2_BENCH_SKIP, carried)
+        if cell_status.get(label, "not-run") == "not-run":
+            cell_status[label] = "skipped:" + reason
+
+    def gate_reason():
+        if smoke:
+            return "smoke"
+        if not on_tpu:
+            return "needs-tpu"
+        return "gated"
 
     # pre-seed every planned cell as None so a mid-run wedge reports the
     # never-reached cells in partial_missing instead of omitting them
@@ -361,6 +412,30 @@ def run_bench() -> None:
                    "bf16_spd16_double", "bf16_spd16_double_fused"]
     for label in planned:
         matrix[label] = None
+        cell_status[label] = "not-run"
+
+    # R2D2_BENCH_RESUME: the supervisor's only-missing-cells retry — after
+    # a mid-run wedge whose backend probe then SUCCEEDS, the rerun child
+    # seeds every already-measured cell from the partial snapshot
+    # ("carried") and spends the fresh window on the missing cells only.
+    if os.environ.get("R2D2_BENCH_RESUME"):
+        try:
+            with open(_partial_path()) as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            prev = {}
+        prev_status = prev.get("cell_status") or {}
+        for k, v in (prev.get("matrix") or {}).items():
+            if (v is not None and k in matrix
+                    and prev_status.get(k, "ok") in ("ok", "ok-reused",
+                                                     "carried")):
+                matrix[k] = v
+                cell_status[k] = "carried"
+                print(f"[{k}] carried from this run's partial snapshot "
+                      "(resume pass)", file=sys.stderr)
+        for k, v in (prev.get("results") or {}).items():
+            if v is not None and k not in results:
+                results[k] = v
 
     def checkpoint():
         # after every cell: snapshot what's measured so far so a later
@@ -368,7 +443,8 @@ def run_bench() -> None:
         try:
             tmp = _partial_path() + ".tmp"
             with open(tmp, "w") as f:
-                json.dump({"results": results, "matrix": matrix, "ctx": ctx},
+                json.dump({"results": results, "matrix": matrix, "ctx": ctx,
+                           "cell_status": cell_status},
                           f)
             os.replace(tmp, _partial_path())
         except OSError as e:
@@ -377,6 +453,11 @@ def run_bench() -> None:
     # --- 1. decode A/B at the base config (f32, spd=1) ------------------
     first = True
     for label, use_pallas in (("xla_decode", False), ("pallas_decode", True)):
+        if results.get(label) is not None:   # resume pass carried it
+            print(f"[{label}] carried from this run's partial snapshot",
+                  file=sys.stderr)
+            first = False
+            continue
         if use_pallas and (not on_tpu or smoke):
             results[label] = None
             reason = ("smoke mode measures the xla path only" if smoke else
@@ -412,7 +493,10 @@ def run_bench() -> None:
     # Part 1 ran with spec.pallas_gather auto-resolved (pallas on TPU); one
     # extra measurement with the gather forced off isolates its effect on
     # the full fused step.
-    if on_tpu and not smoke and spec.pallas_gather:
+    if results.get("xla_gather") is not None:   # resume pass carried it
+        print("[xla_gather] carried from this run's partial snapshot",
+              file=sys.stderr)
+    elif on_tpu and not smoke and spec.pallas_gather:
         spec_xla_gather = dataclasses.replace(spec, pallas_gather=False)
         step = build_step(default_pallas, bf16=False, spd=1,
                           step_spec=spec_xla_gather)
@@ -430,8 +514,13 @@ def run_bench() -> None:
         (True, 1), (True, 4), (True, 16)]
     for bf16, spd in combos:
         label = f"{'bf16' if bf16 else 'f32'}_spd{spd}"
+        if cell_status.get(label) == "carried":
+            print(f"[{label}] carried from this run's partial snapshot",
+                  file=sys.stderr)
+            continue
         if bf16 and not on_tpu:
             matrix[label] = None
+            mark_skip(label, "needs-tpu")
             print(f"[{label}] skipped: bf16 matrix is a TPU measurement",
                   file=sys.stderr)
             continue
@@ -441,13 +530,14 @@ def run_bench() -> None:
             reused = (results["pallas_decode"] if default_pallas
                       else results["xla_decode"])
             matrix[label] = reused
+            cell_status[label] = "ok-reused"
             checkpoint()
             print(f"[{label}] = {reused:.1f} seq/s (reused from part-1 A/B)",
                   file=sys.stderr)
             continue
         step = build_step(default_pallas, bf16, spd)
         sps, ts, rs = measure_path(step, ts, rs, label, steps_per_dispatch=spd)
-        matrix[label] = sps * spec.batch_size
+        record(label, sps * spec.batch_size)
         checkpoint()
         if peak:
             mfu = sps * flops_per_step / peak
@@ -492,13 +582,12 @@ def run_bench() -> None:
                                                use_double, 16)
                 sps, _tspl, rs = measure_path(step, ts_pl, rs, label,
                                               steps_per_dispatch=16)
-                matrix[label] = sps * spec.batch_size
+                record(label, sps * spec.batch_size)
             except Exception as e:   # never kill the bench for extra cells
-                matrix[label] = None
-                print(f"[{label}] FAILED: {type(e).__name__}: {e}",
-                      file=sys.stderr)
-        else:
+                record_fail(label, e)
+        elif cell_status.get(label) != "carried":
             matrix[label] = None
+            mark_skip(label, gate_reason())
         checkpoint()
 
     # --- 2b2. exact-read pad-gather A/B at the bf16_spd16 policy ---------
@@ -523,14 +612,13 @@ def run_bench() -> None:
             ts_pg = create_train_state(jax.random.PRNGKey(1), net, cfg.optim)
             sps, _tspg, rs_pad = measure_path(step, ts_pg, rs_pad, ab_label,
                                               steps_per_dispatch=16)
-            matrix[ab_label] = sps * spec.batch_size
+            record(ab_label, sps * spec.batch_size)
             del rs_pad
         except Exception as e:   # never kill the bench for the extra cell
-            matrix[ab_label] = None
-            print(f"[{ab_label}] FAILED: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-    else:
+            record_fail(ab_label, e)
+    elif cell_status.get(ab_label) != "carried":
         matrix[ab_label] = None
+        mark_skip(ab_label, gate_reason())
     checkpoint()
 
     # --- 2b3. space_to_depth A/B at the bf16_spd16 policy (the current
@@ -559,13 +647,12 @@ def run_bench() -> None:
                                            use_double, 16)
             sps, _ts2, rs = measure_path(step, ts_s2d, rs, "bf16_spd16_s2d",
                                          steps_per_dispatch=16)
-            matrix["bf16_spd16_s2d"] = sps * spec.batch_size
+            record("bf16_spd16_s2d", sps * spec.batch_size)
         except Exception as e:   # never kill the bench for the extra cell
-            matrix["bf16_spd16_s2d"] = None
-            print(f"[bf16_spd16_s2d] FAILED: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-    else:
+            record_fail("bf16_spd16_s2d", e)
+    elif cell_status.get("bf16_spd16_s2d") != "carried":
         matrix["bf16_spd16_s2d"] = None
+        mark_skip("bf16_spd16_s2d", gate_reason())
     checkpoint()
 
     # --- 2b4. NHWC-decode A/B at the bf16_spd16 policy -------------------
@@ -595,13 +682,14 @@ def run_bench() -> None:
                                            use_double, 16)
             sps, _tsn, rs = measure_path(step, ts_n, rs, "bf16_spd16_nhwc",
                                          steps_per_dispatch=16)
-            matrix["bf16_spd16_nhwc"] = sps * spec.batch_size
+            record("bf16_spd16_nhwc", sps * spec.batch_size)
         except Exception as e:   # never kill the bench for the extra cell
-            matrix["bf16_spd16_nhwc"] = None
-            print(f"[bf16_spd16_nhwc] FAILED: {type(e).__name__}: {e}",
-                  file=sys.stderr)
-    else:
+            record_fail("bf16_spd16_nhwc", e)
+    elif cell_status.get("bf16_spd16_nhwc") != "carried":
         matrix["bf16_spd16_nhwc"] = None
+        mark_skip("bf16_spd16_nhwc",
+                  gate_reason() if (not on_tpu or smoke)
+                  else "dead-end; set R2D2_BENCH_NHWC=1 to re-measure")
     checkpoint()
 
     # --- 2c. double-DQN unroll-fusion A/B at the bf16_spd16 policy -------
@@ -616,7 +704,8 @@ def run_bench() -> None:
         for label, fused in (("bf16_spd16_double", "off"),
                              ("bf16_spd16_double_fused", "on")):
             if skipped(label):
-                matrix[label] = None
+                if cell_status.get(label) != "carried":
+                    matrix[label] = None
                 continue
             try:
                 opt_d = dataclasses.replace(
@@ -636,14 +725,14 @@ def run_bench() -> None:
                                                steps_per_dispatch=16)
                 sps, _tsd, rs = measure_path(step, ts_d, rs, label,
                                              steps_per_dispatch=16)
-                matrix[label] = sps * spec.batch_size
+                record(label, sps * spec.batch_size)
             except Exception as e:   # never kill the bench for extra cells
-                matrix[label] = None
-                print(f"[{label}] FAILED: {type(e).__name__}: {e}",
-                      file=sys.stderr)
+                record_fail(label, e)
     else:
-        matrix["bf16_spd16_double"] = None
-        matrix["bf16_spd16_double_fused"] = None
+        for label in ("bf16_spd16_double", "bf16_spd16_double_fused"):
+            if cell_status.get(label) != "carried":
+                matrix[label] = None
+                mark_skip(label, gate_reason())
 
     # --- report ----------------------------------------------------------
     # primary metric: what the SHIPPED defaults actually run — default
@@ -656,7 +745,7 @@ def run_bench() -> None:
     # failed base measurement exits in part 1), so assemble_output never
     # returns None here. Assembly is shared with the supervisor's
     # partial-results fallback (assemble_output).
-    print(json.dumps(assemble_output(results, matrix, ctx)))
+    print(json.dumps(assemble_output(results, matrix, ctx, cell_status)))
 
 
 # The probe must route any JAX_PLATFORMS request through jax.config BEFORE
@@ -735,21 +824,35 @@ def _write_cache(result: dict) -> None:
           file=sys.stderr)
 
 
-def assemble_output(results: dict, matrix: dict, ctx: dict):
+def assemble_output(results: dict, matrix: dict, ctx: dict,
+                    cell_status: dict = None):
     """Build the final JSON dict from measured cells + static context.
     Shared by the measurement child (full run) and the supervisor's
     partial-results fallback (emit_partial_or_stale), so a wedge in a LATE
     cell cannot discard the cells already measured this run. Returns None
-    when no comparable cell exists yet."""
+    when no comparable cell exists yet.
+
+    ``cell_status`` makes the matrix self-describing (per cell: "ok",
+    "ok-reused", "carried", "anomaly", "mosaic-reject", "failed:<Type>",
+    "skipped:<reason>", "not-run"); absent (pre-round-5 snapshots) it is
+    synthesized from the values alone ("ok" / "unknown")."""
+    if cell_status is None:
+        cell_status = {k: ("ok" if v is not None else "unknown")
+                       for k, v in matrix.items()}
+    # anomalous values never elect the headline or best cell
     candidates = {k: v for k, v in matrix.items()
-                  if v is not None and "_double" not in k}
+                  if v is not None and "_double" not in k
+                  and cell_status.get(k) != "anomaly"}
     if not candidates:
         return None
     # _double cells are a different workload (a second unroll's FLOPs) —
     # comparable to each other, not to the default config's cells
     best_label = max(candidates, key=candidates.get)
     default_label = ctx["default_label"]
-    measured_label = (default_label if matrix.get(default_label) is not None
+    # the default cell elects the headline only when its measurement is
+    # clean — an anomaly-flagged default (round-4 f32_spd4 class) must not
+    # become the artifact's value/vs_baseline/MFU
+    measured_label = (default_label if default_label in candidates
                       else best_label)
     seq_updates = matrix[measured_label]
 
@@ -770,6 +873,7 @@ def assemble_output(results: dict, matrix: dict, ctx: dict):
         "xla_gather": _r("xla_gather"),
         "pallas_gather": _r("pallas_gather"),
         "matrix": {k: v and round(v, 1) for k, v in matrix.items()},
+        "cell_status": {k: cell_status.get(k, "unknown") for k in matrix},
         "platform": ctx["platform"],
         "device_kind": ctx["device_kind"],
     }
@@ -790,7 +894,8 @@ def emit_partial_or_stale(reason: str) -> None:
     try:
         with open(_partial_path()) as f:
             snap = json.load(f)
-        out = assemble_output(snap["results"], snap["matrix"], snap["ctx"])
+        out = assemble_output(snap["results"], snap["matrix"], snap["ctx"],
+                              snap.get("cell_status"))
     except (OSError, ValueError, KeyError):
         out = None
     if out is None:
@@ -829,6 +934,10 @@ def emit_stale_or_die(reason: str) -> None:
     out["stale"] = True
     out["stale_reason"] = reason
     out["stale_recorded_at"] = cache.get("recorded_at")
+    if "cell_status" not in out and isinstance(out.get("matrix"), dict):
+        # pre-round-5 cache: synthesize so the artifact stays self-describing
+        out["cell_status"] = {k: ("ok" if v is not None else "unknown")
+                              for k, v in out["matrix"].items()}
     print("bench: emitting LAST-GOOD measurement (stale=true, recorded "
           f"{cache.get('recorded_at')}) because: {reason}", file=sys.stderr)
     print(json.dumps(out))
@@ -890,13 +999,32 @@ def supervise() -> None:
         proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                                 env=env, stdout=subprocess.PIPE, text=True)
         active["proc"] = proc
-        try:
-            out, _ = proc.communicate(timeout=child_timeout)
-        except subprocess.TimeoutExpired:
-            _terminate(proc)
-            emit_partial_or_stale(
-                f"measurement exceeded the {child_timeout:.0f}s deadline "
-                "(backend likely wedged mid-run)")
+        resumed = False
+        while True:
+            try:
+                out, _ = proc.communicate(timeout=child_timeout)
+                break
+            except subprocess.TimeoutExpired:
+                _terminate(proc)
+                if (resumed or os.environ.get("R2D2_BENCH_NO_RESUME")
+                        or not probe_backend(probe_timeout, active)):
+                    emit_partial_or_stale(
+                        f"measurement exceeded the {child_timeout:.0f}s "
+                        "deadline (backend likely wedged mid-run)")
+                # deadline hit but the backend still answers (a single cell
+                # stalled, not a dead tunnel): spend ONE more window on the
+                # missing cells only — the rerun child seeds measured cells
+                # from the partial snapshot (R2D2_BENCH_RESUME)
+                active["proc"] = None
+                print("bench: child deadline hit but the backend probe "
+                      "still answers — re-running missing cells only",
+                      file=sys.stderr, flush=True)
+                resumed = True
+                proc = subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=dict(env, R2D2_BENCH_RESUME="1"),
+                    stdout=subprocess.PIPE, text=True)
+                active["proc"] = proc
         active["proc"] = None
     finally:
         signal.signal(signal.SIGTERM, prev_term)

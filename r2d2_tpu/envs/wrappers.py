@@ -7,6 +7,7 @@ path as the reference, environment.py:71-75); otherwise a numpy fallback
 everywhere.
 """
 
+import functools
 from typing import Any, Tuple
 
 import numpy as np
@@ -26,21 +27,49 @@ def _to_gray(frame: np.ndarray) -> np.ndarray:
     return (frame @ np.array([0.299, 0.587, 0.114])).astype(np.uint8)
 
 
+@functools.lru_cache(maxsize=8)
+def _area_weights(n_src: int, n_dst: int) -> np.ndarray:
+    """(n_dst, n_src) row-normalized coverage weights for 1-D area
+    resampling: output cell i averages the source interval
+    [i*s, (i+1)*s), s = n_src/n_dst, with fractional edge coverage —
+    the pixel-area relation cv2's INTER_AREA computes for downscaling."""
+    scale = n_src / n_dst
+    w = np.zeros((n_dst, n_src), np.float64)
+    for i in range(n_dst):
+        a, b = i * scale, (i + 1) * scale
+        for k in range(int(np.floor(a)), min(int(np.ceil(b)), n_src)):
+            w[i, k] = min(k + 1.0, b) - max(float(k), a)
+    return w / w.sum(axis=1, keepdims=True)
+
+
+_warned_fallback = False
+
+
 def _resize(frame: np.ndarray, height: int, width: int) -> np.ndarray:
     if frame.shape == (height, width):
         return frame
     if _HAS_CV2:
         return cv2.resize(frame, (width, height), interpolation=cv2.INTER_AREA)
-    # numpy area-mean fallback: crop to a multiple then block-average;
-    # exact only for integer ratios, adequate as a dependency-free path.
-    h, w = frame.shape
-    ry, rx = max(h // height, 1), max(w // width, 1)
-    crop = frame[: ry * height, : rx * width]
-    if crop.shape != (ry * height, rx * width):
-        pad_y = ry * height - crop.shape[0]
-        pad_x = rx * width - crop.shape[1]
-        crop = np.pad(crop, ((0, pad_y), (0, pad_x)), mode="edge")
-    return crop.reshape(height, ry, width, rx).mean(axis=(1, 3)).astype(np.uint8)
+    # numpy fallback: exact area resample (separable coverage-weighted
+    # average, fractional ratios included — real Atari is 210x160 -> 84x84,
+    # ratios 2.5 and 1.9). Matches cv2's INTER_AREA up to fixed-point
+    # rounding (+-1 gray level, tested vs cv2 in CI); warn once anyway so a
+    # cv2-less deployment knows its observations are not bit-identical to
+    # the reference preprocessing (ref environment.py:71-75; VERDICT r4).
+    global _warned_fallback
+    if not _warned_fallback:
+        import warnings
+        warnings.warn(
+            "cv2 is not installed: WarpFrame is using the numpy area-"
+            f"resample fallback for {frame.shape} -> ({height}, {width}). "
+            "It matches cv2 INTER_AREA only up to rounding (+-1 gray "
+            "level) — install opencv-python for the reference's exact "
+            "preprocessing.")
+        _warned_fallback = True
+    wy = _area_weights(frame.shape[0], height)
+    wx = _area_weights(frame.shape[1], width)
+    out = wy @ frame.astype(np.float64) @ wx.T
+    return np.clip(np.floor(out + 0.5), 0, 255).astype(np.uint8)
 
 
 class Wrapper:

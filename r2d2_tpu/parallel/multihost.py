@@ -690,9 +690,24 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                         lambda a: jax.make_array_from_process_local_data(
                             batch_sharding, np.asarray(a)), batch_np)
                     ts, m = ext_step(ts, gbatch)
+                    # Pin the layout before the per-host split: the step is
+                    # sharding-agnostic by design (its compiled output
+                    # layout follows GSPMD's choice), so a compiler change
+                    # that replicated or resharded priorities would
+                    # silently hand _local_dp_values wrong-length data.
+                    # device_put is a no-op when the layout already matches
+                    # and an explicit reshard when it does not.
+                    prios_local = _local_dp_values(
+                        jax.device_put(m["priorities"], batch_sharding))
+                    if len(prios_local) != len(batch_np.idxes):
+                        raise RuntimeError(
+                            f"priority write-back shape drift: "
+                            f"{len(prios_local)} local priorities for "
+                            f"{len(batch_np.idxes)} sampled idxes "
+                            "(dp-sharded step output no longer matches "
+                            "this host's batch rows)")
                     host_replay.update_priorities(
-                        batch_np.idxes, _local_dp_values(m["priorities"]),
-                        snapshot)
+                        batch_np.idxes, prios_local, snapshot)
                 else:
                     ts, rs, m = step_fn(ts, rs)
                 step_count += k

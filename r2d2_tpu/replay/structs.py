@@ -115,6 +115,25 @@ class ReplaySpec:
         return -(-self.frame_width // 128) * 128
 
     @property
+    def device_ring_bytes(self) -> int:
+        """Estimated HBM footprint of one ReplayState at replay_init —
+        exact for the arrays it allocates (obs ring dominating; padded
+        dims under exact_gather). Used by the replay_init capacity guard
+        so an oversized ring is refused with numbers instead of OOMing,
+        and available to CLIs for config-time validation. Note
+        dp-sharding does NOT divide this: each shard holds a full ring
+        (sharded_replay_init)."""
+        n, s, l = self.num_blocks, self.seqs_per_block, self.learning
+        obs = (n * self.obs_row_len
+               * self.stored_frame_height * self.stored_frame_width)
+        last_action = n * self.la_row_len * 4
+        hidden = n * s * 2 * self.hidden_dim * 4
+        # action/reward/gamma (n,s,l) + 4 per-sequence i32 fields
+        seq_meta = n * s * (3 * l + 4) * 4
+        tree = (2 ** self.tree_layers - 1) * 4
+        return obs + last_action + hidden + seq_meta + tree
+
+    @property
     def seq_window(self) -> int:
         """Unrolled steps per sampled sequence (ref config.py:51 seq_len)."""
         return self.burn_in + self.learning + self.forward

@@ -7,21 +7,50 @@ POSIX shared-memory segment with a seqlock header — single writer (learner),
 many readers (actor processes), zero RPCs, torn reads detected by version
 mismatch and retried.
 
-Layout: [u64 version][f32 payload...] where payload is the ravel of the param
-pytree (jax.flatten_util.ravel_pytree order). Version is odd while a write is
-in flight; readers spin until they observe the same even version before and
-after the copy.
+Layout: [u64 version][u64 crc32][f32 payload...] where payload is the ravel
+of the param pytree (jax.flatten_util.ravel_pytree order). Version is odd
+while a write is in flight; readers spin until they observe the same even
+version before and after the copy.
+
+Torn-read impossibility, by architecture:
+
+* x86/amd64 (TSO): stores retire in program order and loads are not
+  reordered with other loads, so a reader that observes the same EVEN
+  version before and after its copy cannot have copied a half-written
+  payload — the classic seqlock argument. The crc32 word is unused
+  (written once as 0) so the hot publish path stays a plain memcpy.
+* weakly-ordered hosts (ARM, POWER): CPython emits no fences, so the
+  version stores may become visible before/after the payload stores and
+  the seqlock argument fails. There, every publish also stores
+  ``crc32(payload) ^ version`` and every read validates the copied
+  payload against the header crc AT the observed version before accepting
+  it. Binding the version into the crc rejects both failure shapes: a
+  torn copy (payload bytes mismatch the crc) and a consistent-but-STALE
+  copy (new version visible before the new payload/crc — the old crc no
+  longer matches under the new version, so the reader retries instead of
+  recording last_version against data it never received). A wrong accept
+  needs a crc32 collision (~2**-32 per poll, transient: the next poll
+  re-reads). Validation is keyed off ``platform.machine()`` at import,
+  identical in writer and readers because shm is same-host by nature.
 
 ``InProcWeightStore`` is the thread-mode twin (tests, single-process runs).
 """
 
+import platform
 import threading
+import zlib
 from multiprocessing import shared_memory
 from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
 from jax.flatten_util import ravel_pytree
+
+# x86-TSO machines where the bare seqlock ordering argument holds; anything
+# else pays the crc32 validation path (see module docstring)
+_TSO_MACHINES = ("x86_64", "amd64", "i386", "i686", "x86")
+_NEEDS_CHECKSUM = platform.machine().lower() not in _TSO_MACHINES
+_HEADER_BYTES = 16                      # u64 version + u64 crc32
 
 
 def untrack_attached_shm(shm: shared_memory.SharedMemory) -> None:
@@ -49,25 +78,30 @@ class WeightPublisher:
     def __init__(self, params, name: Optional[str] = None):
         flat, self._unravel = _flatten(params)
         self.num_weights = flat.shape[0]
-        nbytes = 8 + 4 * self.num_weights
+        nbytes = _HEADER_BYTES + 4 * self.num_weights
         self.shm = shared_memory.SharedMemory(create=True, size=nbytes, name=name)
         self.name = self.shm.name
         self._version = np.ndarray((1,), np.uint64, self.shm.buf, 0)
-        self._payload = np.ndarray((self.num_weights,), np.float32, self.shm.buf, 8)
+        self._crc = np.ndarray((1,), np.uint64, self.shm.buf, 8)
+        self._payload = np.ndarray((self.num_weights,), np.float32,
+                                   self.shm.buf, _HEADER_BYTES)
         self._version[0] = 0
+        self._crc[0] = 0
         self.publish(params)
 
     def publish(self, params) -> None:
-        # Seqlock ordering note: the version/payload/version stores have no
-        # explicit memory barriers — readers are correct under x86-TSO store
-        # ordering (this deployment). On weakly-ordered hosts (ARM) a reader
-        # could observe an even version with a partially updated payload;
-        # add a fence (e.g. write payload via a memoryview + os.write-style
-        # flush, or an atomic version word) before targeting ARM.
+        # Ordering: see the module docstring — the bare version/payload/
+        # version protocol is sound under x86-TSO; on weakly-ordered hosts
+        # readers additionally validate the crc stored here.
         flat = np.asarray(jax.device_get(ravel_pytree(params)[0]), np.float32)
-        self._version[0] += 1          # odd: write in flight
+        v = int(self._version[0])
+        self._version[0] = v + 1       # odd: write in flight
+        if _NEEDS_CHECKSUM:
+            # bind the FINAL even version into the crc (see module
+            # docstring: rejects consistent-but-stale reads, not just torn)
+            self._crc[0] = zlib.crc32(flat) ^ ((v + 2) & 0xFFFFFFFF)
         self._payload[:] = flat
-        self._version[0] += 1          # even: stable
+        self._version[0] = v + 2       # even: stable
 
     def close(self) -> None:
         self.shm.close()
@@ -86,7 +120,9 @@ class WeightSubscriber:
         self.shm = shared_memory.SharedMemory(name=name)
         untrack_attached_shm(self.shm)
         self._version = np.ndarray((1,), np.uint64, self.shm.buf, 0)
-        self._payload = np.ndarray((self.num_weights,), np.float32, self.shm.buf, 8)
+        self._crc = np.ndarray((1,), np.uint64, self.shm.buf, 8)
+        self._payload = np.ndarray((self.num_weights,), np.float32,
+                                   self.shm.buf, _HEADER_BYTES)
         self.last_version = 0
 
     def poll(self):
@@ -96,8 +132,11 @@ class WeightSubscriber:
             return None
         for _ in range(64):             # seqlock retry loop
             buf = self._payload.copy()
+            crc = int(self._crc[0])
             v2 = int(self._version[0])
-            if v1 == v2 and v2 % 2 == 0:
+            if v1 == v2 and v2 % 2 == 0 and (
+                    not _NEEDS_CHECKSUM
+                    or (zlib.crc32(buf) ^ (v2 & 0xFFFFFFFF)) == crc):
                 self.last_version = v2
                 return self._unravel(buf)
             v1 = int(self._version[0])

@@ -1,0 +1,55 @@
+"""On-chip learnability spot check (PERF.md "Measurements queued" #4).
+
+Runs the learnability acceptance config (tests/test_learnability.py — the
+CI stand-in for the reference's Boxing curve, /root/reference/README.md:38-40)
+on the REAL chip under the shipped defaults — which now resolve to the
+padded exact-read gather storage — with runtime.steps_per_dispatch=1 to
+keep the calibrated collect:learn ratio (the round-3 run's setup).
+
+Acceptance: every seed >= 2x random (40.0), mean >= 3x (60.0); round-3
+margins were 76/77/70 (mean 74.3) vs 20.0 random.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tests"))
+
+from test_learnability import (COLLECT_EPS, EVAL_SEEDS,  # noqa: E402
+                               RANDOM_EXPECTATION, TRAIN_STEPS, learn_config)
+
+
+def main() -> int:
+    import jax
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+    if dev.platform != "tpu":
+        print("not a TPU backend — this is the on-chip spot check; the CPU "
+              "result is already CI-gated", file=sys.stderr)
+        return 2
+    cfg = learn_config("/tmp/r5_learn_tpu").replace(
+        **{"runtime.steps_per_dispatch": 1})
+    from r2d2_tpu.tools.sync_train import greedy_return, sync_train
+    t0 = time.time()
+    net, learner = sync_train(cfg, TRAIN_STEPS, COLLECT_EPS, seed=0,
+                              deadline=t0 + 3000)
+    returns = [float(greedy_return(net, learner.train_state.params,
+                                   cfg.env, s)) for s in EVAL_SEEDS]
+    mean = sum(returns) / len(returns)
+    out = {"returns": returns, "mean": round(mean, 1),
+           "random_expectation": RANDOM_EXPECTATION,
+           "pass": (min(returns) >= 2 * RANDOM_EXPECTATION
+                    and mean >= 3 * RANDOM_EXPECTATION),
+           "exact_gather_default_resolved": bool(
+               learner.spec.exact_gather),
+           "train_s": round(time.time() - t0, 1),
+           "device_kind": dev.device_kind}
+    print(json.dumps(out))
+    return 0 if out["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

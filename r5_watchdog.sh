@@ -1,0 +1,40 @@
+#!/bin/bash
+# Round-5 capture watchdog: wait for a healthy TPU tunnel, then run the
+# queued measurement sequence in order (verify skill's post-wedge recipe):
+#   1. chip_checks  — validate every pallas kernel's Mosaic lowering (~3 min)
+#   2. bench.py     — full matrix incl. plstm block_t sweep 1/5/11 (~20 min)
+#   3. r5_learn_tpu — on-chip learnability under shipped (padded) defaults
+# Logs: r5_capture.log; artifacts: r5_chip_checks.log, r5_bench_out.json,
+# r5_bench_err.log, r5_learn_out.json, r5_learn_err.log.
+cd /root/repo || exit 1
+LOG=r5_capture.log
+ts() { date -u +%FT%TZ; }
+probe() {
+  timeout 90 python -c "from r2d2_tpu.utils.platform import pin_platform; pin_platform(); import jax; d=jax.devices(); assert d[0].platform=='tpu', d; import jax.numpy as jnp; (jnp.ones((8,128))@jnp.ones((128,8))).block_until_ready(); print('probe-ok', d[0].device_kind)" >> "$LOG" 2>&1
+}
+echo "$(ts) watchdog start (pid $$)" >> "$LOG"
+while true; do
+  if probe; then
+    echo "$(ts) tunnel HEALTHY -> chip_checks" >> "$LOG"
+    python -m r2d2_tpu.cli.chip_checks > r5_chip_checks.log 2>&1
+    echo "$(ts) chip_checks rc=$?" >> "$LOG"
+    echo "$(ts) bench start (plstm bt sweep 1,5,11)" >> "$LOG"
+    R2D2_BENCH_CHILD_TIMEOUT=2700 R2D2_BENCH_PLSTM_BT=1,5,11 \
+      python bench.py > r5_bench_out.json 2> r5_bench_err.log
+    echo "$(ts) bench rc=$?" >> "$LOG"
+    if probe; then
+      echo "$(ts) learnability start" >> "$LOG"
+      # sync_train carries its own in-process deadline (graceful); the
+      # outer timeout is a last resort only (SIGTERM, then SIGKILL +60s)
+      timeout -k 60 4500 python r5_learn_tpu.py \
+        > r5_learn_out.json 2> r5_learn_err.log
+      echo "$(ts) learnability rc=$?" >> "$LOG"
+    else
+      echo "$(ts) tunnel wedged again after bench; skipping learnability" >> "$LOG"
+    fi
+    echo "$(ts) capture sequence COMPLETE" >> "$LOG"
+    break
+  fi
+  echo "$(ts) still wedged; sleeping 180s" >> "$LOG"
+  sleep 180
+done

@@ -76,6 +76,37 @@ def test_weight_shm_roundtrip(small_params):
         pub.close()
 
 
+def test_weight_shm_checksum_path(small_params, monkeypatch):
+    """The non-TSO validation path (VERDICT r4 #6): with _NEEDS_CHECKSUM
+    forced on, (a) the roundtrip still works (crc written + validated), and
+    (b) a payload corrupted AFTER the version settled — the torn-read shape
+    a weakly-ordered host can produce — is rejected instead of returned."""
+    from r2d2_tpu.runtime import weights as W
+    monkeypatch.setattr(W, "_NEEDS_CHECKSUM", True)
+    pub = WeightPublisher(small_params)
+    try:
+        sub = WeightSubscriber(pub.name, small_params)
+        got = sub.poll()
+        assert got is not None
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                    np.asarray(b)),
+            small_params, got)
+        # simulate a torn publish: bump the version to a NEW even value
+        # (so the version gate alone would accept) but corrupt the payload
+        # relative to the stored crc
+        bumped = jax.tree_util.tree_map(lambda x: x + 1.0, small_params)
+        pub.publish(bumped)
+        pub._payload[0] += 123.0
+        assert sub.poll() is None          # crc mismatch -> rejected
+        # a clean re-publish recovers
+        pub.publish(bumped)
+        assert sub.poll() is not None
+        sub.close()
+    finally:
+        pub.close()
+
+
 def test_inproc_store_per_reader_versions(small_params):
     store = InProcWeightStore(small_params)
     assert store.poll(0) is not None
