@@ -1,0 +1,9 @@
+"""CLI alias: ``python -m r2d2_tpu.cli.soak`` — see
+r2d2_tpu/tools/soak.py (production-scale sustained-training soak)."""
+
+import sys
+
+from r2d2_tpu.tools.soak import main
+
+if __name__ == "__main__":
+    sys.exit(main())

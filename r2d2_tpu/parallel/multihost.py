@@ -634,6 +634,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             pending_losses.clear()
 
         debug = bool(os.environ.get("R2D2_MH_DEBUG"))
+        chaos_kill_at = int(os.environ.get("R2D2_MH_CHAOS_KILL_ACTOR", "0"))
+        chaos_done = False
         it = 0
         while step_count < max_steps:
             it += 1
@@ -725,6 +727,27 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                         config_json=cfg.to_json())
             else:
                 time.sleep(0.01)
+
+            if (chaos_kill_at and not chaos_done
+                    and actor_mode == "process" and it >= chaos_kill_at):
+                # chaos hook (tests only, R2D2_MH_CHAOS_KILL_ACTOR=<it>):
+                # SIGKILL one actor child mid-run, then tick supervision
+                # immediately — the fleet must detect the corpse, reclaim
+                # any shm ring slot it held between reserve and commit,
+                # and respawn, all without disturbing the lockstep loop
+                # (restarts are host-local by design, see LocalActorFleet)
+                victim = fleet.threads[0]
+                victim.kill()
+                victim.join(5.0)
+                chaos_restarted = fleet.supervise()
+                import json as _json
+                with open(os.path.join(rt.save_dir,
+                                       f"chaos_kill_r{rank}.json"),
+                          "w") as f:
+                    _json.dump({"iteration": it,
+                                "restarted": chaos_restarted,
+                                "victim_exitcode": victim.exitcode}, f)
+                chaos_done = True
 
             now = time.time()
             if now - last_supervise >= rt.log_interval:

@@ -32,6 +32,15 @@ while true; do
     else
       echo "$(ts) tunnel wedged again after bench; skipping learnability" >> "$LOG"
     fi
+    if probe; then
+      echo "$(ts) soak start (30 min, reference scale)" >> "$LOG"
+      timeout -k 60 3600 python -m r2d2_tpu.cli.soak --seconds=1800 \
+        --save-dir=/tmp/r2d2_soak_r5 \
+        > r5_soak_out.json 2> r5_soak_err.log
+      echo "$(ts) soak rc=$?" >> "$LOG"
+    else
+      echo "$(ts) tunnel wedged; skipping soak" >> "$LOG"
+    fi
     echo "$(ts) capture sequence COMPLETE" >> "$LOG"
     break
   fi

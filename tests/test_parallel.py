@@ -540,3 +540,29 @@ def test_multihost_lockstep_host_replay(tmp_path):
     launch_demo(num_processes=2, devices_per_process=2,
                 save_dir=str(tmp_path / "mh_host_tp"),
                 max_steps=8, timeout=280.0, placement="host", mp=2)
+
+
+@pytest.mark.slow
+def test_multihost_chaos_process_actor_kill_recovers(tmp_path, monkeypatch):
+    """Chaos test (VERDICT r4 #8): SIGKILL a process-mode actor child
+    mid-run under the lockstep multihost trainer with the shm block ring.
+    The per-host fleet must detect the corpse, reclaim its ring slot
+    (RingRecoveryScheduler), and respawn onto the LIVE ring — and training
+    must still finish with bit-identical cross-host params (digest check
+    inside launch_demo)."""
+    import glob
+    import json
+    import os
+
+    from r2d2_tpu.parallel.multihost import launch_demo
+
+    monkeypatch.setenv("R2D2_MH_CHAOS_KILL_ACTOR", "5")
+    save_dir = str(tmp_path / "mh_chaos")
+    launch_demo(num_processes=2, devices_per_process=2, save_dir=save_dir,
+                max_steps=8, timeout=280.0, actor_mode="process")
+    markers = glob.glob(os.path.join(save_dir, "chaos_kill_r*.json"))
+    assert len(markers) == 2, markers          # every rank ran the chaos
+    for m in sorted(markers):
+        rec = json.loads(open(m).read())
+        assert rec["victim_exitcode"] not in (0, None)   # SIGKILLed corpse
+        assert rec["restarted"] >= 1, rec      # supervision respawned it

@@ -248,3 +248,46 @@ def test_chip_checks_refuses_cpu_backend():
     lower there) instead of failing kernel-by-kernel."""
     from r2d2_tpu.tools.chip_checks import run_chip_checks
     assert run_chip_checks() == 2
+
+
+@pytest.mark.slow
+def test_soak_smoke_contract(tmp_path):
+    """The production-soak CLI (VERDICT r4 #3) at toy scale: fill+wrap the
+    ring, train with interleaved ingestion, checkpoint on cadence, emit
+    the one-line JSON contract."""
+    import json
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "r2d2_tpu.cli.soak", "--seconds=6",
+         "--capacity=200", "--checkpoint-interval=3",
+         f"--save-dir={tmp_path}",
+         "--override", "env.frame_height=24",
+         "--override", "env.frame_width=24",
+         "--override", "env.frame_stack=2",
+         "--override", "network.hidden_dim=32",
+         "--override", "network.cnn_out_dim=32",
+         "--override", "network.conv_layers=[[8,4,2],[16,3,1]]",
+         "--override", "replay.block_length=20",
+         "--override", "sequence.burn_in_steps=4",
+         "--override", "sequence.learning_steps=5",
+         "--override", "sequence.forward_steps=3",
+         "--override", "replay.batch_size=8"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "soak"
+    # OBSERVED wrap evidence from the replay state itself: the buffer is
+    # full (capacity learning-steps) and the write pointer came back
+    # around the ring after num_blocks + wrap_extra adds
+    assert out["buffer_steps_after_fill"] == 200    # == capacity
+    assert 0 < out["block_ptr_after_fill"] < out["num_blocks"]
+    assert out["ring_laps_fill"] > 1.0
+    assert out["ring_laps_train"] > 0           # ingestion during training
+    assert out["train_steps"] > 0
+    assert out["steps_per_sec_mean"] > 0
+    assert len(out["checkpoint_save_s"]) >= 1   # cadence fired
+    assert all(np.isfinite(x) for x in out["losses_sampled"])
