@@ -887,8 +887,12 @@ def emit_partial_or_stale(reason: str) -> None:
         return
     out["partial"] = True
     out["partial_reason"] = reason
+    # "missing" = cells a rerun could still measure — deliberately skipped
+    # cells (env-gated nhwc, R2D2_BENCH_SKIP) are not losses of this wedge
+    snap_status = snap.get("cell_status") or {}
     out["partial_missing"] = sorted(
-        k for k, v in snap["matrix"].items() if v is None)
+        k for k, v in snap["matrix"].items()
+        if v is None and not snap_status.get(k, "").startswith("skipped:"))
     print("bench: emitting PARTIAL fresh measurement "
           f"(missing cells: {out['partial_missing']}) because: {reason}",
           file=sys.stderr)
