@@ -1,0 +1,106 @@
+"""Post-bench decision helper for the round-5 capture watchdog.
+
+Reads the fresh bench artifact and applies the measurement-driven default
+flips the round-4 verdict prescribes, so a healthy tunnel window is used
+end-to-end without waiting for a human in the loop:
+
+  1. If a fused-pallas-LSTM cell (bf16_spd16_plstm / _bt5 / _bt11) beats
+     the bf16_spd16 headline by >2%, flip ``network.pallas_lstm`` to
+     "auto" (and ``pallas_lstm_block`` to the winning block size) in
+     config.py, run the fast LSTM parity tests, and exit 10 — the
+     watchdog then re-runs bench.py so the headline cell measures the
+     new default.
+  2. If the headline (now measuring the padded exact-read gather default)
+     came in BELOW the row-gather A/B cell, revert
+     ``replay.pallas_exact_gather`` to "off" and exit 10 likewise.
+  3. Otherwise exit 0 (defaults stand; nothing to re-measure).
+
+Exit 1 = artifact unreadable/stale (no decision possible).
+"""
+import json
+import re
+import subprocess
+import sys
+
+CFG = "/root/repo/r2d2_tpu/config.py"
+
+
+def _edit(pattern, repl):
+    src = open(CFG).read()
+    new, n = re.subn(pattern, repl, src, count=1)
+    if n != 1:
+        raise RuntimeError(f"config edit failed: {pattern!r}")
+    open(CFG, "w").write(new)
+
+
+def main() -> int:
+    try:
+        with open("/root/repo/r5_bench_out.json") as f:
+            out = json.loads(f.read().strip().splitlines()[-1])
+    except (OSError, ValueError, IndexError) as e:
+        print(f"decide: no readable artifact ({e})", file=sys.stderr)
+        return 1
+    if out.get("stale"):
+        print("decide: artifact is stale — no decision", file=sys.stderr)
+        return 1
+    matrix = out.get("matrix") or {}
+    status = out.get("cell_status") or {}
+
+    def val(label):
+        v = matrix.get(label)
+        return v if v is not None and status.get(label, "ok") in (
+            "ok", "ok-reused", "carried") else None
+
+    base = val("bf16_spd16")
+    if base is None:
+        print("decide: no clean headline cell — no decision",
+              file=sys.stderr)
+        return 1
+
+    changed = []
+    # --- 1. fused pallas LSTM ------------------------------------------
+    plstm = {1: val("bf16_spd16_plstm"),
+             5: val("bf16_spd16_plstm_bt5"),
+             11: val("bf16_spd16_plstm_bt11")}
+    plstm = {k: v for k, v in plstm.items() if v is not None}
+    if plstm:
+        bt, best = max(plstm.items(), key=lambda kv: kv[1])
+        print(f"decide: plstm best = {best:.0f} (bt={bt}) vs base "
+              f"{base:.0f}", file=sys.stderr)
+        if best > 1.02 * base:
+            _edit(r'pallas_lstm: str = "off"',
+                  'pallas_lstm: str = "auto"')
+            if bt != 1:
+                _edit(r"pallas_lstm_block: int = 1",
+                      f"pallas_lstm_block: int = {bt}")
+            changed.append(f"pallas_lstm=auto block={bt} ({best:.0f} vs "
+                           f"{base:.0f})")
+
+    # --- 2. exact-gather confirmation ----------------------------------
+    row = val("bf16_spd16_rowgather")
+    if row is not None and row > base:
+        _edit(r'pallas_exact_gather: str = "auto"',
+              'pallas_exact_gather: str = "off"')
+        changed.append(f"pallas_exact_gather=off (rowgather {row:.0f} "
+                       f"beat padded headline {base:.0f})")
+
+    if not changed:
+        print("decide: defaults stand", file=sys.stderr)
+        return 0
+    print("decide: flipped ->", "; ".join(changed), file=sys.stderr)
+    # gate the flip on the fast parity tests before re-spending the chip
+    t = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_network.py",
+         "tests/test_train_step.py", "-q", "-m", "not slow"],
+        cwd="/root/repo", capture_output=True, text=True, timeout=1200)
+    if t.returncode != 0:
+        print("decide: parity tests FAILED after flip — reverting",
+              file=sys.stderr)
+        subprocess.run(["git", "checkout", "--", "r2d2_tpu/config.py"],
+                       cwd="/root/repo")
+        return 1
+    return 10
+
+
+if __name__ == "__main__":
+    sys.exit(main())

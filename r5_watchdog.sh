@@ -22,6 +22,16 @@ while true; do
     R2D2_BENCH_CHILD_TIMEOUT=2700 R2D2_BENCH_PLSTM_BT=1,5,11 \
       python bench.py > r5_bench_out.json 2> r5_bench_err.log
     echo "$(ts) bench rc=$?" >> "$LOG"
+    # measurement-driven default flips (plstm win / exact-gather revert):
+    # rc=10 means config.py changed and parity tests passed -> re-run
+    # bench so the headline cell measures the NEW defaults
+    python r5_decide.py >> "$LOG" 2>&1
+    if [ $? -eq 10 ]; then
+      echo "$(ts) defaults flipped; re-running bench under new defaults" >> "$LOG"
+      R2D2_BENCH_CHILD_TIMEOUT=2700 \
+        python bench.py > r5_bench_flipped_out.json 2> r5_bench_flipped_err.log
+      echo "$(ts) flipped bench rc=$?" >> "$LOG"
+    fi
     if probe; then
       echo "$(ts) learnability start" >> "$LOG"
       # sync_train carries its own in-process deadline (graceful); the
