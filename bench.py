@@ -387,13 +387,22 @@ def run_bench() -> None:
     spec_pad = dataclasses.replace(spec, exact_gather=not spec.exact_gather)
     ab_label = ("bf16_spd16_exactgather" if spec_pad.exact_gather
                 else "bf16_spd16_rowgather")
+    # R2D2_BENCH_PLSTM_BT: comma-separated block_t values to sweep in the
+    # fused-LSTM section (timesteps per kernel grid iteration; must divide
+    # seq_window=55). Parsed here so every swept cell is pre-seeded below —
+    # a wedge before the sweep must report them as not-run, not omit them.
+    plstm_bts = [int(v) for v in os.environ.get(
+        "R2D2_BENCH_PLSTM_BT", "1,5").split(",") if v]
+    plstm_labels = ["bf16_spd16_plstm" if bt == 1
+                    else f"bf16_spd16_plstm_bt{bt}" for bt in plstm_bts]
     if smoke:
         planned = ["f32_spd1"]
     else:
-        planned = ["f32_spd1", "f32_spd4", "f32_spd16",
-                   "bf16_spd1", "bf16_spd4", "bf16_spd16", "bf16_spd16_s2d",
-                   ab_label, "bf16_spd16_nhwc", "bf16_spd16_plstm",
-                   "bf16_spd16_double", "bf16_spd16_double_fused"]
+        planned = (["f32_spd1", "f32_spd4", "f32_spd16",
+                    "bf16_spd1", "bf16_spd4", "bf16_spd16",
+                    "bf16_spd16_s2d", ab_label, "bf16_spd16_nhwc"]
+                   + plstm_labels
+                   + ["bf16_spd16_double", "bf16_spd16_double_fused"])
     for label in planned:
         matrix[label] = None
         cell_status[label] = "not-run"
@@ -541,13 +550,8 @@ def run_bench() -> None:
     # ops/pallas_lstm.py) instead of a lax.scan while-loop, attacking the
     # profiled per-iteration overhead on the serial chain. Win -> flip the
     # default; Mosaic rejection -> documented dead end.
-    # R2D2_BENCH_PLSTM_BT: comma-separated block_t values to sweep
-    # (timesteps per kernel grid iteration; must divide seq_window=55)
-    plstm_bts = [int(v) for v in os.environ.get(
-        "R2D2_BENCH_PLSTM_BT", "1,5").split(",") if v]
-    for bt in plstm_bts:
-        label = ("bf16_spd16_plstm" if bt == 1
-                 else f"bf16_spd16_plstm_bt{bt}")
+    # (plstm_bts / plstm_labels parsed up top so the sweep is pre-seeded)
+    for bt, label in zip(plstm_bts, plstm_labels):
         if (on_tpu and not smoke and default_pallas
                 and not skipped(label)):
             try:
