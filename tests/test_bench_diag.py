@@ -35,6 +35,9 @@ FAKE_CACHE = {
         "metric": "learner_sequence_updates_per_sec_per_chip",
         "value": 11314.0, "unit": "sequences/s", "vs_baseline": 17.68,
         "platform": "tpu", "device_kind": "TPU v5 lite",
+        # pre-round-5 cache shape: matrix without cell_status — the stale
+        # path must synthesize statuses so old caches stay self-describing
+        "matrix": {"bf16_spd16": 11314.0, "f32_spd4": None},
     },
 }
 
@@ -99,6 +102,9 @@ def test_dispatch_failure_falls_back_to_stale_cache(tmp_path):
     assert out["value"] == FAKE_CACHE["output"]["value"]
     assert out["stale_recorded_at"] == FAKE_CACHE["recorded_at"]
     assert "rc=42" in out["stale_reason"]          # the diagnosed-failure code
+    # statuses synthesized for a pre-round-5 cache (value -> ok, null ->
+    # unknown) so even a stale artifact is self-describing
+    assert out["cell_status"] == {"bf16_spd16": "ok", "f32_spd4": "unknown"}
 
 
 def test_genuine_crash_is_not_masked_by_stale_cache(tmp_path):
