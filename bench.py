@@ -296,6 +296,22 @@ def run_bench() -> None:
         "peak": peak,
         "platform": devs[0].platform,
         "device_kind": devs[0].device_kind,
+        # what the default cell ACTUALLY measures on this backend: the
+        # label string doesn't encode every knob (a flipped pallas_lstm
+        # default still reads "bf16_spd16"), so the artifact spells the
+        # resolved configuration out
+        "defaults": {
+            "bf16": bf16_resolved,
+            "steps_per_dispatch": cfg.runtime.resolved_steps_per_dispatch(),
+            "space_to_depth": s2d_default,
+            "pallas_obs_decode": resolve_pallas_obs_decode(
+                cfg.optim.pallas_obs_decode),
+            "pallas_gather": spec.pallas_gather,
+            "exact_gather": spec.exact_gather,
+            "pallas_lstm": resolve_pallas_setting(
+                cfg.network.pallas_lstm, "network.pallas_lstm"),
+            "pallas_lstm_block": cfg.network.pallas_lstm_block,
+        },
     }
 
     def build_step(use_pallas: bool, bf16: bool, spd: int, step_spec=None,
@@ -865,6 +881,8 @@ def assemble_output(results: dict, matrix: dict, ctx: dict,
         "platform": ctx["platform"],
         "device_kind": ctx["device_kind"],
     }
+    if ctx.get("defaults"):
+        out["resolved_defaults"] = ctx["defaults"]
     if ctx.get("peak"):
         steps_per_sec = seq_updates / ctx["batch_size"]
         out["model_tflops_per_sec"] = round(
