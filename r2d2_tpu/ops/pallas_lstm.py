@@ -24,7 +24,21 @@ This kernel runs the scan as a single Pallas grid over T:
   iteration (T must divide evenly; T=55 → 1, 5, 11): the in-kernel loop
   amortizes per-iteration grid/DMA bookkeeping at the cost of bigger
   VMEM blocks. The right value is a chip measurement — bench.py sweeps
-  it in the plstm cells.
+  it in the plstm cells. VMEM budget at the reference shape
+  (B=128, H=512, bf16): the backward kernel is the tight side — six
+  (bt, 128, 512..2048) streamed blocks plus the revisited f32 (512,
+  2048) dWh block and Wh^T; bt=11 sits near ~24 MB of live blocks, so a
+  Mosaic VMEM-exceeded failure for the _bt11 cell is a plausible sweep
+  outcome (recorded per-cell by the bench, not a kernel bug).
+
+Pre-flight lowering audit (round 5, against the four Mosaic rejection
+classes catalogued in PERF.md): every BlockSpec minor dim is
+tile-aligned (128/512/2048); gate writes are static contiguous
+lane-slice stores at x128 offsets (no lane concat, no strided store);
+the only transpose (h_prev.T, backward) runs on f32 — the supported
+32-bit sublane/lane path; no sub-32-bit casts outside supported
+element-wise converts. First real-Mosaic validation happens in
+``cli/chip_checks`` before any bench spend.
 
 The backward pass is a second kernel running the grid in REVERSE
 (index maps `i -> nblocks-1-i`), carrying `dh`/`dc` in scratch and
