@@ -10,7 +10,10 @@ cd /root/repo || exit 1
 LOG=r5_capture.log
 ts() { date -u +%FT%TZ; }
 probe() {
-  timeout 90 python -c "from r2d2_tpu.utils.platform import pin_platform; pin_platform(); import jax; d=jax.devices(); assert d[0].platform=='tpu', d; import jax.numpy as jnp; (jnp.ones((8,128))@jnp.ones((128,8))).block_until_ready(); print('probe-ok', d[0].device_kind)" >> "$LOG" 2>&1
+  # SIGTERM -> SystemExit so atexit/JAX teardown runs when timeout fires:
+  # the default disposition is an abrupt kill, the documented tunnel-wedge
+  # class (bench.py's measurement child installs the same handler)
+  timeout -k 30 90 python -c "import signal, sys; signal.signal(signal.SIGTERM, lambda s, f: sys.exit(143)); from r2d2_tpu.utils.platform import pin_platform; pin_platform(); import jax; d=jax.devices(); assert d[0].platform=='tpu', d; import jax.numpy as jnp; (jnp.ones((8,128))@jnp.ones((128,8))).block_until_ready(); print('probe-ok', d[0].device_kind)" >> "$LOG" 2>&1
 }
 echo "$(ts) watchdog start (pid $$)" >> "$LOG"
 while true; do
@@ -54,6 +57,6 @@ while true; do
     echo "$(ts) capture sequence COMPLETE" >> "$LOG"
     break
   fi
-  echo "$(ts) still wedged; sleeping 180s" >> "$LOG"
-  sleep 180
+  echo "$(ts) still wedged; sleeping 480s" >> "$LOG"
+  sleep 480
 done
