@@ -77,12 +77,18 @@ def main() -> int:
                            f"{base:.0f})")
 
     # --- 2. exact-gather confirmation ----------------------------------
+    # the padded default costs 1.74x obs-ring HBM, so it must BEAT the
+    # row gather by >1% to stay justified; only meaningful when the
+    # headline actually measured padded storage
+    resolved = out.get("resolved_defaults") or {}
     row = val("bf16_spd16_rowgather")
-    if row is not None and row > base:
+    if (row is not None and row >= 0.99 * base
+            and resolved.get("exact_gather", True)):
         _edit(r'pallas_exact_gather: str = "auto"',
               'pallas_exact_gather: str = "off"')
-        changed.append(f"pallas_exact_gather=off (rowgather {row:.0f} "
-                       f"beat padded headline {base:.0f})")
+        changed.append(f"pallas_exact_gather=off (rowgather {row:.0f} vs "
+                       f"padded headline {base:.0f}: <1% win does not "
+                       "justify 1.74x ring HBM)")
 
     if not changed:
         print("decide: defaults stand", file=sys.stderr)
