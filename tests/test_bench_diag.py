@@ -258,3 +258,31 @@ def test_anomalous_default_cell_does_not_elect_headline():
     matrix["bf16_spd16"] = 11290.0
     out = bench.assemble_output({}, matrix, ctx, status)
     assert out["measured_config"] == "bf16_spd16"
+
+
+def test_resume_child_carries_partial_cells(tmp_path):
+    """The R2D2_BENCH_RESUME child must seed already-measured cells from
+    the partial snapshot (status 'carried') and skip their compile+timing
+    windows entirely — run directly in child mode with a crafted partial."""
+    import tempfile
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps({
+        "results": {"xla_decode": 99.0},
+        "matrix": {"f32_spd1": 99.0},
+        "cell_status": {"f32_spd1": "ok"},
+        "ctx": {}}))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env.update({"JAX_PLATFORMS": "cpu", "R2D2_BENCH_SMOKE": "1",
+                "R2D2_BENCH_CHILD": "1", "R2D2_BENCH_RESUME": "1",
+                "R2D2_BENCH_PARTIAL": str(partial)})
+    proc = subprocess.run([sys.executable, BENCH], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["matrix"]["f32_spd1"] == 99.0        # carried, not re-run
+    assert out["cell_status"]["f32_spd1"] == "carried"
+    assert out["value"] == 99.0
+    assert "[f32_spd1] carried" in proc.stderr
+    assert "[xla_decode] carried" in proc.stderr    # results side too
+    # no timing window ran: the carried run must not print a measured rate
+    assert "train steps/s" not in proc.stderr
