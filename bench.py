@@ -21,6 +21,12 @@ Measurements (VERDICT r2 #1/#3 + the rounds-3/4 kernels):
      the double-DQN unroll-fusion pair;
   3. an analytic model-FLOPs/s estimate against the chip's peak (MFU).
 
+This file measures the LEARNER side only (synthetic replay, no actors).
+The system-level number — process-mode vector actors feeding this learner,
+env-steps/s and learner steps/s reported together — is
+r2d2_tpu/tools/e2e_bench.py (also reachable as a soak phase:
+``cli.soak --e2e-seconds=...``); artifact E2E_r06.json.
+
 vs_baseline: the reference publishes NO numbers (BASELINE.json "published":
 {}). Its learner logs 'training speed' in updates/s (worker.py:229); upstream
 runs of this codebase on a desktop GPU train at ~5 updates/s = 640

@@ -1,6 +1,6 @@
 """Actor-side components: CPU rollout policy, episode block assembly."""
 
 from r2d2_tpu.actor.local_buffer import LocalBuffer
-from r2d2_tpu.actor.policy import ActorPolicy
+from r2d2_tpu.actor.policy import ActorPolicy, BatchedActorPolicy
 
-__all__ = ["LocalBuffer", "ActorPolicy"]
+__all__ = ["LocalBuffer", "ActorPolicy", "BatchedActorPolicy"]

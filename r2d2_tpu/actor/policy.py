@@ -13,7 +13,7 @@ The policy owns the per-episode recurrent state and rolling frame stack
 (ref worker.py:516,526,546-547, model.py:34,86-87).
 """
 
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -22,20 +22,38 @@ import numpy as np
 from r2d2_tpu.models.network import NetworkApply, initial_hidden
 
 
+def _pin_params(params, cpu, copy: bool):
+    """CPU-resident params, REALLY copied when ``copy``. ``device_put``
+    alone is wrong for in-process aliases: to the same device it is a
+    no-op, and when the source is the learner's train_state — whose
+    buffers are donated by the next fused step — the alias dies with it
+    (observed as 'Buffer has been deleted or donated' in a
+    single-process CPU run). ONE implementation for both actor policies."""
+    if copy:
+        params = jax.tree_util.tree_map(
+            lambda x: np.array(x, copy=True), params)
+    return jax.device_put(params, cpu)
+
+
+def _force_f32(net: NetworkApply) -> NetworkApply:
+    """Actors infer on host CPUs, where bf16 is emulated and slower —
+    force the f32 compute policy regardless of the learner's (params are
+    f32 storage under either policy, so the weight exchange is unchanged;
+    the reference's amp is learner-only too, worker.py:309 vs the actors'
+    plain CPU model worker.py:509)."""
+    if net.config.bf16:
+        import dataclasses
+        h, w, s = net.obs_hw
+        net = NetworkApply(net.action_dim,
+                           dataclasses.replace(net.config, bf16=False),
+                           s, h, w)
+    return net
+
+
 class ActorPolicy:
     def __init__(self, net: NetworkApply, params, epsilon: float, seed: int = 0,
                  copy_updates: bool = True):
-        # Actors infer on host CPUs, where bf16 is emulated and slower —
-        # force the f32 compute policy regardless of the learner's
-        # (params are f32 storage under either policy, so the weight
-        # exchange is unchanged; the reference's amp is learner-only too,
-        # worker.py:309 vs the actors' plain CPU model worker.py:509).
-        if net.config.bf16:
-            import dataclasses
-            h, w, s = net.obs_hw
-            net = NetworkApply(net.action_dim,
-                               dataclasses.replace(net.config, bf16=False),
-                               s, h, w)
+        net = _force_f32(net)
         self.net = net
         self.epsilon = float(epsilon)
         self.action_dim = net.action_dim
@@ -81,16 +99,7 @@ class ActorPolicy:
         self.last_action = np.int32(action)
 
     def _pin(self, params, copy: bool):
-        """CPU-resident params, REALLY copied when ``copy``. ``device_put``
-        alone is wrong for in-process aliases: to the same device it is a
-        no-op, and when the source is the learner's train_state — whose
-        buffers are donated by the next fused step — the alias dies with it
-        (observed as 'Buffer has been deleted or donated' in a
-        single-process CPU run)."""
-        if copy:
-            params = jax.tree_util.tree_map(
-                lambda x: np.array(x, copy=True), params)
-        return jax.device_put(params, self._cpu)
+        return _pin_params(params, self._cpu, copy)
 
     def update_params(self, params) -> None:
         self.params = self._pin(params, copy=self._copy_updates)
@@ -112,4 +121,115 @@ class ActorPolicy:
         """Q at the current state without advancing the recurrent state —
         the block-boundary bootstrap (ref worker.py:560-563)."""
         _, q, _ = self._step(self.params, self.stacked, self.last_action, self.hidden)
+        return np.asarray(q)
+
+
+class BatchedActorPolicy:
+    """N env lanes through ONE jitted (N, 1) forward pass per tick.
+
+    The scalar ActorPolicy pays a full jit dispatch + interpreter round-trip
+    per env step; at N lanes the same recurrent forward amortizes both —
+    the Podracer batching win (arxiv 2104.06272, and GPU Atari emulation's
+    central measurement, arxiv 1907.08467). Per-lane state (rolling frame
+    stack, packed LSTM hidden, last action) lives in host numpy so a single
+    lane resets without touching the others; the Ape-X ε ladder assigns
+    each lane its own ε and its own RNG stream, drawn in the scalar
+    policy's exact order (one uniform per step, one integer draw only when
+    exploring) so a lane is distributionally identical to the scalar actor
+    it replaces.
+
+    Numerics: the batched forward computes the same math as N scalar
+    forwards, but XLA:CPU tiles its gemms differently at different batch
+    sizes, so Q/hidden can differ from the scalar policy's by ~1 ulp
+    (measured ≤ 1.2e-7 at f32); greedy actions are bit-identical whenever
+    Q gaps exceed that (parity-tested in tests/test_actor_vector.py).
+    """
+
+    def __init__(self, net: NetworkApply, params,
+                 epsilons: Sequence[float], seeds: Sequence[int],
+                 copy_updates: bool = True):
+        if len(epsilons) != len(seeds):
+            raise ValueError(
+                f"epsilons ({len(epsilons)}) and seeds ({len(seeds)}) must "
+                "have one entry per lane")
+        net = _force_f32(net)
+        self.net = net
+        self.num_lanes = len(epsilons)
+        self.epsilons = np.asarray(epsilons, np.float64)
+        self.action_dim = net.action_dim
+        # per-lane streams: lane i draws exactly like ActorPolicy(seed_i)
+        self.rngs = [np.random.default_rng(s) for s in seeds]
+        self._cpu = jax.local_devices(backend="cpu")[0]
+        self._copy_updates = copy_updates
+        self.params = self._pin(params, copy=True)
+
+        def step_fn(params, stacked_obs, last_action, hidden):
+            # stacked_obs: (N, H, W, stack) f32 in [0,1]; last_action: (N,)
+            obs = stacked_obs[:, None]                         # (N, 1, ...)
+            la = jax.nn.one_hot(last_action, net.action_dim,
+                                dtype=jnp.float32)[:, None]
+            q, h = net.module.apply(params, obs, la, hidden)
+            return jnp.argmax(q[:, 0], axis=-1), q[:, 0], h
+
+        self._step = jax.jit(step_fn)
+        self.reset_state()
+
+    def reset_state(self) -> None:
+        """Reset every lane's per-episode state."""
+        h, w, s = self.net.obs_hw
+        n = self.num_lanes
+        # host numpy (not device arrays) so reset_lane mutates one row
+        self.hidden = np.zeros((n, 2, self.net.config.hidden_dim), np.float32)
+        self.stacked = np.zeros((n, h, w, s), np.float32)
+        self.last_action = np.full(n, -1, np.int32)
+
+    def reset_lane(self, lane: int) -> None:
+        self.hidden[lane] = 0.0
+        self.stacked[lane] = 0.0
+        self.last_action[lane] = -1
+
+    def observe_reset_lane(self, lane: int, obs: np.ndarray) -> None:
+        """Fill lane's frame stack with its episode-initial observation
+        (the scalar policy's observe_reset, per lane)."""
+        self.reset_lane(lane)
+        self.stacked[lane] = (np.asarray(obs, np.float32) / 255.0)[..., None]
+
+    def observe(self, obs: np.ndarray, actions: np.ndarray) -> None:
+        """Roll every lane's frame stack and record the taken actions.
+        obs: (N, H, W) uint8; actions: (N,)."""
+        self.stacked = np.roll(self.stacked, -1, axis=-1)
+        self.stacked[..., -1] = np.asarray(obs, np.float32) / 255.0
+        self.last_action = np.asarray(actions, np.int32)
+
+    def _pin(self, params, copy: bool):
+        return _pin_params(params, self._cpu, copy)
+
+    def update_params(self, params) -> None:
+        self.params = self._pin(params, copy=self._copy_updates)
+
+    def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Greedy actions (N,), Q-values (N, A), and packed hiddens
+        (N, 2, hidden) *after* this step; ε-greedy overrides happen in
+        ``act``."""
+        actions, q, hidden = self._step(
+            self.params, self.stacked, self.last_action, self.hidden)
+        # np.array, not asarray: device output views are read-only, and
+        # reset_lane mutates rows of this buffer in place
+        self.hidden = np.array(hidden)
+        return np.asarray(actions), np.asarray(q), self.hidden
+
+    def act(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        actions, q, hidden = self.step()
+        actions = np.array(actions)          # writable for the ε overrides
+        for i, rng in enumerate(self.rngs):
+            if rng.random() < self.epsilons[i]:
+                actions[i] = int(rng.integers(self.action_dim))
+        return actions, q, hidden
+
+    def bootstrap_q(self) -> np.ndarray:
+        """(N, A) Q at every lane's current state without advancing any
+        recurrent state — the block-boundary bootstrap, one jitted call
+        for all lanes (rows of reset lanes are unused by the caller)."""
+        _, q, _ = self._step(
+            self.params, self.stacked, self.last_action, self.hidden)
         return np.asarray(q)

@@ -199,6 +199,15 @@ class ActorConfig:
     actor_update_interval: int = 400   # steps between weight pulls (ref worker.py:568)
     max_episode_steps: int = 27_000
     near_greedy_eps: float = 0.02      # episode-return logging threshold (ref worker.py:555)
+    # Env lanes per actor worker (envs/vector.py). 1 (default) = the legacy
+    # single-env loop, byte-identical behavior. N>1 steps N envs through ONE
+    # jitted (N, 1) policy forward per tick (actor/policy.py
+    # BatchedActorPolicy) — the Podracer/GPU-emulation batching win (arxiv
+    # 2104.06272, 1907.08467): actor cost goes from N interpreter+dispatch
+    # round-trips per env step to one. The Ape-X ε ladder spreads over
+    # num_actors * envs_per_actor total lanes (vector_lane_epsilons), so the
+    # exploration schedule matches an equally-sized scalar-actor fleet.
+    envs_per_actor: int = 1
 
 
 @dataclass(frozen=True)
@@ -328,6 +337,23 @@ class Config:
             )
         if self.sequence.forward_steps < 1:
             raise ValueError("sequence.forward_steps must be >= 1")
+        if self.actor.envs_per_actor < 1:
+            raise ValueError(
+                f"actor.envs_per_actor ({self.actor.envs_per_actor}) must be "
+                ">= 1")
+        if self.actor.envs_per_actor > 100:
+            raise ValueError(
+                f"actor.envs_per_actor ({self.actor.envs_per_actor}) must be "
+                "<= 100: per-lane seeds fill the worker's 100-wide seed "
+                "window (runtime.seed + 100*actor_idx + lane); more lanes "
+                "would duplicate the next worker's env/RNG streams — scale "
+                "actor.num_actors instead")
+        if self.multiplayer.enabled and self.actor.envs_per_actor > 1:
+            raise ValueError(
+                "actor.envs_per_actor > 1 is not supported with multiplayer "
+                "(host/join port wiring is per actor worker; extra lanes in "
+                "one worker would collide on the game sockets — scale "
+                "actor.num_actors instead)")
         if self.multiplayer.enabled and not (
                 -1 <= self.multiplayer.player_id
                 < self.multiplayer.num_players):
@@ -473,6 +499,30 @@ def apex_epsilon(actor_id: int, num_actors: int, base_eps: float,
     if num_actors <= 1:
         return base_eps
     return base_eps ** (1 + actor_id / (num_actors - 1) * alpha)
+
+
+def vector_lane_epsilons(actor_idx: int, actor_cfg: ActorConfig,
+                         total_actors: Optional[int] = None) -> List[float]:
+    """Per-lane ε for one vectorized actor worker: the Ape-X ladder spread
+    over ALL total_actors * envs_per_actor lanes in the fleet, with worker
+    ``actor_idx`` owning the contiguous lane slice — so a fleet of vector
+    actors explores exactly like the equally-sized scalar-actor fleet the
+    reference runs (train.py:16-18). ``total_actors`` defaults to
+    ``actor_cfg.num_actors`` (single-host); a multihost fleet passes its
+    GLOBAL worker count (process_count * num_actors) alongside the global
+    ``actor_idx``, mirroring the scalar path's global apex_epsilon."""
+    if total_actors is None:
+        total_actors = actor_cfg.num_actors
+    if not 0 <= actor_idx < total_actors:
+        raise ValueError(
+            f"actor_idx {actor_idx} outside the fleet of {total_actors} "
+            "workers — multihost callers must pass their global worker "
+            "count as total_actors")
+    k = actor_cfg.envs_per_actor
+    total = total_actors * k
+    return [apex_epsilon(actor_idx * k + lane, total, actor_cfg.base_eps,
+                         actor_cfg.eps_alpha)
+            for lane in range(k)]
 
 
 # Fields eligible for population-based/genetic hyperparameter search, mirroring

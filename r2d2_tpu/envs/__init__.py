@@ -12,5 +12,6 @@ Internal Env protocol is the reference's: ``reset() -> obs``,
 
 from r2d2_tpu.envs.fake import FakeR2D2Env
 from r2d2_tpu.envs.factory import create_env
+from r2d2_tpu.envs.vector import SyncVectorEnv, make_vector_env
 
-__all__ = ["FakeR2D2Env", "create_env"]
+__all__ = ["FakeR2D2Env", "create_env", "SyncVectorEnv", "make_vector_env"]

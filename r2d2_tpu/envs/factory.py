@@ -40,7 +40,7 @@ def create_env(cfg: EnvConfig, *, clip_rewards: Optional[bool] = None,
         env = make_vizdoom(
             env_id, frame_skip=cfg.frame_skip, multi_conf=multi_conf,
             is_host=is_host, testing=testing, port=port,
-            num_players=num_players, name=name, reward_cfg=cfg)
+            num_players=num_players, name=name, reward_cfg=cfg, seed=seed)
         env = WarpFrame(env, cfg.frame_height, cfg.frame_width)
     else:
         try:
@@ -52,7 +52,7 @@ def create_env(cfg: EnvConfig, *, clip_rewards: Optional[bool] = None,
         kwargs = {}
         if cfg.frame_skip > 1:
             kwargs["frameskip"] = cfg.frame_skip
-        env = GymnasiumAdapter(gymnasium.make(env_id, **kwargs))
+        env = GymnasiumAdapter(gymnasium.make(env_id, **kwargs), seed=seed)
         env = WarpFrame(env, cfg.frame_height, cfg.frame_width)
 
     if clip:

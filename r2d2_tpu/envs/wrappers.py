@@ -98,10 +98,22 @@ class Wrapper:
 
 
 class GymnasiumAdapter(Wrapper):
-    """gymnasium 5-tuple API → the reference's 4-tuple protocol."""
+    """gymnasium 5-tuple API → the reference's 4-tuple protocol.
+
+    ``seed`` (optional) is forwarded to the FIRST gymnasium reset — how
+    gymnasium seeds an env — so per-lane vector-env seeds (vector.py)
+    reach the ALE backends; later resets continue the seeded stream."""
+
+    def __init__(self, env, seed=None):
+        super().__init__(env)
+        self._pending_seed = seed
 
     def reset(self):
-        out = self.env.reset()
+        if self._pending_seed is not None:
+            out = self.env.reset(seed=int(self._pending_seed))
+            self._pending_seed = None
+        else:
+            out = self.env.reset()
         return out[0] if isinstance(out, tuple) else out
 
     def step(self, action):

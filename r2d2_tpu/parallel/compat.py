@@ -1,0 +1,29 @@
+"""jax version compatibility for shard_map.
+
+``jax.shard_map`` (with the ``check_vma`` kwarg) is the public API from
+jax 0.6+; older jax (this container ships 0.4.x) only has
+``jax.experimental.shard_map.shard_map`` whose equivalent kwarg is
+``check_rep``. One wrapper exports the NEW surface (``check_vma``) and
+translates down when running on the experimental version, so every call
+site in this package writes modern-jax code and runs on both.
+"""
+
+import functools
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.6
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax < 0.6
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+    kwargs[_CHECK_KW] = check_vma
+    if f is None:
+        return functools.partial(_shard_map, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kwargs)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)

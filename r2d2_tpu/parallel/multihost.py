@@ -157,7 +157,7 @@ def make_lockstep_ingest(spec: ReplaySpec, mesh):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from r2d2_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from r2d2_tpu.parallel.sharded import _shard0, _unshard0
@@ -279,7 +279,7 @@ def make_lockstep_consensus(mesh):
     every host, so every control-flow decision downstream is replicated —
     the lockstep invariant with no device replay involved."""
     import jax
-    from jax.experimental.shard_map import shard_map
+    from r2d2_tpu.parallel.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sharding = NamedSharding(mesh, P("dp"))
@@ -396,14 +396,12 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         raise ValueError(
             f"unknown replay.placement {cfg.replay.placement!r}")
     host_mode = cfg.replay.placement == "host"
-    from r2d2_tpu.actor.policy import ActorPolicy
     from r2d2_tpu.envs.factory import create_env
     from r2d2_tpu.learner.train_step import create_train_state
     from r2d2_tpu.models.network import NetworkApply
     from r2d2_tpu.parallel.mesh import init_distributed, make_mesh
     from r2d2_tpu.parallel.sharded import (
         make_sharded_learner_step, sharded_replay_init)
-    from r2d2_tpu.runtime.actor_loop import run_actor
     from r2d2_tpu.runtime.checkpoint import apply_restore, save_checkpoint
     from r2d2_tpu.runtime.feeder import BlockQueue
     from r2d2_tpu.runtime.metrics import TrainMetrics
@@ -559,7 +557,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         def spawn_actor(i: int):
             # player_idx=pid / actor_idx=gidx reproduces the thread path's
             # seed formula (seed + 10_000*pid + 100*gidx) inside
-            # actor_process_main
+            # actor_process_main; total_actors sizes the vector ε ladder
+            # over the GLOBAL fleet (rank-local num_actors x nprocs)
             gidx = rank * n_local + i
             eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
                                cfg.actor.eps_alpha)
@@ -567,7 +566,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 target=actor_process_main,
                 args=(cfg.to_dict(), pid, gidx, eps, publisher.name,
                       queue._q, stop),
-                kwargs=cfg.multiplayer.env_args(pid, gidx),
+                kwargs={**cfg.multiplayer.env_args(pid, gidx),
+                        "total_actors": nprocs * n_local},
                 daemon=True, name=f"actor-p{pid}h{rank}-{i}")
             p.start()
             return p
@@ -581,21 +581,33 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
                                cfg.actor.eps_alpha)
             seed = cfg.runtime.seed + 10_000 * pid + 100 * gidx
-            env = create_env(cfg.env, seed=seed,
-                             num_players=cfg.multiplayer.num_players,
-                             name=f"p{pid}h{rank}a{i}",
-                             **cfg.multiplayer.env_args(pid, gidx))
-            uw = getattr(env, "unwrapped", env)
+            # shared scalar/vector construction (runtime/actor_loop.py):
+            # env_factory routes through THIS module's create_env symbol,
+            # global gidx + fleet total size the vector ε ladder
+            from r2d2_tpu.runtime.actor_loop import (make_actor_env,
+                                                     make_actor_policy)
+            env = make_actor_env(cfg, pid, gidx, seed,
+                                 env_factory=create_env,
+                                 name=f"p{pid}h{rank}a{i}",
+                                 num_players=cfg.multiplayer.num_players,
+                                 **cfg.multiplayer.env_args(pid, gidx))
+            # vector envs expose lanes; wiring is identical across a
+            # worker's lanes, so record lane 0's
+            uw = getattr(env, "envs", [env])[0]
+            uw = getattr(uw, "unwrapped", uw)
             observed_wiring[i] = getattr(uw, "multiplayer_wiring", None)
-            policy = ActorPolicy(net, ts.params, eps, seed=seed)
+            policy, run_loop = make_actor_policy(
+                cfg, net, ts.params, gidx, seed, epsilon=eps,
+                total_actors=nprocs * n_local)
 
-            def loop(env=env, policy=policy, reader_id=i):
-                # run_actor owns env and closes it on every exit
-                run_actor(cfg, env, policy,
-                          block_sink=lambda b: queue.put_patient(
-                              b, stop.is_set),
-                          weight_poll=lambda: store.poll(reader_id),
-                          should_stop=stop.is_set)
+            def loop(env=env, policy=policy, run_loop=run_loop,
+                     reader_id=i):
+                # the run loop owns env and closes it on every exit
+                run_loop(cfg, env, policy,
+                         block_sink=lambda b: queue.put_patient(
+                             b, stop.is_set),
+                         weight_poll=lambda: store.poll(reader_id),
+                         should_stop=stop.is_set)
 
             t = threading.Thread(target=loop, daemon=True,
                                  name=f"actor-h{rank}-{i}")
@@ -808,7 +820,8 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
                  max_steps: int, resume: str = "",
                  actor_mode: str = "thread", mp: int = 1,
                  player_id: int = -1, num_players: int = 2,
-                 num_actors: int = 1, placement: str = "device") -> None:
+                 num_actors: int = 1, placement: str = "device",
+                 envs_per_actor: int = 1) -> None:
     from r2d2_tpu.utils.platform import pin_cpu_platform
     pin_cpu_platform(devices_per_process)
     import jax
@@ -819,6 +832,7 @@ def _demo_worker(process_id: int, num_processes: int, coordinator: str,
         "mesh.num_processes": num_processes, "mesh.process_id": process_id,
         "mesh.dp": n_global // mp, "mesh.mp": mp,
         "actor.num_actors": num_actors,
+        "actor.envs_per_actor": envs_per_actor,
         "replay.placement": placement,
         **({"runtime.resume": resume} if resume else {}),
         **({"multiplayer.enabled": True, "multiplayer.player_id": player_id,
@@ -869,7 +883,7 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
                 resume: str = "", actor_mode: str = "thread",
                 mp: int = 1, player_id: int = -1,
                 num_players: int = 2, num_actors: int = 1,
-                placement: str = "device") -> list:
+                placement: str = "device", envs_per_actor: int = 1) -> list:
     """Spawn the loopback controllers and assert the final params came out
     BIT-IDENTICAL across hosts (each worker writes a digest file covering
     every param leaf; divergence anywhere fails the launch). Returns the
@@ -900,6 +914,7 @@ def launch_demo(num_processes: int = 2, devices_per_process: int = 2,
             f"--mp={mp}", f"--player-id={player_id}",
             f"--num-players={num_players}", f"--num-actors={num_actors}",
             f"--placement={placement}",
+            f"--envs-per-actor={envs_per_actor}",
         ], num_processes, timeout, "multihost train demo")
 
     digests = []
@@ -941,6 +956,9 @@ def main(argv=None) -> None:
     p.add_argument("--num-actors", type=int, default=1,
                    help="actors per controller; per-player jobs must all "
                         "match on num_processes * num_actors")
+    p.add_argument("--envs-per-actor", type=int, default=1,
+                   help="env lanes per actor worker (vectorized actor; the "
+                        "ε ladder spans num_processes * num_actors * lanes)")
     p.add_argument("--placement", choices=("device", "host"),
                    default="device",
                    help="replay placement: device = HBM rings + lockstep "
@@ -952,14 +970,16 @@ def main(argv=None) -> None:
                     args.save_dir, args.max_steps, resume=args.resume,
                     actor_mode=args.actor_mode, mp=args.mp,
                     player_id=args.player_id, num_players=args.num_players,
-                    num_actors=args.num_actors, placement=args.placement)
+                    num_actors=args.num_actors, placement=args.placement,
+                    envs_per_actor=args.envs_per_actor)
     else:
         _demo_worker(args.process_id, args.num_processes, args.coordinator,
                      args.devices_per_process, args.save_dir, args.max_steps,
                      resume=args.resume, actor_mode=args.actor_mode,
                      mp=args.mp, player_id=args.player_id,
                      num_players=args.num_players,
-                     num_actors=args.num_actors, placement=args.placement)
+                     num_actors=args.num_actors, placement=args.placement,
+                     envs_per_actor=args.envs_per_actor)
 
 
 if __name__ == "__main__":

@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from r2d2_tpu.parallel.compat import shard_map
 
 from r2d2_tpu.config import OptimConfig
 from r2d2_tpu.learner.train_step import TrainState, make_loss_fn, make_optimizer

@@ -14,9 +14,59 @@ from typing import Callable, Optional
 import numpy as np
 
 from r2d2_tpu.actor.local_buffer import LocalBuffer
-from r2d2_tpu.actor.policy import ActorPolicy
+from r2d2_tpu.actor.policy import ActorPolicy, BatchedActorPolicy
 from r2d2_tpu.config import Config
 from r2d2_tpu.replay.structs import ReplaySpec
+
+
+def make_actor_env(cfg: Config, player_idx: int, actor_idx: int, seed: int,
+                   env_factory: Optional[Callable] = None,
+                   name: Optional[str] = None, **env_args):
+    """The ONE place the scalar-vs-vector env choice and the per-lane seed
+    scheme live (seed + lane within the worker's 100-wide seed window —
+    Config validates envs_per_actor <= 100). Shared by the thread-mode
+    orchestrator, the spawned actor process, the multihost fleet, and the
+    throughput bench so the paths cannot drift. ``env_factory`` defaults to
+    envs.factory.create_env (injectable for tests); ``name`` defaults to
+    the single-host convention (multihost passes its rank-tagged name)."""
+    if env_factory is None:
+        from r2d2_tpu.envs.factory import create_env
+        env_factory = create_env
+    if name is None:
+        name = f"p{player_idx}a{actor_idx}"
+    if cfg.actor.envs_per_actor > 1:
+        from r2d2_tpu.envs.vector import make_vector_env
+        return make_vector_env(cfg.env, cfg.actor.envs_per_actor, seed=seed,
+                               name=name, env_factory=env_factory, **env_args)
+    return env_factory(cfg.env, seed=seed, name=name, **env_args)
+
+
+def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
+                      epsilon: Optional[float] = None,
+                      copy_updates: bool = True,
+                      total_actors: Optional[int] = None):
+    """Build the policy matching the env shape ``make_actor_env`` produced;
+    returns ``(policy, run_loop)`` where ``run_loop`` is run_actor or
+    run_vector_actor. ``epsilon`` overrides the scalar path's Ape-X ladder
+    value (process actors receive it from the parent); vector lanes always
+    take the ladder spread (config.vector_lane_epsilons). Multihost fleets
+    pass the GLOBAL ``actor_idx`` and their global worker count as
+    ``total_actors`` so the ladder spans the whole fleet."""
+    from r2d2_tpu.config import apex_epsilon, vector_lane_epsilons
+    if cfg.actor.envs_per_actor > 1:
+        policy = BatchedActorPolicy(
+            net, params,
+            vector_lane_epsilons(actor_idx, cfg.actor, total_actors),
+            seeds=[seed + lane for lane in range(cfg.actor.envs_per_actor)],
+            copy_updates=copy_updates)
+        return policy, run_vector_actor
+    if epsilon is None:
+        epsilon = apex_epsilon(actor_idx,
+                               total_actors or cfg.actor.num_actors,
+                               cfg.actor.base_eps, cfg.actor.eps_alpha)
+    policy = ActorPolicy(net, params, epsilon, seed=seed,
+                         copy_updates=copy_updates)
+    return policy, run_actor
 
 
 def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
@@ -75,6 +125,93 @@ def _run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
             block_sink(lb.finish(policy.bootstrap_q()))
 
         counter += 1
+        if counter >= cfg.actor.actor_update_interval:
+            params = weight_poll()
+            if params is not None:
+                policy.update_params(params)
+            counter = 0
+
+        if max_env_steps is not None and total_steps >= max_env_steps:
+            break
+    return total_steps
+
+
+def run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
+                     block_sink: Callable, weight_poll: Callable,
+                     should_stop: Callable[[], bool],
+                     max_env_steps: Optional[int] = None) -> int:
+    """The N-lane twin of ``run_actor``: one jitted (N, 1) policy forward
+    steps every lane of a SyncVectorEnv per tick; each lane keeps its own
+    LocalBuffer so block content is identical to N scalar actors' (parity-
+    tested at N=1 against run_actor). Returns total env steps across lanes.
+
+    OWNS ``venv`` (and through it every lane env) — closes it on every
+    exit, same contract as run_actor."""
+    try:
+        return _run_vector_actor(cfg, venv, policy, block_sink, weight_poll,
+                                 should_stop, max_env_steps)
+    finally:
+        try:
+            venv.close()
+        except Exception:
+            pass
+
+
+def _run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
+                      block_sink: Callable, weight_poll: Callable,
+                      should_stop: Callable[[], bool],
+                      max_env_steps: Optional[int] = None) -> int:
+    spec = ReplaySpec.from_config(cfg)
+    n = venv.num_envs
+    if n != policy.num_lanes:
+        raise ValueError(f"venv has {n} lanes but policy has "
+                         f"{policy.num_lanes}")
+    buffers = [LocalBuffer(spec, policy.action_dim, cfg.optim.gamma,
+                           cfg.optim.priority_eta) for _ in range(n)]
+
+    obs = venv.reset()
+    for i in range(n):
+        policy.observe_reset_lane(i, obs[i])
+        buffers[i].reset(obs[i])
+    total_steps = 0
+    counter = 0
+
+    while not should_stop():
+        actions, qs, hiddens = policy.act()
+        next_obs, rewards, dones, infos = venv.step(actions)
+        # advance every lane's policy state BEFORE per-lane bookkeeping:
+        # the block-boundary bootstrap reads the post-step state (matching
+        # the scalar loop's observe-then-bootstrap order), and done lanes
+        # get overwritten by observe_reset_lane below anyway
+        policy.observe(next_obs, actions)
+        boot_q = None    # lazily computed once per tick, shared by lanes
+        for i in range(n):
+            lb = buffers[i]
+            lb.add(int(actions[i]), float(rewards[i]), next_obs[i],
+                   qs[i], hiddens[i])
+            # episode accounting lives in the vector env (one source of
+            # truth); auto-reset lanes short-circuit on dones[i]
+            if dones[i] or venv.episode_steps[i] == cfg.actor.max_episode_steps:
+                block = lb.finish(None)
+                if policy.epsilons[i] > cfg.actor.near_greedy_eps:
+                    # only near-greedy lanes report episode returns
+                    block = block.replace(
+                        sum_reward=np.asarray(np.nan, np.float32))
+                block_sink(block)
+                # auto-reset lanes carry the new episode's initial obs in
+                # info; truncated (or non-auto-reset) lanes restart here
+                reset_obs = infos[i].get("reset_obs") if dones[i] else None
+                if reset_obs is None:
+                    reset_obs = venv.reset_lane(i)
+                policy.observe_reset_lane(i, reset_obs)
+                lb.reset(reset_obs)
+            elif len(lb) == spec.block_length:
+                if boot_q is None:
+                    boot_q = policy.bootstrap_q()
+                block_sink(lb.finish(boot_q[i]))
+        total_steps += n
+
+        counter += n
         if counter >= cfg.actor.actor_update_interval:
             params = weight_poll()
             if params is not None:

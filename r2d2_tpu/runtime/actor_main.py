@@ -13,7 +13,11 @@ import os
 
 def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                        epsilon: float, shm_name: str, queue, stop_event,
-                       is_host: bool, port: int) -> None:
+                       is_host: bool, port: int,
+                       total_actors: int = None) -> None:
+    # total_actors: the GLOBAL worker-fleet size for the vector ε ladder —
+    # multihost spawners pass process_count * num_actors with a global
+    # actor_idx; None = single-host (cfg.actor.num_actors)
     # unconditional (not setdefault): an inherited JAX_PLATFORMS=tpu from a
     # TPU-pinned parent would otherwise have every actor child race to open
     # the single-process libtpu — the TPU belongs to the learner alone
@@ -25,18 +29,18 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
     import jax
     import numpy as np
 
-    from r2d2_tpu.actor.policy import ActorPolicy
     from r2d2_tpu.config import Config
-    from r2d2_tpu.envs.factory import create_env
     from r2d2_tpu.models.network import NetworkApply
-    from r2d2_tpu.runtime.actor_loop import run_actor
+    from r2d2_tpu.runtime.actor_loop import make_actor_env, make_actor_policy
     from r2d2_tpu.runtime.weights import WeightSubscriber
 
     cfg = Config.from_dict(cfg_dict)
     seed = cfg.runtime.seed + 10_000 * player_idx + 100 * actor_idx
-    env = create_env(cfg.env, is_host=is_host, port=port,
-                     num_players=cfg.multiplayer.num_players,
-                     name=f"p{player_idx}a{actor_idx}", seed=seed)
+    # scalar or vectorized per cfg.actor.envs_per_actor — the shared
+    # construction path (actor_loop.py) picks for env and policy alike
+    env = make_actor_env(cfg, player_idx, actor_idx, seed,
+                         is_host=is_host, port=port,
+                         num_players=cfg.multiplayer.num_players)
     net = NetworkApply(env.action_space.n, cfg.network, cfg.env.frame_stack,
                        cfg.env.frame_height, cfg.env.frame_width)
     params = net.init(jax.random.PRNGKey(cfg.runtime.seed))
@@ -46,15 +50,18 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
         params = fresh
     # copy_updates=False: WeightSubscriber.poll materializes a fresh copy
     # per poll already — the policy may own those buffers directly
-    policy = ActorPolicy(net, params, epsilon, seed=seed, copy_updates=False)
+    policy, run_loop = make_actor_policy(cfg, net, params, actor_idx, seed,
+                                         epsilon=epsilon,
+                                         copy_updates=False,
+                                         total_actors=total_actors)
 
     from r2d2_tpu.runtime.feeder import put_patient
 
     try:
-        run_actor(cfg, env, policy,
-                  block_sink=lambda b: put_patient(
-                      queue, b, stop_event.is_set),
-                  weight_poll=sub.poll,
-                  should_stop=stop_event.is_set)
+        run_loop(cfg, env, policy,
+                 block_sink=lambda b: put_patient(
+                     queue, b, stop_event.is_set),
+                 weight_poll=sub.poll,
+                 should_stop=stop_event.is_set)
     finally:
-        sub.close()   # env is closed by run_actor (its finally owns it)
+        sub.close()   # env is closed by the run loop (its finally owns it)

@@ -29,7 +29,7 @@ import numpy as np
 from r2d2_tpu.config import Config, apex_epsilon
 from r2d2_tpu.envs.factory import create_env
 from r2d2_tpu.models.network import NetworkApply
-from r2d2_tpu.runtime.actor_loop import run_actor
+from r2d2_tpu.runtime.actor_loop import make_actor_env, make_actor_policy
 from r2d2_tpu.runtime.actor_main import actor_process_main
 from r2d2_tpu.runtime.feeder import BlockQueue
 from r2d2_tpu.runtime.learner_loop import Learner
@@ -72,25 +72,27 @@ class PlayerStack:
             self._spawn_thread_actor(i)
 
     def _spawn_thread_actor(self, i: int) -> threading.Thread:
-        from r2d2_tpu.actor.policy import ActorPolicy
         cfg = self.cfg
-        eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
-                           cfg.actor.eps_alpha)
         seed = cfg.runtime.seed + 10_000 * self.player_idx + 100 * i
-        env = create_env(cfg.env, seed=seed,
-                         num_players=cfg.multiplayer.num_players,
-                         name=f"p{self.player_idx}a{i}",
-                         **self.actor_env_args(i))
-        policy = ActorPolicy(self.net, self.learner.train_state.params,
-                             eps, seed=seed)
+        # scalar (run_actor) or vectorized (run_vector_actor) per
+        # cfg.actor.envs_per_actor — one shared construction path with the
+        # spawned actor process and the throughput bench (actor_loop.py)
+        # env_factory=create_env: route lane construction through THIS
+        # module's symbol so tests can monkeypatch it
+        env = make_actor_env(cfg, self.player_idx, i, seed,
+                             env_factory=create_env,
+                             num_players=cfg.multiplayer.num_players,
+                             **self.actor_env_args(i))
+        policy, run_loop = make_actor_policy(
+            cfg, self.net, self.learner.train_state.params, i, seed)
 
-        def loop(env=env, policy=policy, reader_id=i):
-            # run_actor owns env and closes it on every exit
-            run_actor(cfg, env, policy,
-                      block_sink=lambda b: self.queue.put_patient(
-                          b, self._stop.is_set),
-                      weight_poll=lambda: self.store.poll(reader_id),
-                      should_stop=self._stop.is_set)
+        def loop(env=env, policy=policy, run_loop=run_loop, reader_id=i):
+            # the run loop owns env and closes it on every exit
+            run_loop(cfg, env, policy,
+                     block_sink=lambda b: self.queue.put_patient(
+                         b, self._stop.is_set),
+                     weight_poll=lambda: self.store.poll(reader_id),
+                     should_stop=self._stop.is_set)
 
         t = threading.Thread(target=loop, daemon=True,
                              name=f"actor-p{self.player_idx}-{i}")

@@ -1,0 +1,220 @@
+"""End-to-end actors→learner throughput benchmark.
+
+Everything measured before this tool was learner-only (bench.py: fused-step
+seq-updates/s on synthetic batches; tools/soak.py: device-side ring
+behavior). This tool measures the SYSTEM: how fast experience is generated
+and how fast it is consumed, simultaneously — the reference's two logged
+speeds, 'buffer update speed' and 'training speed'
+(/root/reference/worker.py:222,229) — plus an actor-only scalar-vs-vector
+sweep that quantifies the ``actor.envs_per_actor`` batching win on this
+host (VERDICT "Next round" #3: does the feeder side become the wall?).
+
+Phases:
+
+  1. **Actor sweep** (in-process, no learner): one actor worker on the fake
+     env at each requested ``envs_per_actor`` (1 = the legacy scalar loop,
+     N>1 = the vectorized loop's single jitted (N, 1) forward), timed after
+     a compile warm-up. Reports env-steps/s per cell and the speedup over
+     the scalar loop — the Podracer-style batching measurement (arxiv
+     2104.06272, 1907.08467).
+  2. **End-to-end run** (optional, ``--e2e-seconds > 0``): the REAL system —
+     process-mode vector actors feeding the real learner through the shm
+     block ring — via orchestrator.train, reporting steady-state env-steps/s
+     and learner updates/s (and seq-updates/s = updates/s × batch) from the
+     TrainMetrics records.
+
+Output: ONE JSON line (the driver artifact), also written to ``--out``.
+Hermetic on any backend — the fake env and (for the e2e phase) a
+CPU-feasible reduced training shape, recorded in the artifact.
+"""
+
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+# CPU-feasible e2e shape: the full system topology (process actors, shm
+# ring, real learner) at a reduced frame/network/batch shape so BOTH sides
+# sustain measurable rates on a small CPU host (this container has 2 cores;
+# a batch x window learner step at the reference shape takes ~25 s there,
+# starving the measurement). The artifact records the exact config; TPU
+# runs can override back to the reference training shape.
+E2E_CPU_OVERRIDES = {
+    "env.frame_height": 42, "env.frame_width": 42,
+    "network.hidden_dim": 128, "network.cnn_out_dim": 256,
+    "network.conv_layers": ((16, 8, 4), (32, 4, 2)),
+    "sequence.burn_in_steps": 8, "sequence.learning_steps": 5,
+    "sequence.forward_steps": 3,
+    "replay.capacity": 40_000, "replay.block_length": 80,
+    "replay.batch_size": 8, "replay.learning_starts": 800,
+    "runtime.save_interval": 0, "runtime.log_interval": 2.0,
+}
+
+
+def _bench_config(overrides: Optional[dict] = None):
+    from r2d2_tpu.config import Config
+    base = {"env.game_name": "Fake"}
+    base.update(overrides or {})
+    return Config().replace(**base)
+
+
+def measure_actor_throughput(cfg, envs_per_actor: int, seconds: float = 5.0,
+                             seed: int = 0) -> dict:
+    """env-steps/s of ONE actor worker on the fake env: the scalar loop at
+    envs_per_actor=1, the vectorized loop otherwise. Blocks are dropped at
+    the sink — this isolates the generation side (policy inference + env
+    stepping + LocalBuffer assembly), the part envs_per_actor batches."""
+    import jax
+
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.actor_loop import make_actor_env, make_actor_policy
+
+    cfg = cfg.replace(**{"actor.envs_per_actor": envs_per_actor})
+    net = NetworkApply(6, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    params = net.init(jax.random.PRNGKey(0))
+    sink = lambda _block: None
+    no_poll = lambda: None
+
+    # the same construction path the orchestrator and actor processes use
+    env = make_actor_env(cfg, 0, 0, seed)
+    policy, run_loop = make_actor_policy(cfg, net, params, 0, seed,
+                                         epsilon=cfg.actor.base_eps)
+    run = lambda stop, cap: run_loop(cfg, env, policy, sink, no_poll, stop,
+                                     max_env_steps=cap)
+
+    # compile warm-up outside the timed window (the jitted step + the
+    # bootstrap share one program; one step() compiles it)
+    policy.step()
+
+    deadline = [0.0]
+    stop = lambda: time.time() >= deadline[0]
+    t0 = time.time()
+    deadline[0] = t0 + seconds
+    steps = run(stop, None)
+    elapsed = time.time() - t0
+    return {"envs_per_actor": envs_per_actor, "env_steps": int(steps),
+            "seconds": round(elapsed, 3),
+            "env_steps_per_sec": round(steps / elapsed, 1)}
+
+
+def run_actor_sweep(sweep: List[int], seconds: float = 5.0,
+                    overrides: Optional[dict] = None) -> dict:
+    """The scalar-vs-vectorized table; speedups are against the sweep's
+    envs_per_actor=1 cell (the legacy loop's aggregate env-steps/s — what
+    those same envs achieve when stepped one-at-a-time)."""
+    cfg = _bench_config(overrides)
+    cells = [measure_actor_throughput(cfg, k, seconds=seconds) for k in sweep]
+    out = {"cells": cells}
+    base = next((c for c in cells if c["envs_per_actor"] == 1), None)
+    if base is not None:
+        # only a measured k=1 cell may serve as the scalar baseline — a
+        # sweep without one gets no speedup fields rather than a mislabel
+        for c in cells:
+            c["speedup_vs_scalar"] = round(
+                c["env_steps_per_sec"] / base["env_steps_per_sec"], 2)
+        out["scalar_env_steps_per_sec"] = base["env_steps_per_sec"]
+    return out
+
+
+def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
+            num_actors: int = 1, overrides: Optional[dict] = None) -> dict:
+    """Process-mode vector actors feeding the REAL learner; both speeds
+    measured from the same run's TrainMetrics records (steady-state mean:
+    records after the first, when training has started)."""
+    from r2d2_tpu.runtime.orchestrator import train
+
+    ov = dict(E2E_CPU_OVERRIDES)
+    ov.update({"actor.num_actors": num_actors,
+               "actor.envs_per_actor": envs_per_actor})
+    ov.update(overrides or {})
+    cfg = _bench_config(ov)
+    records = []
+    t0 = time.time()
+    stacks = train(cfg, max_seconds=seconds, actor_mode="process",
+                   log_fn=records.append)
+    elapsed = time.time() - t0
+    learner = stacks[0].learner
+    batch = cfg.replay.batch_size
+    # steady state: drop the first record (warm-up/fill dominates it) and
+    # records where training had not started; if NONE qualify (run too
+    # short to train) the steady-state speeds report 0 — the *_overall
+    # fields still carry the whole-run rates, never mislabeled warm-up
+    steady = [r for r in records[1:] if r.get("training_speed")]
+    env_speed = (float(np.mean([r["buffer_speed"] for r in steady]))
+                 if steady else 0.0)
+    train_speed = (float(np.mean([r["training_speed"] for r in steady]))
+                   if steady else 0.0)
+    return {
+        "seconds": round(elapsed, 1),
+        "num_actors": num_actors,
+        "envs_per_actor": envs_per_actor,
+        "total_env_steps": int(learner.env_steps),
+        "total_train_steps": int(learner.training_steps),
+        "env_steps_per_sec": round(env_speed, 1),
+        "learner_steps_per_sec": round(train_speed, 2),
+        "learner_seq_updates_per_sec": round(train_speed * batch, 1),
+        "env_steps_per_sec_overall": round(learner.env_steps / elapsed, 1),
+        "learner_steps_per_sec_overall": round(
+            learner.training_steps / elapsed, 2),
+        "batch_size": batch,
+        "records": len(records),
+        "config": {k: ov[k] for k in sorted(ov)},
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
+    import jax
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--sweep", default="1,4,16",
+                   help="comma-separated envs_per_actor cells (actor phase)")
+    p.add_argument("--seconds", type=float, default=5.0,
+                   help="measurement window per actor-sweep cell")
+    p.add_argument("--e2e-seconds", type=float, default=60.0,
+                   help="end-to-end actors+learner window (0 disables)")
+    p.add_argument("--envs-per-actor", type=int, default=16,
+                   help="lanes per actor in the e2e phase")
+    p.add_argument("--num-actors", type=int, default=1)
+    p.add_argument("--out", default=os.environ.get("R2D2_E2E_OUT", ""),
+                   help="also write the JSON artifact to this path")
+    p.add_argument("--override", action="append", default=[],
+                   help="dotted config override key=value (repeatable)")
+    args = p.parse_args(argv)
+
+    overrides = {}
+    for ov in args.override:
+        k, _, v = ov.partition("=")
+        try:
+            overrides[k] = json.loads(v)
+        except (json.JSONDecodeError, ValueError):
+            overrides[k] = v
+
+    dev = jax.devices()[0]
+    out = {"metric": "e2e_throughput", "platform": dev.platform,
+           "device_kind": dev.device_kind,
+           "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    sweep = [int(x) for x in args.sweep.split(",") if x]
+    if sweep:
+        out["actor_sweep"] = run_actor_sweep(sweep, seconds=args.seconds,
+                                             overrides=overrides)
+    if args.e2e_seconds > 0:
+        out["e2e"] = run_e2e(args.e2e_seconds, args.envs_per_actor,
+                             args.num_actors, overrides=overrides)
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

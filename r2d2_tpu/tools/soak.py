@@ -203,6 +203,19 @@ def main(argv=None) -> int:
     p.add_argument("--save-dir", default="/tmp/r2d2_soak")
     p.add_argument("--override", action="append", default=[],
                    help="dotted config override key=value (repeatable)")
+    def _env_float(name, fallback):
+        try:
+            return float(os.environ.get(name) or fallback)
+        except ValueError:
+            return fallback
+
+    p.add_argument("--e2e-seconds", type=float,
+                   default=_env_float("R2D2_SOAK_E2E_SECONDS", 0.0),
+                   help="also run the end-to-end actors→learner throughput "
+                        "phase (tools/e2e_bench.py: process-mode vector "
+                        "actors feeding the real learner; reports "
+                        "env-steps/s and learner steps/s together); 0 = off")
+    p.add_argument("--e2e-envs-per-actor", type=int, default=16)
     args = p.parse_args(argv)
     overrides = {}
     for ov in args.override:
@@ -213,6 +226,19 @@ def main(argv=None) -> int:
             overrides[k] = v       # ... plain string otherwise ("tennis")
     out = run_soak(args.seconds, args.capacity, args.checkpoint_interval,
                    args.save_dir, overrides)
+    if args.e2e_seconds > 0:
+        # system-level phase AFTER the device soak: the chip is released by
+        # then, and a failure here must not lose the soak numbers
+        from r2d2_tpu.tools.e2e_bench import run_e2e
+        try:
+            # same --override set as the soak phase (user overrides beat
+            # run_e2e's CPU-reduced defaults), so an on-TPU soak can run
+            # the e2e phase at the reference training shape
+            out["e2e"] = run_e2e(args.e2e_seconds,
+                                 envs_per_actor=args.e2e_envs_per_actor,
+                                 overrides=overrides)
+        except Exception as e:     # pragma: no cover - defensive
+            out["e2e"] = {"error": repr(e)}
     print(json.dumps(out))
     return 0
 

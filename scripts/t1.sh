@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Tier-1 gate — the EXACT command from ROADMAP.md ("Tier-1 verify"), so
+# builders, CI, and the driver all run the same thing. Prints
+# DOTS_PASSED=<n> (the driver's pass-count convention) and exits with
+# pytest's status.
+#
+# Usage: scripts/t1.sh  (from the repo root or any subdirectory)
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
