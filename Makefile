@@ -1,0 +1,23 @@
+# Developer entry points.
+#
+#   make t1    — the tier-1 gate: EXACTLY the ROADMAP.md verify command
+#                (via scripts/t1.sh), preceded by a marker check that the
+#                ingestion tests are collected in the fast ('not slow')
+#                tier — a stray @pytest.mark.slow would silently drop them
+#                from the gate.
+
+.PHONY: t1 check-fast-markers
+
+t1: check-fast-markers
+	bash scripts/t1.sh
+
+check-fast-markers:
+	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_ingest.py \
+	    -m 'not slow' --collect-only -q -p no:cacheprovider 2>/dev/null \
+	    | grep -c '::'); \
+	if [ "$$n" -ge 10 ]; then \
+	    echo "fast-tier ingestion tests collected: $$n"; \
+	else \
+	    echo "ERROR: ingestion tests missing from the 'not slow' tier ($$n collected)"; \
+	    exit 1; \
+	fi

@@ -147,6 +147,19 @@ class ReplayConfig:
     # at replay_init — set "off" there and keep the row-gather's 2.6x win.
     # Requires pallas_sample_gather; the stored obs layout changes with it.
     pallas_exact_gather: str = "auto"
+    # Batched + pipelined ingestion (device placement): the learner's
+    # stager thread coalesces up to this many actor blocks per drain into
+    # ONE stacked host→device transfer + ONE jitted replay_add_many
+    # dispatch, staged in the background so the transfer overlaps the
+    # running train dispatch. -1 = auto (8 on TPU, where per-block dispatch
+    # over the tunnel dominates the learner loop — PERF.md "Experience
+    # ingestion"; 1 on CPU). 1 = the legacy synchronous per-block path.
+    # Capped by num_blocks (scatter rows must not alias).
+    ingest_batch_blocks: int = -1
+    # Max blocks the learner pops from the feeder queue per drain call —
+    # ONE knob for both the training loop and the orchestrator's warm-up
+    # loop (they used to hardcode 32 and 16 respectively).
+    drain_max_blocks: int = 32
     # Reverb-style rate limiter: pause block ingestion (back-pressuring
     # actors through the bounded feeder queue) once
     # env_steps > learning_starts + ratio * train_steps. Pins the
@@ -154,6 +167,16 @@ class ReplayConfig:
     # on the actors/learner scheduling balance of the host. 0 = unthrottled
     # (the reference's behavior: actors free-run, worker.py:528).
     max_env_steps_per_train_step: float = 0.0
+
+    def resolved_ingest_batch_blocks(self) -> int:
+        """-1 auto: batched ingestion (8 blocks/dispatch) iff the backend
+        is TPU — there the per-block python dispatch + tunnel transfer is
+        the measured learner-loop cost; on CPU dispatch is cheap and the
+        legacy per-block path stays the default."""
+        if self.ingest_batch_blocks > 0:
+            return self.ingest_batch_blocks
+        import jax
+        return 8 if jax.default_backend() == "tpu" else 1
 
 
 @dataclass(frozen=True)
@@ -337,6 +360,20 @@ class Config:
             )
         if self.sequence.forward_steps < 1:
             raise ValueError("sequence.forward_steps must be >= 1")
+        if self.replay.ingest_batch_blocks == 0 or \
+                self.replay.ingest_batch_blocks < -1:
+            raise ValueError(
+                f"replay.ingest_batch_blocks ({self.replay.ingest_batch_blocks})"
+                " must be -1 (auto) or >= 1")
+        if self.replay.ingest_batch_blocks > self.num_blocks:
+            raise ValueError(
+                f"replay.ingest_batch_blocks ({self.replay.ingest_batch_blocks})"
+                f" must be <= num_blocks ({self.num_blocks}): replay_add_many"
+                " scatter rows would alias in the ring")
+        if self.replay.drain_max_blocks < 1:
+            raise ValueError(
+                f"replay.drain_max_blocks ({self.replay.drain_max_blocks}) "
+                "must be >= 1")
         if self.actor.envs_per_actor < 1:
             raise ValueError(
                 f"actor.envs_per_actor ({self.actor.envs_per_actor}) must be "

@@ -12,6 +12,7 @@ from r2d2_tpu.parallel.mesh import make_mesh, init_distributed
 from r2d2_tpu.parallel.sharded import (
     make_sharded_learner_step,
     make_sharded_replay_add,
+    make_sharded_replay_add_many,
     sharded_replay_init,
     sharded_buffer_steps,
 )
@@ -23,6 +24,7 @@ from r2d2_tpu.parallel.tensor_parallel import (
 __all__ = [
     "make_mesh", "init_distributed",
     "make_sharded_learner_step", "make_sharded_replay_add",
+    "make_sharded_replay_add_many",
     "sharded_replay_init", "sharded_buffer_steps",
     "make_tp_external_batch_step", "state_shardings",
     "train_multihost", "make_sp_lstm",

@@ -83,6 +83,46 @@ def make_sharded_replay_add(spec: ReplaySpec, mesh: Mesh):
     return jax.jit(add_fn, donate_argnums=0)
 
 
+def make_sharded_replay_add_many(spec: ReplaySpec, mesh: Mesh):
+    """add_many(state, blocks, start_shard): ring-write K stacked blocks in
+    ONE dispatch, round-robin across the dp shards — parity-exact with K
+    sequential ``make_sharded_replay_add`` calls starting at ``start_shard``.
+
+    Block k goes to shard ``(start_shard + k) % dp``; inside the single
+    shard_map dispatch each shard scans the broadcast K-block batch and
+    ring-writes its own strided subset in feed order (owner-conditional
+    writes), so every shard's local pointer advances exactly as under the
+    per-block path. The host pays one dispatch + one K-block transfer
+    instead of K of each. K is a static shape (one compile per drain size).
+    """
+    dp = mesh.shape["dp"]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("dp"), P(), P()), out_specs=P("dp"), check_vma=False)
+    def add_many(state: ReplayState, blocks: Block, start_shard):
+        my = jax.lax.axis_index("dp")
+        local = _shard0(state)
+        k = blocks.priority.shape[0]
+
+        def body(s, xs):
+            blk, i = xs
+            owner = (start_shard[0] + i) % dp
+            return jax.lax.cond(
+                my == owner, lambda st: replay_add(spec, st, blk),
+                lambda st: st, s), None
+
+        local, _ = jax.lax.scan(
+            body, local, (blocks, jnp.arange(k, dtype=jnp.int32)))
+        return _unshard0(local)
+
+    def add_fn(state, blocks, start_shard: int):
+        return add_many(state, blocks,
+                        jnp.asarray([start_shard], jnp.int32))
+
+    return jax.jit(add_fn, donate_argnums=0)
+
+
 def _post_gradient_update(tx, optim: OptimConfig, use_double: bool,
                           train_state: TrainState, grads, key, loss,
                           mean_abs_td, mean_q):

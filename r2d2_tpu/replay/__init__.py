@@ -13,6 +13,7 @@ from r2d2_tpu.replay.structs import Block, ReplaySpec, ReplayState, SampleBatch
 from r2d2_tpu.replay.device_replay import (
     replay_init,
     replay_add,
+    replay_add_many,
     replay_sample,
     replay_update_priorities,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "SampleBatch",
     "replay_init",
     "replay_add",
+    "replay_add_many",
     "replay_sample",
     "replay_update_priorities",
     "HostReplay",

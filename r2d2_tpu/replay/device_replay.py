@@ -106,31 +106,64 @@ def replay_add(spec: ReplaySpec, state: ReplayState, block: Block) -> ReplayStat
     Empty sequence slots carry priority 0 (their leaves become unsamplable)
     and learning_steps 0, which also re-zeroes slots left over from a longer
     block previously in this ring position.
+
+    Exactly the K=1 case of ``replay_add_many`` — one write path, so a
+    Block/ReplayState field added to one cannot silently diverge from the
+    other."""
+    return replay_add_many(
+        spec, state,
+        jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], block))
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def replay_add_many(spec: ReplaySpec, state: ReplayState,
+                    blocks: Block) -> ReplayState:
+    """Ring-write K stacked blocks in ONE dispatch — parity-exact with K
+    sequential ``replay_add`` calls, including ring wrap.
+
+    ``blocks`` is a Block whose every leaf carries a leading K axis (the
+    feeder's stacked drain). Block k lands in ring row
+    ``(block_ptr + k) % num_blocks`` — the same rows the sequential path
+    visits — and all K * seqs_per_block tree leaves are seeded by one
+    ``tree_update``. Requires K <= num_blocks: beyond that the scatter rows
+    alias (XLA scatter-set order over duplicates is undefined), and the
+    sequential path's later-write-wins overwrite cannot be reproduced.
+    K is a static shape, so each distinct drain size compiles once.
     """
+    k = blocks.priority.shape[0]
+    if k > spec.num_blocks:
+        raise ValueError(
+            f"replay_add_many got {k} blocks but the ring has only "
+            f"{spec.num_blocks} rows — scatter rows would alias; cap "
+            "replay.ingest_batch_blocks at num_blocks")
     ptr = state.block_ptr
-    leaf0 = ptr * spec.seqs_per_block
-    idxes = leaf0 + jnp.arange(spec.seqs_per_block, dtype=jnp.int32)
+    rows = (ptr + jnp.arange(k, dtype=jnp.int32)) % spec.num_blocks
+    idxes = (rows[:, None] * spec.seqs_per_block
+             + jnp.arange(spec.seqs_per_block, dtype=jnp.int32)[None, :]
+             ).reshape(-1)
     tree = tree_update(spec.tree_layers, state.tree, spec.prio_exponent,
-                       block.priority, idxes)
-    obs_row = block.obs_row
+                       blocks.priority.reshape(-1), idxes)
+    obs_rows = blocks.obs_row
     if (spec.stored_frame_height != spec.frame_height
             or spec.stored_frame_width != spec.frame_width):
-        obs_row = jnp.pad(obs_row, (
-            (0, 0), (0, spec.stored_frame_height - spec.frame_height),
+        obs_rows = jnp.pad(obs_rows, (
+            (0, 0), (0, 0),
+            (0, spec.stored_frame_height - spec.frame_height),
             (0, spec.stored_frame_width - spec.frame_width)))
     return state.replace(
         tree=tree,
-        obs=state.obs.at[ptr].set(obs_row),
-        last_action=state.last_action.at[ptr].set(block.last_action_row),
-        hidden=state.hidden.at[ptr].set(block.hidden),
-        action=state.action.at[ptr].set(block.action),
-        reward=state.reward.at[ptr].set(block.reward),
-        gamma=state.gamma.at[ptr].set(block.gamma),
-        burn_in_steps=state.burn_in_steps.at[ptr].set(block.burn_in_steps),
-        learning_steps=state.learning_steps.at[ptr].set(block.learning_steps),
-        forward_steps=state.forward_steps.at[ptr].set(block.forward_steps),
-        seq_start=state.seq_start.at[ptr].set(block.seq_start),
-        block_ptr=(ptr + 1) % spec.num_blocks,
+        obs=state.obs.at[rows].set(obs_rows),
+        last_action=state.last_action.at[rows].set(blocks.last_action_row),
+        hidden=state.hidden.at[rows].set(blocks.hidden),
+        action=state.action.at[rows].set(blocks.action),
+        reward=state.reward.at[rows].set(blocks.reward),
+        gamma=state.gamma.at[rows].set(blocks.gamma),
+        burn_in_steps=state.burn_in_steps.at[rows].set(blocks.burn_in_steps),
+        learning_steps=state.learning_steps.at[rows].set(
+            blocks.learning_steps),
+        forward_steps=state.forward_steps.at[rows].set(blocks.forward_steps),
+        seq_start=state.seq_start.at[rows].set(blocks.seq_start),
+        block_ptr=(ptr + k) % spec.num_blocks,
     )
 
 

@@ -105,13 +105,13 @@ class HostReplay:
             forward = self.forward_steps[b, s]
             start = self.seq_start[b, s] - burn_in
 
+            # batched fancy-index gather: window offsets broadcast over
+            # arange(obs_len) — one vectorized take instead of a per-row
+            # Python slice loop (the reference's worker.py:140-166 shape)
             obs_len = spec.seq_window + spec.frame_stack - 1
-            obs = np.zeros((batch, obs_len, spec.frame_height, spec.frame_width), np.uint8)
-            la = np.zeros((batch, spec.seq_window), np.int32)
-            for i in range(batch):
-                t0 = start[i]
-                obs[i] = self.obs[b[i], t0 : t0 + obs_len]
-                la[i] = self.last_action[b[i], t0 : t0 + spec.seq_window]
+            t0 = start[:, None].astype(np.int64)
+            obs = self.obs[b[:, None], t0 + np.arange(obs_len)]
+            la = self.last_action[b[:, None], t0 + np.arange(spec.seq_window)]
 
             return (
                 SampleBatch(

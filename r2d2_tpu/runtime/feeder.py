@@ -12,6 +12,8 @@ import queue as queue_mod
 import subprocess
 from typing import List, Optional
 
+import numpy as np
+
 from r2d2_tpu.replay.structs import Block
 
 
@@ -148,6 +150,32 @@ class BlockQueue:
             except queue_mod.Empty:
                 break
         return out
+
+    def drain_stacked(self, max_items: int = 16):
+        """Non-blocking drain of up to max_items blocks as ONE stacked Block
+        (leading K axis on every leaf) — the batched-ingestion transport
+        contract. On the native shm ring the fields stream straight from the
+        ring slots into contiguous stacked arrays (zero intermediate
+        copies); other queue backends fall back to get_nowait + np.stack.
+        Returns (stacked_block, k); (None, 0) when the queue is empty."""
+        fn = getattr(self._q, "drain_stacked", None)
+        if fn is not None:
+            return fn(max_items)
+        blocks = self.drain(max_items)
+        if not blocks:
+            return None, 0
+        import jax
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *blocks)
+        return stacked, len(blocks)
+
+    def qsize(self) -> int:
+        """Best-effort queue depth; -1 when the backend cannot say (the
+        ingest stager then drains without accumulation/bucketing)."""
+        try:
+            return int(self._q.qsize())
+        except (NotImplementedError, OSError):
+            return -1
 
     def get(self, timeout: Optional[float] = None) -> Block:
         return self._q.get(timeout=timeout)

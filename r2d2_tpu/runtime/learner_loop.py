@@ -31,7 +31,8 @@ from r2d2_tpu.learner.train_step import (
     TrainState, create_train_state, make_external_batch_step,
     make_learner_step, make_multi_learner_step)
 from r2d2_tpu.models.network import NetworkApply
-from r2d2_tpu.replay.device_replay import replay_add, replay_init
+from r2d2_tpu.replay.device_replay import (
+    replay_add, replay_add_many, replay_init)
 from r2d2_tpu.replay.host_replay import HostReplay
 from r2d2_tpu.replay.structs import Block, ReplaySpec
 from r2d2_tpu.runtime.checkpoint import apply_restore, save_checkpoint
@@ -164,6 +165,57 @@ class Learner:
         self._ratio_step_base = self._host_step
         self._pending_losses: list = []   # device scalars, flushed lazily
 
+        # -- batched + pipelined ingestion (ISSUE 2) --
+        # K > 1 (device placement only): a background stager thread drains
+        # the feeder queue in stacked K-block batches and launches their
+        # host→device transfer while the current train dispatch runs; the
+        # main thread commits staged batches (ONE replay_add_many dispatch
+        # per batch) between train dispatches, where ring/rate-limiter
+        # accounting happens — the same interleaving point the per-block
+        # path uses, so the fused step's priority write-back stays
+        # race-free. Host placement keeps K = 1: its ingest is a numpy
+        # copy, not a device dispatch.
+        self._ingest_k = (1 if self.host_mode else
+                          min(cfg.replay.resolved_ingest_batch_blocks(),
+                              self.spec.num_blocks))
+        self._sharded_add_many = None
+        if self.mesh is not None and self._ingest_k > 1:
+            from r2d2_tpu.parallel import make_sharded_replay_add_many
+            self._sharded_add_many = make_sharded_replay_add_many(
+                self.spec, self.mesh)
+        self._stager: Optional[threading.Thread] = None
+        # AOT add_many executables per batch size, compiled in the STAGER
+        # thread before a batch is enqueued, so a new batch size never
+        # stalls the commit path with an XLA compile — on the dp-sharded
+        # path too (batched ingestion auto-engages on TPU, where a lazy
+        # ~1.5 s mid-run compile measurably parks the actors). Replay
+        # shape/sharding avals are captured now, before any donation
+        # invalidates the live arrays.
+        self._add_many_cache: dict = {}
+        if self.replay_state is None:
+            self._replay_shapes = None
+        elif self.mesh is not None:
+            # sharding-annotated avals: lowering a shard_map program from
+            # plain ShapeDtypeStructs would let the compiler pick layouts
+            # the committed per-shard arrays then fail to match
+            self._replay_shapes = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=x.sharding),
+                self.replay_state)
+        else:
+            self._replay_shapes = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                self.replay_state)
+        self._ingest_stop = threading.Event()
+        # depth 2: one batch committing + one transfer in flight bounds
+        # staged memory at 2K blocks while keeping the pipeline full
+        self._ingest_q: queue_mod.Queue = queue_mod.Queue(maxsize=2)
+        self._ingest_error: Optional[BaseException] = None
+        self._staged_env_steps = 0        # popped but not yet committed
+        self._staged_blocks = 0
+        self._staged_lock = threading.Lock()
+        self._pause_started: Optional[float] = None
+
     # -- ingestion --
 
     def ingest(self, block: Block) -> None:
@@ -197,24 +249,238 @@ class Learner:
         ratio = self.cfg.replay.max_env_steps_per_train_step
         if ratio <= 0:
             return False
+        # Staged-but-uncommitted blocks count as collected EVERYWHERE in
+        # this check: they were already popped from the feeder and WILL
+        # commit at the next drain regardless of training, so (a) counting
+        # them toward the training-gate fill cannot livelock, and (b) NOT
+        # counting them would let the stager pull far past the budget
+        # while commits lag behind pops (the gate would read open forever).
+        with self._staged_lock:
+            staged_steps = self._staged_env_steps
+            staged_blocks = self._staged_blocks
         # Never pause while the training gate is closed: ingestion is the
         # only thing that can open it (learning_starts fill, and under a dp
         # mesh one block per shard), so pausing there would livelock —
         # drain() returns 0 forever while ready waits for a block that can
         # never arrive.
-        if not self.ready:
+        if not self._gate_open(staged_blocks, staged_steps):
             return False
         budget = (self.cfg.replay.learning_starts
                   + ratio * max(self._host_step - self._ratio_step_base, 1))
-        return self.env_steps - self._ratio_env_base >= budget
+        return (self.env_steps + staged_steps
+                - self._ratio_env_base) >= budget
 
-    def drain(self, queue, max_items: int = 32) -> int:
-        if self.ingestion_paused:
+    def _note_pause(self, paused: bool) -> None:
+        """Rate-limiter pause-time accounting (whichever thread owns the
+        feeder-pop loop calls this: the main thread on the legacy path, the
+        stager on the pipelined path)."""
+        if paused:
+            if self._pause_started is None:
+                self._pause_started = time.time()
+        elif self._pause_started is not None:
+            self.metrics.on_ingest_pause(time.time() - self._pause_started)
+            self._pause_started = None
+
+    def drain(self, queue, max_items: Optional[int] = None) -> int:
+        """Move actor blocks from the feeder queue into the replay. Legacy
+        path (ingest_batch_blocks = 1): pop + ingest synchronously, up to
+        ``max_items`` (default replay.drain_max_blocks — one knob for this
+        loop and the orchestrator's warm-up loop). Pipelined path (K > 1):
+        commit whatever stacked batches the stager has staged; the stager
+        drains the feeder in K-block bursts on its own thread."""
+        if self._ingest_k > 1:
+            return self._drain_pipelined(queue)
+        if max_items is None:
+            max_items = self.cfg.replay.drain_max_blocks
+        paused = self.ingestion_paused
+        self._note_pause(paused)
+        if paused:
             return 0
+        t0 = time.time()
         blocks = queue.drain(max_items)
         for blk in blocks:
             self.ingest(blk)
+        if blocks:
+            self.metrics.on_ingest_drain(len(blocks), time.time() - t0)
         return len(blocks)
+
+    # -- pipelined ingestion (stager thread + commit) --
+
+    def _drain_pipelined(self, queue) -> int:
+        if self._ingest_error is not None:
+            raise RuntimeError(
+                "ingest stager thread died") from self._ingest_error
+        if self._stager is None or not self._stager.is_alive():
+            self._start_stager(queue)
+        committed = 0
+        # same per-drain block cap as the legacy path: a producer that
+        # outpaces the learner must not starve the train loop by keeping
+        # this commit loop spinning
+        while committed < self.cfg.replay.drain_max_blocks:
+            try:
+                staged, metas, t_pop = self._ingest_q.get_nowait()
+            except queue_mod.Empty:
+                break
+            committed += self._commit_staged(staged, metas, t_pop)
+        self.metrics.set_ingest_queue_depth(self._ingest_q.qsize())
+        return committed
+
+    def _commit_staged(self, staged: Block, metas, t_pop: float) -> int:
+        """ONE device dispatch ring-writes the whole stacked batch; ring
+        pointer, rate-limiter env-step base, and metrics account here — at
+        commit time, on the main thread — so back-pressure and the
+        device/host pointer mirror keep the per-block path's semantics."""
+        k = len(metas)
+        # the stager AOT-compiled this batch size before enqueueing
+        exe = self._add_many_cache.get(k)
+        if self.mesh is not None:
+            if exe is not None:
+                self.replay_state = exe(self.replay_state, staged,
+                                        np.int32(self._next_shard))
+            else:   # defensive fallback: jit-call path (compiles here)
+                self.replay_state = self._sharded_add_many(
+                    self.replay_state, staged, self._next_shard)
+            self._next_shard = (self._next_shard + k) % self._dp
+        else:
+            if exe is not None:
+                self.replay_state = exe(self.replay_state, staged)
+            else:
+                self.replay_state = replay_add_many(
+                    self.spec, self.replay_state, staged)
+        total = 0
+        for learning, ret in metas:
+            self.ring.advance(learning)
+            self.metrics.on_block(learning, ret)
+            total += learning
+        self.env_steps += total
+        with self._staged_lock:
+            self._staged_env_steps -= total
+            self._staged_blocks -= k
+        self.metrics.set_buffer_size(self.ring.buffer_steps)
+        self.metrics.on_ingest_drain(k, time.time() - t_pop)
+        return k
+
+    def _compile_add_many(self, kb: int):
+        """Lower + AOT-compile the add_many executable for batch size
+        ``kb`` — the ONE lowering recipe (stager thread only), shared by
+        the startup precompile and the odd-size fallback, deriving block
+        avals from the authoritative record layout (empty_block_np)."""
+        from r2d2_tpu.replay.structs import empty_block_np
+        proto = empty_block_np(self.spec)
+        blocks = Block(**{
+            name: jax.ShapeDtypeStruct((kb,) + arr.shape, arr.dtype)
+            for name, arr in proto.items()})
+        if self.mesh is not None:
+            shard = jax.ShapeDtypeStruct((), np.int32)
+            return self._sharded_add_many.lower(
+                self._replay_shapes, blocks, shard).compile()
+        return replay_add_many.lower(
+            self.spec, self._replay_shapes, blocks).compile()
+
+    def _precompile_add_many(self) -> None:
+        """AOT-compile add_many for every power-of-two bucket up to K (the
+        only batch sizes the stager drains) — runs once in the stager
+        thread at startup, i.e. during the warm-up fill, so a ~1.5 s XLA
+        compile never stalls mid-run ingestion (measured: a lazy mid-run
+        compile backs the feeder up enough to park the actors)."""
+        # pow2 buckets PLUS K itself: a non-pow2 ingest_batch_blocks is
+        # the steady-state drain size under load and would otherwise hit
+        # the lazy mid-run compile exactly when load first reaches K
+        sizes = []
+        kb = 1
+        while kb < self._ingest_k:
+            sizes.append(kb)
+            kb *= 2
+        sizes.append(self._ingest_k)
+        for kb in sizes:
+            if self._ingest_stop.is_set():
+                break
+            if kb not in self._add_many_cache:
+                self._add_many_cache[kb] = self._compile_add_many(kb)
+
+    def _start_stager(self, queue) -> None:
+        def stage_loop():
+            try:
+                self._precompile_add_many()
+                while not self._ingest_stop.is_set():
+                    paused = self.ingestion_paused
+                    self._note_pause(paused)
+                    if paused:
+                        time.sleep(0.002)
+                        continue
+                    t_pop = time.time()
+                    # Drain what is queued NOW, rounded down to a power-of-
+                    # two bucket (bounds the distinct compiled add_many
+                    # batch sizes at log2(K)+1) — never wait for a full
+                    # batch: an explicit accumulation window throttles
+                    # ingestion below the offered load and back-pressures
+                    # the actors for nothing. Batching emerges under load
+                    # on its own — while the bounded staging queue is full,
+                    # the feeder accumulates and the next drain sees a
+                    # bigger bucket.
+                    want = self._ingest_k
+                    avail = queue.qsize()
+                    if avail == 0:
+                        time.sleep(0.001)
+                        continue
+                    if 0 < avail < want:
+                        want = 1 << (avail.bit_length() - 1)
+                    stacked, k = queue.drain_stacked(want)
+                    if k == 0:
+                        time.sleep(0.001)
+                        continue
+                    if k not in self._add_many_cache:
+                        # odd size (qsize-less backend): compile HERE
+                        # (stager thread), never at commit
+                        self._add_many_cache[k] = self._compile_add_many(k)
+                    learning = np.asarray(stacked.learning_steps)\
+                        .sum(axis=1).astype(np.int64)
+                    rets = np.asarray(stacked.sum_reward, np.float32)
+                    metas = [
+                        (int(learning[i]),
+                         None if np.isnan(rets[i]) else float(rets[i]))
+                        for i in range(k)]
+                    with self._staged_lock:
+                        self._staged_env_steps += int(learning.sum())
+                        self._staged_blocks += k
+                    # starts the host→device transfer; it proceeds while
+                    # the main thread's train dispatch runs (replicated
+                    # across the mesh on the dp-sharded path, matching the
+                    # AOT executable's P() block avals)
+                    if self.mesh is not None:
+                        from jax.sharding import (
+                            NamedSharding, PartitionSpec)
+                        staged = jax.device_put(
+                            stacked, NamedSharding(self.mesh,
+                                                   PartitionSpec()))
+                    else:
+                        staged = jax.device_put(stacked)
+                    while not self._ingest_stop.is_set():
+                        try:
+                            self._ingest_q.put((staged, metas, t_pop),
+                                               timeout=0.2)
+                            break
+                        except queue_mod.Full:
+                            continue
+            except BaseException as e:   # surfaced by _drain_pipelined
+                self._ingest_error = e
+                raise
+
+        self._stager = threading.Thread(
+            target=stage_loop, daemon=True,
+            name=f"learner-ingest-stager-p{self.player_idx}")
+        self._stager.start()
+
+    def _gate_open(self, extra_blocks: int = 0, extra_steps: int = 0) -> bool:
+        """The training-gate conditions — ONE implementation shared by
+        ``ready`` (committed blocks only) and the rate limiter's pause
+        check (committed + staged), so the two cannot drift apart and
+        re-open the pause-before-ready livelock."""
+        if (self.mesh is not None
+                and self.ring.total_adds + extra_blocks < self._dp):
+            return False
+        return (self.ring.buffer_steps + extra_steps
+                >= self.cfg.replay.learning_starts)
 
     @property
     def ready(self) -> bool:
@@ -222,9 +488,7 @@ class Learner:
         Under a dp mesh every shard must also hold at least one block —
         per-shard prioritized sampling over an empty tree yields NaN
         importance weights."""
-        if self.mesh is not None and self.ring.total_adds < self._dp:
-            return False
-        return self.ring.buffer_steps >= self.cfg.replay.learning_starts
+        return self._gate_open()
 
     @property
     def training_steps(self) -> int:
@@ -270,14 +534,35 @@ class Learner:
             self._bg_threads.append(t)
 
     def stop_background(self, join_timeout: float = 10.0) -> None:
+        stuck = []
+        if self._stager is not None:
+            # drain the staging queue so a stager parked in a full-queue
+            # put can observe the stop event; staged-but-uncommitted
+            # blocks are dropped (shutdown only)
+            self._ingest_stop.set()
+            deadline = time.time() + join_timeout
+            while self._stager.is_alive() and time.time() < deadline:
+                try:
+                    self._ingest_q.get_nowait()
+                except queue_mod.Empty:
+                    pass
+                self._stager.join(timeout=0.1)
+            if self._stager.is_alive():
+                stuck.append(self._stager.name)
+            else:
+                self._stager = None
         if not self.host_mode:
+            if stuck:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "learner background threads did not exit within %.1fs: "
+                    "%s", join_timeout, stuck)
             return
         self._bg_stop.set()
         # Unblock a prefetch thread parked in a full-queue put by draining
         # the prefetch queue, then join; surface anything still stuck (a
         # thread blocked inside a device transfer would otherwise outlive
         # the orchestrator's close() silently).
-        stuck = []
         for t in self._bg_threads:
             deadline = time.time() + join_timeout
             while t.is_alive() and time.time() < deadline:

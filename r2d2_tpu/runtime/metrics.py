@@ -43,6 +43,21 @@ class TrainMetrics:
         self.dropped_priority_updates = 0
         self._next_drop_warn = 1
 
+        # ingestion observability (ISSUE 2): per-interval accumulators,
+        # reset at each log(), plus a cumulative block counter the e2e
+        # bench reads for whole-run blocks/s. Locked: the pipelined
+        # stager thread feeds on_ingest_pause while the main thread's
+        # log() resets — an unguarded read-modify-write would double-count
+        # or drop an interval's pause time.
+        import threading
+        self._ingest_lock = threading.Lock()
+        self.ingest_blocks_total = 0
+        self._ingest_drains = 0
+        self._ingest_blocks = 0
+        self._ingest_latency_sum = 0.0
+        self._ingest_pause_time = 0.0
+        self.ingest_queue_depth = 0
+
     # -- feed points --
 
     def on_block(self, learning_steps: int, episode_return: Optional[float]) -> None:
@@ -59,6 +74,27 @@ class TrainMetrics:
 
     def set_buffer_size(self, size: int) -> None:
         self.buffer_size = int(size)
+
+    def on_ingest_drain(self, blocks: int, latency: float) -> None:
+        """Called once per non-empty ingestion drain: ``blocks`` blocks
+        entered the replay in one batch, ``latency`` seconds from queue pop
+        to replay commit (the pipelined path's stage→commit lag; the
+        legacy path's synchronous drain+ingest wall time)."""
+        with self._ingest_lock:
+            self._ingest_drains += 1
+            self._ingest_blocks += blocks
+            self.ingest_blocks_total += blocks
+            self._ingest_latency_sum += latency
+
+    def on_ingest_pause(self, seconds: float) -> None:
+        """Rate-limiter pause time: ingestion stood still for ``seconds``
+        while collection was ahead of the collect:learn budget."""
+        with self._ingest_lock:
+            self._ingest_pause_time += seconds
+
+    def set_ingest_queue_depth(self, depth: int) -> None:
+        """Staged batches awaiting commit (pipelined ingestion gauge)."""
+        self.ingest_queue_depth = int(depth)
 
     def on_dropped_priority_update(self) -> None:
         """Called when a priority write-back batch is dropped because the
@@ -110,6 +146,26 @@ class TrainMetrics:
             "loss": mean_loss,
             "dropped_priority_updates": self.dropped_priority_updates,
         }
+        with self._ingest_lock:
+            # ingestion observability (per-interval; the e2e bench's
+            # ingestion phase reads these)
+            record.update({
+                "ingest_blocks_total": self.ingest_blocks_total,
+                "ingest_drains": self._ingest_drains,
+                "ingest_blocks_per_drain": (
+                    round(self._ingest_blocks / self._ingest_drains, 2)
+                    if self._ingest_drains else None),
+                "ingest_drain_latency_ms": (
+                    round(1e3 * self._ingest_latency_sum
+                          / self._ingest_drains, 3)
+                    if self._ingest_drains else None),
+                "ingest_queue_depth": self.ingest_queue_depth,
+                "ingest_pause_time": round(self._ingest_pause_time, 3),
+            })
+            self._ingest_drains = 0
+            self._ingest_blocks = 0
+            self._ingest_latency_sum = 0.0
+            self._ingest_pause_time = 0.0
         if self._jsonl_path:
             with open(self._jsonl_path, "a") as f:
                 f.write(json.dumps(record) + "\n")

@@ -266,7 +266,11 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
         def timed_out() -> bool:
             return deadline is not None and time.time() > deadline
 
-        # warm-up: fill buffers to learning_starts (ref train.py:49-54)
+        # warm-up: fill buffers to learning_starts (ref train.py:49-54).
+        # drain() bursts at replay.drain_max_blocks here AND in the
+        # training loop below — one knob, no silently different warm-up
+        # rate — and routes to the pipelined stager when
+        # replay.ingest_batch_blocks > 1.
         while (not all(st.learner.ready for st in stacks) and not timed_out()
                and not stop.is_set()):
             for st in stacks:

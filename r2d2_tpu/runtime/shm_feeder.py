@@ -143,6 +143,36 @@ class ShmBlockRing:
         lib.ring_commit_pop(self._base, pos)
         return Block(**out)
 
+    def drain_stacked(self, max_items: int = 16) -> Tuple[Optional[Block], int]:
+        """Non-blocking pop of up to ``max_items`` blocks into ONE stacked
+        Block (leading K axis on every leaf). Each field streams straight
+        from its shm ring slot into row k of a contiguous preallocated
+        stacked array — no intermediate per-block arrays, no Python-level
+        restacking — so the result is device_put-ready as a single
+        transfer. Returns (stacked_block, k); (None, 0) when empty."""
+        lib = self._ensure()
+        out = None
+        k = 0
+        for _ in range(max_items):
+            pos = int(lib.ring_reserve_pop(self._base))
+            if pos < 0:
+                break
+            if out is None:
+                out = {f.name: np.empty((max_items,) + f.shape, f.dtype)
+                       for f in self._fields}
+            slot = self._slot_view(lib, pos)
+            for f in self._fields:
+                raw = slot[f.offset:f.offset + f.nbytes]
+                out[f.name][k] = raw.view(f.dtype).reshape(f.shape)
+            lib.ring_commit_pop(self._base, pos)
+            k += 1
+        if k == 0:
+            return None, 0
+        if k < max_items:
+            # contiguous prefix view — no copy
+            out = {name: arr[:k] for name, arr in out.items()}
+        return Block(**out), k
+
     def get(self, timeout: Optional[float] = None) -> Block:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:

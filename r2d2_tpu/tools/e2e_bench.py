@@ -22,6 +22,12 @@ Phases:
      block ring — via orchestrator.train, reporting steady-state env-steps/s
      and learner updates/s (and seq-updates/s = updates/s × batch) from the
      TrainMetrics records.
+  3. **Ingestion A/B** (default when the e2e phase runs, ``--ingest-ab``):
+     the e2e run twice — batched+pipelined replay ingestion
+     (``replay.ingest_batch_blocks = K``: stacked feeder drains, one
+     ``replay_add_many`` dispatch per K blocks, background stager) vs the
+     legacy per-block path — with blocks/s ingested, drain latency, and
+     rate-limiter pause time from the ingestion counters, in one artifact.
 
 Output: ONE JSON line (the driver artifact), also written to ``--out``.
 Hermetic on any backend — the fake env and (for the e2e phase) a
@@ -148,10 +154,17 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
                  if steady else 0.0)
     train_speed = (float(np.mean([r["training_speed"] for r in steady]))
                    if steady else 0.0)
+    # ingestion observability (TrainMetrics ingest counters, ISSUE 2)
+    blocks_total = learner.metrics.ingest_blocks_total
+    bpd = [r["ingest_blocks_per_drain"] for r in records
+           if r.get("ingest_blocks_per_drain")]
+    lat = [r["ingest_drain_latency_ms"] for r in records
+           if r.get("ingest_drain_latency_ms") is not None]
     return {
         "seconds": round(elapsed, 1),
         "num_actors": num_actors,
         "envs_per_actor": envs_per_actor,
+        "ingest_batch_blocks": learner._ingest_k,
         "total_env_steps": int(learner.env_steps),
         "total_train_steps": int(learner.training_steps),
         "env_steps_per_sec": round(env_speed, 1),
@@ -160,10 +173,43 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "env_steps_per_sec_overall": round(learner.env_steps / elapsed, 1),
         "learner_steps_per_sec_overall": round(
             learner.training_steps / elapsed, 2),
+        "blocks_ingested": int(blocks_total),
+        "blocks_ingested_per_sec": round(blocks_total / elapsed, 2),
+        "ingest_blocks_per_drain": (round(float(np.mean(bpd)), 2)
+                                    if bpd else None),
+        "ingest_drain_latency_ms": (round(float(np.mean(lat)), 3)
+                                    if lat else None),
+        "ingest_pause_time": round(
+            sum(r.get("ingest_pause_time") or 0.0 for r in records), 3),
         "batch_size": batch,
         "records": len(records),
         "config": {k: ov[k] for k in sorted(ov)},
     }
+
+
+def run_ingest_ab(seconds: float, envs_per_actor: int, num_actors: int,
+                  ingest_blocks: int, overrides: Optional[dict] = None
+                  ) -> dict:
+    """Ingestion A/B (ISSUE 2 acceptance): the SAME e2e system run twice on
+    this host — batched+pipelined ingestion (replay.ingest_batch_blocks =
+    ``ingest_blocks``) vs the legacy per-block path (= 1) — in one
+    artifact. The claim under test: higher learner updates/s at unchanged
+    env-steps/s when per-block dispatch leaves the learner's critical
+    path."""
+    out = {}
+    for label, k in (("ingest_off", 1), ("ingest_on", ingest_blocks)):
+        ov = dict(overrides or {})
+        ov["replay.ingest_batch_blocks"] = k
+        out[label] = run_e2e(seconds, envs_per_actor, num_actors,
+                             overrides=ov)
+    off, on = out["ingest_off"], out["ingest_on"]
+    if off["learner_steps_per_sec"] > 0:
+        out["learner_speedup"] = round(
+            on["learner_steps_per_sec"] / off["learner_steps_per_sec"], 3)
+    if off["env_steps_per_sec"] > 0:
+        out["env_steps_ratio"] = round(
+            on["env_steps_per_sec"] / off["env_steps_per_sec"], 3)
+    return out
 
 
 def main(argv=None) -> int:
@@ -183,6 +229,13 @@ def main(argv=None) -> int:
     p.add_argument("--envs-per-actor", type=int, default=16,
                    help="lanes per actor in the e2e phase")
     p.add_argument("--num-actors", type=int, default=1)
+    p.add_argument("--ingest-ab", type=int, default=1,
+                   help="1 (default): run the e2e phase as an ingestion A/B"
+                        " — batched+pipelined (replay.ingest_batch_blocks ="
+                        " --ingest-batch-blocks) vs the per-block path, one"
+                        " artifact; 0: single e2e run at the config default")
+    p.add_argument("--ingest-batch-blocks", type=int, default=8,
+                   help="K for the A/B's batched cell")
     p.add_argument("--out", default=os.environ.get("R2D2_E2E_OUT", ""),
                    help="also write the JSON artifact to this path")
     p.add_argument("--override", action="append", default=[],
@@ -206,8 +259,13 @@ def main(argv=None) -> int:
         out["actor_sweep"] = run_actor_sweep(sweep, seconds=args.seconds,
                                              overrides=overrides)
     if args.e2e_seconds > 0:
-        out["e2e"] = run_e2e(args.e2e_seconds, args.envs_per_actor,
-                             args.num_actors, overrides=overrides)
+        if args.ingest_ab:
+            out["e2e_ingest_ab"] = run_ingest_ab(
+                args.e2e_seconds, args.envs_per_actor, args.num_actors,
+                args.ingest_batch_blocks, overrides=overrides)
+        else:
+            out["e2e"] = run_e2e(args.e2e_seconds, args.envs_per_actor,
+                                 args.num_actors, overrides=overrides)
     line = json.dumps(out)
     print(line)
     if args.out:

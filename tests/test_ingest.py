@@ -1,0 +1,402 @@
+"""Batched + pipelined replay ingestion (ISSUE 2): replay_add_many parity
+with K sequential adds (including ring wrap and the dp-sharded round-robin),
+stacked feeder drains (shm ring + fallback backends), the learner's ingest
+pipeline (commit-time accounting, rate-limiter semantics, drain-burst knob),
+and the ingestion observability counters.
+"""
+
+import contextlib
+import queue as queue_mod
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tests.test_replay import _fill_blocks, make_spec
+
+from r2d2_tpu.config import Config, MeshConfig
+from r2d2_tpu.replay import (
+    Block, HostReplay, replay_add, replay_add_many, replay_init)
+from r2d2_tpu.runtime.feeder import BlockQueue
+from r2d2_tpu.runtime.metrics import TrainMetrics
+
+
+def stack_blocks(blocks) -> Block:
+    """np.stack every leaf — the reference stacking the transports'
+    drain_stacked fast paths are checked against."""
+    return jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *blocks)
+
+
+def assert_trees_equal(a, b):
+    for (path, la), (_, lb) in zip(
+            jax.tree_util.tree_leaves_with_path(a),
+            jax.tree_util.tree_leaves_with_path(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=str(path))
+
+
+# interpret = eager tracing via jax.disable_jit(): the acceptance criterion
+# wants add_many parity to hold both compiled and uncompiled
+MODES = ("compiled", "interpret")
+
+
+def mode_ctx(mode):
+    return jax.disable_jit() if mode == "interpret" else (
+        contextlib.nullcontext())
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("exact_gather", [False, True])
+def test_add_many_matches_sequential_adds_with_wrap(rng, mode, exact_gather):
+    """replay_add_many(K) == K sequential replay_add — ring rows, tree
+    leaves, pointer — across a wrap of a 4-slot ring, padded storage
+    (exact_gather) included."""
+    spec = make_spec(num_blocks=4, exact_gather=exact_gather)
+    blocks = _fill_blocks(spec, 6, rng)   # wraps: 6 adds over 4 slots
+    with mode_ctx(mode):
+        seq = replay_init(spec)
+        for blk in blocks:
+            seq = replay_add(spec, seq, blk)
+        many = replay_init(spec)
+        many = replay_add_many(spec, many, stack_blocks(blocks[:3]))
+        many = replay_add_many(spec, many, stack_blocks(blocks[3:5]))
+        # a K=1 stacked batch and a plain add interoperate on one state
+        many = replay_add_many(spec, many, stack_blocks(blocks[5:6]))
+    assert_trees_equal(seq, many)
+    assert int(many.block_ptr) == 6 % 4
+
+
+def test_add_many_exact_ring_fill(rng):
+    """K == num_blocks is the largest legal batch (all rows distinct)."""
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 4, rng)
+    seq = replay_init(spec)
+    for blk in blocks:
+        seq = replay_add(spec, seq, blk)
+    many = replay_add_many(spec, replay_init(spec), stack_blocks(blocks))
+    assert_trees_equal(seq, many)
+    assert int(many.block_ptr) == 0
+
+
+def test_add_many_rejects_aliasing_batch(rng):
+    """K > num_blocks would scatter twice into one ring row (undefined
+    order) — refused at trace time with the config hint."""
+    spec = make_spec(num_blocks=2)
+    blocks = _fill_blocks(spec, 3, rng)
+    with pytest.raises(ValueError, match="ingest_batch_blocks"):
+        replay_add_many(spec, replay_init(spec), stack_blocks(blocks))
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_sharded_add_many_matches_round_robin(rng, mode):
+    """One add_many dispatch == K sequential sharded adds round-robining
+    from the same start shard, including per-shard ring wrap (7 blocks
+    over dp=4 shards of 3 rows each) and a start_shard mid-cycle."""
+    from r2d2_tpu.parallel import (
+        make_mesh, make_sharded_replay_add, make_sharded_replay_add_many,
+        sharded_replay_init)
+
+    spec = make_spec(num_blocks=3)
+    mesh = make_mesh(MeshConfig(dp=4))
+    blocks = _fill_blocks(spec, 7, rng)
+    with mode_ctx(mode):
+        add1 = make_sharded_replay_add(spec, mesh)
+        addk = make_sharded_replay_add_many(spec, mesh)
+        seq = sharded_replay_init(spec, mesh)
+        shard = 2
+        for blk in blocks:
+            seq = add1(seq, blk, shard)
+            shard = (shard + 1) % 4
+        many = sharded_replay_init(spec, mesh)
+        many = addk(many, stack_blocks(blocks[:5]), 2)
+        many = addk(many, stack_blocks(blocks[5:]), (2 + 5) % 4)
+    assert_trees_equal(seq, many)
+
+
+def test_blockqueue_stacked_drain_fallback(rng):
+    """The queue.Queue / mp.Queue fallback stacks per-block pops into the
+    same contract the shm fast path returns."""
+    spec = make_spec()
+    blocks = _fill_blocks(spec, 5, rng)
+    q = BlockQueue(use_mp=False)
+    for blk in blocks:
+        q.put(blk)
+    stacked, k = q.drain_stacked(3)
+    assert k == 3
+    assert_trees_equal(stacked, stack_blocks(blocks[:3]))
+    stacked, k = q.drain_stacked(16)     # partial tail drain
+    assert k == 2
+    assert_trees_equal(stacked, stack_blocks(blocks[3:]))
+    assert q.drain_stacked(4) == (None, 0)
+
+
+def test_shm_ring_stacked_drain(rng):
+    """Stacked drain straight from the shm ring slots: field-for-field
+    equal to the per-block pops, FIFO order, partial tail, empty case."""
+    pytest.importorskip("r2d2_tpu.native")
+    from r2d2_tpu.runtime.shm_feeder import ShmBlockRing
+
+    spec = make_spec()
+    blocks = _fill_blocks(spec, 5, rng)
+    ring = ShmBlockRing(spec, maxsize=8)
+    try:
+        for blk in blocks:
+            ring.put(blk, timeout=1.0)
+        stacked, k = ring.drain_stacked(3)
+        assert k == 3
+        assert_trees_equal(stacked, stack_blocks(blocks[:3]))
+        # each leaf is one contiguous array, device_put-ready
+        assert all(np.asarray(x).flags["C_CONTIGUOUS"]
+                   for x in jax.tree_util.tree_leaves(stacked))
+        stacked, k = ring.drain_stacked(16)
+        assert k == 2
+        assert_trees_equal(stacked, stack_blocks(blocks[3:]))
+        assert ring.drain_stacked(4) == (None, 0)
+        # ring still usable after stacked drains
+        ring.put(blocks[0], timeout=1.0)
+        got = ring.get_nowait()
+        assert_trees_equal(got, blocks[0])
+    finally:
+        ring.close()
+
+
+# -- learner pipeline --
+
+LEARNER_OVERRIDES = {
+    "env.game_name": "Fake",
+    "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+    "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+    "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+    "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+    "sequence.forward_steps": 3,
+    "replay.capacity": 800, "replay.block_length": 20,
+    "replay.batch_size": 8, "replay.learning_starts": 100,
+    "runtime.save_interval": 0, "runtime.steps_per_dispatch": 1,
+}
+
+
+def make_learner(tmp_path, **extra):
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.learner_loop import Learner
+
+    ov = dict(LEARNER_OVERRIDES)
+    ov["runtime.save_dir"] = str(tmp_path)
+    ov.update(extra)
+    cfg = Config().replace(**ov)
+    net = NetworkApply(4, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    return cfg, Learner(cfg, net)
+
+
+def fill_learner_blocks(learner, n, rng):
+    from r2d2_tpu.actor.local_buffer import LocalBuffer
+
+    spec = learner.spec
+    buf = LocalBuffer(spec, 4, gamma=0.9)
+    buf.reset(np.zeros((spec.frame_height, spec.frame_width), np.uint8))
+    out = []
+    for _ in range(n):
+        for t in range(spec.block_length):
+            buf.add(t % 4, float(t % 3),
+                    np.full((spec.frame_height, spec.frame_width),
+                            t % 250, np.uint8),
+                    rng.normal(size=4).astype(np.float32),
+                    rng.normal(size=(2, spec.hidden_dim)).astype(np.float32))
+        out.append(buf.finish(last_qval=np.ones(4, np.float32)))
+    return out
+
+
+def drain_until(learner, q, want, timeout=30.0):
+    n = 0
+    deadline = time.time() + timeout
+    while n < want and time.time() < deadline:
+        n += learner.drain(q)
+        time.sleep(0.01)
+    return n
+
+
+def test_learner_pipelined_commit_accounting(tmp_path, rng):
+    """The stager+commit path must leave the learner in the identical
+    accounting state the synchronous path produces: env_steps, ring
+    pointer (mirroring the compiled pointer), buffer steps, and the
+    ingestion counters the observability record reads."""
+    cfg, learner = make_learner(tmp_path, **{
+        "replay.ingest_batch_blocks": 4})
+    try:
+        assert learner._ingest_k == 4
+        q = BlockQueue(use_mp=False)
+        for blk in fill_learner_blocks(learner, 10, rng):
+            q.put(blk)
+        assert drain_until(learner, q, 10) == 10
+        spec = learner.spec
+        assert learner.env_steps == 10 * spec.block_length
+        assert learner.ring.ptr == 10 % spec.num_blocks
+        assert int(learner.replay_state.block_ptr) == learner.ring.ptr
+        assert learner.ring.buffer_steps == 10 * spec.block_length
+        assert learner.metrics.ingest_blocks_total == 10
+        assert learner.ready
+        learner.step()            # the committed ring must be trainable
+        learner.flush_metrics()
+        assert learner.training_steps == 1
+        record = learner.metrics.log(1.0)
+        assert record["ingest_blocks_per_drain"] is not None
+        assert record["ingest_drain_latency_ms"] is not None
+        assert record["ingest_queue_depth"] == 0
+    finally:
+        learner.stop_background()
+
+
+def test_learner_pipelined_matches_legacy_replay_state(tmp_path, rng):
+    """Same blocks through the pipelined path and the legacy path yield
+    byte-identical replay state."""
+    cfg_p, pipelined = make_learner(tmp_path / "p", **{
+        "replay.ingest_batch_blocks": 3})
+    cfg_l, legacy = make_learner(tmp_path / "l", **{
+        "replay.ingest_batch_blocks": 1})
+    try:
+        blocks = fill_learner_blocks(legacy, 7, rng)
+        qp, ql = BlockQueue(use_mp=False), BlockQueue(use_mp=False)
+        for blk in blocks:
+            qp.put(blk)
+            ql.put(blk)
+        assert drain_until(pipelined, qp, 7) == 7
+        assert legacy.drain(ql) == 7
+        assert_trees_equal(pipelined.replay_state, legacy.replay_state)
+        assert pipelined.env_steps == legacy.env_steps
+    finally:
+        pipelined.stop_background()
+        legacy.stop_background()
+
+
+def test_learner_pipelined_sharded_matches_legacy(tmp_path, rng):
+    """dp-sharded pipelined ingestion: the stager's AOT-compiled
+    make_sharded_replay_add_many commits must leave the identical sharded
+    replay state as the legacy per-block round-robin, and never compile on
+    the commit path (the cache holds every pow2 bucket after startup)."""
+    cfg_p, pipelined = make_learner(tmp_path / "p", **{
+        "mesh.dp": 2, "replay.ingest_batch_blocks": 3})
+    cfg_l, legacy = make_learner(tmp_path / "l", **{
+        "mesh.dp": 2, "replay.ingest_batch_blocks": 1})
+    try:
+        blocks = fill_learner_blocks(legacy, 7, rng)
+        qp, ql = BlockQueue(use_mp=False), BlockQueue(use_mp=False)
+        for blk in blocks:
+            qp.put(blk)
+            ql.put(blk)
+        assert drain_until(pipelined, qp, 7, timeout=60.0) == 7
+        assert legacy.drain(ql) == 7
+        assert_trees_equal(pipelined.replay_state, legacy.replay_state)
+        assert pipelined._next_shard == legacy._next_shard
+        assert {1, 2} <= set(pipelined._add_many_cache)  # pow2 precompile
+    finally:
+        pipelined.stop_background()
+        legacy.stop_background()
+
+
+def test_rate_limiter_backpressures_pipelined_stager(tmp_path, rng):
+    """With the collect:learn limiter engaged and no training running, the
+    stager must stop pulling from the feeder (blocks stay queued =
+    actor back-pressure) once committed + staged steps reach the budget —
+    within one staging batch of the synchronous trigger point."""
+    cfg, learner = make_learner(tmp_path, **{
+        "replay.ingest_batch_blocks": 2,
+        "replay.learning_starts": 100,
+        "replay.max_env_steps_per_train_step": 20.0})
+    try:
+        q = BlockQueue(use_mp=False)
+        blocks = fill_learner_blocks(learner, 12, rng)
+        for blk in blocks:
+            q.put(blk)
+        # budget with zero training steps: learning_starts + ratio * 1
+        # = 120 steps = 6 blocks; the pipeline may hold up to 2 staged
+        # batches (4 blocks) beyond the committed ones
+        drain_until(learner, q, 12, timeout=3.0)
+        time.sleep(0.5)      # give the stager time to overrun, if it would
+        learner.drain(q)
+        committed = learner.env_steps // learner.spec.block_length
+        with learner._staged_lock:
+            staged = learner._staged_env_steps // learner.spec.block_length
+        assert committed >= 6                  # reached the budget
+        assert committed + staged <= 6 + 2 * 2  # bounded overrun
+        assert learner.ingestion_paused
+        # pause time is being accounted for the observability record
+        learner.metrics.on_ingest_pause(0.0)   # flush helper is thread-side
+    finally:
+        learner.stop_background()
+
+
+def test_drain_burst_knob_shared_by_default(tmp_path, rng):
+    """Legacy drain's default burst is replay.drain_max_blocks (the one
+    knob the training loop AND the warm-up loop inherit), overridable per
+    call."""
+    cfg, learner = make_learner(tmp_path, **{
+        "replay.ingest_batch_blocks": 1, "replay.drain_max_blocks": 3})
+    try:
+        q = BlockQueue(use_mp=False)
+        for blk in fill_learner_blocks(learner, 8, rng):
+            q.put(blk)
+        assert learner.drain(q) == 3          # cfg default
+        assert learner.drain(q, max_items=4) == 4   # explicit override
+        assert learner.drain(q) == 1
+    finally:
+        learner.stop_background()
+
+
+def test_host_sample_vectorized_gather_matches_loop(rng):
+    """The batched fancy-index gather must return exactly what the removed
+    per-row python slice loop returned."""
+    spec = make_spec()
+    host = HostReplay(spec, seed=0, use_native=False)
+    for blk in _fill_blocks(spec, 3, rng):
+        host.add(blk)
+    batch, _ = host.sample()
+    idx = np.asarray(batch.idxes, np.int64)
+    b, s = idx // spec.seqs_per_block, idx % spec.seqs_per_block
+    start = host.seq_start[b, s] - host.burn_in_steps[b, s]
+    obs_len = spec.seq_window + spec.frame_stack - 1
+    for i in range(spec.batch_size):
+        t0 = int(start[i])
+        np.testing.assert_array_equal(
+            batch.obs[i], host.obs[b[i], t0:t0 + obs_len])
+        np.testing.assert_array_equal(
+            batch.last_action[i],
+            host.last_action[b[i], t0:t0 + spec.seq_window])
+    assert batch.obs.dtype == np.uint8
+    assert batch.last_action.dtype == np.int32
+
+
+def test_config_ingest_knob_validation():
+    cfg = Config().replace(**{"env.game_name": "Fake"})
+    assert cfg.replay.resolved_ingest_batch_blocks() == 1   # auto on CPU
+    assert cfg.replay.drain_max_blocks == 32
+    with pytest.raises(ValueError, match="ingest_batch_blocks"):
+        cfg.replace(**{"replay.ingest_batch_blocks": 0})
+    with pytest.raises(ValueError, match="must be <= num_blocks"):
+        cfg.replace(**{"replay.ingest_batch_blocks": cfg.num_blocks + 1})
+    with pytest.raises(ValueError, match="drain_max_blocks"):
+        cfg.replace(**{"replay.drain_max_blocks": 0})
+    # explicit K round-trips the config serialization
+    k = cfg.replace(**{"replay.ingest_batch_blocks": 4})
+    assert Config.from_json(k.to_json()).replay.ingest_batch_blocks == 4
+
+
+def test_metrics_ingest_record_resets_per_interval(tmp_path):
+    m = TrainMetrics(0, str(tmp_path))
+    m.on_ingest_drain(4, 0.002)
+    m.on_ingest_drain(2, 0.004)
+    m.on_ingest_pause(0.5)
+    m.set_ingest_queue_depth(1)
+    rec = m.log(1.0)
+    assert rec["ingest_drains"] == 2
+    assert rec["ingest_blocks_per_drain"] == 3.0
+    assert rec["ingest_drain_latency_ms"] == 3.0
+    assert rec["ingest_pause_time"] == 0.5
+    assert rec["ingest_queue_depth"] == 1
+    assert rec["ingest_blocks_total"] == 6
+    rec2 = m.log(1.0)    # interval accumulators reset, cumulative stays
+    assert rec2["ingest_drains"] == 0
+    assert rec2["ingest_blocks_per_drain"] is None
+    assert rec2["ingest_pause_time"] == 0.0
+    assert rec2["ingest_blocks_total"] == 6
