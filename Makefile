@@ -2,14 +2,23 @@
 #
 #   make t1    — the tier-1 gate: EXACTLY the ROADMAP.md verify command
 #                (via scripts/t1.sh), preceded by a marker check that the
-#                ingestion tests are collected in the fast ('not slow')
-#                tier — a stray @pytest.mark.slow would silently drop them
-#                from the gate.
+#                ingestion and chaos tests are collected in the fast
+#                ('not slow') tier — a stray @pytest.mark.slow would
+#                silently drop them from the gate.
+#   make chaos — the fast-tier worker-health / fault-injection suite
+#                (tests/test_chaos.py, 'chaos and not slow'); the
+#                slow-marked chaos slices (real injected hangs/crash-loops
+#                through process actors) run with the full tier or via
+#                pytest -m chaos.
 
-.PHONY: t1 check-fast-markers
+.PHONY: t1 chaos check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
+
+chaos: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+	    -m 'chaos and not slow' -p no:cacheprovider
 
 check-fast-markers:
 	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_ingest.py \
@@ -19,5 +28,14 @@ check-fast-markers:
 	    echo "fast-tier ingestion tests collected: $$n"; \
 	else \
 	    echo "ERROR: ingestion tests missing from the 'not slow' tier ($$n collected)"; \
+	    exit 1; \
+	fi
+	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
+	    -m 'chaos and not slow' --collect-only -q -p no:cacheprovider 2>/dev/null \
+	    | grep -c '::'); \
+	if [ "$$n" -ge 12 ]; then \
+	    echo "fast-tier chaos tests collected: $$n"; \
+	else \
+	    echo "ERROR: chaos tests missing from the 'chaos and not slow' tier ($$n collected)"; \
 	    exit 1; \
 	fi

@@ -231,6 +231,16 @@ class ActorConfig:
     # num_actors * envs_per_actor total lanes (vector_lane_epsilons), so the
     # exploration schedule matches an equally-sized scalar-actor fleet.
     envs_per_actor: int = 1
+    # Deterministic fault injection (tools/chaos.py): ';'-joined
+    # ``slot:kind`` entries, e.g. "1:crash@block=3;2:hang@block=5;0:slowx4".
+    # ``crash@block=N`` raises on the worker's N-th block emit (1-based),
+    # ``hang@block=N`` wedges it there forever, ``slow@factor=F`` (or
+    # ``slowxF``) stretches the interval between emits by F. Slots are
+    # fleet-local worker indices (one fleet per host). "" (default) = no
+    # faults. Exists so every health behavior — watchdog kill, backoff,
+    # breaker, ring reclamation — is exercised by real misbehaving workers
+    # in tests and in the soak's chaos phase, not just hoped for.
+    fault_spec: str = ""
 
 
 @dataclass(frozen=True)
@@ -329,6 +339,40 @@ class RuntimeConfig:
     seed: int = 0
     profile_dir: str = ""            # non-empty: write jax.profiler traces here
     restart_dead_actors: bool = True  # supervisor (the reference has none, SURVEY §5.3)
+    # -- worker health (heartbeats / watchdog / backoff / breaker) --
+    # Seconds between supervision passes (dead-worker scan, hang watchdog,
+    # ring reclamation, stall detector) — decoupled from log_interval so
+    # hang detection latency does not ride the logging cadence.
+    supervise_interval_s: float = 5.0
+    # Hang watchdog: a worker that is alive but whose heartbeat (published
+    # per block emit, and while parked under feeder back-pressure) is older
+    # than this is killed (process) or flagged+abandoned (thread) and
+    # routed through the normal respawn path. 0 disables hang detection.
+    hang_timeout_s: float = 120.0
+    # Grace before a worker's FIRST heartbeat (process spawn + jax import +
+    # env construction + first block can far exceed hang_timeout_s); a
+    # worker wedged during bring-up — the classic stuck ViZDoom multiplayer
+    # join — is still detected, just on this slower clock.
+    hang_spawn_grace_s: float = 300.0
+    # Per-slot exponential restart backoff: the first respawn is
+    # immediate; each further failure inside restart_window_s doubles the
+    # wait, starting at base for the second (k-th failure waits
+    # base * 2^(k-2), capped at max). Stops a crash-looping actor from
+    # burning a CPU respawning every supervision tick.
+    restart_backoff_base_s: float = 1.0
+    restart_backoff_max_s: float = 60.0
+    # Crash-loop circuit breaker: after this many failures inside
+    # restart_window_s the slot is PARKED (no further respawns; training
+    # continues degraded; surfaced in metrics as actor_parked_slots /
+    # actor_breaker_trips). 0 disables the breaker.
+    max_restarts_per_window: int = 5
+    restart_window_s: float = 300.0
+    # Learner-side stall detector: when ingestion sits at zero new blocks
+    # for this long while workers are nominally alive and the rate limiter
+    # is not deliberately pausing, emit a one-shot diagnostic dump
+    # (per-slot heartbeat ages, queue/ring occupancy, limiter state)
+    # instead of starving silently. 0 disables.
+    ingest_stall_timeout_s: float = 300.0
 
 
 @dataclass(frozen=True)
@@ -385,6 +429,25 @@ class Config:
                 "window (runtime.seed + 100*actor_idx + lane); more lanes "
                 "would duplicate the next worker's env/RNG streams — scale "
                 "actor.num_actors instead")
+        if self.actor.fault_spec:
+            from r2d2_tpu.tools.chaos import parse_fault_spec
+            faults = parse_fault_spec(self.actor.fault_spec)
+            bad = [s for s in faults if s >= self.actor.num_actors]
+            if bad:
+                raise ValueError(
+                    f"actor.fault_spec targets slot(s) {bad} outside the "
+                    f"fleet of {self.actor.num_actors} workers")
+        for fname, lo in (("supervise_interval_s", 0.0),
+                          ("restart_window_s", 0.0)):
+            if getattr(self.runtime, fname) <= lo:
+                raise ValueError(f"runtime.{fname} must be > {lo}")
+        for fname in ("hang_timeout_s", "hang_spawn_grace_s",
+                      "restart_backoff_base_s", "restart_backoff_max_s",
+                      "ingest_stall_timeout_s"):
+            if getattr(self.runtime, fname) < 0:
+                raise ValueError(f"runtime.{fname} must be >= 0")
+        if self.runtime.max_restarts_per_window < 0:
+            raise ValueError("runtime.max_restarts_per_window must be >= 0")
         if self.multiplayer.enabled and self.actor.envs_per_actor > 1:
             raise ValueError(
                 "actor.envs_per_actor > 1 is not supported with multiplayer "

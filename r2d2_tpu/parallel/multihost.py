@@ -85,15 +85,22 @@ class LocalActorFleet:
     ``queue``: pass the host's BlockQueue when workers are PROCESSES so a
     producer crash between reserve and commit gets its shm ring slot
     reclaimed (RingRecoveryScheduler semantics; no-op for thread fleets
-    and non-shm transports)."""
+    and non-shm transports).
+
+    ``health``: pass a runtime.feeder.WorkerHealth to enable hang
+    detection, restart backoff, and the crash-loop breaker — the SAME
+    policy object PlayerStack uses, so single-host and multihost get
+    identical supervision semantics. None (default) keeps the plain
+    dead-worker scan."""
 
     def __init__(self, spawn_fn: Callable[[int], object], n: int,
-                 restart_dead: bool, stop, queue=None):
+                 restart_dead: bool, stop, queue=None, health=None):
         from r2d2_tpu.runtime.feeder import RingRecoveryScheduler
         self._spawn = spawn_fn
         self._restart = restart_dead
         self._stop = stop
         self._queue = queue
+        self.health = health
         self._ring_recovery = RingRecoveryScheduler()
         self._seen_dead: set = set()
         self.threads: List[object] = [spawn_fn(i) for i in range(n)]
@@ -111,9 +118,10 @@ class LocalActorFleet:
             return None
 
     def supervise(self) -> int:
-        """Respawn dead workers; returns the number restarted (logged).
-        Ring reclamation runs for newly-dead workers regardless of the
-        restart flag (the wedge exists either way)."""
+        """Respawn dead (and, with ``health``, hung) workers; returns the
+        number restarted (logged). Ring reclamation runs for newly-failed
+        workers regardless of the restart flag (the wedge exists either
+        way)."""
         import logging
 
         from r2d2_tpu.runtime.feeder import supervise_workers
@@ -122,9 +130,12 @@ class LocalActorFleet:
         restarted = supervise_workers(
             self.threads, self._seen_dead,
             respawn=self._respawn if self._restart else None,
-            ring=self._ring_recovery if self._queue is not None else None)
+            ring=self._ring_recovery if self._queue is not None else None,
+            health=self.health)
         if self._queue is not None:
-            self._ring_recovery.tick(self._queue)
+            freed = self._ring_recovery.tick(self._queue)
+            if self.health is not None:
+                self.health.ring_slots_recovered += freed
         if restarted:
             logging.getLogger(__name__).warning(
                 "restarted %d dead actor worker(s)", restarted)
@@ -562,12 +573,14 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             gidx = rank * n_local + i
             eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
                                cfg.actor.eps_alpha)
+            heartbeats.reset_slot(i)
             p = ctx.Process(
                 target=actor_process_main,
                 args=(cfg.to_dict(), pid, gidx, eps, publisher.name,
                       queue._q, stop),
                 kwargs={**cfg.multiplayer.env_args(pid, gidx),
-                        "total_actors": nprocs * n_local},
+                        "total_actors": nprocs * n_local,
+                        "health_board": heartbeats, "health_slot": i},
                 daemon=True, name=f"actor-p{pid}h{rank}-{i}")
             p.start()
             return p
@@ -600,19 +613,45 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 cfg, net, ts.params, gidx, seed, epsilon=eps,
                 total_actors=nprocs * n_local)
 
+            # per-spawn cancel event + instrumented sink: identical health
+            # wiring to PlayerStack._spawn_thread_actor
+            cancel = threading.Event()
+
+            def should_stop(cancel=cancel):
+                return stop.is_set() or cancel.is_set()
+
+            from r2d2_tpu.runtime.actor_loop import instrument_block_sink
+            heartbeats.reset_slot(i)
+            sink = instrument_block_sink(
+                cfg, i,
+                lambda b, should_stop=should_stop, slot=i: queue.put_patient(
+                    b, should_stop,
+                    beat=lambda: heartbeats.touch(slot)),
+                board=heartbeats)
+
             def loop(env=env, policy=policy, run_loop=run_loop,
-                     reader_id=i):
+                     reader_id=i, sink=sink, should_stop=should_stop):
                 # the run loop owns env and closes it on every exit
                 run_loop(cfg, env, policy,
-                         block_sink=lambda b: queue.put_patient(
-                             b, stop.is_set),
+                         block_sink=sink,
                          weight_poll=lambda: store.poll(reader_id),
-                         should_stop=stop.is_set)
+                         should_stop=should_stop)
 
             t = threading.Thread(target=loop, daemon=True,
                                  name=f"actor-h{rank}-{i}")
+            t.health_cancel = cancel
             t.start()
             return t
+
+    # worker health is host-local by construction (heartbeats, backoff,
+    # breaker touch no collective state) — the same board+policy objects
+    # the single-host PlayerStack uses, so supervision semantics are
+    # identical across the two paths. Created HERE, immediately before the
+    # try that owns its shm segment (the spawn closures above bind late);
+    # nothing between this allocation and the finally can raise past it.
+    from r2d2_tpu.runtime.feeder import HeartbeatBoard, WorkerHealth
+    heartbeats = HeartbeatBoard(n_local)
+    health = WorkerHealth.from_runtime(n_local, heartbeats, cfg.runtime)
 
     # fleet construction onward sits inside the try: a spawn failure for
     # actor k must not orphan the k-1 already-running actor processes on a
@@ -621,7 +660,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     try:
         fleet = LocalActorFleet(
             spawn_actor, n_local, cfg.runtime.restart_dead_actors, stop,
-            queue=queue if actor_mode == "process" else None)
+            queue=queue if actor_mode == "process" else None,
+            health=health)
 
         # pid-keyed logs/checkpoints: per-player jobs sharing a filesystem
         # write train_player{pid}.log and player-pid checkpoint dirs, like
@@ -634,6 +674,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         step_count = int(ts.step)  # nonzero after resume; max_steps cumulative
         step_base = step_count     # rate-limiter budget counts from THIS
         paused = False             # process's start
+        last_ckpt_step = step_count   # last step a checkpoint covered
         pending_losses: list = []
         last_log = last_supervise = time.time()
         info = {"buffer_steps": 0, "env_steps": 0, "filled_shards": 0}
@@ -737,6 +778,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                         ts.opt_state, ts.target_params, step_count,
                         resumed_env + info["env_steps"],
                         config_json=cfg.to_json())
+                    last_ckpt_step = step_count
             else:
                 time.sleep(0.01)
 
@@ -762,18 +804,34 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 chaos_done = True
 
             now = time.time()
-            if now - last_supervise >= rt.log_interval:
+            if now - last_supervise >= rt.supervise_interval_s:
                 fleet.supervise()   # every host tends its own actor fleet
                 last_supervise = now
             if metrics is not None and now - last_log >= rt.log_interval:
                 flush_losses()
                 metrics.env_steps = resumed_env + info["env_steps"]
                 metrics.set_buffer_size(info["buffer_steps"])
+                metrics.set_actor_health(health.snapshot())
                 record = metrics.log(now - last_log)
                 if log_fn:
                     log_fn({"rank": rank, **record})
                 last_log = now
         flush_losses()
+        # preemption-safe final checkpoint (same contract as the
+        # single-host Learner.save_final): a clean stop — signal fed
+        # through the stop consensus, deadline, or max_steps — between
+        # periodic saves writes one last rank-0 checkpoint so the pod
+        # resumes from the stop point, not the last interval boundary.
+        # Reached only on the clean path (every rank broke out of the
+        # loop together), so params are consistent across hosts.
+        if (rank == 0 and rt.save_interval
+                and step_count > last_ckpt_step):
+            save_checkpoint(
+                rt.save_dir, cfg.env.game_name,
+                step_count // rt.save_interval + 1, pid, ts.params,
+                ts.opt_state, ts.target_params, step_count,
+                resumed_env + info["env_steps"],
+                config_json=cfg.to_json())
     finally:
         stop.set()
         for sig, handler in prev_handlers.items():
@@ -786,6 +844,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         if publisher is not None:
             publisher.close()
         queue.close()    # releases/unlinks the shm ring (owner side)
+        heartbeats.close()   # releases/unlinks the heartbeat board
 
     return {"step": step_count, "env_steps": resumed_env + info["env_steps"],
             "buffer_steps": info["buffer_steps"], "params": ts.params,

@@ -69,6 +69,33 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
     return policy, run_actor
 
 
+def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
+                          board=None) -> Callable:
+    """Health instrumentation around a block sink — the ONE wrapping point
+    shared by every actor spawner (thread, process, single-host,
+    multihost), so scalar and vector loops alike publish heartbeats and
+    honor ``actor.fault_spec`` without knowing about either. Order:
+    heartbeat first (the beat marks "reached the sink alive", so an
+    injected hang is detected on the regular ``hang_timeout_s`` clock, not
+    the spawn grace), then the fault, then the real sink. ``slot`` is the
+    fleet-local worker index (the HeartbeatBoard row and the fault-spec
+    key)."""
+    wrapped = sink
+    if cfg.actor.fault_spec:
+        from r2d2_tpu.tools.chaos import apply_fault, parse_fault_spec
+        fault = parse_fault_spec(cfg.actor.fault_spec).get(slot)
+        if fault is not None:
+            wrapped = apply_fault(wrapped, fault)
+    if board is None:
+        return wrapped
+
+    def sink_with_heartbeat(block, _wrapped=wrapped):
+        board.beat(slot)
+        return _wrapped(block)
+
+    return sink_with_heartbeat
+
+
 def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
               weight_poll: Callable, should_stop: Callable[[], bool],
               max_env_steps: Optional[int] = None) -> int:

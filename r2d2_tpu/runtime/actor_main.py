@@ -14,10 +14,16 @@ import os
 def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                        epsilon: float, shm_name: str, queue, stop_event,
                        is_host: bool, port: int,
-                       total_actors: int = None) -> None:
+                       total_actors: int = None,
+                       health_board=None, health_slot: int = None) -> None:
     # total_actors: the GLOBAL worker-fleet size for the vector ε ladder —
     # multihost spawners pass process_count * num_actors with a global
     # actor_idx; None = single-host (cfg.actor.num_actors)
+    # A respawn dispatched just before shutdown can finish booting AFTER
+    # the parent unlinked the weight/heartbeat segments — exit quietly
+    # instead of dying loudly on a FileNotFoundError mid-bring-up.
+    if stop_event.is_set():
+        return
     # unconditional (not setdefault): an inherited JAX_PLATFORMS=tpu from a
     # TPU-pinned parent would otherwise have every actor child race to open
     # the single-process libtpu — the TPU belongs to the learner alone
@@ -44,7 +50,13 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
     net = NetworkApply(env.action_space.n, cfg.network, cfg.env.frame_stack,
                        cfg.env.frame_height, cfg.env.frame_width)
     params = net.init(jax.random.PRNGKey(cfg.runtime.seed))
-    sub = WeightSubscriber(shm_name, params)
+    try:
+        sub = WeightSubscriber(shm_name, params)
+    except FileNotFoundError:
+        if stop_event.is_set():
+            env.close()   # parent tore the segments down mid-boot: shutdown
+            return
+        raise
     fresh = sub.poll()
     if fresh is not None:
         params = fresh
@@ -55,12 +67,25 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                                          copy_updates=False,
                                          total_actors=total_actors)
 
+    from r2d2_tpu.runtime.actor_loop import instrument_block_sink
     from r2d2_tpu.runtime.feeder import put_patient
+
+    # health wiring: heartbeat per block emit + liveness touches while
+    # parked under back-pressure, and fault injection for this slot —
+    # same instrumentation point as the thread spawners (actor_loop.py).
+    # health_slot is the fleet-local index (actor_idx is GLOBAL under a
+    # multihost fleet); it defaults to actor_idx for single-host spawners.
+    slot = actor_idx if health_slot is None else health_slot
+    beat = ((lambda: health_board.touch(slot))
+            if health_board is not None else None)
+    sink = instrument_block_sink(
+        cfg, slot,
+        lambda b: put_patient(queue, b, stop_event.is_set, beat=beat),
+        board=health_board)
 
     try:
         run_loop(cfg, env, policy,
-                 block_sink=lambda b: put_patient(
-                     queue, b, stop_event.is_set),
+                 block_sink=sink,
                  weight_poll=sub.poll,
                  should_stop=stop_event.is_set)
     finally:

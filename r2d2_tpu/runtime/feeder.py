@@ -7,30 +7,323 @@ steps and ingests via the jitted ``replay_add``. Bounded so a stalled learner
 back-pressures actors instead of exhausting host RAM.
 """
 
+import json
+import logging
 import multiprocessing as mp
 import queue as queue_mod
 import subprocess
-from typing import List, Optional
+import time
+from collections import deque
+from multiprocessing import shared_memory
+from typing import Callable, List, Optional
 
 import numpy as np
 
 from r2d2_tpu.replay.structs import Block
 
 
-def put_patient(q, block: Block, should_stop, poll: float = 0.5) -> bool:
+def put_patient(q, block: Block, should_stop, poll: float = 0.5,
+                beat: Optional[Callable[[], None]] = None) -> bool:
     """Blocking put that survives indefinite back-pressure (the rate
     limiter deliberately parks actors here) but still honors the stop
     signal. Returns False iff stopped before the block was accepted.
     Module-level because process-mode actors receive the raw (picklable)
     mp.Queue, not the BlockQueue wrapper — one implementation serves both
-    (actor_main imports this; BlockQueue.put_patient delegates)."""
+    (actor_main imports this; BlockQueue.put_patient delegates).
+    ``beat`` (the worker's HeartbeatBoard.touch) is called once per poll
+    iteration so a deliberately parked producer keeps reading as ALIVE to
+    the hang watchdog — back-pressure is not a hang."""
     while not should_stop():
+        if beat is not None:
+            beat()
         try:
             q.put(block, timeout=poll)
             return True
         except queue_mod.Full:
             continue
     return False
+
+
+class HeartbeatBoard:
+    """Per-slot worker liveness: an (n_slots, 2) float64 table
+    [progress_count, last_beat_unix_ts] in ONE ``multiprocessing.
+    shared_memory`` region, so thread and process workers publish through
+    the identical object. Publishing (``beat``: one row store per block
+    emit; ``touch``: timestamp only, from parked ``put_patient`` polls) is
+    off the policy hot path. Picklable like ShmBlockRing: the handle
+    crosses the spawn boundary by name and the child attaches lazily; the
+    creating process owns the region and unlinks it on close()."""
+
+    def __init__(self, n_slots: int, _attach_name: Optional[str] = None):
+        self.n_slots = n_slots
+        self._owner = _attach_name is None
+        self._shm = None
+        self._arr = None
+        self._final = None        # post-close snapshot for post-mortem reads
+        if self._owner:
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=n_slots * 2 * 8)
+            self._bind()
+            self._arr[:, 0] = 0.0
+            self._arr[:, 1] = time.time()
+        else:
+            self._name = _attach_name
+
+    def __getstate__(self):
+        return {"n_slots": self.n_slots, "name": self.name}
+
+    def __setstate__(self, state):
+        self.__init__(state["n_slots"], _attach_name=state["name"])
+
+    @property
+    def name(self) -> str:
+        return self._shm.name if self._shm is not None else self._name
+
+    def _bind(self) -> None:
+        self._arr = np.ndarray((self.n_slots, 2), np.float64, self._shm.buf)
+
+    def _ensure(self) -> np.ndarray:
+        if self._shm is None:
+            if self._final is not None:
+                # closed: serve the frozen snapshot (chaos reports and
+                # tests read counters after the run tears down)
+                return self._final
+            from r2d2_tpu.runtime.weights import untrack_attached_shm
+            self._shm = shared_memory.SharedMemory(name=self._name)
+            untrack_attached_shm(self._shm)
+            self._bind()
+        return self._arr
+
+    def beat(self, slot: int) -> None:
+        """Progress heartbeat: one row store per block emit."""
+        arr = self._ensure()
+        arr[slot] = (arr[slot, 0] + 1.0, time.time())
+
+    def touch(self, slot: int) -> None:
+        """Liveness without progress (parked producer)."""
+        self._ensure()[slot, 1] = time.time()
+
+    def reset_slot(self, slot: int) -> None:
+        """Fresh incarnation: called at every (re)spawn so the new worker
+        starts its own grace clock."""
+        self._ensure()[slot] = (0.0, time.time())
+
+    def count(self, slot: int) -> int:
+        return int(self._ensure()[slot, 0])
+
+    def counts(self) -> np.ndarray:
+        return self._ensure()[:, 0].copy()
+
+    def age(self, slot: int, now: Optional[float] = None) -> float:
+        now = time.time() if now is None else now
+        return max(0.0, now - float(self._ensure()[slot, 1]))
+
+    def ages(self, now: Optional[float] = None) -> np.ndarray:
+        now = time.time() if now is None else now
+        return np.maximum(now - self._ensure()[:, 1], 0.0)
+
+    def close(self) -> None:
+        if self._shm is None:
+            return
+        self._final = self._arr.copy()
+        self._arr = None
+        self._shm.close()
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+        self._shm = None
+
+
+class WorkerHealth:
+    """Per-slot worker health policy: hang detection over a HeartbeatBoard,
+    exponential restart backoff, and a crash-loop circuit breaker — ONE
+    implementation shared by the single-host supervisor
+    (orchestrator.PlayerStack) and the multihost fleet
+    (parallel/multihost.LocalActorFleet), driven by ``supervise_workers``.
+
+    Backoff: the first failure of a slot respawns immediately; each
+    further failure inside ``restart_window_s`` doubles the wait, starting
+    at ``backoff_base_s`` for the second (k-th failure waits
+    ``backoff_base_s * 2^(k-2)``, capped at ``backoff_max_s``). Breaker:
+    after ``max_restarts_per_window``
+    failures inside the window the slot is PARKED — no further respawns,
+    training continues degraded, and the trip is surfaced loudly (warning
+    log + actor_parked_slots / actor_breaker_trips in TrainMetrics)."""
+
+    def __init__(self, n_slots: int, board: Optional[HeartbeatBoard] = None,
+                 hang_timeout_s: float = 0.0,
+                 hang_spawn_grace_s: float = 300.0,
+                 backoff_base_s: float = 1.0, backoff_max_s: float = 60.0,
+                 max_restarts_per_window: int = 0,
+                 restart_window_s: float = 300.0):
+        self.board = board
+        self.hang_timeout_s = hang_timeout_s
+        self.hang_spawn_grace_s = hang_spawn_grace_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.max_restarts_per_window = max_restarts_per_window
+        self.restart_window_s = restart_window_s
+        self._windows = [deque() for _ in range(n_slots)]  # failure times
+        self._next_allowed = [0.0] * n_slots
+        self._parked = [False] * n_slots
+        self.restarts = 0
+        self.hangs_detected = 0
+        self.breaker_trips = 0
+        self.ring_slots_recovered = 0
+
+    @classmethod
+    def from_runtime(cls, n_slots: int, board: Optional[HeartbeatBoard],
+                     rt) -> "WorkerHealth":
+        """Build from a RuntimeConfig (duck-typed: any object carrying the
+        runtime.* health fields)."""
+        return cls(n_slots, board,
+                   hang_timeout_s=rt.hang_timeout_s,
+                   hang_spawn_grace_s=rt.hang_spawn_grace_s,
+                   backoff_base_s=rt.restart_backoff_base_s,
+                   backoff_max_s=rt.restart_backoff_max_s,
+                   max_restarts_per_window=rt.max_restarts_per_window,
+                   restart_window_s=rt.restart_window_s)
+
+    def check_hung(self, slot: int, now: float) -> bool:
+        """True when the slot's heartbeat has gone stale: hang_timeout_s
+        after any beat, hang_spawn_grace_s (if longer) before the
+        incarnation's FIRST beat (spawn + env construction can dwarf the
+        steady-state block cadence)."""
+        if self.board is None or self.hang_timeout_s <= 0:
+            return False
+        timeout = self.hang_timeout_s
+        if self.board.count(slot) == 0:
+            timeout = max(timeout, self.hang_spawn_grace_s)
+        return self.board.age(slot, now) > timeout
+
+    def on_failure(self, slot: int, now: float, hung: bool = False) -> None:
+        """Record one failure (death or hang) for the slot: advances the
+        backoff ladder and may trip the breaker."""
+        log = logging.getLogger(__name__)
+        if hung:
+            self.hangs_detected += 1
+            log.warning(
+                "worker slot %d HUNG (alive, heartbeat %.1fs stale): "
+                "killing and routing through respawn", slot,
+                self.board.age(slot, now) if self.board is not None else -1.0)
+        win = self._windows[slot]
+        cutoff = now - self.restart_window_s
+        while win and win[0] < cutoff:
+            win.popleft()
+        prior = len(win)
+        win.append(now)
+        if (self.max_restarts_per_window > 0
+                and prior + 1 > self.max_restarts_per_window):
+            self._parked[slot] = True
+            self.breaker_trips += 1
+            log.warning(
+                "circuit breaker TRIPPED: worker slot %d failed %d times "
+                "within %.0fs — slot parked, training continues degraded",
+                slot, prior + 1, self.restart_window_s)
+            return
+        delay = 0.0 if prior == 0 else min(
+            self.backoff_base_s * 2.0 ** (prior - 1), self.backoff_max_s)
+        self._next_allowed[slot] = now + delay
+        if delay:
+            log.warning(
+                "worker slot %d failed %d time(s) in the last %.0fs: "
+                "respawn backed off %.1fs", slot, prior + 1,
+                self.restart_window_s, delay)
+
+    def is_parked(self, slot: int) -> bool:
+        return self._parked[slot]
+
+    def respawn_due(self, slot: int, now: float) -> bool:
+        return not self._parked[slot] and now >= self._next_allowed[slot]
+
+    def on_spawn(self, slot: int) -> None:
+        self.restarts += 1
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """Supervision counters for the periodic TrainMetrics record."""
+        age_max = None
+        if self.board is not None:
+            ages = self.board.ages(now)
+            if len(ages):
+                age_max = round(float(ages.max()), 1)
+        return {
+            "actor_restarts": self.restarts,
+            "actor_hangs_detected": self.hangs_detected,
+            "actor_breaker_trips": self.breaker_trips,
+            "actor_parked_slots": int(sum(self._parked)),
+            "shm_slots_recovered": self.ring_slots_recovered,
+            "heartbeat_age_max_s": age_max,
+        }
+
+
+def kill_worker(w) -> None:
+    """Forcibly clear a hung worker. Process: terminate → short join →
+    kill escalation (a wedged ViZDoom child can ignore SIGTERM). Thread:
+    python cannot kill a thread — set its per-spawn cancel event (the
+    spawner's should_stop honors it if the thread ever unwedges) and
+    abandon it; the replacement takes its slot."""
+    cancel = getattr(w, "health_cancel", None)
+    if cancel is not None:
+        cancel.set()
+    if hasattr(w, "terminate"):
+        w.terminate()
+        w.join(timeout=1.0)
+        if w.is_alive() and hasattr(w, "kill"):
+            w.kill()
+            w.join(timeout=1.0)
+
+
+class IngestStallDetector:
+    """Learner-side stall detector: fires ONCE per stall episode when
+    ingestion sits at zero new blocks for ``timeout_s`` while workers are
+    nominally alive and the rate limiter is not deliberately pausing —
+    emitting a diagnostic dump (heartbeat ages, queue/ring occupancy,
+    limiter state) instead of starving silently. Re-arms when blocks flow
+    again."""
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+        self._last_total: Optional[int] = None
+        self._last_change: Optional[float] = None
+        self._fired = False
+        self._was_paused = False
+        self.dumps = 0
+
+    def check(self, blocks_total: int, workers_alive: int,
+              limiter_paused: bool, now: Optional[float] = None,
+              diagnostics: Optional[Callable[[], dict]] = None) -> bool:
+        if self.timeout_s <= 0:
+            return False
+        now = time.time() if now is None else now
+        if self._last_total is None or blocks_total != self._last_total:
+            self._last_total = blocks_total
+            self._last_change = now
+            self._fired = False
+            return False
+        if limiter_paused:
+            # a deliberate rate-limiter pause is not a stall; the clock
+            # restarts at the first unpaused observation
+            self._was_paused = True
+            self._last_change = now
+            return False
+        if self._was_paused:
+            self._was_paused = False
+            self._last_change = now
+            return False
+        if (self._fired or workers_alive == 0
+                or now - self._last_change < self.timeout_s):
+            return False
+        self._fired = True
+        self.dumps += 1
+        info = diagnostics() if diagnostics is not None else {}
+        logging.getLogger(__name__).warning(
+            "ingestion STALLED: zero blocks for %.1fs with %d worker(s) "
+            "nominally up — diagnostics: %s",
+            now - self._last_change, workers_alive,
+            json.dumps(info, default=str))
+        return True
 
 
 class RingRecoveryScheduler:
@@ -77,33 +370,56 @@ class RingRecoveryScheduler:
 
 
 def supervise_workers(workers, seen_dead: set, respawn=None,
-                      ring: Optional[RingRecoveryScheduler] = None) -> int:
-    """The ONE dead-worker scan shared by the single-host supervisor
+                      ring: Optional[RingRecoveryScheduler] = None,
+                      health: Optional[WorkerHealth] = None) -> int:
+    """The ONE worker-health scan shared by the single-host supervisor
     (orchestrator.PlayerStack) and the multihost fleet
     (parallel/multihost.LocalActorFleet).
 
     ``workers`` is a list of threads or processes (anything with
-    ``is_alive``). Each newly-dead worker notifies ``ring`` when given
-    (shm slot reclamation). With ``respawn``, each dead worker is replaced
-    by ``respawn(i)`` — return None to keep the dead one and retry next
-    tick. Without ``respawn``, ``seen_dead`` (holding the objects — no id
-    reuse) counts a permanently-dead worker exactly once, so it cannot
-    re-schedule reclamation every tick. Returns the number respawned."""
+    ``is_alive``). A worker counts as FAILED when it is dead, or — with
+    ``health`` — alive but hung (stale heartbeat; it is killed/flagged via
+    ``kill_worker``). Each newly-failed worker notifies ``ring`` when given
+    (shm slot reclamation) and feeds ``health`` (backoff ladder, breaker).
+    With ``respawn``, a failed worker is replaced by ``respawn(i)`` once
+    its backoff elapses and its slot is not parked — ``respawn`` may
+    return None to keep the corpse and retry next tick. ``seen_dead``
+    (holding the objects — no id reuse) makes every corpse count exactly
+    once, so a slot waiting out its backoff cannot re-arm ring reclamation
+    or re-advance the backoff ladder every tick. Returns the number
+    respawned."""
     restarted = 0
+    now = time.time()
     for i, w in enumerate(workers):
-        if w.is_alive():
+        if health is not None and health.is_parked(i):
             continue
-        if respawn is not None:
-            if ring is not None:
-                ring.on_death()
-            new = respawn(i)
-            if new is not None:
-                workers[i] = new
-                restarted += 1
-        elif w not in seen_dead:
+        known_corpse = w in seen_dead
+        if not known_corpse:
+            if w.is_alive():
+                if health is None or not health.check_hung(i, now):
+                    continue
+                hung = True        # alive but wedged: clear it now
+                kill_worker(w)
+            else:
+                hung = False
             seen_dead.add(w)
             if ring is not None:
                 ring.on_death()
+            if health is not None:
+                health.on_failure(i, now, hung=hung)
+        if respawn is None:
+            continue
+        if health is not None and not health.respawn_due(i, now):
+            continue
+        new = respawn(i)
+        if new is not None:
+            workers[i] = new
+            # the corpse left the list: drop it so seen_dead stays bounded
+            # by the fleet size over a days-long run, not by total failures
+            seen_dead.discard(w)
+            if health is not None:
+                health.on_spawn(i)
+            restarted += 1
     return restarted
 
 
@@ -138,8 +454,9 @@ class BlockQueue:
     def put(self, block: Block, timeout: Optional[float] = None) -> None:
         self._q.put(block, timeout=timeout)
 
-    def put_patient(self, block: Block, should_stop, poll: float = 0.5) -> bool:
-        return put_patient(self._q, block, should_stop, poll)
+    def put_patient(self, block: Block, should_stop, poll: float = 0.5,
+                    beat: Optional[Callable[[], None]] = None) -> bool:
+        return put_patient(self._q, block, should_stop, poll, beat=beat)
 
     def drain(self, max_items: int = 16) -> List[Block]:
         """Non-blocking drain of up to max_items blocks."""

@@ -156,6 +156,10 @@ class Learner:
                 self.spec.num_blocks * (self._dp if self.mesh else 1))
         self.env_steps = resumed_env_steps
         self._host_step = int(self.train_state.step)
+        # last step a checkpoint covered: save_final() is a no-op unless
+        # training advanced past it (nothing new to save at construction,
+        # resumed or fresh)
+        self._last_saved_step = self._host_step
         # Rate-limiter baselines: the collect:learn budget is measured from
         # THIS process's starting point, not from step/env-step zero — a
         # resumed run restores large cumulative counters while its replay
@@ -644,10 +648,24 @@ class Learner:
 
     def save(self, index: int) -> str:
         ts = self.train_state
+        self._last_saved_step = self._host_step
         return save_checkpoint(
             self.cfg.runtime.save_dir, self.cfg.env.game_name, index,
             self.player_idx, ts.params, ts.opt_state, ts.target_params,
             int(ts.step), self.env_steps, config_json=self.cfg.to_json())
+
+    def save_final(self) -> Optional[str]:
+        """Preemption-safe final checkpoint: write one last save on a clean
+        stop so a preempted run resumes from the stop point, not the last
+        periodic interval boundary. No-op when save_interval is unset or
+        the current step is already covered by a save (stopping exactly on
+        a boundary must not write the same state twice). The index lands
+        one past the current periodic slot so it sorts as the newest
+        checkpoint for resume."""
+        rt = self.cfg.runtime
+        if not rt.save_interval or self._host_step <= self._last_saved_step:
+            return None
+        return self.save(self._host_step // rt.save_interval + 1)
 
     def run(self, queue, should_stop: Callable[[], bool],
             max_steps: Optional[int] = None) -> int:

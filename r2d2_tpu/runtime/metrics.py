@@ -58,6 +58,12 @@ class TrainMetrics:
         self._ingest_pause_time = 0.0
         self.ingest_queue_depth = 0
 
+        # worker-health counters (ISSUE 3): last supervision snapshot
+        # (PlayerStack.supervise / the multihost fleet push WorkerHealth's
+        # cumulative counters here); defaults keep the record schema
+        # stable for learner-only runs that never supervise
+        self._actor_health = {}
+
     # -- feed points --
 
     def on_block(self, learning_steps: int, episode_return: Optional[float]) -> None:
@@ -95,6 +101,12 @@ class TrainMetrics:
     def set_ingest_queue_depth(self, depth: int) -> None:
         """Staged batches awaiting commit (pipelined ingestion gauge)."""
         self.ingest_queue_depth = int(depth)
+
+    def set_actor_health(self, snapshot: dict) -> None:
+        """Supervision counters (WorkerHealth.snapshot + stall-dump count)
+        for the periodic record — restarts, hangs, breaker trips, parked
+        slots, heartbeat staleness."""
+        self._actor_health = dict(snapshot)
 
     def on_dropped_priority_update(self) -> None:
         """Called when a priority write-back batch is dropped because the
@@ -145,7 +157,17 @@ class TrainMetrics:
             "training_speed": train_speed,
             "loss": mean_loss,
             "dropped_priority_updates": self.dropped_priority_updates,
+            # worker-health counters: cumulative, overlaid by the latest
+            # supervision snapshot when a supervisor is running
+            "actor_restarts": 0,
+            "actor_hangs_detected": 0,
+            "actor_breaker_trips": 0,
+            "actor_parked_slots": 0,
+            "shm_slots_recovered": 0,
+            "ingest_stall_dumps": 0,
+            "heartbeat_age_max_s": None,
         }
+        record.update(self._actor_health)
         with self._ingest_lock:
             # ingestion observability (per-interval; the e2e bench's
             # ingestion phase reads these)

@@ -50,9 +50,18 @@ class PlayerStack:
         self.learner = Learner(cfg, self.net, player_idx, metrics=self.metrics)
         self.threads: List[threading.Thread] = []
         self.processes: List[mp.Process] = []
-        from r2d2_tpu.runtime.feeder import RingRecoveryScheduler
+        from r2d2_tpu.runtime.feeder import (
+            HeartbeatBoard, IngestStallDetector, RingRecoveryScheduler,
+            WorkerHealth)
         self._seen_dead: set = set()    # reaped dead process objects
         self._ring_recovery = RingRecoveryScheduler()
+        # worker-health subsystem: per-slot heartbeats + the shared
+        # watchdog/backoff/breaker policy (feeder.py) + the learner-side
+        # ingest stall detector
+        self.heartbeats = HeartbeatBoard(cfg.actor.num_actors)
+        self.health = WorkerHealth.from_runtime(
+            cfg.actor.num_actors, self.heartbeats, cfg.runtime)
+        self._stall = IngestStallDetector(cfg.runtime.ingest_stall_timeout_s)
         self.publisher = None
         self.store = None
         self.queue: Optional[BlockQueue] = None
@@ -86,16 +95,35 @@ class PlayerStack:
         policy, run_loop = make_actor_policy(
             cfg, self.net, self.learner.train_state.params, i, seed)
 
-        def loop(env=env, policy=policy, run_loop=run_loop, reader_id=i):
+        # per-spawn cancel event: the hang watchdog cannot kill a thread,
+        # so it sets this and abandons the incarnation — a thread that
+        # ever unwedges sees should_stop and exits instead of double-
+        # feeding its slot
+        cancel = threading.Event()
+
+        def should_stop(cancel=cancel):
+            return self._stop.is_set() or cancel.is_set()
+
+        from r2d2_tpu.runtime.actor_loop import instrument_block_sink
+        self.heartbeats.reset_slot(i)
+        sink = instrument_block_sink(
+            cfg, i,
+            lambda b: self.queue.put_patient(
+                b, should_stop,
+                beat=lambda: self.heartbeats.touch(i)),
+            board=self.heartbeats)
+
+        def loop(env=env, policy=policy, run_loop=run_loop, reader_id=i,
+                 sink=sink, should_stop=should_stop):
             # the run loop owns env and closes it on every exit
             run_loop(cfg, env, policy,
-                     block_sink=lambda b: self.queue.put_patient(
-                         b, self._stop.is_set),
+                     block_sink=sink,
                      weight_poll=lambda: self.store.poll(reader_id),
-                     should_stop=self._stop.is_set)
+                     should_stop=should_stop)
 
         t = threading.Thread(target=loop, daemon=True,
                              name=f"actor-p{self.player_idx}-{i}")
+        t.health_cancel = cancel
         t.start()
         if i < len(self.threads):
             self.threads[i] = t
@@ -119,11 +147,13 @@ class PlayerStack:
         cfg = self.cfg
         eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
                            cfg.actor.eps_alpha)
+        self.heartbeats.reset_slot(i)
         p = self._ctx.Process(
             target=actor_process_main,
             args=(cfg.to_dict(), self.player_idx, i, eps,
                   self.publisher.name, self.queue._q, self._stop),
-            kwargs=self.actor_env_args(i),
+            kwargs={**self.actor_env_args(i),
+                    "health_board": self.heartbeats, "health_slot": i},
             daemon=True, name=f"actor-p{self.player_idx}-{i}")
         p.start()
         if i < len(self.processes):
@@ -133,11 +163,14 @@ class PlayerStack:
         return p
 
     def supervise(self) -> int:
-        """Restart dead actors (the reference has no failure handling at all
-        — a crashed Ray actor silently reduces throughput forever, SURVEY
-        §5.3). Returns the number of restarts performed.
+        """One health pass: restart dead actors (the reference has no
+        failure handling at all — a crashed Ray actor silently reduces
+        throughput forever, SURVEY §5.3), kill+respawn HUNG ones (alive
+        but heartbeat-stale), apply per-slot restart backoff and the
+        crash-loop breaker, run the ingest stall detector, and surface the
+        counters in TrainMetrics. Returns the number of restarts performed.
 
-        Shm-ring slot reclamation runs for every NEWLY-detected dead actor
+        Shm-ring slot reclamation runs for every NEWLY-failed actor
         process regardless of runtime.restart_dead_actors (round-3 advisor):
         a producer that died between reserve and commit wedges the ring head
         slot whether or not it gets respawned, and with restarts off the
@@ -150,13 +183,44 @@ class PlayerStack:
         if restart:
             restarted += supervise_workers(
                 self.threads, self._seen_dead,
-                respawn=self._spawn_thread_actor)
+                respawn=self._spawn_thread_actor,
+                health=self.health)
         restarted += supervise_workers(
             self.processes, self._seen_dead,
             respawn=self._spawn_process_actor if restart else None,
-            ring=self._ring_recovery)
-        self._ring_recovery.tick(self.queue)
+            ring=self._ring_recovery,
+            health=self.health)
+        self.health.ring_slots_recovered += self._ring_recovery.tick(
+            self.queue)
+        workers = self.processes or self.threads
+        self._stall.check(
+            self.metrics.ingest_blocks_total,
+            sum(1 for w in workers if w.is_alive()),
+            self.learner.ingestion_paused,
+            diagnostics=self._stall_diagnostics)
+        self.metrics.set_actor_health(
+            {**self.health.snapshot(),
+             "ingest_stall_dumps": self._stall.dumps})
         return restarted
+
+    def _stall_diagnostics(self) -> dict:
+        """Snapshot for the one-shot stall dump: who was alive, how stale
+        each heartbeat was, and where the pipeline stood."""
+        lr = self.learner
+        workers = self.processes or self.threads
+        return {
+            "heartbeat_ages_s": [round(float(a), 1)
+                                 for a in self.heartbeats.ages()],
+            "heartbeat_counts": [int(c) for c in self.heartbeats.counts()],
+            "workers_alive": [w.is_alive() for w in workers],
+            "parked_slots": [i for i in range(self.cfg.actor.num_actors)
+                             if self.health.is_parked(i)],
+            "queue_depth": self.queue.qsize() if self.queue else -1,
+            "buffer_steps": lr.ring.buffer_steps,
+            "staged_blocks": lr._staged_blocks,
+            "ingestion_paused": lr.ingestion_paused,
+            "training_steps": lr.training_steps,
+        }
 
     def close(self) -> None:
         self.learner.stop_background()
@@ -166,6 +230,12 @@ class PlayerStack:
             p.join(timeout=5.0)
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=2.0)
+            if p.is_alive():
+                # terminate ignored (wedged engine child): escalate so a
+                # zombie never outlives the run
+                p.kill()
+                p.join(timeout=2.0)
         # join thread actors too: a daemon actor thread still inside an XLA
         # compile when the interpreter exits dies with a C++ abort
         # ("FATAL: exception not rethrown") — harmless but alarming noise
@@ -173,6 +243,7 @@ class PlayerStack:
             t.join(timeout=5.0)
         if self.queue is not None:
             self.queue.close()   # releases/unlinks the shm ring (owner)
+        self.heartbeats.close()  # releases/unlinks the heartbeat board
 
 
 def train(cfg: Config, *, max_training_steps: Optional[int] = None,
@@ -251,7 +322,12 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
             player_indices = [cfg.multiplayer.player_id]
         else:
             player_indices = list(range(num_players))
-        stacks = [PlayerStack(cfg, p, action_dim) for p in player_indices]
+        # appended one-by-one (not a comprehension): PlayerStack.__init__
+        # allocates the heartbeat shm segment, and the finally below only
+        # closes stacks that made it into the list — a mid-population
+        # construction failure must not leak the earlier stacks' segments
+        for p in player_indices:
+            stacks.append(PlayerStack(cfg, p, action_dim))
         for st in stacks:
             if actor_mode == "thread":
                 st.start_actors_threads(stop)
@@ -261,10 +337,21 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
         start = time.time()
         deadline = start + max_seconds if max_seconds else None
         max_steps = max_training_steps or cfg.optim.training_steps
-        last_log = start
+        last_log = last_supervise = start
 
         def timed_out() -> bool:
             return deadline is not None and time.time() > deadline
+
+        def supervise_due() -> bool:
+            # supervision runs on its own cadence, decoupled from the log
+            # interval, in BOTH loops — an actor that dies or hangs before
+            # learning_starts used to go unsupervised and wedge warm-up
+            # until the deadline
+            nonlocal last_supervise
+            if time.time() - last_supervise < cfg.runtime.supervise_interval_s:
+                return False
+            last_supervise = time.time()
+            return True
 
         # warm-up: fill buffers to learning_starts (ref train.py:49-54).
         # drain() bursts at replay.drain_max_blocks here AND in the
@@ -275,6 +362,9 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
                and not stop.is_set()):
             for st in stacks:
                 st.learner.drain(st.queue)
+            if supervise_due():
+                for st in stacks:
+                    st.supervise()
             time.sleep(0.02)
 
         # initial step-0 checkpoint (ref worker.py:311)
@@ -299,10 +389,12 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
             if profiling and now > profile_until:
                 jax.profiler.stop_trace()
                 profiling = False
+            if supervise_due():
+                for st in stacks:
+                    st.supervise()
             if now - last_log >= cfg.runtime.log_interval:
                 for st in stacks:
                     st.learner.flush_metrics()
-                    st.supervise()
                     record = st.metrics.log(now - last_log)
                     if log_fn:
                         log_fn({"player": st.player_idx, **record})
@@ -314,6 +406,16 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
     finally:
         stop.set()
         for st in stacks:
+            # preemption-safe final checkpoint: a clean stop (SIGTERM/
+            # SIGINT or deadline) between periodic saves would otherwise
+            # resume from the last interval boundary, replaying work
+            try:
+                if cfg.runtime.save_interval:
+                    st.learner.save_final()
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "final checkpoint for player %d failed", st.player_idx)
             st.close()
         for sig, handler in prev_handlers.items():
             try:

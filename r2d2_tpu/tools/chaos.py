@@ -1,0 +1,247 @@
+"""Deterministic fault-injection harness for the worker-health subsystem.
+
+The reference has no failure handling at all (SURVEY §5.3) and therefore
+nothing to test failures against; this framework's supervisor, hang
+watchdog, restart backoff, and circuit breaker (runtime/feeder.py) are only
+trustworthy if they are exercised by REAL killed/wedged workers, not
+synthetic stubs. This module is that exerciser:
+
+  * ``parse_fault_spec`` / ``FaultSpec``: the grammar behind the
+    ``actor.fault_spec`` config hook — ';'-joined ``slot:kind`` entries,
+    deterministic at block granularity so every actor mode (thread,
+    process, scalar, vector) misbehaves at exactly the same point:
+
+        1:crash@block=3     slot 1 raises on its 3rd block emit (1-based)
+        2:hang@block=5      slot 2 wedges forever at its 5th emit
+        0:slow@factor=4     slot 0's emit interval stretched 4x (alias 0:slowx4)
+
+  * ``apply_fault``: wraps a block sink with one fault. Injection lives at
+    the sink because every actor loop funnels through it — the one
+    choke-point shared by run_actor, run_vector_actor, thread workers, and
+    spawned processes (runtime/actor_loop.instrument_block_sink).
+
+  * ``run_chaos``: a self-contained chaos phase (also ``tools/soak.py
+    --chaos-seconds`` and ``python -m r2d2_tpu.tools.chaos``): train on the
+    fake env with a crash-looping slot and a hanging slot injected, and
+    report what supervision did about it (restarts, hangs detected,
+    breaker trips, parked slots) alongside proof training kept advancing.
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict
+
+_KINDS = ("crash", "hang", "slow")
+
+
+class ChaosFault(RuntimeError):
+    """Raised by an injected crash fault (distinguishable from real bugs)."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    kind: str            # "crash" | "hang" | "slow"
+    block: int = 0       # 1-based emit ordinal triggering crash/hang
+    factor: float = 1.0  # slow-down multiplier (slow only)
+
+
+def parse_fault_spec(spec: str) -> Dict[int, FaultSpec]:
+    """Parse ``actor.fault_spec`` into {slot: FaultSpec}; raises ValueError
+    on malformed input so a bad spec fails at Config construction, not
+    mid-run inside a spawned worker."""
+    faults: Dict[int, FaultSpec] = {}
+    for entry in filter(None, (e.strip() for e in spec.split(";"))):
+        slot_s, sep, rest = entry.partition(":")
+        if not sep or not rest:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: expected 'slot:kind[@...]'")
+        try:
+            slot = int(slot_s)
+        except ValueError:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: slot must be an integer") \
+                from None
+        if slot < 0:
+            raise ValueError(f"fault_spec entry {entry!r}: slot must be >= 0")
+        if slot in faults:
+            raise ValueError(f"fault_spec: duplicate slot {slot}")
+        kind, _, params = rest.partition("@")
+        kv = {}
+        if params:
+            k, sep, v = params.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: expected '@key=value'")
+            kv[k] = v
+        if kind.startswith("slowx"):                # shorthand: slowx4
+            kind, kv = "slow", {"factor": kind[len("slowx"):]}
+        if kind not in _KINDS:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: unknown kind {kind!r} "
+                f"(expected one of {_KINDS})")
+        if kind in ("crash", "hang"):
+            try:
+                block = int(kv.get("block", ""))
+            except ValueError:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: {kind} needs @block=N") \
+                    from None
+            if block < 1:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: block must be >= 1 "
+                    "(1-based emit ordinal)")
+            faults[slot] = FaultSpec(kind, block=block)
+        else:
+            try:
+                factor = float(kv.get("factor", ""))
+            except ValueError:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: slow needs @factor=F "
+                    "(or the slowxF shorthand)") from None
+            if factor <= 1.0:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: slow factor must be > 1")
+            faults[slot] = FaultSpec("slow", factor=factor)
+    return faults
+
+
+def apply_fault(sink: Callable, fault: FaultSpec) -> Callable:
+    """Wrap a block sink with one injected fault. Crash raises ChaosFault
+    INSTEAD of emitting block N (the worker dies with the block in hand —
+    the mid-production death shape); hang wedges there forever (a truly
+    unresponsive worker: it ignores stop signals by design, so only the
+    watchdog can clear it); slow sleeps (factor-1) x the observed
+    inter-emit interval, genuinely stretching block production by
+    ``factor`` without guessing at step timings."""
+    state = {"emitted": 0, "last": None}
+
+    def faulty_sink(block):
+        state["emitted"] += 1
+        if fault.kind == "crash" and state["emitted"] >= fault.block:
+            raise ChaosFault(
+                f"injected crash at block emit {state['emitted']}")
+        if fault.kind == "hang" and state["emitted"] >= fault.block:
+            while True:             # deliberately ignores every stop signal
+                time.sleep(0.25)
+        if fault.kind == "slow" and state["last"] is not None:
+            # cap one sleep at 5s so a long first interval (compile) does
+            # not turn the slow fault into an accidental hang
+            time.sleep(min((fault.factor - 1.0)
+                           * (time.monotonic() - state["last"]), 5.0))
+        state["last"] = time.monotonic()
+        return sink(block)
+
+    return faulty_sink
+
+
+# ---------------------------------------------------------------------------
+# Chaos phase: injected faults against the real orchestrator (fake env).
+
+
+def run_chaos(seconds: float = 60.0, actor_mode: str = "process",
+              config_overrides: dict = None) -> dict:
+    """Train on the fake env with one healthy, one crash-looping, and one
+    hanging actor injected; return a JSON-able report of what supervision
+    did (the soak's chaos phase, and ``python -m r2d2_tpu.tools.chaos``).
+
+    The crash-looping slot must trip the circuit breaker and park; the
+    hanging slot must be watchdog-killed and respawned with backoff; the
+    learner must keep training on the healthy slot throughout."""
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.runtime.orchestrator import train
+
+    overrides = {
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "actor.num_actors": 3,
+        "actor.fault_spec": "1:crash@block=2;2:hang@block=2",
+        "runtime.save_interval": 0, "runtime.log_interval": 2.0,
+        "runtime.steps_per_dispatch": 1,
+        "runtime.supervise_interval_s": 0.5,
+        "runtime.hang_timeout_s": 4.0,
+        "runtime.hang_spawn_grace_s": 90.0,
+        "runtime.restart_backoff_base_s": 0.5,
+        "runtime.restart_backoff_max_s": 4.0,
+        # breaker threshold per mode: thread respawns are cheap, so the
+        # crash-loop shows one backed-off respawn before parking (trips on
+        # the 3rd failure); a process crash cycle costs a full child
+        # bring-up (tens of seconds of jax import + env construction), so
+        # the default budget only fits two — park on the 2nd failure (the
+        # backoff ladder itself is proven by the thread phase and the unit
+        # tests)
+        "runtime.max_restarts_per_window": 2 if actor_mode == "thread" else 1,
+        "runtime.restart_window_s": 300.0,
+        "runtime.ingest_stall_timeout_s": 0.0,
+    }
+    overrides.update(config_overrides or {})
+    cfg = Config().replace(**overrides)
+
+    records = []
+    t0 = time.time()
+    stacks = train(cfg, max_training_steps=10**9, max_seconds=seconds,
+                   actor_mode=actor_mode, log_fn=records.append)
+    stack = stacks[0]
+    report = {
+        "metric": "chaos", "actor_mode": actor_mode,
+        "duration_s": round(time.time() - t0, 1),
+        "fault_spec": cfg.actor.fault_spec,
+        "training_steps": stack.learner.training_steps,
+        "env_steps": stack.learner.env_steps,
+        **stack.health.snapshot(),
+        "heartbeat_counts": [int(c) for c in stack.health.board.counts()],
+        "records": records[-3:],
+    }
+    report["verdict"] = {
+        "trained_through_faults": stack.learner.training_steps > 0,
+        "hang_detected": stack.health.hangs_detected >= 1,
+        "restarts_happened": stack.health.restarts >= 1,
+    }
+    if actor_mode == "thread":
+        # required only where the budget guarantees enough crash cycles:
+        # a process crash cycle costs a full child bring-up (tens of
+        # seconds under CPU contention), so short process-mode runs may
+        # legitimately end before the breaker threshold — the trip still
+        # shows up in actor_breaker_trips/actor_parked_slots when reached,
+        # and the deterministic breaker guarantees live in
+        # tests/test_chaos.py
+        report["verdict"]["breaker_parked_crash_loop"] = \
+            stack.health.breaker_trips >= 1
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import sys
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seconds", type=float, default=60.0)
+    p.add_argument("--actor-mode", choices=("thread", "process"),
+                   default="process")
+    p.add_argument("--override", action="append", default=[],
+                   help="dotted config override key=value (repeatable)")
+    args = p.parse_args(argv)
+    overrides = {}
+    for ov in args.override:
+        k, _, v = ov.partition("=")
+        try:
+            overrides[k] = json.loads(v)
+        except (json.JSONDecodeError, ValueError):
+            overrides[k] = v
+    out = run_chaos(args.seconds, args.actor_mode, overrides)
+    print(json.dumps(out))
+    ok = all(out["verdict"].values())
+    print(f"chaos: verdict={'PASS' if ok else 'FAIL'} {out['verdict']}",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

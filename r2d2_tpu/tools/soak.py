@@ -216,6 +216,15 @@ def main(argv=None) -> int:
                         "actors feeding the real learner; reports "
                         "env-steps/s and learner steps/s together); 0 = off")
     p.add_argument("--e2e-envs-per-actor", type=int, default=16)
+    p.add_argument("--chaos-seconds", type=float,
+                   default=_env_float("R2D2_SOAK_CHAOS_SECONDS", 0.0),
+                   help="also run the chaos phase (tools/chaos.py): train "
+                        "with injected crash-loop + hang faults and report "
+                        "what supervision did (restarts, hang detections, "
+                        "breaker trips) alongside proof training kept "
+                        "advancing; 0 = off")
+    p.add_argument("--chaos-actor-mode", choices=("thread", "process"),
+                   default="process")
     args = p.parse_args(argv)
     overrides = {}
     for ov in args.override:
@@ -239,6 +248,15 @@ def main(argv=None) -> int:
                                  overrides=overrides)
         except Exception as e:     # pragma: no cover - defensive
             out["e2e"] = {"error": repr(e)}
+    if args.chaos_seconds > 0:
+        # chaos phase LAST, same failure isolation as the e2e phase: a
+        # wedged fault-injection run must not lose the soak numbers
+        from r2d2_tpu.tools.chaos import run_chaos
+        try:
+            out["chaos"] = run_chaos(args.chaos_seconds,
+                                     actor_mode=args.chaos_actor_mode)
+        except Exception as e:     # pragma: no cover - defensive
+            out["chaos"] = {"error": repr(e)}
     print(json.dumps(out))
     return 0
 

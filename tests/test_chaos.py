@@ -1,0 +1,565 @@
+"""Worker-health subsystem tests driven by the deterministic fault-injection
+harness (tools/chaos.py): heartbeats, hang watchdog, restart backoff, the
+crash-loop circuit breaker, ingest stall detection, and the end-to-end chaos
+slices where REAL killed/wedged workers exercise all of it (the failure
+handling the reference lacks entirely, SURVEY §5.3).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.runtime.feeder import (
+    BlockQueue, HeartbeatBoard, IngestStallDetector, WorkerHealth,
+    supervise_workers)
+from r2d2_tpu.tools.chaos import (
+    ChaosFault, FaultSpec, apply_fault, parse_fault_spec)
+
+from tests.test_runtime import tiny_config
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# fault-spec grammar
+
+
+def test_parse_fault_spec_grammar():
+    faults = parse_fault_spec("1:crash@block=3;2:hang@block=5;0:slowx4")
+    assert faults[1] == FaultSpec("crash", block=3)
+    assert faults[2] == FaultSpec("hang", block=5)
+    assert faults[0] == FaultSpec("slow", factor=4.0)
+    assert parse_fault_spec("0:slow@factor=2.5")[0].factor == 2.5
+    assert parse_fault_spec("") == {}
+    assert parse_fault_spec(" 1:crash@block=1 ; ")[1].block == 1
+
+
+@pytest.mark.parametrize("bad", [
+    "nocolon", "x:crash@block=1", "-1:crash@block=1", "0:boom",
+    "0:crash", "0:crash@block=0", "0:crash@block=x", "0:hang",
+    "0:slow", "0:slow@factor=1.0", "0:slowxfast",
+    "0:crash@block=1;0:hang@block=2",          # duplicate slot
+])
+def test_parse_fault_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+def test_config_validates_fault_spec():
+    cfg = Config()
+    cfg.replace(**{"actor.fault_spec": "1:crash@block=2"})   # in range: ok
+    with pytest.raises(ValueError, match="outside the fleet"):
+        cfg.replace(**{"actor.fault_spec": "7:crash@block=2"})
+    with pytest.raises(ValueError, match="unknown kind"):
+        cfg.replace(**{"actor.fault_spec": "0:explode"})
+    with pytest.raises(ValueError, match="hang_timeout_s"):
+        cfg.replace(**{"runtime.hang_timeout_s": -1.0})
+    with pytest.raises(ValueError, match="supervise_interval_s"):
+        cfg.replace(**{"runtime.supervise_interval_s": 0.0})
+
+
+def test_apply_fault_crash_and_slow():
+    emitted = []
+    crash = apply_fault(emitted.append, FaultSpec("crash", block=3))
+    crash("a"); crash("b")
+    with pytest.raises(ChaosFault):
+        crash("c")
+    assert emitted == ["a", "b"]          # block 3 died with the block in hand
+
+    got = []
+    slow = apply_fault(got.append, FaultSpec("slow", factor=3.0))
+    slow("x")                              # first emit: no interval yet
+    time.sleep(0.05)
+    t0 = time.monotonic()
+    slow("y")                              # sleeps ~2x the 0.05s interval
+    assert time.monotonic() - t0 >= 0.08
+    assert got == ["x", "y"]
+
+
+# ---------------------------------------------------------------------------
+# heartbeat board
+
+
+def test_heartbeat_board_beat_touch_reset():
+    board = HeartbeatBoard(3)
+    try:
+        assert board.counts().tolist() == [0.0, 0.0, 0.0]
+        board.beat(1)
+        board.beat(1)
+        assert board.count(1) == 2
+        assert board.age(1) < 1.0
+        # touch: liveness without progress
+        board._ensure()[2, 1] = time.time() - 50.0
+        assert board.age(2) > 49.0
+        board.touch(2)
+        assert board.age(2) < 1.0 and board.count(2) == 0
+        board.reset_slot(1)
+        assert board.count(1) == 0 and board.age(1) < 1.0
+    finally:
+        board.close()
+
+
+def test_heartbeat_board_crosses_pickle_boundary():
+    """The spawn-mode contract: the pickled handle attaches to the SAME
+    region (one writer's beats visible to the other side)."""
+    import pickle
+
+    board = HeartbeatBoard(2)
+    attached = pickle.loads(pickle.dumps(board))
+    try:
+        attached.beat(0)
+        assert board.count(0) == 1
+        board.beat(0)
+        assert attached.count(0) == 2
+    finally:
+        attached.close()
+        board.close()
+
+
+def test_put_patient_beats_while_parked():
+    """A producer parked under back-pressure keeps publishing liveness —
+    back-pressure must never read as a hang to the watchdog."""
+    q = BlockQueue(maxsize=1, use_mp=False)
+    q.put("a")                             # full
+    beats = []
+    t = threading.Thread(
+        target=lambda: q.put_patient("b", should_stop=lambda: False,
+                                     poll=0.05, beat=lambda: beats.append(1)))
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive() and len(beats) >= 3   # parked, still beating
+    assert q.drain(max_items=1) == ["a"]
+    t.join(timeout=5.0)
+    assert q.drain() == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# restart backoff + circuit breaker (WorkerHealth policy, deterministic time)
+
+
+def test_backoff_ladder_is_exponential_and_capped():
+    h = WorkerHealth(1, backoff_base_s=2.0, backoff_max_s=5.0,
+                     restart_window_s=100.0)
+    h.on_failure(0, now=10.0)
+    assert h.respawn_due(0, now=10.0)          # first failure: immediate
+    h.on_failure(0, now=20.0)
+    assert not h.respawn_due(0, now=21.0)      # 2nd: base backoff (2s)
+    assert h.respawn_due(0, now=22.1)
+    h.on_failure(0, now=30.0)
+    assert not h.respawn_due(0, now=33.0)      # 3rd: 2*base (4s)
+    assert h.respawn_due(0, now=34.1)
+    h.on_failure(0, now=40.0)
+    assert h.respawn_due(0, now=45.1)          # 4th: capped at max (5s), not 8
+    assert not h.respawn_due(0, now=44.9)
+    # window expiry resets the ladder: a failure long after the last one
+    # respawns immediately again
+    h.on_failure(0, now=500.0)
+    assert h.respawn_due(0, now=500.0)
+
+
+def test_breaker_parks_slot_after_window_budget():
+    h = WorkerHealth(2, backoff_base_s=0.0, max_restarts_per_window=2,
+                     restart_window_s=100.0)
+    h.on_failure(0, now=1.0)
+    h.on_failure(0, now=2.0)
+    assert not h.is_parked(0)
+    h.on_failure(0, now=3.0)                   # 3rd failure in window: trip
+    assert h.is_parked(0)
+    assert not h.respawn_due(0, now=999.0)     # parked = parked forever
+    assert not h.is_parked(1)                  # per-slot
+    snap = h.snapshot()
+    assert snap["actor_breaker_trips"] == 1
+    assert snap["actor_parked_slots"] == 1
+
+
+def test_breaker_disabled_by_zero():
+    h = WorkerHealth(1, backoff_base_s=0.0, max_restarts_per_window=0)
+    for k in range(20):
+        h.on_failure(0, now=float(k))
+    assert not h.is_parked(0)
+
+
+# ---------------------------------------------------------------------------
+# supervise_workers: hang watchdog, backoff, breaker integration
+
+
+class StubWorker:
+    def __init__(self, alive=True, ignore_terminate=False):
+        self.alive = alive
+        self.terminated = self.killed = False
+        self._ignore = ignore_terminate
+        self.health_cancel = threading.Event()
+
+    def is_alive(self):
+        return self.alive
+
+    def terminate(self):
+        self.terminated = True
+        if not self._ignore:
+            self.alive = False
+
+    def kill(self):
+        self.killed = True
+        self.alive = False
+
+    def join(self, timeout=None):
+        pass
+
+
+def _stale_board(n, slot, age, count=1):
+    board = HeartbeatBoard(n)
+    arr = board._ensure()
+    arr[slot] = (count, time.time() - age)
+    return board
+
+
+def test_watchdog_kills_and_respawns_hung_worker():
+    """Alive-but-silent worker: killed (terminate), counted as a hang,
+    replaced through the normal respawn path."""
+    board = _stale_board(1, 0, age=100.0)
+    try:
+        h = WorkerHealth(1, board, hang_timeout_s=5.0, hang_spawn_grace_s=5.0)
+        hung = StubWorker(alive=True)
+        workers, seen, spawned = [hung], set(), []
+
+        def respawn(i):
+            board.reset_slot(i)
+            spawned.append(i)
+            return StubWorker(alive=True)
+
+        assert supervise_workers(workers, seen, respawn=respawn, health=h) == 1
+        assert hung.terminated and not hung.alive
+        assert h.hangs_detected == 1 and h.restarts == 1
+        assert spawned == [0]
+        # the fresh incarnation (board just reset) is NOT hung
+        assert supervise_workers(workers, seen, respawn=respawn, health=h) == 0
+        assert h.hangs_detected == 1
+    finally:
+        board.close()
+
+
+def test_watchdog_escalates_to_kill_and_flags_threads():
+    board = _stale_board(2, 0, age=100.0)
+    board._ensure()[1] = (1, time.time() - 100.0)
+    try:
+        h = WorkerHealth(2, board, hang_timeout_s=5.0, hang_spawn_grace_s=5.0)
+        stubborn = StubWorker(alive=True, ignore_terminate=True)
+
+        class ThreadStub:                      # no terminate/kill surface
+            health_cancel = threading.Event()
+
+            def is_alive(self):
+                return True
+
+        threadlike = ThreadStub()
+        workers, seen = [stubborn, threadlike], set()
+        supervise_workers(workers, seen, respawn=lambda i: None, health=h)
+        assert stubborn.terminated and stubborn.killed     # escalation
+        assert threadlike.health_cancel.is_set()           # flagged
+        assert threadlike.is_alive()                       # ...but unkillable
+        assert h.hangs_detected == 2
+    finally:
+        board.close()
+
+
+def test_watchdog_spawn_grace_covers_bringup():
+    """Before the incarnation's FIRST beat the (longer) spawn grace
+    applies — slow process bring-up is not a hang; after a beat the regular
+    timeout takes over."""
+    board = _stale_board(1, 0, age=10.0, count=0)    # 10s old, never beat
+    try:
+        h = WorkerHealth(1, board, hang_timeout_s=5.0,
+                         hang_spawn_grace_s=60.0)
+        assert not h.check_hung(0, time.time())      # inside spawn grace
+        board._ensure()[0, 0] = 1.0                  # first beat happened
+        assert h.check_hung(0, time.time())          # now 5s rule applies
+    finally:
+        board.close()
+
+
+def test_supervise_workers_backoff_defers_respawn():
+    h = WorkerHealth(1, backoff_base_s=0.3, backoff_max_s=5.0)
+    workers, seen = [StubWorker(alive=False)], set()
+    respawn = lambda i: StubWorker(alive=True)
+    assert supervise_workers(workers, seen, respawn=respawn, health=h) == 1
+    workers[0].alive = False                       # dies again immediately
+    # 2nd failure: recorded once, respawn deferred by the 0.3s backoff
+    assert supervise_workers(workers, seen, respawn=respawn, health=h) == 0
+    assert supervise_workers(workers, seen, respawn=respawn, health=h) == 0
+    assert len(h._windows[0]) == 2                 # corpse counted ONCE
+    time.sleep(0.35)
+    assert supervise_workers(workers, seen, respawn=respawn, health=h) == 1
+    assert h.restarts == 2
+
+
+def test_supervise_workers_parked_slot_stays_down():
+    h = WorkerHealth(2, backoff_base_s=0.0, max_restarts_per_window=1)
+    workers = [StubWorker(alive=False), StubWorker(alive=True)]
+    seen = set()
+    respawn_calls = []
+
+    def respawn(i):
+        respawn_calls.append(i)
+        return StubWorker(alive=False)             # crash-loop: dies at once
+
+    for _ in range(4):
+        supervise_workers(workers, seen, respawn=respawn, health=h)
+    assert h.is_parked(0) and h.breaker_trips == 1
+    n = len(respawn_calls)
+    supervise_workers(workers, seen, respawn=respawn, health=h)
+    assert len(respawn_calls) == n                 # parked: no more respawns
+
+
+# ---------------------------------------------------------------------------
+# ingest stall detector
+
+
+def test_stall_detector_one_shot_and_rearm():
+    det = IngestStallDetector(timeout_s=10.0)
+    dumps = []
+    diag = lambda: dumps.append(1) or {"x": 1}
+    assert not det.check(5, 2, False, now=0.0, diagnostics=diag)
+    assert not det.check(5, 2, False, now=9.0, diagnostics=diag)
+    assert det.check(5, 2, False, now=11.0, diagnostics=diag)      # fires
+    assert not det.check(5, 2, False, now=50.0, diagnostics=diag)  # one-shot
+    assert det.dumps == 1 and len(dumps) == 1
+    # progress re-arms; a NEW stall episode fires again
+    assert not det.check(6, 2, False, now=51.0, diagnostics=diag)
+    assert det.check(6, 2, False, now=62.0, diagnostics=diag)
+    assert det.dumps == 2
+
+
+def test_stall_detector_ignores_limiter_pause_and_dead_fleet():
+    det = IngestStallDetector(timeout_s=10.0)
+    assert not det.check(5, 2, False, now=0.0)
+    # rate-limiter pause: deliberate, clock restarts at unpause
+    assert not det.check(5, 2, True, now=20.0)
+    assert not det.check(5, 2, False, now=25.0)
+    assert not det.check(5, 2, False, now=34.0)    # only 9s since unpause
+    assert det.check(5, 2, False, now=36.0)
+    # zero alive workers: the supervisor story, not a silent stall
+    det2 = IngestStallDetector(timeout_s=10.0)
+    assert not det2.check(5, 0, False, now=0.0)
+    assert not det2.check(5, 0, False, now=100.0)
+    # disabled
+    det3 = IngestStallDetector(timeout_s=0.0)
+    assert not det3.check(5, 2, False, now=0.0)
+    assert not det3.check(5, 2, False, now=1000.0)
+
+
+def test_metrics_record_carries_health_counters(tmp_path):
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+
+    m = TrainMetrics(player_idx=0, log_dir=str(tmp_path))
+    rec = m.log(1.0)
+    assert rec["actor_restarts"] == 0 and rec["actor_hangs_detected"] == 0
+    m.set_actor_health({"actor_restarts": 3, "actor_hangs_detected": 1,
+                        "actor_breaker_trips": 1, "actor_parked_slots": 1,
+                        "shm_slots_recovered": 2, "ingest_stall_dumps": 1,
+                        "heartbeat_age_max_s": 4.2})
+    rec = m.log(1.0)
+    assert rec["actor_restarts"] == 3 and rec["actor_hangs_detected"] == 1
+    assert rec["actor_breaker_trips"] == 1 and rec["actor_parked_slots"] == 1
+    assert rec["shm_slots_recovered"] == 2 and rec["heartbeat_age_max_s"] == 4.2
+
+
+# ---------------------------------------------------------------------------
+# PlayerStack integration (no training loop needed)
+
+
+def test_playerstack_close_escalates_to_kill(tmp_path):
+    """Satellite: a terminate-ignoring child must be kill()ed by close(),
+    never leaked as a zombie."""
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.runtime.orchestrator import PlayerStack
+
+    cfg = tiny_config(tmp_path)
+    probe = create_env(cfg.env)
+    stack = PlayerStack(cfg, 0, probe.action_space.n)
+    probe.close()
+    stubborn = StubWorker(alive=True, ignore_terminate=True)
+    polite = StubWorker(alive=True)
+    stack.processes = [stubborn, polite]
+    stack.close()
+    assert stubborn.terminated and stubborn.killed and not stubborn.alive
+    assert polite.terminated and not polite.killed
+
+
+def test_learner_save_final_on_stop(tmp_path):
+    """Satellite: save_final writes exactly one extra checkpoint when (and
+    only when) training advanced past the last periodic save."""
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.learner_loop import Learner
+
+    cfg = tiny_config(tmp_path)
+    probe = create_env(cfg.env)
+    net = NetworkApply(probe.action_space.n, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    probe.close()
+    learner = Learner(cfg, net)
+    assert learner.save_final() is None            # nothing trained yet
+    learner._host_step = 7                         # mid-interval stop point
+    path = learner.save_final()
+    assert path is not None
+    assert learner.save_final() is None            # already covered
+    # disabled checkpointing: never writes
+    learner2 = Learner(cfg.replace(**{"runtime.save_interval": 0}), net)
+    learner2._host_step = 7
+    assert learner2.save_final() is None
+
+
+def test_thread_actors_publish_heartbeats_scalar_and_vector(tmp_path):
+    """Heartbeat parity: scalar and vectorized thread actors both publish
+    per-slot progress through the same board (process mode is asserted by
+    the slow end-to-end chaos test)."""
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.runtime.orchestrator import PlayerStack
+
+    for overrides in ({}, {"actor.num_actors": 1, "actor.envs_per_actor": 4}):
+        cfg = tiny_config(tmp_path, **overrides)
+        probe = create_env(cfg.env)
+        stack = PlayerStack(cfg, 0, probe.action_space.n)
+        probe.close()
+        stop = threading.Event()
+        stack.start_actors_threads(stop)
+        try:
+            deadline = time.time() + 90.0
+            while (time.time() < deadline
+                   and not (stack.heartbeats.counts() > 0).all()):
+                stack.queue.drain(64)      # keep the queue from backing up
+                time.sleep(0.05)
+            counts = stack.heartbeats.counts()
+            assert (counts > 0).all(), counts
+            assert stack.heartbeats.ages().max() < 60.0
+        finally:
+            stop.set()
+            stack.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos slices (real misbehaving workers)
+
+
+@pytest.mark.slow
+def test_warmup_crash_is_supervised(tmp_path):
+    """Satellite: an actor that dies BEFORE learning_starts is respawned by
+    the warm-up loop's supervision (it used to run unsupervised and wedge
+    until the deadline)."""
+    from r2d2_tpu.runtime.orchestrator import train
+
+    cfg = tiny_config(tmp_path, **{
+        "actor.num_actors": 2,
+        # slot 1 dies on its FIRST emit, every incarnation — without
+        # warm-up supervision half the fleet would stay down for good
+        "actor.fault_spec": "1:crash@block=1",
+        "runtime.save_interval": 0,
+        "runtime.supervise_interval_s": 0.2,
+        "runtime.restart_backoff_base_s": 0.1,
+        "runtime.restart_backoff_max_s": 0.5,
+        "runtime.max_restarts_per_window": 0,
+    })
+    stacks = train(cfg, max_training_steps=3, max_seconds=240,
+                   actor_mode="thread")
+    st = stacks[0]
+    assert st.learner.training_steps >= 3          # warm-up completed
+    assert st.health.restarts >= 1                 # ...under supervision
+
+
+@pytest.mark.slow
+def test_thread_crash_loop_trips_breaker_training_degrades(tmp_path):
+    """Crash-loop → breaker parks the slot; training continues degraded on
+    the healthy actor; counters land in the emitted metrics record."""
+    from r2d2_tpu.runtime.orchestrator import train
+
+    records = []
+    cfg = tiny_config(tmp_path, **{
+        "actor.num_actors": 2,
+        "actor.fault_spec": "1:crash@block=1",
+        "runtime.save_interval": 0, "runtime.log_interval": 0.5,
+        "runtime.supervise_interval_s": 0.2,
+        "runtime.restart_backoff_base_s": 0.05,
+        "runtime.restart_backoff_max_s": 0.2,
+        "runtime.max_restarts_per_window": 2,
+        "runtime.restart_window_s": 300.0,
+    })
+    stacks = train(cfg, max_training_steps=10**9, max_seconds=45,
+                   actor_mode="thread", log_fn=records.append)
+    st = stacks[0]
+    assert st.health.breaker_trips >= 1
+    assert st.health.is_parked(1)
+    assert st.health.restarts >= 2                  # backed-off respawns ran
+    assert st.learner.training_steps > 0            # degraded, not dead
+    last = records[-1]
+    assert last["actor_breaker_trips"] >= 1
+    assert last["actor_parked_slots"] == 1
+    assert last["actor_restarts"] >= 2
+
+
+@pytest.mark.slow
+def test_process_hang_watchdog_end_to_end(tmp_path):
+    """ACCEPTANCE: a hang (not a crash) injected into one process-mode
+    actor — the watchdog detects it within hang_timeout_s, kills and
+    respawns the worker with backoff, the shm ring keeps feeding (slot
+    reclamation pass scheduled + ingestion continues), learner training
+    steps advance throughout, and the hang/restart counters are visible in
+    the emitted metrics records."""
+    from r2d2_tpu.runtime.orchestrator import train
+
+    records = []
+    cfg = tiny_config(tmp_path, **{
+        "actor.num_actors": 2,
+        "actor.fault_spec": "1:hang@block=1",       # wedges on its 1st emit
+        "runtime.save_interval": 0, "runtime.log_interval": 1.0,
+        "runtime.supervise_interval_s": 0.5,
+        "runtime.hang_timeout_s": 3.0,
+        "runtime.hang_spawn_grace_s": 150.0,
+        "runtime.restart_backoff_base_s": 0.5,
+        "runtime.restart_backoff_max_s": 2.0,
+        "runtime.max_restarts_per_window": 0,
+    })
+    stacks = train(cfg, max_training_steps=10**9, max_seconds=60,
+                   actor_mode="process", log_fn=records.append)
+    st = stacks[0]
+    # watchdog saw the wedged worker and killed it; supervision respawned
+    assert st.health.hangs_detected >= 1
+    assert st.health.restarts >= 1
+    # the kill routed through ring-slot reclamation scheduling
+    assert st._ring_recovery._last_death > 0
+    # the healthy actor's heartbeats flowed the whole time (process-mode
+    # heartbeat parity)
+    assert st.heartbeats.counts()[0] > 0
+    # training ran throughout
+    assert st.learner.training_steps > 0
+    hang_recs = [r for r in records if r["actor_hangs_detected"] >= 1]
+    assert hang_recs, "hang counter never reached the metrics records"
+    first, last = hang_recs[0], records[-1]
+    assert last["actor_restarts"] >= 1
+    # the learner kept ingesting and training AFTER the hang was handled
+    assert last["env_steps"] > first["env_steps"]
+    assert last["training_steps"] > first["training_steps"]
+
+
+@pytest.mark.slow
+def test_chaos_harness_thread_mode(tmp_path):
+    """tools/chaos.run_chaos (the soak's chaos phase): one healthy, one
+    crash-looping (→ breaker), one hanging (→ watchdog) actor; the report
+    must carry a full PASS verdict."""
+    from r2d2_tpu.tools.chaos import run_chaos
+
+    out = run_chaos(seconds=45.0, actor_mode="thread", config_overrides={
+        "runtime.save_dir": str(tmp_path),
+        "runtime.hang_timeout_s": 3.0,
+        "runtime.hang_spawn_grace_s": 60.0,
+        "runtime.restart_backoff_base_s": 0.1,
+        "runtime.restart_backoff_max_s": 0.5,
+    })
+    assert out["verdict"]["trained_through_faults"], out
+    assert out["verdict"]["hang_detected"], out
+    assert out["verdict"]["breaker_parked_crash_loop"], out
+    assert out["verdict"]["restarts_happened"], out
+    assert out["heartbeat_counts"][0] > 0          # healthy slot progressed
+    assert out["records"][-1]["actor_parked_slots"] >= 1
