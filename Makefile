@@ -10,8 +10,13 @@
 #                slow-marked chaos slices (real injected hangs/crash-loops
 #                through process actors) run with the full tier or via
 #                pytest -m chaos.
+#   make telemetry — the fast-tier telemetry suite (tests/test_telemetry.py:
+#                histogram percentiles/merge, span rings, board
+#                aggregation, record schema stability, profiler capture
+#                lifecycle); the slow-marked e2e slices run with the full
+#                tier.
 
-.PHONY: t1 chaos check-fast-markers
+.PHONY: t1 chaos telemetry check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -19,6 +24,10 @@ t1: check-fast-markers
 chaos: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
 	    -m 'chaos and not slow' -p no:cacheprovider
+
+telemetry: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q \
+	    -m 'not slow' -p no:cacheprovider
 
 check-fast-markers:
 	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_ingest.py \
@@ -37,5 +46,14 @@ check-fast-markers:
 	    echo "fast-tier chaos tests collected: $$n"; \
 	else \
 	    echo "ERROR: chaos tests missing from the 'chaos and not slow' tier ($$n collected)"; \
+	    exit 1; \
+	fi
+	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
+	    -m 'not slow' --collect-only -q -p no:cacheprovider 2>/dev/null \
+	    | grep -c '::'); \
+	if [ "$$n" -ge 20 ]; then \
+	    echo "fast-tier telemetry tests collected: $$n"; \
+	else \
+	    echo "ERROR: telemetry tests missing from the 'not slow' tier ($$n collected)"; \
 	    exit 1; \
 	fi

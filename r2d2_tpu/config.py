@@ -300,6 +300,30 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Unified runtime telemetry (r2d2_tpu/telemetry/): percentile stage
+    timers, span tracing, cross-process aggregation. On by default — the
+    benched overhead budget is < 2% env-steps/s (tools/e2e_bench.py
+    --telemetry-ab; PERF.md "Telemetry overhead")."""
+
+    # Master kill-switch: false turns every telemetry entry point into a
+    # cheap no-op (stage observes, span records, board publication, the
+    # aggregated 'stages' block in the periodic record).
+    enabled: bool = True
+    # Span ring capacity PER THREAD (spans.py). When a drain interval
+    # overflows it the oldest spans drop (counted, surfaced as
+    # telemetry_dropped_spans in the periodic record) — sized for block
+    # cadence, not per-env-step events.
+    ring_size: int = 4096
+    # Drain cadence: spans ring -> spans_*.jsonl, and worker histogram
+    # counts -> the shared-memory board.
+    flush_interval_s: float = 5.0
+    # Span tracing sub-switch: histograms stay on (they are the
+    # aggregated record's source); spans cost a JSONL file per process.
+    spans: bool = True
+
+
+@dataclass(frozen=True)
 class RuntimeConfig:
     """Process orchestration, logging, checkpointing (ref config.py:8-10,20-21,40)."""
 
@@ -338,6 +362,13 @@ class RuntimeConfig:
     test_epsilon: float = 0.01
     seed: int = 0
     profile_dir: str = ""            # non-empty: write jax.profiler traces here
+    # Mid-run xprof trigger: > 0 arms a ONE-SHOT jax.profiler capture that
+    # starts when the learner step counter first reaches this value and
+    # runs for min(log_interval, 30)s — profiling the steady state instead
+    # of (or in addition to) the first-interval capture profile_dir
+    # enables. Traces land in profile_dir, or {save_dir}/xprof when
+    # profile_dir is unset. SIGUSR2 triggers the same capture on demand.
+    profile_at_step: int = 0
     restart_dead_actors: bool = True  # supervisor (the reference has none, SURVEY §5.3)
     # -- worker health (heartbeats / watchdog / backoff / breaker) --
     # Seconds between supervision passes (dead-worker scan, hang watchdog,
@@ -390,6 +421,7 @@ class Config:
     multiplayer: MultiplayerConfig = field(default_factory=MultiplayerConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     def __post_init__(self):
         if self.replay.block_length % self.sequence.learning_steps != 0:
@@ -448,6 +480,14 @@ class Config:
                 raise ValueError(f"runtime.{fname} must be >= 0")
         if self.runtime.max_restarts_per_window < 0:
             raise ValueError("runtime.max_restarts_per_window must be >= 0")
+        if self.runtime.profile_at_step < 0:
+            raise ValueError("runtime.profile_at_step must be >= 0")
+        if self.telemetry.ring_size < 16:
+            raise ValueError(
+                f"telemetry.ring_size ({self.telemetry.ring_size}) must be "
+                ">= 16")
+        if self.telemetry.flush_interval_s <= 0:
+            raise ValueError("telemetry.flush_interval_s must be > 0")
         if self.multiplayer.enabled and self.actor.envs_per_actor > 1:
             raise ValueError(
                 "actor.envs_per_actor > 1 is not supported with multiplayer "
@@ -506,7 +546,10 @@ class Config:
         """Inverse of to_dict (tuples round-trip through JSON lists)."""
         kwargs = {}
         for f in dataclasses.fields(cls):
-            sub = dict(d[f.name])
+            # sections absent from the dict take their defaults: configs
+            # serialized before a section existed (checkpoint .config.json
+            # files) must keep loading after the schema grows
+            sub = dict(d.get(f.name) or {})
             for key, value in sub.items():
                 if isinstance(value, list):
                     sub[key] = tuple(
@@ -523,7 +566,7 @@ _SECTION_TYPES = {
     "env": EnvConfig, "network": NetworkConfig, "sequence": SequenceConfig,
     "replay": ReplayConfig, "optim": OptimConfig, "actor": ActorConfig,
     "multiplayer": MultiplayerConfig, "mesh": MeshConfig,
-    "runtime": RuntimeConfig,
+    "runtime": RuntimeConfig, "telemetry": TelemetryConfig,
 }
 
 # Field annotations are strings (PEP 563 via `from __future__ import

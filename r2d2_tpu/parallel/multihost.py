@@ -249,6 +249,21 @@ def _make_gspmd_lockstep_ingest(spec: ReplaySpec, mesh):
     return ingest
 
 
+def _write_host_telemetry_row(path: str, rank: int, tele,
+                              t_start: float) -> None:
+    """One per-host aggregated telemetry row per log interval. Rank 0's
+    stage summary rides the main TrainMetrics record (it owns the
+    player's metrics files); every other rank appends compact rows here so
+    a pod-wide view exists without breaking the rank-0-deduplicates-side-
+    effects rule — tools/inspect.py reads both."""
+    import json
+    row = {"t": round(time.time() - t_start, 3), "rank": rank,
+           "stages": tele.interval_summary(),
+           "telemetry_dropped_spans": tele.spans.dropped}
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
 def owned_dp_rows(mesh) -> List[int]:
     """dp rows whose devices (all mp columns) live on THIS process.
     Host-local data (experience blocks, host-replay batches) can only feed
@@ -574,13 +589,16 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             eps = apex_epsilon(gidx, nprocs * n_local, cfg.actor.base_eps,
                                cfg.actor.eps_alpha)
             heartbeats.reset_slot(i)
+            if tele_board is not None:
+                tele_board.reset_slot(i)
             p = ctx.Process(
                 target=actor_process_main,
                 args=(cfg.to_dict(), pid, gidx, eps, publisher.name,
                       queue._q, stop),
                 kwargs={**cfg.multiplayer.env_args(pid, gidx),
                         "total_actors": nprocs * n_local,
-                        "health_board": heartbeats, "health_slot": i},
+                        "health_board": heartbeats, "health_slot": i,
+                        "telemetry_board": tele_board},
                 daemon=True, name=f"actor-p{pid}h{rank}-{i}")
             p.start()
             return p
@@ -626,8 +644,9 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 cfg, i,
                 lambda b, should_stop=should_stop, slot=i: queue.put_patient(
                     b, should_stop,
-                    beat=lambda: heartbeats.touch(slot)),
-                board=heartbeats)
+                    beat=lambda: heartbeats.touch(slot),
+                    telemetry=tele),
+                board=heartbeats, telemetry=tele)
 
             def loop(env=env, policy=policy, run_loop=run_loop,
                      reader_id=i, sink=sink, should_stop=should_stop):
@@ -635,7 +654,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 run_loop(cfg, env, policy,
                          block_sink=sink,
                          weight_poll=lambda: store.poll(reader_id),
-                         should_stop=should_stop)
+                         should_stop=should_stop,
+                         telemetry=tele)
 
             t = threading.Thread(target=loop, daemon=True,
                                  name=f"actor-h{rank}-{i}")
@@ -653,11 +673,41 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     heartbeats = HeartbeatBoard(n_local)
     health = WorkerHealth.from_runtime(n_local, heartbeats, cfg.runtime)
 
+    # per-rank fleet telemetry (ISSUE 4) — host-local like the health
+    # subsystem (no collective state): thread actors observe straight into
+    # this rank's Telemetry; process actors publish through the shm board,
+    # which interval_summary() differences. Rank 0's summary joins the
+    # TrainMetrics record; other ranks append per-host rows.
+    # shm allocation ONLY here (no file I/O — that sits inside the try
+    # below, whose finally owns these segments' close())
+    from r2d2_tpu.telemetry import Telemetry, TelemetryBoard
+    tele = Telemetry.from_config(cfg, name=f"learner-h{rank}")
+    tele_board = None
+    if cfg.telemetry.enabled and actor_mode == "process":
+        tele_board = TelemetryBoard(n_local)
+        tele.attach_board(tele_board)
+
     # fleet construction onward sits inside the try: a spawn failure for
     # actor k must not orphan the k-1 already-running actor processes on a
     # live shm ring — the finally unwinds them (round-4 review)
     fleet = None
     try:
+        if cfg.telemetry.enabled:
+            resume = bool(cfg.runtime.resume)
+            if not resume:
+                # fresh run: clear this rank's actors' stale span files
+                # (the spawned processes APPEND so supervisor respawns
+                # keep their predecessors' spans)
+                for i in range(n_local):
+                    try:
+                        os.remove(os.path.join(
+                            cfg.runtime.save_dir or ".",
+                            f"spans_p{pid}_a{rank * n_local + i}.jsonl"))
+                    except OSError:
+                        pass
+            tele.start_drain(os.path.join(
+                cfg.runtime.save_dir or ".", f"spans_host{rank}.jsonl"),
+                append=resume)
         fleet = LocalActorFleet(
             spawn_actor, n_local, cfg.runtime.restart_dead_actors, stop,
             queue=queue if actor_mode == "process" else None,
@@ -666,7 +716,20 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         # pid-keyed logs/checkpoints: per-player jobs sharing a filesystem
         # write train_player{pid}.log and player-pid checkpoint dirs, like
         # the in-process population path (ref worker.py:35-37)
-        metrics = TrainMetrics(pid, cfg.runtime.save_dir) if rank == 0 else None
+        metrics = (TrainMetrics(pid, cfg.runtime.save_dir,
+                                resume=bool(cfg.runtime.resume))
+                   if rank == 0 else None)
+        if metrics is not None:
+            metrics.set_telemetry(tele)   # stages ride the rank-0 record
+        host_rows_path = os.path.join(
+            cfg.runtime.save_dir or ".", f"telemetry_host{rank}.jsonl")
+        if rank != 0 and tele.enabled:
+            os.makedirs(cfg.runtime.save_dir or ".", exist_ok=True)
+            if not cfg.runtime.resume:
+                # same append-on-resume contract as TrainMetrics: a
+                # preemption resume keeps the pod-wide telemetry history
+                open(host_rows_path, "w").close()
+        t_run_start = time.time()
         max_steps = max_training_steps or cfg.optim.training_steps
         deadline = time.time() + max_seconds if max_seconds else None
         rt = cfg.runtime
@@ -681,7 +744,11 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
 
         def flush_losses():
             if pending_losses and metrics is not None:
-                for arr in jax.device_get(pending_losses):
+                t0 = time.perf_counter()
+                arrays = jax.device_get(pending_losses)
+                tele.observe("learner/device_sync",
+                             time.perf_counter() - t0)
+                for arr in arrays:
                     for loss in np.atleast_1d(arr):
                         metrics.on_train_step(float(loss))
             pending_losses.clear()
@@ -710,10 +777,15 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 info = consense(len(host_replay), env_local,
                                 len(host_replay) > 0, local_stop)
             else:
+                t0 = time.perf_counter()
                 rs, cum_env, dev_info = ingest_fn(
                     rs, cum_env, *feed.build(block, local_stop))
                 info = {kk: int(v)
                         for kk, v in jax.device_get(dev_info).items()}
+                if block is not None:
+                    # only real ingests count — the pre-ready no-op spin
+                    # iterations would otherwise dominate the histogram
+                    tele.observe("ingest/commit", time.perf_counter() - t0)
             if debug:
                 print(f"[mh rank={rank} it={it}] step={step_count} "
                       f"block={block is not None} {info}", flush=True)
@@ -740,11 +812,16 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             if ready:
                 prev = step_count
                 if host_mode:
+                    t0 = time.perf_counter()
                     batch_np, snapshot = host_replay.sample(local_batch)
                     gbatch = jax.tree_util.tree_map(
                         lambda a: jax.make_array_from_process_local_data(
                             batch_sharding, np.asarray(a)), batch_np)
+                    t1 = time.perf_counter()
+                    tele.observe("learner/sample", t1 - t0)
                     ts, m = ext_step(ts, gbatch)
+                    tele.observe("learner/train_dispatch",
+                                 time.perf_counter() - t1)
                     # Pin the layout before the per-host split: the step is
                     # sharding-agnostic by design (its compiled output
                     # layout follows GSPMD's choice), so a compiler change
@@ -761,16 +838,25 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                             f"{len(batch_np.idxes)} sampled idxes "
                             "(dp-sharded step output no longer matches "
                             "this host's batch rows)")
+                    t0 = time.perf_counter()
                     host_replay.update_priorities(
                         batch_np.idxes, prios_local, snapshot)
+                    tele.observe("learner/priority_writeback",
+                                 time.perf_counter() - t0)
                 else:
+                    t0 = time.perf_counter()
                     ts, rs, m = step_fn(ts, rs)
+                    tele.observe("learner/train_dispatch",
+                                 time.perf_counter() - t0)
                 step_count += k
                 if metrics is not None:   # only rank 0 flushes; don't
                     pending_losses.append(m["loss"])   # accumulate elsewhere
                 boundary = lambda iv: iv and step_count // iv > prev // iv
                 if boundary(rt.weight_publish_interval):
+                    t0 = time.perf_counter()
                     publish(ts.params)
+                    tele.observe("weights/publish",
+                                 time.perf_counter() - t0)
                 if rank == 0 and boundary(rt.save_interval):
                     save_checkpoint(
                         rt.save_dir, cfg.env.game_name,
@@ -807,14 +893,22 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             if now - last_supervise >= rt.supervise_interval_s:
                 fleet.supervise()   # every host tends its own actor fleet
                 last_supervise = now
-            if metrics is not None and now - last_log >= rt.log_interval:
-                flush_losses()
-                metrics.env_steps = resumed_env + info["env_steps"]
-                metrics.set_buffer_size(info["buffer_steps"])
-                metrics.set_actor_health(health.snapshot())
-                record = metrics.log(now - last_log)
-                if log_fn:
-                    log_fn({"rank": rank, **record})
+            if now - last_log >= rt.log_interval:
+                if metrics is not None:
+                    flush_losses()
+                    metrics.env_steps = resumed_env + info["env_steps"]
+                    metrics.set_buffer_size(info["buffer_steps"])
+                    metrics.set_actor_health(health.snapshot())
+                    record = metrics.log(now - last_log)
+                    if log_fn:
+                        log_fn({"rank": rank, **record})
+                elif tele.enabled:
+                    # ranks > 0 have no TrainMetrics (rank 0 de-duplicates
+                    # side effects) but their pipeline still needs
+                    # observability: one aggregated per-host row per
+                    # interval
+                    _write_host_telemetry_row(host_rows_path, rank, tele,
+                                              t_run_start)
                 last_log = now
         flush_losses()
         # preemption-safe final checkpoint (same contract as the
@@ -845,6 +939,9 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             publisher.close()
         queue.close()    # releases/unlinks the shm ring (owner side)
         heartbeats.close()   # releases/unlinks the heartbeat board
+        tele.close()         # stops the drain thread, final flush
+        if tele_board is not None:
+            tele_board.close()
 
     return {"step": step_count, "env_steps": resumed_env + info["env_steps"],
             "buffer_steps": info["buffer_steps"], "params": ts.params,

@@ -9,6 +9,7 @@ boundary finish with bootstrap Q; pull fresh weights every
 hardcodes 400; here the config field is honored).
 """
 
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -17,6 +18,7 @@ from r2d2_tpu.actor.local_buffer import LocalBuffer
 from r2d2_tpu.actor.policy import ActorPolicy, BatchedActorPolicy
 from r2d2_tpu.config import Config
 from r2d2_tpu.replay.structs import ReplaySpec
+from r2d2_tpu.telemetry import NULL_TELEMETRY
 
 
 def make_actor_env(cfg: Config, player_idx: int, actor_idx: int, seed: int,
@@ -70,35 +72,47 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
 
 
 def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
-                          board=None) -> Callable:
-    """Health instrumentation around a block sink — the ONE wrapping point
-    shared by every actor spawner (thread, process, single-host,
-    multihost), so scalar and vector loops alike publish heartbeats and
-    honor ``actor.fault_spec`` without knowing about either. Order:
-    heartbeat first (the beat marks "reached the sink alive", so an
-    injected hang is detected on the regular ``hang_timeout_s`` clock, not
-    the spawn grace), then the fault, then the real sink. ``slot`` is the
-    fleet-local worker index (the HeartbeatBoard row and the fault-spec
-    key)."""
+                          board=None, telemetry=None) -> Callable:
+    """Health + telemetry instrumentation around a block sink — the ONE
+    wrapping point shared by every actor spawner (thread, process,
+    single-host, multihost), so scalar and vector loops alike publish
+    heartbeats, honor ``actor.fault_spec``, and time their block emits
+    without knowing about any of it. Order: telemetry outermost (an
+    injected fault's stall shows up in the 'actor/block_emit' tail —
+    that's the point), then heartbeat (the beat marks "reached the sink
+    alive", so an injected hang is detected on the regular
+    ``hang_timeout_s`` clock, not the spawn grace), then the fault, then
+    the real sink. ``slot`` is the fleet-local worker index (the
+    HeartbeatBoard row and the fault-spec key)."""
     wrapped = sink
     if cfg.actor.fault_spec:
         from r2d2_tpu.tools.chaos import apply_fault, parse_fault_spec
         fault = parse_fault_spec(cfg.actor.fault_spec).get(slot)
         if fault is not None:
             wrapped = apply_fault(wrapped, fault)
-    if board is None:
-        return wrapped
-
-    def sink_with_heartbeat(block, _wrapped=wrapped):
-        board.beat(slot)
-        return _wrapped(block)
-
-    return sink_with_heartbeat
+    if board is not None:
+        def sink_with_heartbeat(block, _wrapped=wrapped):
+            board.beat(slot)
+            return _wrapped(block)
+        wrapped = sink_with_heartbeat
+    if telemetry is not None and telemetry.enabled:
+        def sink_with_telemetry(block, _wrapped=wrapped):
+            t0 = time.time()
+            try:
+                return _wrapped(block)
+            finally:
+                t1 = time.time()
+                telemetry.observe("actor/block_emit", t1 - t0)
+                telemetry.record_span("actor/block_emit", t0, t1,
+                                      {"slot": slot})
+        wrapped = sink_with_telemetry
+    return wrapped
 
 
 def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
               weight_poll: Callable, should_stop: Callable[[], bool],
-              max_env_steps: Optional[int] = None) -> int:
+              max_env_steps: Optional[int] = None, *,
+              telemetry=None) -> int:
     """Returns total env steps taken. ``block_sink(block)`` ships a finished
     block; ``weight_poll()`` returns fresh params or None.
 
@@ -108,7 +122,7 @@ def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
     restart (round-3 advisor)."""
     try:
         return _run_actor(cfg, env, policy, block_sink, weight_poll,
-                          should_stop, max_env_steps)
+                          should_stop, max_env_steps, telemetry)
     finally:
         try:
             env.close()
@@ -118,7 +132,8 @@ def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
 
 def _run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
                weight_poll: Callable, should_stop: Callable[[], bool],
-               max_env_steps: Optional[int] = None) -> int:
+               max_env_steps: Optional[int] = None, telemetry=None) -> int:
+    tele = telemetry if telemetry is not None else NULL_TELEMETRY
     spec = ReplaySpec.from_config(cfg)
     lb = LocalBuffer(spec, policy.action_dim, cfg.optim.gamma,
                      cfg.optim.priority_eta)
@@ -131,8 +146,14 @@ def _run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
     counter = 0
 
     while not should_stop():
+        # per-step timing goes to histograms only (one integer increment
+        # each when telemetry is on; spans stay at block cadence)
+        t0 = time.perf_counter()
         action, q, hidden = policy.act()
+        t1 = time.perf_counter()
         next_obs, reward, done, _ = env.step(action)
+        tele.observe("actor/forward", t1 - t0)
+        tele.observe("actor/env_step", time.perf_counter() - t1)
         policy.observe(next_obs, action)
         lb.add(action, reward, next_obs, q, hidden)
         episode_steps += 1
@@ -153,9 +174,11 @@ def _run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
 
         counter += 1
         if counter >= cfg.actor.actor_update_interval:
+            t0 = time.perf_counter()
             params = weight_poll()
             if params is not None:
                 policy.update_params(params)
+            tele.observe("actor/weight_sync", time.perf_counter() - t0)
             counter = 0
 
         if max_env_steps is not None and total_steps >= max_env_steps:
@@ -166,7 +189,8 @@ def _run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
 def run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
                      block_sink: Callable, weight_poll: Callable,
                      should_stop: Callable[[], bool],
-                     max_env_steps: Optional[int] = None) -> int:
+                     max_env_steps: Optional[int] = None, *,
+                     telemetry=None) -> int:
     """The N-lane twin of ``run_actor``: one jitted (N, 1) policy forward
     steps every lane of a SyncVectorEnv per tick; each lane keeps its own
     LocalBuffer so block content is identical to N scalar actors' (parity-
@@ -176,7 +200,7 @@ def run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
     exit, same contract as run_actor."""
     try:
         return _run_vector_actor(cfg, venv, policy, block_sink, weight_poll,
-                                 should_stop, max_env_steps)
+                                 should_stop, max_env_steps, telemetry)
     finally:
         try:
             venv.close()
@@ -187,7 +211,9 @@ def run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
 def _run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
                       block_sink: Callable, weight_poll: Callable,
                       should_stop: Callable[[], bool],
-                      max_env_steps: Optional[int] = None) -> int:
+                      max_env_steps: Optional[int] = None,
+                      telemetry=None) -> int:
+    tele = telemetry if telemetry is not None else NULL_TELEMETRY
     spec = ReplaySpec.from_config(cfg)
     n = venv.num_envs
     if n != policy.num_lanes:
@@ -204,8 +230,14 @@ def _run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
     counter = 0
 
     while not should_stop():
+        # one forward + one vector-env step per tick: the timing unit the
+        # histograms see (a 16-lane tick counts once, covering 16 steps)
+        t0 = time.perf_counter()
         actions, qs, hiddens = policy.act()
+        t1 = time.perf_counter()
         next_obs, rewards, dones, infos = venv.step(actions)
+        tele.observe("actor/forward", t1 - t0)
+        tele.observe("actor/env_step", time.perf_counter() - t1)
         # advance every lane's policy state BEFORE per-lane bookkeeping:
         # the block-boundary bootstrap reads the post-step state (matching
         # the scalar loop's observe-then-bootstrap order), and done lanes
@@ -240,9 +272,11 @@ def _run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
 
         counter += n
         if counter >= cfg.actor.actor_update_interval:
+            t0 = time.perf_counter()
             params = weight_poll()
             if params is not None:
                 policy.update_params(params)
+            tele.observe("actor/weight_sync", time.perf_counter() - t0)
             counter = 0
 
         if max_env_steps is not None and total_steps >= max_env_steps:

@@ -15,7 +15,8 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                        epsilon: float, shm_name: str, queue, stop_event,
                        is_host: bool, port: int,
                        total_actors: int = None,
-                       health_board=None, health_slot: int = None) -> None:
+                       health_board=None, health_slot: int = None,
+                       telemetry_board=None) -> None:
     # total_actors: the GLOBAL worker-fleet size for the vector ε ladder —
     # multihost spawners pass process_count * num_actors with a global
     # actor_idx; None = single-host (cfg.actor.num_actors)
@@ -78,15 +79,37 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
     slot = actor_idx if health_slot is None else health_slot
     beat = ((lambda: health_board.touch(slot))
             if health_board is not None else None)
+
+    # telemetry: this process's stage timers publish into its slot of the
+    # shared board (the learner aggregates per log interval); spans drain
+    # to a per-process JSONL next to the training logs. The board handle
+    # crossed the spawn boundary by name, same lifecycle as the
+    # heartbeat board.
+    from r2d2_tpu.telemetry import Telemetry
+    tele = Telemetry.from_config(
+        cfg, name=f"actor-p{player_idx}-{actor_idx}",
+        board=telemetry_board, slot=slot)
+    if tele.enabled:
+        # append: a supervisor respawn must not wipe the previous
+        # incarnation's spans — the crash window is exactly what a
+        # post-mortem trace export wants (the spawner truncates stale
+        # files once per fresh run)
+        tele.start_drain(os.path.join(
+            cfg.runtime.save_dir or ".",
+            f"spans_p{player_idx}_a{actor_idx}.jsonl"), append=True)
+
     sink = instrument_block_sink(
         cfg, slot,
-        lambda b: put_patient(queue, b, stop_event.is_set, beat=beat),
-        board=health_board)
+        lambda b: put_patient(queue, b, stop_event.is_set, beat=beat,
+                              telemetry=tele),
+        board=health_board, telemetry=tele)
 
     try:
         run_loop(cfg, env, policy,
                  block_sink=sink,
                  weight_poll=sub.poll,
-                 should_stop=stop_event.is_set)
+                 should_stop=stop_event.is_set,
+                 telemetry=tele)
     finally:
+        tele.close()
         sub.close()   # env is closed by the run loop (its finally owns it)

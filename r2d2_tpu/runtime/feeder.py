@@ -23,7 +23,8 @@ from r2d2_tpu.replay.structs import Block
 
 
 def put_patient(q, block: Block, should_stop, poll: float = 0.5,
-                beat: Optional[Callable[[], None]] = None) -> bool:
+                beat: Optional[Callable[[], None]] = None,
+                telemetry=None) -> bool:
     """Blocking put that survives indefinite back-pressure (the rate
     limiter deliberately parks actors here) but still honors the stop
     signal. Returns False iff stopped before the block was accepted.
@@ -32,12 +33,18 @@ def put_patient(q, block: Block, should_stop, poll: float = 0.5,
     (actor_main imports this; BlockQueue.put_patient delegates).
     ``beat`` (the worker's HeartbeatBoard.touch) is called once per poll
     iteration so a deliberately parked producer keeps reading as ALIVE to
-    the hang watchdog — back-pressure is not a hang."""
+    the hang watchdog — back-pressure is not a hang. ``telemetry``
+    observes the whole entry-to-accepted wait as 'actor/queue_put' — the
+    stage whose tail IS the back-pressure signal."""
+    t0 = time.perf_counter()
     while not should_stop():
         if beat is not None:
             beat()
         try:
             q.put(block, timeout=poll)
+            if telemetry is not None:
+                telemetry.observe("actor/queue_put",
+                                  time.perf_counter() - t0)
             return True
         except queue_mod.Full:
             continue
@@ -455,8 +462,10 @@ class BlockQueue:
         self._q.put(block, timeout=timeout)
 
     def put_patient(self, block: Block, should_stop, poll: float = 0.5,
-                    beat: Optional[Callable[[], None]] = None) -> bool:
-        return put_patient(self._q, block, should_stop, poll, beat=beat)
+                    beat: Optional[Callable[[], None]] = None,
+                    telemetry=None) -> bool:
+        return put_patient(self._q, block, should_stop, poll, beat=beat,
+                           telemetry=telemetry)
 
     def drain(self, max_items: int = 16) -> List[Block]:
         """Non-blocking drain of up to max_items blocks."""

@@ -135,7 +135,8 @@ class Learner:
                     self._step_fn = make_learner_step(
                         net, self.spec, cfg.optim, cfg.network.use_double)
 
-        self.metrics = metrics or TrainMetrics(player_idx, cfg.runtime.save_dir)
+        self.metrics = metrics or TrainMetrics(player_idx, cfg.runtime.save_dir,
+                                               resume=bool(cfg.runtime.resume))
         self.publish: Optional[Callable] = None   # wired by orchestrator
 
         # Ring accounting: ONE RingAccountant per replay (VERDICT r2 weak
@@ -220,6 +221,14 @@ class Learner:
         self._staged_lock = threading.Lock()
         self._pause_started: Optional[float] = None
 
+    @property
+    def tele(self):
+        """The process Telemetry, read through metrics DYNAMICALLY: the
+        orchestrator attaches it to TrainMetrics (set_telemetry), possibly
+        after this Learner was constructed; a stale binding here would
+        silently observe into the NULL sink forever."""
+        return self.metrics.telemetry
+
     # -- ingestion --
 
     def ingest(self, block: Block) -> None:
@@ -302,10 +311,17 @@ class Learner:
             return 0
         t0 = time.time()
         blocks = queue.drain(max_items)
+        t_get = time.time()
         for blk in blocks:
             self.ingest(blk)
         if blocks:
-            self.metrics.on_ingest_drain(len(blocks), time.time() - t0)
+            t1 = time.time()
+            self.metrics.on_ingest_drain(len(blocks), t1 - t0)
+            tele = self.tele
+            tele.observe("ingest/ring_get", t_get - t0)
+            tele.observe("ingest/commit", t1 - t_get)
+            tele.record_span("ingest/commit", t0, t1,
+                             {"blocks": len(blocks)})
         return len(blocks)
 
     # -- pipelined ingestion (stager thread + commit) --
@@ -335,6 +351,7 @@ class Learner:
         commit time, on the main thread — so back-pressure and the
         device/host pointer mirror keep the per-block path's semantics."""
         k = len(metas)
+        t_commit = time.time()
         # the stager AOT-compiled this batch size before enqueueing
         exe = self._add_many_cache.get(k)
         if self.mesh is not None:
@@ -361,7 +378,10 @@ class Learner:
             self._staged_env_steps -= total
             self._staged_blocks -= k
         self.metrics.set_buffer_size(self.ring.buffer_steps)
-        self.metrics.on_ingest_drain(k, time.time() - t_pop)
+        now = time.time()
+        self.metrics.on_ingest_drain(k, now - t_pop)
+        self.tele.observe("ingest/commit", now - t_commit)
+        self.tele.record_span("ingest/commit", t_commit, now, {"blocks": k})
         return k
 
     def _compile_add_many(self, kb: int):
@@ -433,6 +453,8 @@ class Learner:
                     if k == 0:
                         time.sleep(0.001)
                         continue
+                    self.tele.observe("ingest/ring_get",
+                                      time.time() - t_pop)
                     if k not in self._add_many_cache:
                         # odd size (qsize-less backend): compile HERE
                         # (stager thread), never at commit
@@ -459,6 +481,13 @@ class Learner:
                                                    PartitionSpec()))
                     else:
                         staged = jax.device_put(stacked)
+                    now = time.time()
+                    # stage = pop + stack + host->device launch; the wait
+                    # for a staging-queue slot below is back-pressure, not
+                    # staging work, and stays out of the histogram
+                    self.tele.observe("ingest/stage", now - t_pop)
+                    self.tele.record_span("ingest/stage", t_pop, now,
+                                          {"blocks": k})
                     while not self._ingest_stop.is_set():
                         try:
                             self._ingest_q.put((staged, metas, t_pop),
@@ -505,8 +534,10 @@ class Learner:
         def prefetch():
             try:
                 while not self._bg_stop.is_set():
+                    t0 = time.time()
                     batch, snapshot = self.host_replay.sample()
                     dev = self._place_batch(batch)
+                    self.tele.observe("learner/sample", time.time() - t0)
                     while not self._bg_stop.is_set():
                         try:
                             self._prefetch_q.put((dev, snapshot), timeout=0.5)
@@ -524,9 +555,12 @@ class Learner:
                         idxes, prios, snapshot = self._writeback_q.get(timeout=0.5)
                     except queue_mod.Empty:
                         continue
+                    t0 = time.time()
                     self.host_replay.update_priorities(
                         np.asarray(idxes), np.asarray(jax.device_get(prios)),
                         snapshot)
+                    self.tele.observe("learner/priority_writeback",
+                                      time.time() - t0)
             except BaseException as e:
                 self._bg_error = e
                 raise
@@ -619,11 +653,19 @@ class Learner:
         host-mirrored. Publish/checkpoint fire when their interval boundary
         falls inside the dispatched step range."""
         prev = self._host_step
+        t0 = time.time()
         if self.host_mode:
             m = self._host_step_once()
         else:
             self.train_state, self.replay_state, m = self._step_fn(
                 self.train_state, self.replay_state)
+        t1 = time.time()
+        tele = self.tele
+        # host-side dispatch cost (the device executes asynchronously;
+        # device occupancy is what xprof captures measure)
+        tele.observe("learner/train_dispatch", t1 - t0)
+        tele.record_span("learner/train_dispatch", t0, t1,
+                         {"k": self._k, "step": prev})
         self._host_step += self._k
         step = self._host_step
         self._pending_losses.append(m["loss"])  # scalar (k=1) or (k,) array
@@ -632,7 +674,9 @@ class Learner:
         if (self.publish is not None
                 and step // rt.weight_publish_interval
                     > prev // rt.weight_publish_interval):
+            t0 = time.time()
             self.publish(self.train_state.params)
+            tele.observe("weights/publish", time.time() - t0)
         if rt.save_interval and step // rt.save_interval > prev // rt.save_interval:
             self.save(step // rt.save_interval)
         return m
@@ -641,7 +685,12 @@ class Learner:
         """Convert accumulated device losses to host floats (ONE sync for the
         whole interval) and feed the training counters."""
         if self._pending_losses:
+            t0 = time.time()
             arrays = jax.device_get(self._pending_losses)
+            t1 = time.time()
+            self.tele.observe("learner/device_sync", t1 - t0)
+            self.tele.record_span("learner/device_sync", t0, t1,
+                                  {"losses": len(self._pending_losses)})
             self._pending_losses.clear()
             for loss in np.concatenate([np.atleast_1d(a) for a in arrays]):
                 self.metrics.on_train_step(float(loss))

@@ -18,19 +18,32 @@ import numpy as np
 
 class TrainMetrics:
     def __init__(self, player_idx: int = 0, log_dir: str = ".",
-                 jsonl: bool = True):
+                 jsonl: bool = True, resume: bool = False):
         self.player_idx = player_idx
-        os.makedirs(log_dir, exist_ok=True) if log_dir else None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
         self.logger = logging.getLogger(f"r2d2_tpu.player_{player_idx}")
         self.logger.setLevel(logging.INFO)
         self.logger.propagate = False
         path = os.path.join(log_dir or ".", f"train_player{player_idx}.log")
-        handler = logging.FileHandler(path, "w")
+        # resume=True (runtime.resume set): APPEND — a preempted run
+        # resuming from its final checkpoint must not wipe the log/JSONL
+        # history the plots and the inspector are built from; a fresh run
+        # truncates both (the JSONL is opened "a" per record, so it needs
+        # the explicit truncation here).
+        handler = logging.FileHandler(path, "a" if resume else "w")
         handler.setFormatter(logging.Formatter("%(message)s"))
         self.logger.handlers = [handler]
         self._jsonl_path = (os.path.join(log_dir or ".", f"metrics_player{player_idx}.jsonl")
                             if jsonl else None)
+        if self._jsonl_path and not resume:
+            open(self._jsonl_path, "w").close()
         self._start = time.time()
+        # telemetry aggregator (set_telemetry): owns the stage timers this
+        # record's 'stages' block summarizes. NULL keeps learner-only
+        # constructions working with zero branching at the call sites.
+        from r2d2_tpu.telemetry import NULL_TELEMETRY
+        self.telemetry = NULL_TELEMETRY
 
         self.buffer_size = 0
         self.env_steps = 0
@@ -101,6 +114,12 @@ class TrainMetrics:
     def set_ingest_queue_depth(self, depth: int) -> None:
         """Staged batches awaiting commit (pipelined ingestion gauge)."""
         self.ingest_queue_depth = int(depth)
+
+    def set_telemetry(self, telemetry) -> None:
+        """Attach the process's Telemetry: log() then emits the aggregated
+        per-interval 'stages' block (P50/P95/P99 per pipeline stage,
+        fleet-wide when an actor TelemetryBoard is attached to it)."""
+        self.telemetry = telemetry
 
     def set_actor_health(self, snapshot: dict) -> None:
         """Supervision counters (WorkerHealth.snapshot + stall-dump count)
@@ -188,6 +207,14 @@ class TrainMetrics:
             self._ingest_blocks = 0
             self._ingest_latency_sum = 0.0
             self._ingest_pause_time = 0.0
+        if self.telemetry.enabled:
+            # ONE aggregated block per interval covering the whole fleet:
+            # learner-local stage timers merged with the actor board's
+            # per-slot deltas (ISSUE 4). Omitted entirely when telemetry
+            # is off — consumers key on its presence, and the PR-2/3 keys
+            # above are unaffected either way (schema-stability-tested).
+            record["stages"] = self.telemetry.interval_summary()
+            record["telemetry_dropped_spans"] = self.telemetry.spans.dropped
         if self._jsonl_path:
             with open(self._jsonl_path, "a") as f:
                 f.write(json.dumps(record) + "\n")

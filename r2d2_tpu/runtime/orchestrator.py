@@ -18,13 +18,11 @@ on port base+i; actor i of every other player joins that game.
 """
 
 import multiprocessing as mp
+import os
 import signal
 import threading
 import time
 from typing import Callable, List, Optional
-
-import jax
-import numpy as np
 
 from r2d2_tpu.config import Config, apex_epsilon
 from r2d2_tpu.envs.factory import create_env
@@ -46,7 +44,20 @@ class PlayerStack:
         self.player_idx = player_idx
         self.net = NetworkApply(action_dim, cfg.network, cfg.env.frame_stack,
                                 cfg.env.frame_height, cfg.env.frame_width)
-        self.metrics = TrainMetrics(player_idx, cfg.runtime.save_dir)
+        self.metrics = TrainMetrics(player_idx, cfg.runtime.save_dir,
+                                    resume=bool(cfg.runtime.resume))
+        # unified telemetry (ISSUE 4): ONE Telemetry for this process
+        # (learner threads + thread actors observe straight into it);
+        # process actors publish through the shm board, which the
+        # aggregator differences per log interval. Attached to metrics
+        # BEFORE Learner construction so the learner's stage observes
+        # never land in the NULL sink; the board's shm allocation happens
+        # at the END of __init__ so nothing can raise past a live segment.
+        from r2d2_tpu.telemetry import Telemetry
+        self.telemetry = Telemetry.from_config(
+            cfg, name=f"learner-p{player_idx}")
+        self.tele_board = None
+        self.metrics.set_telemetry(self.telemetry)
         self.learner = Learner(cfg, self.net, player_idx, metrics=self.metrics)
         self.threads: List[threading.Thread] = []
         self.processes: List[mp.Process] = []
@@ -65,6 +76,37 @@ class PlayerStack:
         self.publisher = None
         self.store = None
         self.queue: Optional[BlockQueue] = None
+        # LAST: telemetry board shm + the span-drain's file I/O. Anything
+        # raising after an shm allocation would leak the segment (train()
+        # only closes stacks that made it into its list), so the file I/O
+        # is guarded to unwind BOTH boards created above.
+        if cfg.telemetry.enabled:
+            from r2d2_tpu.telemetry import TelemetryBoard
+            self.tele_board = TelemetryBoard(cfg.actor.num_actors)
+            self.telemetry.attach_board(self.tele_board)
+            try:
+                resume = bool(cfg.runtime.resume)
+                save_dir = cfg.runtime.save_dir or "."
+                if not resume:
+                    # fresh run: clear stale actor span files from a
+                    # previous run of this save_dir (actor processes
+                    # APPEND so respawns keep their predecessors' spans —
+                    # this is the one place that truncates, once per run)
+                    import glob
+                    for stale in glob.glob(os.path.join(
+                            save_dir, f"spans_p{player_idx}_a*.jsonl")):
+                        try:
+                            os.remove(stale)
+                        except OSError:
+                            pass
+                self.telemetry.start_drain(
+                    os.path.join(save_dir,
+                                 f"spans_player{player_idx}.jsonl"),
+                    append=resume)
+            except BaseException:
+                self.tele_board.close()
+                self.heartbeats.close()
+                raise
 
     def actor_env_args(self, actor_idx: int):
         """Multiplayer host/join wiring (ref train.py:33-38; shared with
@@ -110,8 +152,9 @@ class PlayerStack:
             cfg, i,
             lambda b: self.queue.put_patient(
                 b, should_stop,
-                beat=lambda: self.heartbeats.touch(i)),
-            board=self.heartbeats)
+                beat=lambda: self.heartbeats.touch(i),
+                telemetry=self.telemetry),
+            board=self.heartbeats, telemetry=self.telemetry)
 
         def loop(env=env, policy=policy, run_loop=run_loop, reader_id=i,
                  sink=sink, should_stop=should_stop):
@@ -119,7 +162,8 @@ class PlayerStack:
             run_loop(cfg, env, policy,
                      block_sink=sink,
                      weight_poll=lambda: self.store.poll(reader_id),
-                     should_stop=should_stop)
+                     should_stop=should_stop,
+                     telemetry=self.telemetry)
 
         t = threading.Thread(target=loop, daemon=True,
                              name=f"actor-p{self.player_idx}-{i}")
@@ -148,12 +192,17 @@ class PlayerStack:
         eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
                            cfg.actor.eps_alpha)
         self.heartbeats.reset_slot(i)
+        if self.tele_board is not None:
+            # fresh incarnation: cumulative telemetry counts restart at
+            # zero (the aggregator's reset detection handles the edge)
+            self.tele_board.reset_slot(i)
         p = self._ctx.Process(
             target=actor_process_main,
             args=(cfg.to_dict(), self.player_idx, i, eps,
                   self.publisher.name, self.queue._q, self._stop),
             kwargs={**self.actor_env_args(i),
-                    "health_board": self.heartbeats, "health_slot": i},
+                    "health_board": self.heartbeats, "health_slot": i,
+                    "telemetry_board": self.tele_board},
             daemon=True, name=f"actor-p{self.player_idx}-{i}")
         p.start()
         if i < len(self.processes):
@@ -180,11 +229,14 @@ class PlayerStack:
             return 0
         restart = self.cfg.runtime.restart_dead_actors
         restarted = 0
-        if restart:
-            restarted += supervise_workers(
-                self.threads, self._seen_dead,
-                respawn=self._spawn_thread_actor,
-                health=self.health)
+        # threads are scanned even with restarts off (respawn=None), like
+        # processes below: the hang watchdog must still flag a wedged
+        # thread and feed the failure counters — restart_dead_actors
+        # gates RESPAWNING, not detection
+        restarted += supervise_workers(
+            self.threads, self._seen_dead,
+            respawn=self._spawn_thread_actor if restart else None,
+            health=self.health)
         restarted += supervise_workers(
             self.processes, self._seen_dead,
             respawn=self._spawn_process_actor if restart else None,
@@ -244,6 +296,9 @@ class PlayerStack:
         if self.queue is not None:
             self.queue.close()   # releases/unlinks the shm ring (owner)
         self.heartbeats.close()  # releases/unlinks the heartbeat board
+        self.telemetry.close()   # stops the drain thread, final flush
+        if self.tele_board is not None:
+            self.tele_board.close()
 
 
 def train(cfg: Config, *, max_training_steps: Optional[int] = None,
@@ -290,6 +345,19 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
     # artifacts). Only the main thread may install handlers; restored below.
     prev_handlers = {}
     stacks: List[PlayerStack] = []
+    # profiler capture state (telemetry/profiler.py owns the trace
+    # lifecycle — start/stop are idempotent, so the finally below can
+    # always stop without tracking which trigger started it). Triggers:
+    # legacy first-interval (profile_dir set), runtime.profile_at_step
+    # (one-shot, fires when the learner step counter first reaches it),
+    # and SIGUSR2 (on demand, any number of times).
+    from r2d2_tpu.telemetry import ProfilerCapture
+    prof = ProfilerCapture()
+    prof_dir = cfg.runtime.profile_dir or os.path.join(
+        cfg.runtime.save_dir or ".", "xprof")
+    prof_window = min(cfg.runtime.log_interval, 30.0)
+    profile_at_armed = cfg.runtime.profile_at_step > 0
+    profile_request = threading.Event()
     try:
         # Everything after handler installation sits inside this try so the
         # finally always restores them — even when stack construction or
@@ -312,6 +380,16 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
                     prev_handlers[sig] = signal.signal(sig, _on_signal)
                 except (ValueError, OSError):
                     pass
+
+            def _on_usr2(signum, frame):
+                # handler only flags; the loop starts the capture outside
+                # signal context (jax.profiler is not async-signal-safe)
+                profile_request.set()
+            try:
+                prev_handlers[signal.SIGUSR2] = signal.signal(
+                    signal.SIGUSR2, _on_usr2)
+            except (ValueError, OSError, AttributeError):
+                pass
 
         # player_id >= 0: this job runs exactly ONE player of the
         # population (per-player-job composition — README "Multiplayer at
@@ -373,11 +451,11 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
                 st.learner.save(0)
 
         # optional jax.profiler trace of the first training interval
-        # (SURVEY §5.1 — the reference has no profiling at all)
-        profiling = bool(cfg.runtime.profile_dir)
-        if profiling:
-            jax.profiler.start_trace(cfg.runtime.profile_dir)
-            profile_until = time.time() + min(cfg.runtime.log_interval, 30.0)
+        # (SURVEY §5.1 — the reference has no profiling at all); capture
+        # lifecycle owned by ProfilerCapture so an exception anywhere can
+        # neither leave a trace running nor stop a dead one
+        if cfg.runtime.profile_dir:
+            prof.start(cfg.runtime.profile_dir, prof_window)
 
         while (not timed_out() and not stop.is_set()
                and any(st.learner.training_steps < max_steps for st in stacks)):
@@ -386,9 +464,23 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
                 if st.learner.ready and st.learner.training_steps < max_steps:
                     st.learner.step()
             now = time.time()
-            if profiling and now > profile_until:
-                jax.profiler.stop_trace()
-                profiling = False
+            prof.poll(now)
+            if profile_at_armed and any(
+                    st.learner.training_steps
+                    >= cfg.runtime.profile_at_step for st in stacks):
+                # mid-run steady-state capture (one-shot): the step
+                # counter first crossed runtime.profile_at_step. Disarm
+                # only on a REAL start — start() refuses while another
+                # capture (e.g. the first-interval one) is still live,
+                # and the knob's capture must then fire once it ends,
+                # not be silently lost.
+                if prof.start(prof_dir, prof_window):
+                    profile_at_armed = False
+            if profile_request.is_set():
+                # SIGUSR2: on demand; the request stays pending across a
+                # still-live capture window for the same reason
+                if prof.start(prof_dir, prof_window):
+                    profile_request.clear()
             if supervise_due():
                 for st in stacks:
                     st.supervise()
@@ -399,11 +491,10 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
                     if log_fn:
                         log_fn({"player": st.player_idx, **record})
                 last_log = now
-        if profiling:
-            jax.profiler.stop_trace()
         for st in stacks:
             st.learner.flush_metrics()
     finally:
+        prof.stop()   # idempotent: no-op unless a capture is live
         stop.set()
         for st in stacks:
             # preemption-safe final checkpoint: a clean stop (SIGTERM/

@@ -1,0 +1,39 @@
+"""Unified runtime telemetry (ISSUE 4).
+
+Three layers, all behind the ``telemetry.enabled`` kill-switch:
+
+  * **Percentile stage timers** (histogram.py, core.StageTimers):
+    fixed-bucket log-scale histograms — one integer increment per
+    observation on the hot path — giving P50/P95/P99 per pipeline stage,
+    mergeable across threads and processes by elementwise addition.
+  * **Span tracer** (spans.py): thread-local ring buffers of
+    (name, t_start, t_end, tags) events at block cadence, drained
+    off-thread to JSONL; ``tools/inspect.py`` exports Chrome-trace JSON
+    for Perfetto, viewable alongside an xprof capture.
+  * **Cross-process aggregation** (board.py): actor processes publish
+    cumulative histogram counts into a shared-memory board on the flush
+    cadence; the learner differences it per log interval so
+    ``TrainMetrics.log`` emits ONE fleet-wide aggregated record.
+
+``profiler.ProfilerCapture`` owns jax.profiler trace lifecycles (the
+first-interval capture, mid-run ``runtime.profile_at_step`` / SIGUSR2
+triggers, and tools/profile_step.py all share it).
+"""
+
+from r2d2_tpu.telemetry.board import TelemetryBoard
+from r2d2_tpu.telemetry.core import (NULL_TELEMETRY, STAGE_INDEX, STAGES,
+                                     StageTimers, Telemetry,
+                                     summarize_matrix)
+from r2d2_tpu.telemetry.histogram import (NBUCKETS, LogHistogram,
+                                          bucket_bounds, bucket_index,
+                                          bucket_mid, percentile, summarize)
+from r2d2_tpu.telemetry.profiler import ProfilerCapture, trace
+from r2d2_tpu.telemetry.spans import SpanTracer, chrome_trace_events
+
+__all__ = [
+    "NBUCKETS", "NULL_TELEMETRY", "STAGES", "STAGE_INDEX",
+    "LogHistogram", "ProfilerCapture", "SpanTracer", "StageTimers",
+    "Telemetry", "TelemetryBoard", "bucket_bounds", "bucket_index",
+    "bucket_mid", "chrome_trace_events", "percentile", "summarize",
+    "summarize_matrix", "trace",
+]

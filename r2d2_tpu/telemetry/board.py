@@ -1,0 +1,122 @@
+"""Cross-process histogram aggregation: the shared-memory telemetry board.
+
+Actor PROCESSES cannot feed the learner's in-process stage timers, and
+shipping timing events through the experience queue would put telemetry
+on the data path. Instead each actor slot owns one row of a
+``multiprocessing.shared_memory`` table of CUMULATIVE histogram counts —
+(n_slots, n_stages * NBUCKETS) int64 — and publishes by overwriting its
+row on the telemetry flush cadence (core.py drain thread; publishing is
+one vectorized row store, off the policy hot path). The learner side
+reads the whole table per log interval and differences it against the
+previous read, so each interval's aggregated percentiles cover exactly
+that interval's fleet-wide observations. Same pickle/attach lifecycle as
+the HeartbeatBoard (runtime/feeder.py): the handle crosses the spawn
+boundary by name, the creator owns and unlinks the region.
+
+Torn reads are tolerated by design: a row store is not atomic, so a read
+racing a publish can see a row mid-write. Counts are cumulative and
+monotonic per slot, so the torn buckets surface in the NEXT interval's
+delta instead of being lost. A respawned actor restarts its row from
+zero; the reader treats any count decrease as a slot reset and takes the
+fresh cumulative row as that interval's delta.
+"""
+
+import time
+from multiprocessing import shared_memory
+from typing import Optional
+
+import numpy as np
+
+from r2d2_tpu.telemetry.histogram import NBUCKETS
+
+
+class TelemetryBoard:
+    def __init__(self, n_slots: int, n_stages: Optional[int] = None,
+                 _attach_name: Optional[str] = None):
+        if n_stages is None:
+            from r2d2_tpu.telemetry.core import STAGES
+            n_stages = len(STAGES)
+        self.n_slots = n_slots
+        self.n_stages = n_stages
+        self._owner = _attach_name is None
+        self._shm = None
+        self._arr = None
+        self._final = None     # post-close snapshot for post-mortem reads
+        self._prev = None      # owner-side last-read snapshot (take_deltas)
+        if self._owner:
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=n_slots * n_stages * NBUCKETS * 8)
+            self._bind()
+            self._arr[:] = 0
+        else:
+            self._name = _attach_name
+
+    def __getstate__(self):
+        return {"n_slots": self.n_slots, "n_stages": self.n_stages,
+                "name": self.name}
+
+    def __setstate__(self, state):
+        self.__init__(state["n_slots"], state["n_stages"],
+                      _attach_name=state["name"])
+
+    @property
+    def name(self) -> str:
+        return self._shm.name if self._shm is not None else self._name
+
+    def _bind(self) -> None:
+        self._arr = np.ndarray((self.n_slots, self.n_stages * NBUCKETS),
+                               np.int64, self._shm.buf)
+
+    def _ensure(self) -> np.ndarray:
+        if self._shm is None:
+            if self._final is not None:
+                return self._final
+            from r2d2_tpu.runtime.weights import untrack_attached_shm
+            self._shm = shared_memory.SharedMemory(name=self._name)
+            untrack_attached_shm(self._shm)
+            self._bind()
+        return self._arr
+
+    def publish(self, slot: int, counts: np.ndarray) -> None:
+        """Overwrite this slot's row with the worker's CUMULATIVE
+        (n_stages, NBUCKETS) counts matrix — one vectorized store."""
+        self._ensure()[slot] = counts.reshape(-1)
+
+    def read(self) -> np.ndarray:
+        """Snapshot of the whole table as (n_slots, n_stages, NBUCKETS)."""
+        return (self._ensure().copy()
+                .reshape(self.n_slots, self.n_stages, NBUCKETS))
+
+    def reset_slot(self, slot: int) -> None:
+        """Fresh incarnation (actor respawn): zero the row so the new
+        worker's cumulative counts start clean. The reader's reset
+        detection handles the discontinuity."""
+        self._ensure()[slot] = 0
+
+    def take_deltas(self) -> np.ndarray:
+        """Owner-side interval read: per-stage counts observed fleet-wide
+        since the previous call, summed over slots -> (n_stages, NBUCKETS).
+        A slot whose counts DECREASED anywhere was reset (respawn); its
+        fresh cumulative row counts as that interval's delta."""
+        cur = self.read()
+        if self._prev is None:
+            delta = cur
+        else:
+            delta = cur - self._prev
+            reset = (delta < 0).any(axis=(1, 2))
+            delta[reset] = cur[reset]
+        self._prev = cur
+        return delta.sum(axis=0)
+
+    def close(self) -> None:
+        if self._shm is None:
+            return
+        self._final = self._arr.copy()
+        self._arr = None
+        self._shm.close()
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+        self._shm = None

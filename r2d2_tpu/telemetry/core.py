@@ -1,0 +1,207 @@
+"""Telemetry facade: stage timers + span tracer + publication, one object
+per process (the learner process shares one across its threads; each
+spawned actor process builds its own bound to a TelemetryBoard slot).
+
+Kill-switch: ``telemetry.enabled=false`` turns every entry point into a
+cheap no-op (one attribute check); the module-level NULL_TELEMETRY serves
+call sites that received no telemetry at all, so instrumented code never
+branches on None. Overhead with telemetry ON is budgeted < 2% env-steps/s
+(tools/e2e_bench.py --telemetry-ab measures it; PERF.md records the A/B).
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from r2d2_tpu.telemetry.histogram import NBUCKETS, summarize
+from r2d2_tpu.telemetry.spans import SpanTracer
+
+# The canonical pipeline stages — ONE fixed, ordered list shared by local
+# timers, the shm board layout, and the aggregated record, so counts merge
+# elementwise everywhere. Actor-side stages are published through the
+# board by process actors (thread actors observe straight into the
+# learner's local timers); learner-side stages are always local.
+STAGES = (
+    "actor/env_step",             # venv/env .step per tick
+    "actor/forward",              # jitted policy forward per tick
+    "actor/block_emit",           # whole block sink call (incl. queue wait)
+    "actor/queue_put",            # time inside put_patient (back-pressure)
+    "actor/weight_sync",          # weight_poll + policy.update_params
+    "ingest/ring_get",            # feeder drain: shm ring pop / queue get
+    "ingest/stage",               # stager: stack + host->device + enqueue
+    "ingest/commit",              # replay_add / add_many commit dispatch
+    "learner/sample",             # host-placement prefetch sample
+    "learner/train_dispatch",     # fused-step dispatch (host-side)
+    "learner/device_sync",        # flush_metrics device readback
+    "learner/priority_writeback", # host-placement async priority update
+    "weights/publish",            # learner -> weight service publish
+)
+STAGE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(STAGES)}
+
+
+class StageTimers:
+    """Per-process cumulative histogram matrix, (len(STAGES), NBUCKETS)
+    int64. ``observe`` is the hot entry point: one bucket_index + one
+    locked increment (stage cadence is per-tick at worst, so the lock is
+    uncontended in practice; it exists because the stager, write-back,
+    actor threads, and the main loop all observe into one matrix)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._m = np.zeros((len(STAGES), NBUCKETS), np.int64)
+        self._prev = np.zeros_like(self._m)
+
+    def observe(self, stage: str, seconds: float) -> None:
+        from r2d2_tpu.telemetry.histogram import bucket_index
+        row = STAGE_INDEX[stage]          # typo'd stage -> KeyError, loudly
+        with self._lock:
+            self._m[row, bucket_index(seconds)] += 1
+
+    def cumulative(self) -> np.ndarray:
+        with self._lock:
+            return self._m.copy()
+
+    def take(self) -> np.ndarray:
+        """Counts observed since the previous take() -> (stages, buckets)."""
+        with self._lock:
+            cur = self._m.copy()
+        delta = cur - self._prev
+        self._prev = cur
+        return delta
+
+
+def summarize_matrix(matrix: np.ndarray) -> Dict[str, Dict[str, float]]:
+    """{stage: {count, p50_ms, p95_ms, p99_ms}} for every stage with data."""
+    out = {}
+    for i, name in enumerate(STAGES):
+        s = summarize(matrix[i])
+        if s is not None:
+            out[name] = s
+    return out
+
+
+class Telemetry:
+    """One per process. ``board``/``slot``: publication target for worker
+    processes (the owner side instead passes the board to
+    ``interval_summary`` via ``attach_board``)."""
+
+    def __init__(self, enabled: bool = True, ring_size: int = 4096,
+                 flush_interval_s: float = 5.0, spans: bool = True,
+                 name: str = "main", board=None, slot: Optional[int] = None):
+        self.enabled = enabled
+        self.name = name
+        self.flush_interval_s = flush_interval_s
+        self.timers = StageTimers()
+        self.spans = SpanTracer(ring_size, enabled=enabled and spans)
+        self._board = board          # worker side: publish target
+        self._slot = slot
+        self._agg_board = None       # owner side: aggregation source
+        self._spans_path: Optional[str] = None
+        self._drain_stop: Optional[threading.Event] = None
+        self._drain_thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_config(cls, cfg, name: str = "main", board=None,
+                    slot: Optional[int] = None) -> "Telemetry":
+        """Build from a Config (duck-typed: anything carrying a
+        ``telemetry`` section with the TelemetryConfig fields)."""
+        t = cfg.telemetry
+        return cls(enabled=t.enabled, ring_size=t.ring_size,
+                   flush_interval_s=t.flush_interval_s, spans=t.spans,
+                   name=name, board=board, slot=slot)
+
+    # -- hot-path entry points --
+
+    def observe(self, stage: str, seconds: float) -> None:
+        if self.enabled:
+            self.timers.observe(stage, seconds)
+
+    def record_span(self, name: str, t_start: float, t_end: float,
+                    tags: Optional[dict] = None) -> None:
+        self.spans.record(name, t_start, t_end, tags)
+
+    def span(self, name: str, **tags):
+        return self.spans.span(name, **tags)
+
+    # -- publication / aggregation --
+
+    def attach_board(self, board) -> None:
+        """Owner side: fold this board's per-interval deltas into
+        interval_summary() (the learner aggregating its actor fleet)."""
+        self._agg_board = board
+
+    def flush(self) -> None:
+        """Publish cumulative counts to the board (worker side) and append
+        drained spans to the spans file, if configured."""
+        if not self.enabled:
+            return
+        if self._board is not None and self._slot is not None:
+            self._board.publish(self._slot, self.timers.cumulative())
+        if self._spans_path:
+            events = self.spans.drain()
+            if events:
+                with open(self._spans_path, "a") as f:
+                    for ev in events:
+                        ev["pid"] = self.name
+                        f.write(json.dumps(ev) + "\n")
+
+    def interval_summary(self) -> Dict[str, Dict[str, float]]:
+        """The aggregated per-interval record: local observations since
+        the last call, merged with the attached board's fleet-wide deltas.
+        Consumes the interval — call once per log boundary."""
+        if not self.enabled:
+            return {}
+        matrix = self.timers.take()
+        if self._agg_board is not None:
+            matrix = matrix + self._agg_board.take_deltas()
+        return summarize_matrix(matrix)
+
+    # -- background drain --
+
+    def start_drain(self, spans_path: Optional[str] = None,
+                    append: bool = False) -> None:
+        """Start the off-thread drain loop: every flush_interval_s,
+        publish board counts and append spans to ``spans_path`` (JSONL).
+        ``append=False`` truncates at start (a fresh run's file);
+        ``append=True`` keeps what's there — respawned actor processes
+        and resumed runs must not wipe the history a post-mortem needs."""
+        if not self.enabled or self._drain_thread is not None:
+            return
+        if spans_path and self.spans.enabled:
+            os.makedirs(os.path.dirname(spans_path) or ".", exist_ok=True)
+            if not append:
+                open(spans_path, "w").close()
+            self._spans_path = spans_path
+        self._drain_stop = threading.Event()
+
+        def loop():
+            while not self._drain_stop.wait(self.flush_interval_s):
+                try:
+                    self.flush()
+                except (OSError, ValueError):
+                    # a torn-down board/file at shutdown must not kill the
+                    # drain thread loudly; the final flush in close() is
+                    # best-effort too
+                    pass
+
+        self._drain_thread = threading.Thread(
+            target=loop, daemon=True, name=f"telemetry-drain-{self.name}")
+        self._drain_thread.start()
+
+    def close(self) -> None:
+        if self._drain_stop is not None:
+            self._drain_stop.set()
+            self._drain_thread.join(timeout=2.0)
+            self._drain_thread = None
+            self._drain_stop = None
+        try:
+            self.flush()
+        except (OSError, ValueError):
+            pass
+
+
+NULL_TELEMETRY = Telemetry(enabled=False, spans=False, name="null")

@@ -1,0 +1,81 @@
+"""One owner for ``jax.profiler`` trace lifecycles.
+
+The orchestrator used to inline start_trace/stop_trace with two separate
+stop sites; an exception raised between the start and the first stop
+skipped the in-loop stop but could still reach the second, stopping a
+dead trace (and conversely a propagating exception could leave the trace
+running). ProfilerCapture makes start/stop idempotent and gives the run
+loop a single ``poll(now)`` to end a bounded capture — shared by the
+first-interval capture, the mid-run ``runtime.profile_at_step`` /
+SIGUSR2 triggers (runtime/orchestrator.py), and the step profiler
+(tools/profile_step.py via ``trace``).
+"""
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class ProfilerCapture:
+    def __init__(self):
+        self.active = False
+        self.captures = 0
+        self._until: Optional[float] = None
+        self.out_dir: Optional[str] = None
+
+    def start(self, out_dir: str, duration_s: Optional[float] = None) -> bool:
+        """Begin a capture; returns False (and changes nothing) when one
+        is already running. ``duration_s`` arms poll()-driven stop."""
+        if self.active:
+            return False
+        import jax
+        try:
+            jax.profiler.start_trace(out_dir)
+        except RuntimeError as e:
+            # another trace is live in this process (e.g. an outer tool's
+            # capture) — skip rather than corrupt it
+            logging.getLogger(__name__).warning(
+                "profiler capture skipped: %s", e)
+            return False
+        self.active = True
+        self.out_dir = out_dir
+        self._until = (time.time() + duration_s
+                       if duration_s is not None else None)
+        return True
+
+    def poll(self, now: Optional[float] = None) -> bool:
+        """Stop a bounded capture whose window elapsed; returns True if a
+        capture was stopped."""
+        if not self.active or self._until is None:
+            return False
+        if (time.time() if now is None else now) < self._until:
+            return False
+        self.stop()
+        return True
+
+    def stop(self) -> None:
+        """Idempotent: stopping with no active capture is a no-op."""
+        if not self.active:
+            return
+        import jax
+        self.active = False        # cleared first: stop_trace may raise
+        self._until = None
+        self.captures += 1
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError as e:
+            logging.getLogger(__name__).warning(
+                "profiler stop_trace failed: %s", e)
+
+
+@contextmanager
+def trace(out_dir: str):
+    """Context-managed capture for tools: the trace always stops exactly
+    once, raise or return."""
+    cap = ProfilerCapture()
+    cap.start(out_dir)
+    try:
+        yield cap
+    finally:
+        cap.stop()

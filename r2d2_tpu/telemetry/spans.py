@@ -1,0 +1,118 @@
+"""Low-overhead span tracer: thread-local ring buffers, drained off-thread.
+
+Every pipeline stage worth seeing on a timeline records a
+``(name, t_start, t_end, tags)`` event. The hot path takes NO locks: each
+thread appends to its own bounded ``deque`` (the GIL makes ``append``
+atomic; ``maxlen`` gives ring semantics — the oldest events fall off when
+a drain falls behind, counted in ``dropped``). The Telemetry drain thread
+(core.py) swaps events out periodically and appends them to a JSONL file
+that ``tools/inspect.py`` turns into Chrome-trace JSON viewable in
+Perfetto alongside an xprof capture.
+
+Span cadence is block-level (emits, drains, dispatches — a few to a few
+hundred per second), NOT per-env-step: per-step timing goes to the
+histograms (histogram.py), which cost one integer increment each.
+"""
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class SpanTracer:
+    def __init__(self, ring_size: int = 4096, enabled: bool = True):
+        from collections import deque
+        self._deque = deque
+        self.ring_size = ring_size
+        self.enabled = enabled
+        self._local = threading.local()
+        self._rings: List = []          # (thread_name, deque)
+        self._register_lock = threading.Lock()   # registration only
+        self.dropped = 0                # approximate (racy increment is fine)
+
+    def _ring(self):
+        ring = getattr(self._local, "ring", None)
+        if ring is None:
+            ring = self._deque(maxlen=self.ring_size)
+            self._local.ring = ring
+            with self._register_lock:
+                self._rings.append((threading.current_thread(), ring))
+        return ring
+
+    def record(self, name: str, t_start: float, t_end: float,
+               tags: Optional[Dict] = None) -> None:
+        """Record one completed span (wall-clock unix seconds)."""
+        if not self.enabled:
+            return
+        ring = self._ring()
+        if len(ring) >= self.ring_size:
+            self.dropped += 1
+        ring.append((name, t_start, t_end, tags))
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        """Time a block as one span; no-op (and no clock reads) when
+        disabled."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.record(name, t0, time.time(), tags or None)
+
+    def drain(self) -> List[dict]:
+        """Pop every buffered event from every thread's ring (off-thread:
+        the drain loop owns this). Writers keep appending concurrently;
+        ``popleft`` and ``append`` never touch the same end."""
+        out = []
+        with self._register_lock:
+            rings = list(self._rings)
+        dead = []
+        for thread, ring in rings:
+            for _ in range(len(ring)):
+                try:
+                    name, t0, t1, tags = ring.popleft()
+                except IndexError:
+                    break
+                ev = {"name": name, "ts": t0, "dur": t1 - t0,
+                      "tid": thread.name}
+                if tags:
+                    ev["tags"] = tags
+                out.append(ev)
+            if not thread.is_alive() and not ring:
+                # respawned workers register fresh rings; drained rings of
+                # dead threads must not accumulate over a crash-looping
+                # soak
+                dead.append((thread, ring))
+        if dead:
+            with self._register_lock:
+                for entry in dead:
+                    try:
+                        self._rings.remove(entry)
+                    except ValueError:
+                        pass
+        out.sort(key=lambda e: e["ts"])
+        return out
+
+
+def chrome_trace_events(events: List[dict], pid: str,
+                        pid_index: int = 0) -> List[dict]:
+    """Convert drained span events (JSONL schema above) to Chrome-trace
+    'X' events plus the process/thread name metadata Perfetto uses for
+    track labels. Timestamps convert to microseconds."""
+    tids: Dict[str, int] = {}
+    out = [{"ph": "M", "name": "process_name", "pid": pid_index,
+            "args": {"name": pid}}]
+    for ev in events:
+        tid = tids.setdefault(ev.get("tid", "main"), len(tids))
+        out.append({"ph": "X", "name": ev["name"], "pid": pid_index,
+                    "tid": tid, "ts": round(ev["ts"] * 1e6, 1),
+                    "dur": round(ev["dur"] * 1e6, 1),
+                    "args": ev.get("tags") or {}})
+    for name, tid in tids.items():
+        out.append({"ph": "M", "name": "thread_name", "pid": pid_index,
+                    "tid": tid, "args": {"name": name}})
+    return out

@@ -137,11 +137,24 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
     ov.update({"actor.num_actors": num_actors,
                "actor.envs_per_actor": envs_per_actor})
     ov.update(overrides or {})
+    # bench runs must not litter the default save_dir with telemetry
+    # streams (save_interval is 0 here, but spans/metrics still write);
+    # a scratch dir we created is removed again after the run
+    scratch = None
+    if "runtime.save_dir" not in ov:
+        import tempfile
+        scratch = tempfile.mkdtemp(prefix="r2d2_e2e_")
+        ov["runtime.save_dir"] = scratch
     cfg = _bench_config(ov)
     records = []
     t0 = time.time()
-    stacks = train(cfg, max_seconds=seconds, actor_mode="process",
-                   log_fn=records.append)
+    try:
+        stacks = train(cfg, max_seconds=seconds, actor_mode="process",
+                       log_fn=records.append)
+    finally:
+        if scratch is not None:
+            import shutil
+            shutil.rmtree(scratch, ignore_errors=True)
     elapsed = time.time() - t0
     learner = stacks[0].learner
     batch = cfg.replay.batch_size
@@ -160,6 +173,15 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
            if r.get("ingest_blocks_per_drain")]
     lat = [r["ingest_drain_latency_ms"] for r in records
            if r.get("ingest_drain_latency_ms") is not None]
+    # telemetry evidence (ISSUE 4): per-stage the newest summary seen in
+    # any record (union, not last-record-only: the board flush cadence can
+    # exceed this shape's short log interval, so actor stages land in
+    # SOME intervals — at the production log_interval every record has
+    # them)
+    stages = {}
+    for r in records:
+        stages.update(r.get("stages") or {})
+    stages = stages or None
     return {
         "seconds": round(elapsed, 1),
         "num_actors": num_actors,
@@ -183,6 +205,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
             sum(r.get("ingest_pause_time") or 0.0 for r in records), 3),
         "batch_size": batch,
         "records": len(records),
+        "stages": stages,
         "config": {k: ov[k] for k in sorted(ov)},
     }
 
@@ -212,6 +235,32 @@ def run_ingest_ab(seconds: float, envs_per_actor: int, num_actors: int,
     return out
 
 
+def run_telemetry_ab(seconds: float, envs_per_actor: int, num_actors: int,
+                     overrides: Optional[dict] = None) -> dict:
+    """Telemetry overhead A/B (ISSUE 4 acceptance): the SAME e2e system
+    run twice — ``telemetry.enabled`` on vs off — in one artifact. The
+    budget under test: full telemetry (per-stage histograms on every
+    pipeline hot path, span rings, board publication) costs < 2%
+    env-steps/s. The ON cell also carries the aggregated stage
+    percentiles as evidence the instrumentation actually flowed."""
+    out = {}
+    for label, on in (("telemetry_off", False), ("telemetry_on", True)):
+        ov = dict(overrides or {})
+        ov["telemetry.enabled"] = on
+        out[label] = run_e2e(seconds, envs_per_actor, num_actors,
+                             overrides=ov)
+    off, on_ = out["telemetry_off"], out["telemetry_on"]
+    if off["env_steps_per_sec"] > 0:
+        ratio = on_["env_steps_per_sec"] / off["env_steps_per_sec"]
+        out["env_steps_ratio"] = round(ratio, 3)
+        out["overhead_pct"] = round((1.0 - ratio) * 100.0, 2)
+    if off["learner_steps_per_sec"] > 0:
+        out["learner_steps_ratio"] = round(
+            on_["learner_steps_per_sec"] / off["learner_steps_per_sec"], 3)
+    out["stage_count_on"] = len(on_.get("stages") or {})
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -236,6 +285,11 @@ def main(argv=None) -> int:
                         " artifact; 0: single e2e run at the config default")
     p.add_argument("--ingest-batch-blocks", type=int, default=8,
                    help="K for the A/B's batched cell")
+    p.add_argument("--telemetry-ab", type=int, default=0,
+                   help="1: run the e2e phase as a telemetry on/off A/B "
+                        "instead (overhead budget < 2%% env-steps/s; one "
+                        "artifact with both cells + the ON cell's stage "
+                        "percentiles)")
     p.add_argument("--out", default=os.environ.get("R2D2_E2E_OUT", ""),
                    help="also write the JSON artifact to this path")
     p.add_argument("--override", action="append", default=[],
@@ -259,7 +313,11 @@ def main(argv=None) -> int:
         out["actor_sweep"] = run_actor_sweep(sweep, seconds=args.seconds,
                                              overrides=overrides)
     if args.e2e_seconds > 0:
-        if args.ingest_ab:
+        if args.telemetry_ab:
+            out["e2e_telemetry_ab"] = run_telemetry_ab(
+                args.e2e_seconds, args.envs_per_actor, args.num_actors,
+                overrides=overrides)
+        elif args.ingest_ab:
             out["e2e_ingest_ab"] = run_ingest_ab(
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,
                 args.ingest_batch_blocks, overrides=overrides)

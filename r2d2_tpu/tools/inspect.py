@@ -1,0 +1,174 @@
+"""Live run inspector: terminal dashboard over the telemetry stream,
+plus Chrome-trace export of the recorded spans.
+
+Reads what a training run leaves in ``runtime.save_dir``:
+
+  * ``metrics_player{p}.jsonl``  — the per-interval aggregated records
+    (throughput counters, health counters, and the telemetry 'stages'
+    block with fleet-wide P50/P95/P99 per pipeline stage);
+  * ``telemetry_host{r}.jsonl``  — per-host stage rows under multihost;
+  * ``spans_*.jsonl``            — drained span events per process.
+
+Dashboard mode tails the records and redraws one screen per interval —
+run it in a second terminal against a live soak. Export mode
+(``--export-trace out.json``) merges every spans file into ONE
+Chrome-trace JSON (each process a pid row, each thread a tid track) that
+loads in Perfetto / chrome://tracing, viewable alongside the xprof
+capture ``runtime.profile_at_step`` or SIGUSR2 triggered.
+
+    python -m r2d2_tpu.tools.inspect --dir models               # once
+    python -m r2d2_tpu.tools.inspect --dir models --follow      # live
+    python -m r2d2_tpu.tools.inspect --dir models --export-trace t.json
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from r2d2_tpu.tools.logparse import parse_jsonl
+
+# stages in display order; anything else in the record appends after
+_STAGE_ORDER = [
+    "actor/forward", "actor/env_step", "actor/block_emit",
+    "actor/queue_put", "actor/weight_sync",
+    "ingest/ring_get", "ingest/stage", "ingest/commit",
+    "learner/sample", "learner/train_dispatch", "learner/device_sync",
+    "learner/priority_writeback", "weights/publish",
+]
+
+
+def _fmt(v, width: int = 10) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float):
+        return f"{v:.3f}".rjust(width)
+    return str(v).rjust(width)
+
+
+def render_record(record: dict, host_rows: Optional[List[dict]] = None
+                  ) -> str:
+    """One dashboard frame from the newest aggregated record."""
+    lines = []
+    lines.append(
+        f"t={record.get('t', 0):8.1f}s  "
+        f"env_steps={record.get('env_steps', 0):>10}  "
+        f"train_steps={record.get('training_steps', 0):>8}  "
+        f"buffer={record.get('buffer_size', 0):>8}")
+    lines.append(
+        f"env-steps/s={record.get('buffer_speed') or 0.0:9.1f}  "
+        f"updates/s={record.get('training_speed') or 0.0:7.2f}  "
+        f"loss={_fmt(record.get('loss'), 8)}  "
+        f"return={_fmt(record.get('avg_episode_return'), 8)}")
+    health = [f"{k.split('actor_')[-1]}={record[k]}" for k in (
+        "actor_restarts", "actor_hangs_detected", "actor_breaker_trips",
+        "actor_parked_slots") if record.get(k)]
+    ingest = (f"ingest: blocks={record.get('ingest_blocks_total', 0)} "
+              f"blocks/drain={_fmt(record.get('ingest_blocks_per_drain'), 6)}"
+              f" queue={record.get('ingest_queue_depth', 0)} "
+              f"pause={record.get('ingest_pause_time', 0.0)}s")
+    lines.append(ingest + ("   health: " + " ".join(health) if health else ""))
+    stages = record.get("stages") or {}
+    if stages:
+        lines.append("")
+        lines.append(f"{'stage':<28}{'count':>8}{'p50 ms':>10}"
+                     f"{'p95 ms':>10}{'p99 ms':>10}")
+        order = ([s for s in _STAGE_ORDER if s in stages]
+                 + [s for s in sorted(stages) if s not in _STAGE_ORDER])
+        for name in order:
+            s = stages[name]
+            lines.append(f"{name:<28}{s.get('count', 0):>8}"
+                         f"{_fmt(s.get('p50_ms'))}{_fmt(s.get('p95_ms'))}"
+                         f"{_fmt(s.get('p99_ms'))}")
+        dropped = record.get("telemetry_dropped_spans")
+        if dropped:
+            lines.append(f"(spans dropped under ring pressure: {dropped})")
+    else:
+        lines.append("(no 'stages' block — telemetry.enabled=false, or a "
+                     "pre-telemetry run)")
+    for row in host_rows or []:
+        n = len(row.get("stages") or {})
+        lines.append(f"host rank {row.get('rank')}: {n} stages at "
+                     f"t={row.get('t', 0):.1f}s "
+                     f"(telemetry_host{row.get('rank')}.jsonl)")
+    return "\n".join(lines)
+
+
+def newest_host_rows(run_dir: str) -> List[dict]:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "telemetry_host*.jsonl"))):
+        recs = parse_jsonl(path, limit=1)
+        if recs:
+            rows.append(recs[-1])
+    return rows
+
+
+def export_chrome_trace(run_dir: str, out_path: str) -> int:
+    """Merge every spans_*.jsonl under ``run_dir`` into one Chrome-trace
+    JSON; returns the number of span events exported."""
+    from r2d2_tpu.telemetry import chrome_trace_events
+    events = []
+    n = 0
+    for pid_index, path in enumerate(
+            sorted(glob.glob(os.path.join(run_dir, "spans_*.jsonl")))):
+        spans = parse_jsonl(path)
+        n += len(spans)
+        pid = (spans[0].get("pid") if spans else None) or \
+            os.path.basename(path)[len("spans_"):-len(".jsonl")]
+        events.extend(chrome_trace_events(spans, pid, pid_index))
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return n
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default="models",
+                   help="the run's save_dir (metrics/spans live there)")
+    p.add_argument("--player", type=int, default=0)
+    p.add_argument("--follow", action="store_true",
+                   help="keep tailing and redraw per new record")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="poll cadence in follow mode")
+    p.add_argument("--export-trace", default="",
+                   help="write Chrome-trace JSON here (Perfetto-loadable) "
+                        "and exit")
+    args = p.parse_args(argv)
+
+    if args.export_trace:
+        n = export_chrome_trace(args.dir, args.export_trace)
+        print(f"exported {n} spans from {args.dir!r} to "
+              f"{args.export_trace!r}")
+        return 0
+
+    path = os.path.join(args.dir, f"metrics_player{args.player}.jsonl")
+    last_len = -1
+    while True:
+        try:
+            records = parse_jsonl(path)
+        except FileNotFoundError:
+            print(f"waiting for {path} ..." if args.follow
+                  else f"no metrics stream at {path}")
+            if not args.follow:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if records and len(records) != last_len:
+            last_len = len(records)
+            frame = render_record(records[-1], newest_host_rows(args.dir))
+            if args.follow and sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+            print(f"== {path} (record {len(records)}) ==")
+            print(frame, flush=True)
+        if not args.follow:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,13 +1,19 @@
-"""Parser for ``train_player{i}.log`` files.
+"""Parsers for the training telemetry file formats.
 
-Key strings match the reference's ReplayBuffer.log emissions exactly
+``parse_log`` reads ``train_player{i}.log``: key strings match the
+reference's ReplayBuffer.log emissions exactly
 (/root/reference/worker.py:220-234), which is also what the reference's
-plot.py regexes expect (/root/reference/plot.py:33-48) — so this parser reads
-logs from either framework.
+plot.py regexes expect (/root/reference/plot.py:33-48) — so this parser
+reads logs from either framework. ``parse_jsonl`` reads the structured
+stream TrainMetrics appends per log interval (``metrics_player{i}.jsonl``
+and the multihost per-host ``telemetry_host{r}.jsonl`` rows share the
+line format) — the machine-readable side tools/inspect.py and the e2e
+bench consume.
 """
 
+import json
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, Optional
 
 
 @dataclass
@@ -41,3 +47,20 @@ def parse_log(path: str) -> ParsedLog:
             elif line.startswith("number of training steps:"):
                 out.training_steps.append(float(line.split(":")[1]))
     return out
+
+
+def parse_jsonl(path: str, limit: Optional[int] = None) -> List[dict]:
+    """All records of a metrics/telemetry JSONL stream, oldest first
+    (``limit`` keeps only the newest N). Partial trailing lines — a writer
+    mid-append — are skipped, not fatal: the inspector tails live files."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out[-limit:] if limit else out

@@ -70,11 +70,15 @@ def capture_step_trace(cfg: Config, steps: int, out_dir: str,
     # metadata file so ms/step always divides by what really ran
     dispatches = -(-max(1, steps) // k)
     traced_steps = dispatches * k
-    jax.profiler.start_trace(out_dir)
-    for _ in range(dispatches):
-        ts, rs, m = step(ts, rs)
-    jax.block_until_ready(m["loss"])
-    jax.profiler.stop_trace()
+    # shared capture lifecycle (telemetry/profiler.py): the trace stops
+    # exactly once even when a step raises mid-capture — the same helper
+    # the orchestrator's first-interval/profile_at_step/SIGUSR2 captures
+    # run on
+    from r2d2_tpu.telemetry.profiler import trace
+    with trace(out_dir):
+        for _ in range(dispatches):
+            ts, rs, m = step(ts, rs)
+        jax.block_until_ready(m["loss"])
     with open(os.path.join(out_dir, "profile_meta.json"), "w") as f:
         json.dump({"steps": traced_steps, "steps_per_dispatch": k,
                    "batch_size": spec.batch_size}, f)

@@ -265,7 +265,9 @@ def test_supervisor_restarts_dead_actor(tmp_path):
 
 
 def test_supervisor_disabled_by_config(tmp_path):
-    """runtime.restart_dead_actors=False turns supervision off entirely."""
+    """runtime.restart_dead_actors=False disables RESPAWNING: the health
+    scan still runs (hang detection, failure accounting) but a dead
+    worker stays dead."""
     import threading
     from r2d2_tpu.envs.factory import create_env
     from r2d2_tpu.runtime.orchestrator import PlayerStack
