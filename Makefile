@@ -15,8 +15,13 @@
 #                aggregation, record schema stability, profiler capture
 #                lifecycle); the slow-marked e2e slices run with the full
 #                tier.
+#   make learning — the fast-tier learning-diagnostics suite
+#                (tests/test_learning_diag.py: device-vs-host histogram
+#                parity, dQ reference agreement, staleness stamps through
+#                shm/mp/ring-wrap, NaN forensics, record schema); the
+#                slow e2e slice runs with the full tier.
 
-.PHONY: t1 chaos telemetry check-fast-markers
+.PHONY: t1 chaos telemetry learning check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -27,6 +32,10 @@ chaos: check-fast-markers
 
 telemetry: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
+learning: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_learning_diag.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
 check-fast-markers:
@@ -55,5 +64,14 @@ check-fast-markers:
 	    echo "fast-tier telemetry tests collected: $$n"; \
 	else \
 	    echo "ERROR: telemetry tests missing from the 'not slow' tier ($$n collected)"; \
+	    exit 1; \
+	fi
+	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_learning_diag.py \
+	    -m 'not slow' --collect-only -q -p no:cacheprovider 2>/dev/null \
+	    | grep -c '::'); \
+	if [ "$$n" -ge 12 ]; then \
+	    echo "fast-tier learning-diagnostics tests collected: $$n"; \
+	else \
+	    echo "ERROR: learning-diagnostics tests missing from the 'not slow' tier ($$n collected)"; \
 	    exit 1; \
 	fi

@@ -16,7 +16,57 @@ import re
 
 import numpy as np
 
-from r2d2_tpu.tools.logparse import parse_log
+from r2d2_tpu.tools.logparse import learning_series, parse_jsonl, parse_log
+
+
+def plot_learning(file_path: str, out: str, show: bool) -> None:
+    """--learning mode: render the learning-diagnostics series (ΔQ
+    stored/zero/recomputed, sample-age P50/P95, grad norm — ISSUE 5) from
+    each player's ``metrics_player{i}.jsonl`` to one grid."""
+    import matplotlib
+    if not show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    paths = sorted(glob.glob(os.path.join(file_path,
+                                          "metrics_player*.jsonl")))
+    series = []
+    for path in paths:
+        s = learning_series(parse_jsonl(path))
+        if s["t"]:
+            player = re.search(r"metrics_player(\d+)\.jsonl", path).group(1)
+            series.append((player, s))
+    if not series:
+        raise SystemExit(
+            f"no metrics_player*.jsonl with a 'learning' block under "
+            f"{file_path!r} — run with telemetry.learning_enabled=true")
+
+    fig, axes = plt.subplots(3, len(series), squeeze=False,
+                             figsize=(7 * len(series), 9))
+    for col, (player, s) in enumerate(series):
+        t = np.asarray([x or 0.0 for x in s["t"]]) / 60.0
+
+        def draw(ax, keys, ylabel):
+            for key in keys:
+                ys = np.asarray([np.nan if v is None else v for v in s[key]],
+                                float)
+                if np.isfinite(ys).any():
+                    ax.plot(t, ys, ".-", label=key)
+            ax.set_ylabel(ylabel)
+            ax.legend(loc="upper right", fontsize=8)
+
+        draw(axes[0][col], ["delta_q_stored", "delta_q_zero",
+                            "delta_q_recomputed"], "normalized dQ")
+        axes[0][col].set_title(f"player {player}")
+        draw(axes[1][col], ["sample_age_p50", "sample_age_p95",
+                            "replay_age_p50"], "age (weight publishes)")
+        draw(axes[2][col], ["grad_norm", "td_p50"], "grad norm / |TD| p50")
+        axes[2][col].set_xlabel("training time (minutes)")
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out}")
+    if show:
+        plt.show()
 
 
 def main(argv=None) -> None:
@@ -33,7 +83,17 @@ def main(argv=None) -> None:
                    help="seconds per log interval (ref config.py:40)")
     p.add_argument("--out", default="training_curves.png")
     p.add_argument("--show", action="store_true")
+    p.add_argument("--learning", action="store_true",
+                   help="plot the learning-diagnostics series (dQ, "
+                        "sample-age, grad norm) from metrics_player*.jsonl "
+                        "instead of the reward curves")
     args = p.parse_args(argv)
+
+    if args.learning:
+        out = args.out if args.out != "training_curves.png" \
+            else "learning_curves.png"
+        plot_learning(args.file_path, out, args.show)
+        return
 
     import matplotlib
     if not args.show:

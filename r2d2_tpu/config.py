@@ -321,6 +321,29 @@ class TelemetryConfig:
     # Span tracing sub-switch: histograms stay on (they are the
     # aggregated record's source); spans cost a JSONL file per process.
     spans: bool = True
+    # -- learning-dynamics diagnostics (telemetry/learning.py, ISSUE 5) --
+    # Kill switch for the learner-side LEARNING diagnostics fused into the
+    # jitted train step: |TD|/priority/Q histograms, per-group gradient
+    # norms, target-network parameter distance, the stored-state ΔQ
+    # check, sample-age staleness, and NaN forensics. Off (or with the
+    # master `enabled` off) the train step compiles WITHOUT any
+    # diagnostic outputs — the hot path is byte-identical to pre-PR5.
+    learning_enabled: bool = True
+    # Learner steps between ΔQ / target-distance evaluations (lax.cond
+    # inside the jitted step: the extra unrolls only execute on interval
+    # steps, so the steady-state cost is amortized to ~nothing).
+    learning_interval: int = 200
+    # Sequences per ΔQ evaluation (the full-context reference unroll runs
+    # over the whole stored block row — ~8x the window length — so this
+    # sub-batch bounds its transient activation memory; 16 ≈ one training
+    # batch's activation footprint at the reference shape).
+    learning_dq_batch: int = 16
+    # What to do when the train step's loss/grad-norm first goes
+    # non-finite (detected at the metrics flush): both policies write a
+    # one-shot nan_dump_player{p}.json forensic record; "warn" logs and
+    # continues (the reference's silent-NaN failure mode, made loud),
+    # "halt" raises after the dump so the run stops at the poisoned step.
+    nan_policy: str = "warn"
 
 
 @dataclass(frozen=True)
@@ -488,6 +511,18 @@ class Config:
                 ">= 16")
         if self.telemetry.flush_interval_s <= 0:
             raise ValueError("telemetry.flush_interval_s must be > 0")
+        if self.telemetry.learning_interval < 1:
+            raise ValueError(
+                f"telemetry.learning_interval "
+                f"({self.telemetry.learning_interval}) must be >= 1")
+        if self.telemetry.learning_dq_batch < 1:
+            raise ValueError(
+                f"telemetry.learning_dq_batch "
+                f"({self.telemetry.learning_dq_batch}) must be >= 1")
+        if self.telemetry.nan_policy not in ("warn", "halt"):
+            raise ValueError(
+                f"telemetry.nan_policy ({self.telemetry.nan_policy!r}) must "
+                "be 'warn' or 'halt'")
         if self.multiplayer.enabled and self.actor.envs_per_actor > 1:
             raise ValueError(
                 "actor.envs_per_actor > 1 is not supported with multiplayer "

@@ -181,6 +181,12 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
             "priorities": priorities,
             "mean_abs_td": jnp.sum(jnp.abs(td)) / num_valid,
             "mean_q": jnp.sum(q_chosen * mask) / num_valid,
+            # raw per-element views for the learning-diagnostics histograms
+            # (telemetry/learning.py); DCE'd when no LearningDiag consumes
+            # them, so the plain step's program is unchanged
+            "abs_td": jnp.abs(td),
+            "mask": mask,
+            "q_chosen": q_chosen,
         }
         return loss, aux
 
@@ -188,13 +194,21 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
 
 
 def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
-                      use_double: bool, jit: bool = True):
+                      use_double: bool, jit: bool = True, diag=None):
     """Build the fused step:
 
         step(train_state, replay_state) -> (train_state, replay_state, metrics)
 
     Both states are donated: the optimizer state, params, replay rings and
     priority tree update in place in HBM.
+
+    ``diag`` (telemetry.LearningDiag or None): fuse the learning-dynamics
+    diagnostics into the same program — device-side |TD|/priority/Q
+    histograms, per-group grad norms, the non-finite guard, sample
+    staleness stamps, and (every ``diag.interval`` steps, under lax.cond
+    so the steady-state path is untouched) target-parameter distance and
+    the stored-state ΔQ check. None compiles the pre-diagnostics program
+    byte-for-byte — the telemetry.learning_enabled kill switch.
     """
     loss_fn = make_loss_fn(net, spec, optim, use_double)
     tx = make_optimizer(optim)
@@ -232,12 +246,20 @@ def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
         else:
             target_params = train_state.target_params
 
+        grad_norm = optax.global_norm(grads)
         metrics = {
             "loss": loss,
             "mean_abs_td": aux["mean_abs_td"],
             "mean_q": aux["mean_q"],
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
         }
+        if diag is not None:
+            from r2d2_tpu.telemetry.learning import fused_diagnostics
+            # pre-update params: consistent with the batch just trained on
+            metrics.update(fused_diagnostics(
+                net, spec, diag, new_step, train_state.params,
+                train_state.target_params, batch, aux, grads, loss,
+                grad_norm, replay_state=replay_state))
         train_state = train_state.replace(
             params=params, target_params=target_params,
             opt_state=opt_state, step=new_step, key=key)
@@ -249,7 +271,8 @@ def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
 
 
 def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
-                             optim: OptimConfig, use_double: bool):
+                             optim: OptimConfig, use_double: bool,
+                             diag=None):
     """Train step for host-placement replay (config replay.placement="host"):
     the batch is sampled by HostReplay on the CPU (native C++ sum tree) and
     fed across the host boundary, mirroring the reference's architecture
@@ -281,13 +304,23 @@ def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
         else:
             target_params = train_state.target_params
 
+        grad_norm = optax.global_norm(grads)
         metrics = {
             "loss": loss,
             "priorities": aux["priorities"],
             "mean_abs_td": aux["mean_abs_td"],
             "mean_q": aux["mean_q"],
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
         }
+        if diag is not None and batch.weight_version is not None:
+            # host placement: histograms / grad norms / staleness / the
+            # non-finite guard; ΔQ needs the device-resident ring context
+            # and reports NaN here (replay_state=None)
+            from r2d2_tpu.telemetry.learning import fused_diagnostics
+            metrics.update(fused_diagnostics(
+                net, spec, diag, new_step, train_state.params,
+                train_state.target_params, batch, aux, grads, loss,
+                grad_norm, replay_state=None))
         train_state = train_state.replace(
             params=params, target_params=target_params,
             opt_state=opt_state, step=new_step, key=train_state.key)
@@ -298,7 +331,7 @@ def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
 
 def make_multi_learner_step(net: NetworkApply, spec: ReplaySpec,
                             optim: OptimConfig, use_double: bool,
-                            steps_per_dispatch: int):
+                            steps_per_dispatch: int, diag=None):
     """K fused steps per dispatch via lax.scan — one host round-trip buys K
     training steps.
 
@@ -308,9 +341,13 @@ def make_multi_learner_step(net: NetworkApply, spec: ReplaySpec,
     identical to K calls of the single step (same RNG chain, same per-step
     target-sync schedule via the carried step counter); only the host-side
     observation points (weight publish, checkpoint) coarsen to dispatch
-    boundaries. Returns stacked (K,) metrics per dispatch.
+    boundaries. Returns stacked (K,) metrics per dispatch (the learning
+    diagnostics' histograms stack to (K, 64), ΔQ to (K,) with NaN on the
+    non-interval steps — the scanned cond predicate rides the carried
+    step counter, so interval steps fire inside the scan too).
     """
-    inner = make_learner_step(net, spec, optim, use_double, jit=False)
+    inner = make_learner_step(net, spec, optim, use_double, jit=False,
+                              diag=diag)
 
     def multi_step(train_state: TrainState, replay_state: ReplayState):
         def body(carry, _):

@@ -422,6 +422,10 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         raise ValueError(
             f"unknown replay.placement {cfg.replay.placement!r}")
     host_mode = cfg.replay.placement == "host"
+    from r2d2_tpu.telemetry.learning import LearningAggregator, LearningDiag
+    # learning diagnostics (ISSUE 5): fused into the lockstep step like
+    # the single-host path; only rank 0 aggregates (it owns TrainMetrics)
+    learn_diag = LearningDiag.from_config(cfg)
     from r2d2_tpu.envs.factory import create_env
     from r2d2_tpu.learner.train_step import create_train_state
     from r2d2_tpu.models.network import NetworkApply
@@ -493,7 +497,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         host_replay = HostReplay(spec, seed=cfg.runtime.seed + 7919 * rank)
         consense = make_lockstep_consensus(mesh)
         ext_step = make_external_batch_step(net, spec, cfg.optim,
-                                            cfg.network.use_double)
+                                            cfg.network.use_double,
+                                            diag=learn_diag)
         batch_sharding = NamedSharding(mesh, P("dp"))
         if mesh.shape["mp"] == 1:
             # replicate the state across the mesh (mp > 1 already placed
@@ -518,7 +523,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         k = cfg.runtime.resolved_steps_per_dispatch()
         step_fn = make_sharded_learner_step(
             net, spec, cfg.optim, cfg.network.use_double, mesh,
-            steps_per_dispatch=k)
+            steps_per_dispatch=k, diag=learn_diag)
         ingest_fn = make_lockstep_ingest(spec, mesh)
         feed = HostFeed(spec, mesh)
 
@@ -646,7 +651,11 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                     b, should_stop,
                     beat=lambda: heartbeats.touch(slot),
                     telemetry=tele),
-                board=heartbeats, telemetry=tele)
+                board=heartbeats, telemetry=tele,
+                # generation stamp, same contract as the single-host
+                # thread spawner (reader_id matches weight_poll below)
+                weight_version=lambda reader_id=i:
+                    store.reader_version(reader_id))
 
             def loop(env=env, policy=policy, run_loop=run_loop,
                      reader_id=i, sink=sink, should_stop=should_stop):
@@ -721,6 +730,16 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                    if rank == 0 else None)
         if metrics is not None:
             metrics.set_telemetry(tele)   # stages ride the rank-0 record
+        # rank-0 learning aggregation: the 'learning' block (+ NaN
+        # forensics) rides the same rank-0 record as everything else
+        learn_agg = (LearningAggregator(pid, cfg.runtime.save_dir,
+                                        cfg.telemetry.nan_policy,
+                                        cfg.optim.lr)
+                     if metrics is not None and learn_diag is not None
+                     else None)
+        pub_count = ((lambda: publisher.publish_count)
+                     if publisher is not None
+                     else (lambda: store.publish_count))
         host_rows_path = os.path.join(
             cfg.runtime.save_dir or ".", f"telemetry_host{rank}.jsonl")
         if rank != 0 and tele.enabled:
@@ -742,6 +761,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         last_log = last_supervise = time.time()
         info = {"buffer_steps": 0, "env_steps": 0, "filled_shards": 0}
 
+        halt_error: list = []
+
         def flush_losses():
             if pending_losses and metrics is not None:
                 t0 = time.perf_counter()
@@ -752,6 +773,31 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                     for loss in np.atleast_1d(arr):
                         metrics.on_train_step(float(loss))
             pending_losses.clear()
+            if learn_agg is not None:
+                # occupancy ages: host placement has the ring mirror right
+                # here (this rank's HostReplay accountant); under the
+                # device-placement lockstep ingest the stamps live only
+                # device-side, so occupancy stays a single-host/host-mode
+                # feature — sample ages flow either way
+                occ = (host_replay.ring.live_versions() if host_mode
+                       else None)
+                try:
+                    metrics.set_learning(learn_agg.flush(
+                        step_count, publish_count=pub_count(),
+                        occupancy_versions=occ))
+                except RuntimeError as e:
+                    if "nan_policy=halt" not in str(e):
+                        raise
+                    # nan_policy=halt under lockstep: raising out of the
+                    # loop on rank 0 alone would abandon the other ranks
+                    # mid-collective (they would wedge until the
+                    # jax.distributed heartbeat timeout — the same hazard
+                    # the SIGTERM path routes around). Feed the shared
+                    # stop consensus instead: every rank exits the loop on
+                    # the SAME iteration, then rank 0 re-raises after the
+                    # clean unwind.
+                    halt_error.append(e)
+                    stop.set()
 
         debug = bool(os.environ.get("R2D2_MH_DEBUG"))
         chaos_kill_at = int(os.environ.get("R2D2_MH_CHAOS_KILL_ACTOR", "0"))
@@ -843,6 +889,17 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                         batch_np.idxes, prios_local, snapshot)
                     tele.observe("learner/priority_writeback",
                                  time.perf_counter() - t0)
+                    if learn_agg is not None and "ld/weight_versions" in m:
+                        # the (B,) stamp/idx passthroughs keep the batch's
+                        # global dp sharding, which rank 0 cannot
+                        # device_get across hosts — substitute this rank's
+                        # LOCAL sampled values (already host numpy; the
+                        # same distribution rank 0 trained on). The
+                        # reduced histograms/scalars are GSPMD reduction
+                        # outputs and fetch fine.
+                        m["ld/weight_versions"] = np.asarray(
+                            batch_np.weight_version)
+                        m["ld/batch_idxes"] = np.asarray(batch_np.idxes)
                 else:
                     t0 = time.perf_counter()
                     ts, rs, m = step_fn(ts, rs)
@@ -851,6 +908,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 step_count += k
                 if metrics is not None:   # only rank 0 flushes; don't
                     pending_losses.append(m["loss"])   # accumulate elsewhere
+                if learn_agg is not None:
+                    learn_agg.on_dispatch(m)
                 boundary = lambda iv: iv and step_count // iv > prev // iv
                 if boundary(rt.weight_publish_interval):
                     t0 = time.perf_counter()
@@ -926,6 +985,10 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 ts.opt_state, ts.target_params, step_count,
                 resumed_env + info["env_steps"],
                 config_json=cfg.to_json())
+        if halt_error:
+            # deferred nan_policy=halt (see flush_losses): every rank left
+            # the loop via the stop consensus; now fail loudly on rank 0
+            raise halt_error[0]
     finally:
         stop.set()
         for sig, handler in prev_handlers.items():

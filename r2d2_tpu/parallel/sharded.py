@@ -157,7 +157,7 @@ def _post_gradient_update(tx, optim: OptimConfig, use_double: bool,
 
 def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
                               optim: OptimConfig, use_double: bool, mesh: Mesh,
-                              steps_per_dispatch: int = 1):
+                              steps_per_dispatch: int = 1, diag=None):
     """The dp-sharded fused step. Same contract as make_learner_step.
 
     ``steps_per_dispatch`` > 1 scans K per-shard steps inside the shard_map
@@ -174,6 +174,12 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
     sample-in-HBM step; replay stays dp-sharded (mp-replicated). This
     honors the "model sharding is a mesh-axis change" promise on the
     flagship device-replay path (VERDICT r3 #4).
+
+    ``diag`` (telemetry.LearningDiag or None): the learning diagnostics,
+    reduced to replicated outputs so they fit the step's P() metric specs —
+    histograms psum across shards (one GLOBAL-batch histogram), scalars
+    pmean, staleness via reduced pmin/pmax/pmean version stats (the raw
+    per-sequence stamp vectors differ per shard and are omitted here).
     """
     loss_fn = make_loss_fn(net, spec, optim, use_double)
     tx = make_optimizer(optim)
@@ -195,10 +201,34 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
                            spec.prio_exponent, aux["priorities"], batch.idxes)
         replay_state = replay_state.replace(tree=tree)
 
+        ld = {}
+        if diag is not None:
+            import optax as _optax
+            from r2d2_tpu.telemetry.learning import fused_diagnostics
+            ld = fused_diagnostics(
+                net, spec, diag, train_state.step + 1, train_state.params,
+                train_state.target_params, batch, aux, grads, loss,
+                _optax.global_norm(grads), replay_state=replay_state,
+                raw_arrays=False)
+            # make every diagnostic replicated (out_specs P()): counts add,
+            # scalars average, version extrema take the fleet min/max
+            for kk in ("ld/td_hist", "ld/prio_hist", "ld/q_hist"):
+                ld[kk] = jax.lax.psum(ld[kk], "dp")
+            ld["ld/version_min"] = jax.lax.pmin(ld["ld/version_min"], "dp")
+            ld["ld/version_max"] = jax.lax.pmax(ld["ld/version_max"], "dp")
+            ld["ld/nonfinite"] = jax.lax.pmax(ld["ld/nonfinite"], "dp")
+            for kk in ("ld/version_mean", "ld/unknown_frac",
+                       "ld/delta_q_stored", "ld/delta_q_zero",
+                       "ld/delta_q_recomputed", "ld/target_dist"):
+                ld[kk] = jax.lax.pmean(ld[kk], "dp")
+            # grad-group norms are computed from the pmean'd grads —
+            # already replicated, no reduction needed
+
         train_state, metrics = _post_gradient_update(
             tx, optim, use_double, train_state, grads, key, loss,
             jax.lax.pmean(aux["mean_abs_td"], "dp"),
             jax.lax.pmean(aux["mean_q"], "dp"))
+        metrics.update(ld)
         return train_state, replay_state, metrics
 
     # mp > 1 routes to the fully-GSPMD formulation: a shard_map body that is
@@ -208,7 +238,7 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
     # manual collectives instead.
     if mesh.shape.get("mp", 1) > 1:
         return _make_gspmd_learner_step(net, spec, optim, use_double, mesh,
-                                        steps_per_dispatch)
+                                        steps_per_dispatch, diag=diag)
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -234,7 +264,7 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
 
 def _make_gspmd_learner_step(net: NetworkApply, spec: ReplaySpec,
                              optim: OptimConfig, use_double: bool, mesh: Mesh,
-                             steps_per_dispatch: int = 1):
+                             steps_per_dispatch: int = 1, diag=None):
     """The dp x mp fused step, expressed entirely in GSPMD terms.
 
     Identical math and RNG chain to the manual shard_map path (per-shard
@@ -273,9 +303,25 @@ def _make_gspmd_learner_step(net: NetworkApply, spec: ReplaySpec,
         replay_global = replay_global.replace(
             tree=jax.lax.with_sharding_constraint(trees, replay_sharding))
 
+        ld = {}
+        if diag is not None:
+            import optax as _optax
+            from r2d2_tpu.telemetry.learning import fused_diagnostics
+            # shard 0's local view: the per-shard idxes index per-shard
+            # rings, so the ΔQ context (and with it the whole diagnostic
+            # sub-batch) is taken from one shard — documented, and the
+            # loss/grads fed in stay GLOBAL
+            shard0 = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+            ld = fused_diagnostics(
+                net, spec, diag, train_state.step + 1, train_state.params,
+                train_state.target_params, shard0(batches), shard0(aux_v),
+                grads, loss_v.mean(), _optax.global_norm(grads),
+                replay_state=shard0(replay_global))
+
         train_state, metrics = _post_gradient_update(
             tx, optim, use_double, train_state, grads, key, loss_v.mean(),
             aux_v["mean_abs_td"].mean(), aux_v["mean_q"].mean())
+        metrics.update(ld)
         return train_state, replay_global, metrics
 
     def step(train_state: TrainState, replay_global: ReplayState):

@@ -61,7 +61,8 @@ def state_shardings(train_state: TrainState, mesh: Mesh,
 
 def make_tp_external_batch_step(net: NetworkApply, spec: ReplaySpec,
                                 optim: OptimConfig, use_double: bool,
-                                mesh: Mesh, min_shard_width: int = 32):
+                                mesh: Mesh, min_shard_width: int = 32,
+                                diag=None):
     """Returns (step, place_state, place_batch).
 
     ``place_state(ts)`` / ``place_batch(batch)`` lay host values onto the
@@ -76,7 +77,11 @@ def make_tp_external_batch_step(net: NetworkApply, spec: ReplaySpec,
         raise ValueError(
             f"replay.batch_size={spec.batch_size} is not divisible by the "
             f"mesh dp={dp} — the batch axis cannot shard evenly")
-    step = make_external_batch_step(net, spec, optim, use_double)
+    # diag (telemetry.LearningDiag) threads through like every other
+    # step factory: the TP path must not silently disable the learning
+    # diagnostics (or the NaN guard) that plain host placement carries
+    step = make_external_batch_step(net, spec, optim, use_double,
+                                    diag=diag)
     batch_sharding = NamedSharding(mesh, P("dp"))   # device_put broadcasts
                                                     # one sharding over the
                                                     # whole batch pytree

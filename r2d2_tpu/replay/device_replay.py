@@ -95,6 +95,7 @@ def replay_init(spec: ReplaySpec) -> ReplayState:
         learning_steps=jnp.zeros((n, s), jnp.int32),
         forward_steps=jnp.zeros((n, s), jnp.int32),
         seq_start=jnp.zeros((n, s), jnp.int32),
+        weight_version=jnp.full((n,), -1, jnp.int32),
         block_ptr=jnp.zeros((), jnp.int32),
     )
 
@@ -163,6 +164,8 @@ def replay_add_many(spec: ReplaySpec, state: ReplayState,
             blocks.learning_steps),
         forward_steps=state.forward_steps.at[rows].set(blocks.forward_steps),
         seq_start=state.seq_start.at[rows].set(blocks.seq_start),
+        weight_version=state.weight_version.at[rows].set(
+            blocks.weight_version.astype(jnp.int32)),
         block_ptr=(ptr + k) % spec.num_blocks,
     )
 
@@ -219,6 +222,7 @@ def replay_sample(spec: ReplaySpec, state: ReplayState, key: jax.Array) -> Sampl
         forward_steps=forward,
         is_weights=is_weights,
         idxes=idxes,
+        weight_version=state.weight_version[block_idx],
     )
 
 

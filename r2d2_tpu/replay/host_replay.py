@@ -51,6 +51,7 @@ class HostReplay:
         self.learning_steps = np.zeros((n, s), np.int32)
         self.forward_steps = np.zeros((n, s), np.int32)
         self.seq_start = np.zeros((n, s), np.int32)
+        self.weight_version = np.full((n,), -1, np.int32)
         # single authority for pointer/step accounting; in host placement
         # the Learner reads this same instance (no mirrored pointer)
         self.ring = RingAccountant(n)
@@ -75,7 +76,10 @@ class HostReplay:
     def add(self, block: Block) -> None:
         spec = self.spec
         with self.lock:
-            ptr = self.ring.advance(int(np.asarray(block.learning_steps).sum()))
+            wv = int(np.asarray(block.weight_version))
+            ptr = self.ring.advance(
+                int(np.asarray(block.learning_steps).sum()), wv)
+            self.weight_version[ptr] = wv
             idxes = ptr * spec.seqs_per_block + np.arange(spec.seqs_per_block, dtype=np.int64)
             self._tree_update(np.asarray(block.priority, np.float64), idxes)
             self.obs[ptr] = block.obs_row
@@ -126,6 +130,7 @@ class HostReplay:
                     forward_steps=forward,
                     is_weights=is_weights.astype(np.float32),
                     idxes=idxes.astype(np.int32),
+                    weight_version=self.weight_version[b],
                 ),
                 self.ring.total_adds,
             )

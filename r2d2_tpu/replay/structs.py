@@ -130,8 +130,10 @@ class ReplaySpec:
         hidden = n * s * 2 * self.hidden_dim * 4
         # action/reward/gamma (n,s,l) + 4 per-sequence i32 fields
         seq_meta = n * s * (3 * l + 4) * 4
+        # per-block weight-version stamps (staleness accounting)
+        versions = n * 4
         tree = (2 ** self.tree_layers - 1) * 4
-        return obs + last_action + hidden + seq_meta + tree
+        return obs + last_action + hidden + seq_meta + versions + tree
 
     @property
     def seq_window(self) -> int:
@@ -180,6 +182,13 @@ class Block(struct.PyTreeNode):
     seq_start: jnp.ndarray     # (S,) int32 — timeline offset of first learning step
     num_sequences: jnp.ndarray  # () int32
     sum_reward: jnp.ndarray    # () f32, NaN = do not report
+    # Generation stamp for staleness accounting (ISSUE 5): the weight
+    # service's PUBLISH COUNT the producing actor was acting with when it
+    # emitted this block (stamped by instrument_block_sink). Trailing and
+    # defaulted so pre-stamp (PR4-era) block records still construct —
+    # -1 = unknown, reported as such rather than crashing.
+    weight_version: jnp.ndarray = struct.field(
+        default_factory=lambda: np.full((), -1, np.int32))  # () int32
 
 
 class ReplayState(struct.PyTreeNode):
@@ -197,6 +206,7 @@ class ReplayState(struct.PyTreeNode):
     learning_steps: jnp.ndarray  # (N, S) int32
     forward_steps: jnp.ndarray  # (N, S) int32
     seq_start: jnp.ndarray     # (N, S) int32
+    weight_version: jnp.ndarray  # (N,) int32 — per-block generation stamp
     block_ptr: jnp.ndarray     # () int32 ring pointer
 
 
@@ -216,6 +226,12 @@ class SampleBatch(struct.PyTreeNode):
     forward_steps: jnp.ndarray  # (B,) int32
     is_weights: jnp.ndarray    # (B,) f32
     idxes: jnp.ndarray         # (B,) int32 — tree leaf indices for write-back
+    # (B,) int32 per-sequence generation stamp (the containing block's
+    # weight_version; -1 = unknown). Trailing + defaulted: externally
+    # assembled batches (tests, synthetic pipelines) that predate the
+    # stamp keep constructing; a None leaf is dropped from the pytree, so
+    # every jitted consumer that ignores it compiles unchanged.
+    weight_version: jnp.ndarray = None
 
 
 class RingAccountant:
@@ -238,16 +254,27 @@ class RingAccountant:
         self.total_adds = 0        # monotonic; never wraps
         self.slot_steps = [0] * num_blocks
         self.buffer_steps = 0      # live learning steps across the ring
+        # per-slot generation stamp (the landed block's weight_version;
+        # -1 = empty or unstamped) — the host mirror behind the learner's
+        # replay-occupancy age percentiles (ISSUE 5)
+        self.slot_versions = [-1] * num_blocks
 
-    def advance(self, learning_steps: int) -> int:
+    def advance(self, learning_steps: int, weight_version: int = -1) -> int:
         """Account one block write: returns the slot it lands in and rolls
         the pointer, replacing the overwritten slot's step count."""
         slot = self.ptr
         self.buffer_steps += learning_steps - self.slot_steps[slot]
         self.slot_steps[slot] = learning_steps
+        self.slot_versions[slot] = int(weight_version)
         self.ptr = (slot + 1) % self.num_blocks
         self.total_adds += 1
         return slot
+
+    def live_versions(self):
+        """Generation stamps of the slots currently holding data — the
+        occupancy-age source (unstamped live slots report -1 = unknown)."""
+        return [v for v, steps in zip(self.slot_versions, self.slot_steps)
+                if steps > 0]
 
     def stale_adds(self, adds_snapshot: int) -> int:
         return self.total_adds - adds_snapshot
@@ -269,4 +296,5 @@ def empty_block_np(spec: ReplaySpec) -> dict:
         seq_start=np.zeros((spec.seqs_per_block,), np.int32),
         num_sequences=np.zeros((), np.int32),
         sum_reward=np.full((), np.nan, np.float32),
+        weight_version=np.full((), -1, np.int32),
     )

@@ -72,7 +72,9 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
 
 
 def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
-                          board=None, telemetry=None) -> Callable:
+                          board=None, telemetry=None,
+                          weight_version: Optional[Callable[[], int]] = None
+                          ) -> Callable:
     """Health + telemetry instrumentation around a block sink — the ONE
     wrapping point shared by every actor spawner (thread, process,
     single-host, multihost), so scalar and vector loops alike publish
@@ -82,9 +84,18 @@ def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
     that's the point), then heartbeat (the beat marks "reached the sink
     alive", so an injected hang is detected on the regular
     ``hang_timeout_s`` clock, not the spawn grace), then the fault, then
-    the real sink. ``slot`` is the fleet-local worker index (the
+    — innermost, so every path above sees the stamped record — the
+    staleness stamp: ``weight_version()`` (the weight service's publish
+    count the actor is currently acting with) lands in the block's
+    weight_version field, the generation half of the learner's sample-age
+    accounting (ISSUE 5). ``slot`` is the fleet-local worker index (the
     HeartbeatBoard row and the fault-spec key)."""
     wrapped = sink
+    if weight_version is not None:
+        def sink_with_stamp(block, _wrapped=wrapped):
+            return _wrapped(block.replace(weight_version=np.asarray(
+                int(weight_version()), np.int32)))
+        wrapped = sink_with_stamp
     if cfg.actor.fault_spec:
         from r2d2_tpu.tools.chaos import apply_fault, parse_fault_spec
         fault = parse_fault_spec(cfg.actor.fault_spec).get(slot)

@@ -102,7 +102,10 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
         cfg, slot,
         lambda b: put_patient(queue, b, stop_event.is_set, beat=beat,
                               telemetry=tele),
-        board=health_board, telemetry=tele)
+        board=health_board, telemetry=tele,
+        # staleness stamp: the publish count of the params this actor is
+        # acting with (the subscriber's last adopted version)
+        weight_version=lambda: sub.publish_count)
 
     try:
         run_loop(cfg, env, policy,

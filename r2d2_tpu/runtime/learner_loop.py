@@ -54,6 +54,22 @@ class Learner:
             cfg.runtime, self.train_state)
         self.host_mode = cfg.replay.placement == "host"
         self.mesh = None
+        # learning-dynamics diagnostics (ISSUE 5): a LearningDiag fuses
+        # the diagnostic outputs into the jitted step; None (the
+        # telemetry.learning_enabled kill switch) compiles the
+        # pre-diagnostics program byte-for-byte. The aggregator holds the
+        # per-dispatch device outputs and builds the periodic record's
+        # 'learning' block (and owns the NaN forensics) at flush.
+        from r2d2_tpu.telemetry.learning import (LearningAggregator,
+                                                 LearningDiag)
+        self._diag = LearningDiag.from_config(cfg)
+        self._learning_agg = (LearningAggregator(
+            player_idx, cfg.runtime.save_dir, cfg.telemetry.nan_policy,
+            cfg.optim.lr) if self._diag is not None else None)
+        # wired by the orchestrator alongside `publish`: () -> the weight
+        # service's current publish count — the learner half of the
+        # sample-age clock (None = ages reported as unknown)
+        self.weight_version_fn: Optional[Callable[[], int]] = None
         if self.host_mode:
             # dispatch amortization needs the device-resident replay (each
             # host-mode step consumes one host-sampled batch); degrade
@@ -84,11 +100,12 @@ class Learner:
                 self._step_fn, place_state, self._place_batch = (
                     make_tp_external_batch_step(
                         net, self.spec, cfg.optim, cfg.network.use_double,
-                        tp_mesh))
+                        tp_mesh, diag=self._diag))
                 self.train_state = place_state(self.train_state)
             else:
                 self._step_fn = make_external_batch_step(
-                    net, self.spec, cfg.optim, cfg.network.use_double)
+                    net, self.spec, cfg.optim, cfg.network.use_double,
+                    diag=self._diag)
                 self._place_batch = jax.device_put
             self._prefetch_q: queue_mod.Queue = queue_mod.Queue(
                 maxsize=max(1, cfg.runtime.prefetch_batches))
@@ -122,7 +139,7 @@ class Learner:
                 self.replay_state = sharded_replay_init(self.spec, self.mesh)
                 self._step_fn = make_sharded_learner_step(
                     net, self.spec, cfg.optim, cfg.network.use_double,
-                    self.mesh, steps_per_dispatch=self._k)
+                    self.mesh, steps_per_dispatch=self._k, diag=self._diag)
                 self._sharded_add = make_sharded_replay_add(
                     self.spec, self.mesh)
             else:
@@ -130,10 +147,11 @@ class Learner:
                 if self._k > 1:
                     self._step_fn = make_multi_learner_step(
                         net, self.spec, cfg.optim, cfg.network.use_double,
-                        self._k)
+                        self._k, diag=self._diag)
                 else:
                     self._step_fn = make_learner_step(
-                        net, self.spec, cfg.optim, cfg.network.use_double)
+                        net, self.spec, cfg.optim, cfg.network.use_double,
+                        diag=self._diag)
 
         self.metrics = metrics or TrainMetrics(player_idx, cfg.runtime.save_dir,
                                                resume=bool(cfg.runtime.resume))
@@ -246,7 +264,8 @@ class Learner:
             else:
                 self.replay_state = replay_add(
                     self.spec, self.replay_state, block)
-            self.ring.advance(learning)
+            self.ring.advance(learning,
+                              int(np.asarray(block.weight_version)))
         self.env_steps += learning
         ret = float(np.asarray(block.sum_reward))
         self.metrics.on_block(learning, None if np.isnan(ret) else ret)
@@ -369,8 +388,8 @@ class Learner:
                 self.replay_state = replay_add_many(
                     self.spec, self.replay_state, staged)
         total = 0
-        for learning, ret in metas:
-            self.ring.advance(learning)
+        for learning, ret, wv in metas:
+            self.ring.advance(learning, wv)
             self.metrics.on_block(learning, ret)
             total += learning
         self.env_steps += total
@@ -462,9 +481,11 @@ class Learner:
                     learning = np.asarray(stacked.learning_steps)\
                         .sum(axis=1).astype(np.int64)
                     rets = np.asarray(stacked.sum_reward, np.float32)
+                    wvs = np.asarray(stacked.weight_version, np.int64)
                     metas = [
                         (int(learning[i]),
-                         None if np.isnan(rets[i]) else float(rets[i]))
+                         None if np.isnan(rets[i]) else float(rets[i]),
+                         int(wvs[i]))
                         for i in range(k)]
                     with self._staged_lock:
                         self._staged_env_steps += int(learning.sum())
@@ -669,6 +690,10 @@ class Learner:
         self._host_step += self._k
         step = self._host_step
         self._pending_losses.append(m["loss"])  # scalar (k=1) or (k,) array
+        if self._learning_agg is not None:
+            # hold the dispatch's ld/ outputs (device values, no sync);
+            # aggregated into the 'learning' record block at flush time
+            self._learning_agg.on_dispatch(m)
 
         rt = self.cfg.runtime
         if (self.publish is not None
@@ -683,7 +708,11 @@ class Learner:
 
     def flush_metrics(self) -> None:
         """Convert accumulated device losses to host floats (ONE sync for the
-        whole interval) and feed the training counters."""
+        whole interval) and feed the training counters. With learning
+        diagnostics on, also aggregate the interval's ld/ outputs into the
+        record's 'learning' block — and run the NaN forensics there (a
+        nan_policy=halt raises out of this flush, stopping the run at the
+        log boundary that first observed the poisoned step)."""
         if self._pending_losses:
             t0 = time.time()
             arrays = jax.device_get(self._pending_losses)
@@ -694,6 +723,12 @@ class Learner:
             self._pending_losses.clear()
             for loss in np.concatenate([np.atleast_1d(a) for a in arrays]):
                 self.metrics.on_train_step(float(loss))
+        if self._learning_agg is not None:
+            pub = (int(self.weight_version_fn())
+                   if self.weight_version_fn is not None else None)
+            self.metrics.set_learning(self._learning_agg.flush(
+                self._host_step, publish_count=pub,
+                occupancy_versions=self.ring.live_versions()))
 
     def save(self, index: int) -> str:
         ts = self.train_state

@@ -77,6 +77,12 @@ class TrainMetrics:
         # stable for learner-only runs that never supervise
         self._actor_health = {}
 
+        # learning-dynamics block (ISSUE 5): set per flush by the
+        # LearningAggregator; emitted once per record then cleared, and
+        # OMITTED entirely when learning diagnostics are off (consumers
+        # key on its presence, like the 'stages' block)
+        self._learning = None
+
     # -- feed points --
 
     def on_block(self, learning_steps: int, episode_return: Optional[float]) -> None:
@@ -120,6 +126,13 @@ class TrainMetrics:
         per-interval 'stages' block (P50/P95/P99 per pipeline stage,
         fleet-wide when an actor TelemetryBoard is attached to it)."""
         self.telemetry = telemetry
+
+    def set_learning(self, block: Optional[dict]) -> None:
+        """Attach the interval's learning-diagnostics block (|TD|/priority
+        /Q histograms, grad norms, ΔQ, staleness — telemetry/learning.py);
+        None = nothing this interval (no training steps, or diagnostics
+        disabled) and the record carries no 'learning' key."""
+        self._learning = block
 
     def set_actor_health(self, snapshot: dict) -> None:
         """Supervision counters (WorkerHealth.snapshot + stall-dump count)
@@ -207,6 +220,11 @@ class TrainMetrics:
             self._ingest_blocks = 0
             self._ingest_latency_sum = 0.0
             self._ingest_pause_time = 0.0
+        if self._learning is not None:
+            # ONE learning block per interval (ISSUE 5) — consumed on
+            # emission so a training pause doesn't replay stale numbers
+            record["learning"] = self._learning
+            self._learning = None
         if self.telemetry.enabled:
             # ONE aggregated block per interval covering the whole fleet:
             # learner-local stage timers merged with the actor board's

@@ -117,6 +117,9 @@ class PlayerStack:
         cfg = self.cfg
         self.store = InProcWeightStore(self.learner.train_state.params)
         self.learner.publish = self.store.publish
+        # staleness clock (ISSUE 5): the learner half of sample-age =
+        # publish count at flush − the block's generation stamp
+        self.learner.weight_version_fn = lambda: self.store.publish_count
         self.queue = BlockQueue(use_mp=False)
         self._stop = stop
         for i in range(cfg.actor.num_actors):
@@ -154,7 +157,10 @@ class PlayerStack:
                 b, should_stop,
                 beat=lambda: self.heartbeats.touch(i),
                 telemetry=self.telemetry),
-            board=self.heartbeats, telemetry=self.telemetry)
+            board=self.heartbeats, telemetry=self.telemetry,
+            # generation stamp: the store version this thread actor last
+            # adopted (reader_id = slot index, matching weight_poll below)
+            weight_version=lambda: self.store.reader_version(i))
 
         def loop(env=env, policy=policy, run_loop=run_loop, reader_id=i,
                  sink=sink, should_stop=should_stop):
@@ -180,6 +186,8 @@ class PlayerStack:
         self._ctx = mp.get_context("spawn")
         self.publisher = WeightPublisher(self.learner.train_state.params)
         self.learner.publish = self.publisher.publish
+        self.learner.weight_version_fn = \
+            lambda: self.publisher.publish_count
         self.queue = BlockQueue(
             use_mp=True, ctx=self._ctx,
             shm_spec=self.learner.spec if cfg.runtime.shm_transport else None)

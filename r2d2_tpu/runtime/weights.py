@@ -103,6 +103,13 @@ class WeightPublisher:
         self._payload[:] = flat
         self._version[0] = v + 2       # even: stable
 
+    @property
+    def publish_count(self) -> int:
+        """Monotonic publication counter (seqlock versions are 2 per
+        publish) — the learner-side clock for staleness accounting: block
+        generation stamps and sample ages are measured in these units."""
+        return int(self._version[0]) // 2
+
     def close(self) -> None:
         self.shm.close()
         try:
@@ -142,6 +149,13 @@ class WeightSubscriber:
             v1 = int(self._version[0])
         return None
 
+    @property
+    def publish_count(self) -> int:
+        """Publication counter of the params this reader last adopted
+        (0 = still on its locally-initialized copy) — what the actor
+        stamps into each emitted block's weight_version."""
+        return self.last_version // 2
+
     def close(self) -> None:
         self.shm.close()
 
@@ -159,6 +173,20 @@ class InProcWeightStore:
         with self._lock:
             self._params = jax.device_get(params)
             self._version += 1
+
+    @property
+    def publish_count(self) -> int:
+        """Current publication counter (the construction params count as
+        publication 1) — same staleness clock as WeightPublisher's."""
+        with self._lock:
+            return self._version
+
+    def reader_version(self, reader_id: int = 0) -> int:
+        """Publication counter of the params reader ``reader_id`` last
+        adopted. A reader that never polled holds the construction params
+        (version 1) — thread actors are spawned with exactly those."""
+        with self._lock:
+            return self._reader_versions.get(reader_id, 1)
 
     def poll(self, reader_id: int = 0):
         with self._lock:

@@ -18,6 +18,11 @@ Three layers, all behind the ``telemetry.enabled`` kill-switch:
 ``profiler.ProfilerCapture`` owns jax.profiler trace lifecycles (the
 first-interval capture, mid-run ``runtime.profile_at_step`` / SIGUSR2
 triggers, and tools/profile_step.py all share it).
+
+``learning.py`` (ISSUE 5) is the LEARNING-side layer: diagnostics fused
+into the jitted train step (|TD|/priority/Q histograms on the shared
+bucket layout, grad norms, the stored-state ΔQ check, staleness, NaN
+forensics) aggregated into the periodic record's ``learning`` block.
 """
 
 from r2d2_tpu.telemetry.board import TelemetryBoard
@@ -26,14 +31,17 @@ from r2d2_tpu.telemetry.core import (NULL_TELEMETRY, STAGE_INDEX, STAGES,
                                      summarize_matrix)
 from r2d2_tpu.telemetry.histogram import (NBUCKETS, LogHistogram,
                                           bucket_bounds, bucket_index,
-                                          bucket_mid, percentile, summarize)
+                                          bucket_mid, percentile, summarize,
+                                          value_summary)
+from r2d2_tpu.telemetry.learning import LearningAggregator, LearningDiag
 from r2d2_tpu.telemetry.profiler import ProfilerCapture, trace
 from r2d2_tpu.telemetry.spans import SpanTracer, chrome_trace_events
 
 __all__ = [
     "NBUCKETS", "NULL_TELEMETRY", "STAGES", "STAGE_INDEX",
-    "LogHistogram", "ProfilerCapture", "SpanTracer", "StageTimers",
+    "LearningAggregator", "LearningDiag", "LogHistogram",
+    "ProfilerCapture", "SpanTracer", "StageTimers",
     "Telemetry", "TelemetryBoard", "bucket_bounds", "bucket_index",
     "bucket_mid", "chrome_trace_events", "percentile", "summarize",
-    "summarize_matrix", "trace",
+    "summarize_matrix", "trace", "value_summary",
 ]

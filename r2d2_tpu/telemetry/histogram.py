@@ -30,6 +30,13 @@ _STEP = _DECADES / NBUCKETS  # log10 width of one bucket (0.125 -> ~33%/bucket)
 _INV_STEP = 1.0 / _STEP
 _LOG_LO = math.log10(_LO)
 
+# Public aliases for the bucket layout — the device-side bucketize-scatter
+# in telemetry/learning.py reproduces bucket_index() inside jit and MUST
+# use the exact same constants (parity-tested device vs host).
+BUCKET_LO = _LO
+BUCKET_LOG_LO = _LOG_LO
+BUCKET_INV_STEP = _INV_STEP
+
 
 def bucket_index(seconds: float) -> int:
     """Bucket for one duration; durations outside [1 µs, 100 s) clamp to
@@ -78,6 +85,22 @@ def summarize(counts: np.ndarray) -> Optional[Dict[str, float]]:
     out = {"count": total}
     for name, q in (("p50_ms", 0.50), ("p95_ms", 0.95), ("p99_ms", 0.99)):
         out[name] = round(percentile(counts, q) * 1e3, 4)
+    return out
+
+
+def value_summary(counts: np.ndarray) -> Optional[Dict[str, float]]:
+    """summarize() twin for VALUE-domain histograms (|TD error|, priority,
+    |Q| — the learning-diagnostics histograms reuse the duration layout's
+    bucket edges, reading 1e-6..100 as raw magnitudes instead of seconds):
+    count + P50/P95/P99 in raw units, no ms scaling. None when empty."""
+    total = int(np.asarray(counts).sum())
+    if total == 0:
+        return None
+    out = {"count": total}
+    for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+        # 6 significant digits (values span 1e-6..100 — fixed-decimal
+        # rounding would flatten the small-magnitude buckets)
+        out[name] = float(f"{percentile(np.asarray(counts), q):.6g}")
     return out
 
 

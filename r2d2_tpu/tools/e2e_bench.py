@@ -28,6 +28,10 @@ Phases:
      ``replay_add_many`` dispatch per K blocks, background stager) vs the
      legacy per-block path — with blocks/s ingested, drain latency, and
      rate-limiter pause time from the ingestion counters, in one artifact.
+  4. **Telemetry / learning A/Bs** (``--telemetry-ab`` / ``--learning-ab``):
+     the same e2e system with the respective kill switch on vs off — the
+     < 2% overhead budgets for the PR-4 stage telemetry and the PR-5
+     fused learning diagnostics (histograms, staleness, ΔQ cadence).
 
 Output: ONE JSON line (the driver artifact), also written to ``--out``.
 Hermetic on any backend — the fake env and (for the e2e phase) a
@@ -182,6 +186,22 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
     for r in records:
         stages.update(r.get("stages") or {})
     stages = stages or None
+    # learning-diagnostics evidence (ISSUE 5): newest non-null value per
+    # field across the records (ΔQ fires on its own step cadence, so most
+    # short log intervals carry None for it — field-wise merge keeps the
+    # last real sample); histogram bucket dumps stripped (the artifact
+    # wants the summary, not 3x64 counts)
+    learning = None
+    for r in records:
+        lb = r.get("learning")
+        if not lb:
+            continue
+        clean = {k: v for k, v in lb.items() if not k.endswith("_counts")}
+        if learning is None:
+            learning = clean
+        else:
+            learning.update(
+                {k: v for k, v in clean.items() if v is not None})
     return {
         "seconds": round(elapsed, 1),
         "num_actors": num_actors,
@@ -206,6 +226,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "batch_size": batch,
         "records": len(records),
         "stages": stages,
+        "learning": learning,
         "config": {k: ov[k] for k in sorted(ov)},
     }
 
@@ -261,6 +282,72 @@ def run_telemetry_ab(seconds: float, envs_per_actor: int, num_actors: int,
     return out
 
 
+def run_learning_ab(seconds: float, envs_per_actor: int, num_actors: int,
+                    overrides: Optional[dict] = None,
+                    repeats: int = 2) -> dict:
+    """Learning-diagnostics overhead A/B (ISSUE 5 acceptance): the SAME
+    e2e system with ``telemetry.learning_enabled`` on vs off, in one
+    artifact. Budget under test: fused histograms + staleness stamps +
+    the interval-gated ΔQ unrolls cost < 2% on BOTH env-steps/s and
+    learner updates/s. The ON cell carries the aggregated ``learning``
+    block (ΔQ stored/zero/recomputed, sample ages, grad norms) as
+    evidence the diagnostics actually flowed end-to-end.
+
+    Cells run INTERLEAVED off/on ``repeats`` times and the headline
+    ratios come from per-arm medians: on a small shared host the actor
+    side swings ±10% run-to-run (2-core scheduling noise dwarfs the
+    effect under test — the telemetry-AB round hit the same wall), and a
+    single pair routinely reports whichever way the wind blew. Every
+    cell's speeds stay in the artifact."""
+    cells = {"learning_off": [], "learning_on": []}
+    for _ in range(max(repeats, 1)):
+        for label, on in (("learning_off", False), ("learning_on", True)):
+            ov = dict(overrides or {})
+            ov["telemetry.learning_enabled"] = on
+            # dQ must FIRE inside the window for the evidence fields, but
+            # its cadence is the measurement: one reference unroll costs
+            # ~2 train steps (measured on the CPU e2e shape), so
+            # interval=100 amortizes to ~1% of learner time — the
+            # production default (200) halves that again. Forcing a tight
+            # cadence here would measure a config nobody runs.
+            ov.setdefault("telemetry.learning_interval", 100)
+            cells[label].append(run_e2e(seconds, envs_per_actor,
+                                        num_actors, overrides=ov))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"learning_off": cells["learning_off"][-1],
+           "learning_on": cells["learning_on"][-1],
+           "repeats": max(repeats, 1),
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("learning_off", "env_steps_per_sec") > 0:
+        ratio = (med("learning_on", "env_steps_per_sec")
+                 / med("learning_off", "env_steps_per_sec"))
+        out["env_steps_ratio"] = round(ratio, 3)
+        out["overhead_pct"] = round((1.0 - ratio) * 100.0, 2)
+    if med("learning_off", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio"] = round(
+            med("learning_on", "learner_steps_per_sec")
+            / med("learning_off", "learner_steps_per_sec"), 3)
+    # evidence: newest ON cell carrying each field
+    lb = {}
+    for c in cells["learning_on"]:
+        lb.update({k: v for k, v in (c.get("learning") or {}).items()
+                   if v is not None})
+    out["learning_block_on"] = bool(lb)
+    out["delta_q_on"] = lb.get("delta_q")
+    out["sample_age_on"] = lb.get("sample_age")
+    out["learning_block_off"] = any(
+        c.get("learning") for c in cells["learning_off"])
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -290,6 +377,15 @@ def main(argv=None) -> int:
                         "instead (overhead budget < 2%% env-steps/s; one "
                         "artifact with both cells + the ON cell's stage "
                         "percentiles)")
+    p.add_argument("--learning-ab", type=int, default=0,
+                   help="1: run the e2e phase as a learning-diagnostics "
+                        "on/off A/B instead (telemetry.learning_enabled; "
+                        "budget < 2%% on env-steps/s AND learner "
+                        "updates/s; the ON cell carries the 'learning' "
+                        "block as end-to-end evidence)")
+    p.add_argument("--ab-repeats", type=int, default=2,
+                   help="interleaved off/on pairs for the learning A/B "
+                        "(medians per arm; small-host noise control)")
     p.add_argument("--out", default=os.environ.get("R2D2_E2E_OUT", ""),
                    help="also write the JSON artifact to this path")
     p.add_argument("--override", action="append", default=[],
@@ -313,7 +409,11 @@ def main(argv=None) -> int:
         out["actor_sweep"] = run_actor_sweep(sweep, seconds=args.seconds,
                                              overrides=overrides)
     if args.e2e_seconds > 0:
-        if args.telemetry_ab:
+        if args.learning_ab:
+            out["e2e_learning_ab"] = run_learning_ab(
+                args.e2e_seconds, args.envs_per_actor, args.num_actors,
+                overrides=overrides, repeats=args.ab_repeats)
+        elif args.telemetry_ab:
             out["e2e_telemetry_ab"] = run_telemetry_ab(
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,
                 overrides=overrides)

@@ -70,6 +70,10 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None
               f" queue={record.get('ingest_queue_depth', 0)} "
               f"pause={record.get('ingest_pause_time', 0.0)}s")
     lines.append(ingest + ("   health: " + " ".join(health) if health else ""))
+    lb = record.get("learning")
+    if lb:
+        lines.append("")
+        lines.append(render_learning(lb))
     stages = record.get("stages") or {}
     if stages:
         lines.append("")
@@ -93,6 +97,51 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None
         lines.append(f"host rank {row.get('rank')}: {n} stages at "
                      f"t={row.get('t', 0):.1f}s "
                      f"(telemetry_host{row.get('rank')}.jsonl)")
+    return "\n".join(lines)
+
+
+def render_learning(lb: dict) -> str:
+    """The learning-dynamics panel (ISSUE 5): ΔQ, value-histogram
+    percentiles, grad norms, staleness — one compact block per record."""
+    lines = []
+    dq = lb.get("delta_q") or {}
+    if any(v is not None for v in dq.values()):
+        lines.append(
+            "learning: dQ stored={} zero={} recomputed={}".format(
+                *(_fmt(dq.get(k), 8).strip()
+                  for k in ("stored", "zero", "recomputed"))))
+    else:
+        lines.append("learning: (no dQ sample this interval)")
+    row = []
+    for label, key in (("|TD|", "td_abs"), ("prio", "priority"),
+                       ("|Q|", "q_abs")):
+        h = lb.get(key)
+        if h:
+            row.append(f"{label} p50={h['p50']:.4g} p95={h['p95']:.4g}")
+    if row:
+        lines.append("  " + "   ".join(row))
+    gn = lb.get("grad_norm") or {}
+    if gn:
+        lines.append("  grad-norm " + " ".join(
+            f"{k}={v.get('mean'):.4g}" for k, v in sorted(gn.items())
+            if v.get("mean") is not None))
+    age = lb.get("sample_age") or {}
+    rage = lb.get("replay_age") or {}
+    bits = []
+    if age.get("p50") is not None:
+        bits.append(f"sample-age p50={age['p50']:.0f} p95={age['p95']:.0f} "
+                    f"max={age['max']}")
+    if age.get("unknown_frac"):
+        bits.append(f"unknown={100 * age['unknown_frac']:.0f}%")
+    if rage.get("p50") is not None:
+        bits.append(f"replay-age p50={rage['p50']:.0f} p95={rage['p95']:.0f}")
+    if lb.get("target_param_dist") is not None:
+        bits.append(f"target-dist={lb['target_param_dist']:.4g}")
+    if bits:
+        lines.append("  " + "   ".join(bits))
+    if lb.get("nonfinite_steps"):
+        lines.append(f"  !! NON-FINITE steps this interval: "
+                     f"{lb['nonfinite_steps']} (see nan_dump_player*.json)")
     return "\n".join(lines)
 
 

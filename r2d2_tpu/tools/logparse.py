@@ -49,6 +49,40 @@ def parse_log(path: str) -> ParsedLog:
     return out
 
 
+def learning_series(records: List[dict]) -> dict:
+    """Time series of the ``learning`` block (ISSUE 5) across a metrics
+    JSONL stream, aligned on the records that CARRY one (training pauses
+    and pre-PR5 records are skipped, not holes). Keys: t, delta_q_stored/
+    zero/recomputed, sample_age_p50/p95, replay_age_p50, grad_norm, plus
+    td_p50/q_p50 — everything cli/plot.py --learning draws. Values are
+    None where a record's block lacked that entry (e.g. ΔQ between
+    interval steps)."""
+    out = {k: [] for k in (
+        "t", "training_steps", "delta_q_stored", "delta_q_zero",
+        "delta_q_recomputed", "sample_age_p50", "sample_age_p95",
+        "replay_age_p50", "grad_norm", "td_p50", "q_p50")}
+    for r in records:
+        lb = r.get("learning")
+        if not lb:
+            continue
+        dq = lb.get("delta_q") or {}
+        age = lb.get("sample_age") or {}
+        rage = lb.get("replay_age") or {}
+        gn = (lb.get("grad_norm") or {}).get("global") or {}
+        out["t"].append(r.get("t"))
+        out["training_steps"].append(r.get("training_steps"))
+        out["delta_q_stored"].append(dq.get("stored"))
+        out["delta_q_zero"].append(dq.get("zero"))
+        out["delta_q_recomputed"].append(dq.get("recomputed"))
+        out["sample_age_p50"].append(age.get("p50"))
+        out["sample_age_p95"].append(age.get("p95"))
+        out["replay_age_p50"].append(rage.get("p50"))
+        out["grad_norm"].append(gn.get("mean"))
+        out["td_p50"].append((lb.get("td_abs") or {}).get("p50"))
+        out["q_p50"].append((lb.get("q_abs") or {}).get("p50"))
+    return out
+
+
 def parse_jsonl(path: str, limit: Optional[int] = None) -> List[dict]:
     """All records of a metrics/telemetry JSONL stream, oldest first
     (``limit`` keeps only the newest N). Partial trailing lines — a writer

@@ -1,0 +1,722 @@
+"""Learning-dynamics observability tests (ISSUE 5): device-vs-host
+histogram parity, ΔQ against an interpreted reference unroll, staleness
+stamps end-to-end (queue transports, replay ring wrap, PR4-era blocks),
+NaN forensics (one-shot dump, warn/halt policies), record-schema
+stability, and a slow e2e slice proving the ``learning`` block lands in
+the periodic record with a nonzero sample-age distribution.
+"""
+
+import json
+import queue as queue_mod
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.models.network import NetworkApply
+from r2d2_tpu.replay.device_replay import replay_add, replay_init, replay_sample
+from r2d2_tpu.replay.structs import Block, ReplaySpec, RingAccountant, \
+    empty_block_np
+from r2d2_tpu.replay.synthetic import make_synthetic_block
+from r2d2_tpu.telemetry.histogram import bucket_index, bucket_mid, \
+    value_summary
+from r2d2_tpu.telemetry.learning import (LearningAggregator, LearningDiag,
+                                         delta_q_diag, value_counts)
+
+ACTIONS = 4
+
+
+def tiny_cfg(**overrides) -> Config:
+    cfg = Config().replace(**{
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 400, "replay.block_length": 20,
+        "replay.batch_size": 8,
+        "replay.pallas_sample_gather": "off",
+        "replay.pallas_exact_gather": "off",
+    })
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def tiny_net(cfg: Config) -> NetworkApply:
+    return NetworkApply(ACTIONS, cfg.network, cfg.env.frame_stack,
+                        cfg.env.frame_height, cfg.env.frame_width)
+
+
+def stamped_block(spec, rng, version: int):
+    blk = make_synthetic_block(spec, rng)
+    return blk.replace(
+        action=np.asarray(blk.action) % ACTIONS,
+        last_action_row=np.asarray(blk.last_action_row) % ACTIONS,
+        weight_version=np.asarray(version, np.int32))
+
+
+def filled_replay(spec, rng, n_blocks=4, start_version=1):
+    rs = replay_init(spec)
+    for i in range(n_blocks):
+        rs = replay_add(spec, rs,
+                        stamped_block(spec, rng, start_version + i))
+    return rs
+
+
+# ---------------------------------------------------------------------------
+# device-side histograms
+
+
+def test_value_hist_device_matches_host(rng):
+    # bucket midpoints: deterministically inside their bucket under both
+    # the host float64 math and the device float32 math
+    buckets = rng.integers(1, 63, size=200)
+    values = np.asarray([bucket_mid(int(b)) for b in buckets], np.float32)
+    counts = np.asarray(jax.jit(value_counts)(values))
+    ref = np.zeros(64, np.int64)
+    for v in values:
+        ref[bucket_index(float(v))] += 1
+    np.testing.assert_array_equal(counts, ref)
+    assert counts.sum() == 200
+
+
+def test_value_hist_clamps_and_signs():
+    import jax.numpy as jnp
+    vals = jnp.asarray([0.0, -0.5, 0.5, 1e12, -1e12, jnp.nan])
+    counts = np.asarray(value_counts(vals))
+    assert counts.sum() == 6
+    assert counts[0] >= 1            # 0 clamps into the bottom bucket
+    assert counts[63] >= 3           # overflow + NaN saturate the top
+    # sign is dropped: |x| histogrammed
+    assert counts[bucket_index(0.5)] == 2
+
+
+def test_value_hist_mask_excludes():
+    import jax.numpy as jnp
+    vals = jnp.asarray([[0.5, 0.5], [0.5, 0.5]])
+    mask = jnp.asarray([[1.0, 0.0], [1.0, 1.0]])
+    assert int(np.asarray(value_counts(vals, mask)).sum()) == 3
+
+
+def test_value_summary_schema():
+    counts = np.zeros(64, np.int64)
+    counts[10] = 50
+    counts[20] = 50
+    s = value_summary(counts)
+    assert s["count"] == 100
+    assert s["p50"] == pytest.approx(bucket_mid(10), rel=1e-5)
+    assert s["p99"] == pytest.approx(bucket_mid(20), rel=1e-5)
+    assert value_summary(np.zeros(64)) is None
+
+
+# ---------------------------------------------------------------------------
+# ΔQ vs an interpreted reference unroll
+
+
+def test_delta_q_matches_interpreted_reference(rng):
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    net = tiny_net(cfg)
+    params = net.init(jax.random.PRNGKey(1))
+    rs = filled_replay(spec, rng)
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(2))
+
+    m = 4
+    got = jax.jit(lambda b, r: delta_q_diag(net, spec, params, b, r, m))(
+        batch, rs)
+
+    # interpreted reference: per-row python loop, plain net.apply calls
+    def q_at(obs_row, la_row, hidden, positions):
+        T = la_row.shape[0]
+        fsi = np.arange(T)[:, None] + np.arange(spec.frame_stack)[None, :]
+        stacked = np.asarray(obs_row)[fsi]            # (T, K, H, W)
+        stacked = stacked.transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+        la = np.zeros((T, ACTIONS), np.float32)
+        valid = np.asarray(la_row) >= 0
+        la[np.arange(T)[valid], np.asarray(la_row)[valid]] = 1.0
+        q, _ = net.apply(params, stacked[None], la[None], hidden[None])
+        return np.asarray(q)[0][positions]            # (L, A)
+
+    L = spec.learning
+    dq_s = dq_z = dq_r = 0.0
+    total = 0.0
+    idxes = np.asarray(batch.idxes)[:m]
+    for row in range(m):
+        b, s = idxes[row] // spec.seqs_per_block, idxes[row] % spec.seqs_per_block
+        seq_start = int(np.asarray(rs.seq_start)[b, s])
+        burn = int(np.asarray(batch.burn_in_steps)[row])
+        learn = int(np.asarray(batch.learning_steps)[row])
+        opos = burn + np.arange(L)
+        q_sto = q_at(np.asarray(batch.obs)[row],
+                     np.asarray(batch.last_action)[row],
+                     np.asarray(batch.hidden)[row], opos)
+        q_zer = q_at(np.asarray(batch.obs)[row],
+                     np.asarray(batch.last_action)[row],
+                     np.zeros((2, spec.hidden_dim), np.float32), opos)
+        q_rec = q_at(np.asarray(rs.obs)[b], np.asarray(rs.last_action)[b],
+                     np.zeros((2, spec.hidden_dim), np.float32),
+                     seq_start + np.arange(L))
+        for j in range(L):
+            w = 1.0 if j < learn else 0.0
+            total += w
+            scale_r = np.abs(q_rec[j]).max() + 1e-3
+            scale_s = np.abs(q_sto[j]).max() + 1e-3
+            dq_s += w * np.linalg.norm(q_sto[j] - q_rec[j]) / scale_r
+            dq_z += w * np.linalg.norm(q_zer[j] - q_rec[j]) / scale_r
+            dq_r += w * np.linalg.norm(q_rec[j] - q_sto[j]) / scale_s
+    ref = np.asarray([dq_s, dq_z, dq_r]) / max(total, 1.0)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-3, atol=2e-4)
+
+
+def test_delta_q_stored_is_small_when_stored_state_is_true(rng):
+    """When the stored hidden IS the state the full-context unroll reaches
+    at the window start, the stored strategy must beat the zero strategy
+    — the paper's Fig. 4 ordering, reproduced exactly."""
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    net = tiny_net(cfg)
+    params = net.init(jax.random.PRNGKey(1))
+    rs = filled_replay(spec, rng)
+
+    # overwrite the stored hiddens with the TRUE full-context states:
+    # unroll each block row from zero and snapshot at each window start
+    def true_hiddens(obs_row, la_row, seq_starts, burn_ins):
+        T = la_row.shape[0]
+        fsi = np.arange(T)[:, None] + np.arange(spec.frame_stack)[None, :]
+        stacked = np.asarray(obs_row)[fsi].transpose(0, 2, 3, 1)\
+            .astype(np.float32) / 255.0
+        la = np.zeros((T, ACTIONS), np.float32)
+        valid = np.asarray(la_row) >= 0
+        la[np.arange(T)[valid], np.asarray(la_row)[valid]] = 1.0
+        hid = np.zeros((2, spec.hidden_dim), np.float32)
+        out = np.zeros((spec.seqs_per_block, 2, spec.hidden_dim), np.float32)
+        starts = {int(s) - int(b): i
+                  for i, (s, b) in enumerate(zip(seq_starts, burn_ins))}
+        for t in range(T):
+            if t in starts:
+                out[starts[t]] = hid
+            _, packed = net.apply(params, stacked[t][None, None],
+                                  la[t][None, None], hid[None])
+            hid = np.asarray(packed)[0]
+        return out
+
+    hid_ring = np.asarray(rs.hidden).copy()
+    for b in range(spec.num_blocks):
+        hid_ring[b] = true_hiddens(
+            np.asarray(rs.obs)[b], np.asarray(rs.last_action)[b],
+            np.asarray(rs.seq_start)[b], np.asarray(rs.burn_in_steps)[b])
+    rs = rs.replace(hidden=hid_ring)
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(3))
+    dq_s, dq_z, dq_r = [float(x) for x in
+                        delta_q_diag(net, spec, params, batch, rs, 8)]
+    assert dq_s < 1e-2, dq_s           # stored+burn-in ≈ the reference
+    assert dq_r < 1e-2, dq_r
+    assert dq_z > dq_s                 # zero-state discrepancy is larger
+
+
+# ---------------------------------------------------------------------------
+# fused-step integration
+
+
+def _fused_setup(rng, diag, **cfg_over):
+    from r2d2_tpu.learner.train_step import (create_train_state,
+                                             make_learner_step)
+    cfg = tiny_cfg(**cfg_over)
+    spec = ReplaySpec.from_config(cfg)
+    net = tiny_net(cfg)
+    ts = create_train_state(jax.random.PRNGKey(0), net, cfg.optim)
+    rs = filled_replay(spec, rng)
+    step = make_learner_step(net, spec, cfg.optim, cfg.network.use_double,
+                             diag=diag)
+    return cfg, spec, ts, rs, step
+
+
+def test_fused_step_emits_learning_metrics(rng):
+    cfg, spec, ts, rs, step = _fused_setup(
+        rng, LearningDiag(interval=1, dq_batch=4))
+    ts, rs, m = step(ts, rs)
+    valid = int(np.asarray(m["ld/td_hist"]).sum())
+    # one histogram entry per VALID learning step of the batch
+    assert valid == int(np.asarray(jax.device_get(ts.step)) * 0 +
+                        sum(min(spec.learning, l) for l in
+                            [spec.learning] * spec.batch_size))
+    assert int(np.asarray(m["ld/prio_hist"]).sum()) == spec.batch_size
+    assert int(np.asarray(m["ld/q_hist"]).sum()) == valid
+    for k in ("ld/grad_norm", "ld/grad_norm_torso", "ld/grad_norm_lstm",
+              "ld/grad_norm_head", "ld/target_dist", "ld/delta_q_stored",
+              "ld/delta_q_zero", "ld/delta_q_recomputed"):
+        assert np.isfinite(float(np.asarray(m[k]))), k
+    assert int(m["ld/nonfinite"]) == 0
+    assert np.asarray(m["ld/weight_versions"]).shape == (spec.batch_size,)
+    assert np.all(np.asarray(m["ld/weight_versions"]) >= 1)
+    assert np.asarray(m["ld/batch_idxes"]).shape == (spec.batch_size,)
+
+
+def test_fused_step_interval_gates_delta_q(rng):
+    cfg, spec, ts, rs, step = _fused_setup(
+        rng, LearningDiag(interval=2, dq_batch=4))
+    ts, rs, m1 = step(ts, rs)     # step 1: off-interval
+    ts, rs, m2 = step(ts, rs)     # step 2: interval fires
+    assert np.isnan(float(m1["ld/delta_q_stored"]))
+    assert np.isnan(float(m1["ld/target_dist"]))
+    assert np.isfinite(float(m2["ld/delta_q_stored"]))
+    assert np.isfinite(float(m2["ld/target_dist"]))
+    # histograms flow EVERY step regardless of the interval
+    assert int(np.asarray(m1["ld/td_hist"]).sum()) > 0
+
+
+def test_fused_step_without_diag_has_no_ld_keys(rng):
+    cfg, spec, ts, rs, step = _fused_setup(rng, None)
+    ts, rs, m = step(ts, rs)
+    assert not any(k.startswith("ld/") for k in m)
+    assert {"loss", "mean_abs_td", "mean_q", "grad_norm"} <= set(m)
+
+
+def test_multi_step_dispatch_stacks_diag(rng):
+    from r2d2_tpu.learner.train_step import (create_train_state,
+                                             make_multi_learner_step)
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    net = tiny_net(cfg)
+    ts = create_train_state(jax.random.PRNGKey(0), net, cfg.optim)
+    rs = filled_replay(spec, rng)
+    step = make_multi_learner_step(net, spec, cfg.optim,
+                                   cfg.network.use_double, 4,
+                                   diag=LearningDiag(interval=2, dq_batch=4))
+    ts, rs, m = step(ts, rs)
+    assert np.asarray(m["ld/td_hist"]).shape == (4, 64)
+    dq = np.asarray(m["ld/delta_q_zero"])
+    assert dq.shape == (4,)
+    # carried step counter drives the cadence inside the scan: steps 2, 4
+    assert np.isnan(dq[0]) and np.isnan(dq[2])
+    assert np.isfinite(dq[1]) and np.isfinite(dq[3])
+
+
+def test_sharded_step_diag_is_replicated_and_global(rng):
+    from r2d2_tpu.config import MeshConfig
+    from r2d2_tpu.learner.train_step import create_train_state
+    from r2d2_tpu.parallel import (make_mesh, make_sharded_learner_step,
+                                   make_sharded_replay_add,
+                                   sharded_replay_init)
+    cfg = tiny_cfg(**{"mesh.dp": 2})
+    spec = ReplaySpec.from_config(cfg)
+    net = tiny_net(cfg)
+    ts = create_train_state(jax.random.PRNGKey(0), net, cfg.optim)
+    mesh = make_mesh(cfg.mesh)
+    rs = sharded_replay_init(spec, mesh)
+    add = make_sharded_replay_add(spec, mesh)
+    for i in range(4):
+        rs = add(rs, stamped_block(spec, rng, i + 1), i % 2)
+    step = make_sharded_learner_step(
+        net, spec, cfg.optim, cfg.network.use_double, mesh,
+        diag=LearningDiag(interval=1, dq_batch=4))
+    ts, rs, m = step(ts, rs)
+    # histograms psum over shards: GLOBAL batch counts (dp * B sequences)
+    assert int(np.asarray(m["ld/prio_hist"]).sum()) == 2 * spec.batch_size
+    assert np.isfinite(float(m["ld/delta_q_stored"]))
+    assert float(m["ld/version_min"]) >= 1.0
+    assert float(m["ld/version_max"]) <= 4.0
+    # raw per-sample vectors are omitted on the reduced sharded path
+    assert "ld/weight_versions" not in m
+
+
+def test_external_batch_step_diag_host_mode(rng):
+    from r2d2_tpu.learner.train_step import (create_train_state,
+                                             make_external_batch_step)
+    from r2d2_tpu.replay.host_replay import HostReplay
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    net = tiny_net(cfg)
+    ts = create_train_state(jax.random.PRNGKey(0), net, cfg.optim)
+    hr = HostReplay(spec, seed=0)
+    for i in range(4):
+        hr.add(stamped_block(spec, rng, i + 1))
+    batch, _ = hr.sample()
+    step = make_external_batch_step(net, spec, cfg.optim,
+                                    cfg.network.use_double,
+                                    diag=LearningDiag(interval=1,
+                                                      dq_batch=4))
+    ts, m = step(ts, jax.device_put(batch))
+    assert int(np.asarray(m["ld/td_hist"]).sum()) > 0
+    assert np.all(np.asarray(m["ld/weight_versions"]) >= 1)
+    # ΔQ needs the device-resident ring context: NaN in host placement
+    assert np.isnan(float(m["ld/delta_q_stored"]))
+    assert np.isfinite(float(m["ld/target_dist"]))
+
+
+# ---------------------------------------------------------------------------
+# staleness stamps end-to-end
+
+
+def test_staleness_stamp_survives_ring_wrap(rng):
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)          # 20 ring rows
+    rs = replay_init(spec)
+    n = spec.num_blocks
+    for i in range(n + 3):                      # wrap by 3
+        rs = replay_add(spec, rs, stamped_block(spec, rng, i + 1))
+    ring = np.asarray(rs.weight_version)
+    # rows 0..2 overwritten by the wrapped adds n+1..n+3
+    assert list(ring[:3]) == [n + 1, n + 2, n + 3]
+    assert list(ring[3:]) == list(range(4, n + 1))
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(0))
+    assert set(int(v) for v in np.asarray(batch.weight_version)) <= set(
+        range(4, n + 4))
+
+
+def test_staleness_stamp_survives_queue_transports(rng):
+    from r2d2_tpu.runtime.feeder import BlockQueue
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    blk = stamped_block(spec, rng, 37)
+    # shm ring (falls back to mp.Queue when the native toolchain is
+    # absent — the stamp must survive either backend), mp.Queue, thread
+    for q in (BlockQueue(maxsize=4, use_mp=True, shm_spec=spec),
+              BlockQueue(maxsize=4, use_mp=True),
+              BlockQueue(maxsize=4, use_mp=False)):
+        try:
+            q.put(blk, timeout=5.0)
+            got = q.get(timeout=5.0)
+            assert int(np.asarray(got.weight_version)) == 37
+            q.put(blk, timeout=5.0)
+            q.put(stamped_block(spec, rng, 41), timeout=5.0)
+            # mp.Queue's feeder thread makes items poppable asynchronously
+            # (qsize can lead get_nowait) — accumulate until both arrive
+            import time
+            deadline = time.time() + 10.0
+            versions = []
+            while len(versions) < 2 and time.time() < deadline:
+                stacked, k = q.drain_stacked(4)
+                if k:
+                    versions += [int(v) for v in
+                                 np.asarray(stacked.weight_version)]
+                else:
+                    time.sleep(0.01)
+            assert versions == [37, 41]
+        finally:
+            q.close()
+
+
+def test_host_replay_carries_stamp(rng):
+    from r2d2_tpu.replay.host_replay import HostReplay
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    hr = HostReplay(spec, seed=0)
+    for i in range(3):
+        hr.add(stamped_block(spec, rng, 10 + i))
+    batch, _ = hr.sample()
+    assert set(int(v) for v in np.asarray(batch.weight_version)) <= {10, 11, 12}
+    assert hr.ring.live_versions() == [10, 11, 12]
+
+
+def test_pr4_era_block_defaults_to_unknown(rng):
+    """A PR4-era record — no weight_version field — must construct, flow
+    through replay, and report its age as unknown, not crash."""
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    legacy = {k: v for k, v in empty_block_np(spec).items()
+              if k != "weight_version"}
+    blk = Block(**legacy)                       # default: -1 = unknown
+    assert int(np.asarray(blk.weight_version)) == -1
+    rs = replay_init(spec)
+    rs = replay_add(spec, rs, blk.replace(
+        priority=np.ones((spec.seqs_per_block,), np.float32),
+        learning_steps=np.full((spec.seqs_per_block,), spec.learning,
+                               np.int32)))
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(0))
+    assert np.all(np.asarray(batch.weight_version) == -1)
+    agg = LearningAggregator(0, ".", "warn", 1e-4)
+    agg.on_dispatch({"ld/weight_versions": np.asarray(batch.weight_version)})
+    block = agg.flush(1, publish_count=5)
+    assert block["sample_age"]["unknown_frac"] == 1.0
+    assert "p50" not in block["sample_age"]
+
+
+def test_instrument_sink_stamps_weight_version(rng):
+    from r2d2_tpu.runtime.actor_loop import instrument_block_sink
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    seen = []
+    sink = instrument_block_sink(cfg, 0, seen.append,
+                                 weight_version=lambda: 9)
+    sink(stamped_block(spec, rng, -1))
+    assert int(np.asarray(seen[0].weight_version)) == 9
+
+
+def test_ring_accountant_tracks_versions():
+    ring = RingAccountant(3)
+    ring.advance(10, 5)
+    ring.advance(10, 6)
+    assert ring.live_versions() == [5, 6]
+    ring.advance(10, 7)
+    ring.advance(10, 8)                          # wraps slot 0
+    assert ring.live_versions() == [8, 6, 7]
+    ring.advance(0)                              # empty block, unstamped
+    assert ring.live_versions() == [8, 7]        # slot 1 emptied
+
+
+def test_weight_service_publish_counts(rng):
+    from r2d2_tpu.runtime.weights import (InProcWeightStore, WeightPublisher,
+                                          WeightSubscriber)
+    params = {"w": np.arange(8, dtype=np.float32)}
+    pub = WeightPublisher(params)
+    try:
+        assert pub.publish_count == 1            # the __init__ publish
+        sub = WeightSubscriber(pub.name, params)
+        assert sub.publish_count == 0            # nothing adopted yet
+        assert sub.poll() is not None
+        assert sub.publish_count == 1
+        pub.publish(params)
+        pub.publish(params)
+        assert pub.publish_count == 3
+        assert sub.poll() is not None
+        assert sub.publish_count == 3
+        sub.close()
+    finally:
+        pub.close()
+    store = InProcWeightStore(params)
+    assert store.publish_count == 1
+    assert store.reader_version(0) == 1          # never polled: init params
+    store.publish(params)
+    assert store.poll(0) is not None
+    assert store.reader_version(0) == 2 == store.publish_count
+
+
+# ---------------------------------------------------------------------------
+# aggregation + NaN forensics
+
+
+def _fake_dispatch(nonfinite=0, versions=(3, 4), dq=0.25):
+    hist = np.zeros(64, np.int64)
+    hist[12] = 7
+    return {
+        "ld/td_hist": hist, "ld/prio_hist": hist, "ld/q_hist": hist,
+        "ld/grad_norm": np.float32(1.5),
+        "ld/grad_norm_torso": np.float32(0.5),
+        "ld/nonfinite": np.int32(nonfinite),
+        "ld/weight_versions": np.asarray(versions, np.int32),
+        "ld/batch_idxes": np.asarray([1, 2], np.int32),
+        "ld/target_dist": np.float32(0.1),
+        "ld/delta_q_stored": np.float32(dq),
+        "ld/delta_q_zero": np.float32(2 * dq),
+        "ld/delta_q_recomputed": np.float32(dq),
+    }
+
+
+def test_aggregator_builds_learning_block(tmp_path):
+    agg = LearningAggregator(0, str(tmp_path), "warn", 1e-4)
+    agg.on_dispatch(_fake_dispatch())
+    agg.on_dispatch(_fake_dispatch(dq=np.nan))
+    block = agg.flush(10, publish_count=6,
+                      occupancy_versions=[2, 5, -1])
+    assert block["td_abs"]["count"] == 14       # two dispatches merged
+    assert block["grad_norm"]["global"]["mean"] == 1.5
+    assert block["grad_norm"]["torso"]["mean"] == 0.5
+    assert block["delta_q"]["stored"] == 0.25   # last FINITE value
+    assert block["target_param_dist"] == pytest.approx(0.1)
+    age = block["sample_age"]
+    assert age["p50"] == 2.5 and age["max"] == 3  # pub 6 - versions {3,4}
+    assert age["unknown_frac"] == 0.0
+    rage = block["replay_age"]
+    assert rage["max"] == 4 and rage["slots"] == 2
+    assert rage["unknown_slots"] == 1
+    assert block["nonfinite_steps"] == 0
+    # flush consumed the interval
+    assert agg.flush(11) is None
+
+
+def test_aggregator_handles_multi_step_stacked_rows(tmp_path):
+    agg = LearningAggregator(0, str(tmp_path), "warn", 1e-4)
+    d = _fake_dispatch()
+    # (K, 64) histograms and (K, B) versions, as the k-step scan stacks
+    d["ld/td_hist"] = np.stack([d["ld/td_hist"]] * 3)
+    d["ld/weight_versions"] = np.asarray([[3, 4], [5, 6], [7, 8]], np.int32)
+    agg.on_dispatch(d)
+    block = agg.flush(3, publish_count=10)
+    assert block["td_abs"]["count"] == 21
+    assert block["sample_age"]["max"] == 7      # oldest = version 3
+
+
+def test_nan_dump_fires_exactly_once(tmp_path):
+    agg = LearningAggregator(0, str(tmp_path), "warn", 1e-4)
+    agg.on_dispatch(_fake_dispatch(nonfinite=1))
+    block = agg.flush(5, publish_count=6)
+    assert block["nonfinite_steps"] == 1
+    path = tmp_path / "nan_dump_player0.json"
+    assert path.exists()
+    dump = json.loads(path.read_text())
+    assert dump["step"] == 5 and dump["lr"] == 1e-4
+    assert dump["last_batch_idxes"] == [1, 2]
+    assert "td_abs_counts" in dump["histograms"]
+    stamp = path.stat().st_mtime_ns
+    # a second poisoned interval must NOT rewrite the dump
+    agg.on_dispatch(_fake_dispatch(nonfinite=1))
+    agg.flush(6, publish_count=7)
+    assert path.stat().st_mtime_ns == stamp
+    assert agg.nan_dumped
+
+
+def test_nan_policy_halt_raises_after_dump(tmp_path):
+    agg = LearningAggregator(1, str(tmp_path), "halt", 1e-4)
+    agg.on_dispatch(_fake_dispatch(nonfinite=1))
+    with pytest.raises(RuntimeError, match="nan_policy=halt"):
+        agg.flush(5, publish_count=6)
+    assert (tmp_path / "nan_dump_player1.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# config + record schema
+
+
+def test_config_roundtrips_learning_fields():
+    cfg = tiny_cfg(**{"telemetry.learning_enabled": False,
+                      "telemetry.learning_interval": 77,
+                      "telemetry.learning_dq_batch": 9,
+                      "telemetry.nan_policy": "halt"})
+    back = Config.from_json(cfg.to_json())
+    assert back.telemetry.learning_enabled is False
+    assert back.telemetry.learning_interval == 77
+    assert back.telemetry.learning_dq_batch == 9
+    assert back.telemetry.nan_policy == "halt"
+
+
+def test_pre_pr5_config_dict_loads_with_defaults():
+    d = Config().to_dict()
+    # a PR4-era checkpoint config: telemetry section without the new keys
+    for k in ("learning_enabled", "learning_interval", "learning_dq_batch",
+              "nan_policy"):
+        del d["telemetry"][k]
+    cfg = Config.from_dict(d)
+    assert cfg.telemetry.learning_enabled is True
+    assert cfg.telemetry.nan_policy == "warn"
+    assert LearningDiag.from_config(cfg) is not None
+
+
+def test_learning_diag_gating():
+    assert LearningDiag.from_config(
+        tiny_cfg(**{"telemetry.learning_enabled": False})) is None
+    assert LearningDiag.from_config(
+        tiny_cfg(**{"telemetry.enabled": False})) is None
+    d = LearningDiag.from_config(tiny_cfg())
+    assert d == LearningDiag(interval=200, dq_batch=16)
+
+
+def test_config_validates_learning_fields():
+    with pytest.raises(ValueError, match="learning_interval"):
+        tiny_cfg(**{"telemetry.learning_interval": 0})
+    with pytest.raises(ValueError, match="nan_policy"):
+        tiny_cfg(**{"telemetry.nan_policy": "explode"})
+
+
+def test_record_schema_learning_block(tmp_path):
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+    m = TrainMetrics(0, str(tmp_path))
+    m.set_learning({"delta_q": {"stored": 0.1}})
+    record = m.log(1.0)
+    assert record["learning"]["delta_q"]["stored"] == 0.1
+    # PR2/3/4 keys unaffected (schema stability)
+    for key in ("buffer_size", "env_steps", "training_steps", "loss",
+                "ingest_blocks_total", "ingest_drains", "actor_restarts",
+                "actor_parked_slots", "heartbeat_age_max_s"):
+        assert key in record, key
+    # consumed on emission; absent when nothing was set
+    record2 = m.log(1.0)
+    assert "learning" not in record2
+    # and the block round-trips the JSONL stream
+    from r2d2_tpu.tools.logparse import learning_series, parse_jsonl
+    records = parse_jsonl(str(tmp_path / "metrics_player0.jsonl"))
+    series = learning_series(records)
+    assert series["delta_q_stored"] == [0.1]
+
+
+def test_plot_cli_learning_mode(tmp_path):
+    import os
+    recs = [{"t": float(i), "training_steps": i * 10,
+             "learning": {
+                 "delta_q": {"stored": 0.1 + i * 0.01, "zero": 0.5,
+                             "recomputed": 0.1},
+                 "sample_age": {"p50": 2.0, "p95": 5.0, "max": 9,
+                                "unknown_frac": 0.0},
+                 "grad_norm": {"global": {"mean": 1.0, "max": 2.0}},
+                 "td_abs": {"count": 10, "p50": 0.1, "p95": 0.3,
+                            "p99": 0.5},
+             }} for i in range(6)]
+    with open(tmp_path / "metrics_player0.jsonl", "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    out = str(tmp_path / "learning.png")
+    from r2d2_tpu.cli.plot import main
+    main(["--learning", "--file_path", str(tmp_path), "--out", out])
+    assert os.path.getsize(out) > 1000
+
+
+def test_render_learning_panel():
+    from r2d2_tpu.tools.inspect import render_record
+    frame = render_record({
+        "t": 10.0, "env_steps": 100, "training_steps": 5, "buffer_size": 50,
+        "learning": {
+            "delta_q": {"stored": 0.12, "zero": 0.5, "recomputed": 0.11},
+            "td_abs": {"count": 10, "p50": 0.1, "p95": 0.2, "p99": 0.3},
+            "grad_norm": {"global": {"mean": 1.0, "max": 2.0}},
+            "sample_age": {"p50": 2.0, "p95": 4.0, "max": 6,
+                           "unknown_frac": 0.0},
+            "replay_age": {"p50": 1.0, "p95": 3.0, "max": 5, "slots": 4,
+                           "unknown_slots": 0},
+            "nonfinite_steps": 1,
+        }})
+    assert "dQ stored=0.12" in frame.replace("0.120000", "0.12")
+    assert "sample-age p50=2" in frame
+    assert "NON-FINITE" in frame
+
+
+# ---------------------------------------------------------------------------
+# slow e2e slice: the learning block lands end-to-end
+
+
+@pytest.mark.slow
+def test_e2e_learning_block_and_kill_switch(tmp_path):
+    from r2d2_tpu.runtime.orchestrator import train
+    from tests.test_runtime import tiny_config
+
+    cfg = tiny_config(tmp_path, **{
+        "runtime.save_interval": 0,
+        "runtime.log_interval": 1.0,
+        "runtime.weight_publish_interval": 1,
+        "telemetry.learning_interval": 5,
+        "telemetry.learning_dq_batch": 4,
+    })
+    records = []
+    stacks = train(cfg, max_training_steps=30, max_seconds=180,
+                   actor_mode="thread", log_fn=records.append)
+    assert stacks[0].learner.training_steps >= 30
+    blocks = [r["learning"] for r in records if r.get("learning")]
+    assert blocks, "no learning block in any record"
+    # ΔQ fired at the 5-step cadence inside the run
+    dq = [b["delta_q"] for b in blocks if b.get("delta_q")]
+    assert dq and all(
+        np.isfinite(d[k]) for d in dq
+        for k in ("stored", "zero", "recomputed")), dq
+    # histograms + grad norms present
+    assert any(b.get("td_abs") for b in blocks)
+    assert any(b.get("grad_norm", {}).get("global") for b in blocks)
+    # NONZERO sample-age distribution: publishes advanced past the
+    # generation stamps of replayed experience
+    ages = [b["sample_age"] for b in blocks if b.get("sample_age")]
+    assert ages, "no sample ages aggregated"
+    assert max(a.get("max", 0) for a in ages) > 0
+    assert all(a.get("unknown_frac", 1.0) < 1.0 for a in ages)
+    # occupancy ages ride along
+    assert any(b.get("replay_age") for b in blocks)
+
+    # kill switch: same system, learning_enabled=false -> no block at all
+    cfg_off = tiny_config(tmp_path / "off", **{
+        "runtime.save_interval": 0,
+        "runtime.log_interval": 1.0,
+        "telemetry.learning_enabled": False,
+    })
+    records_off = []
+    train(cfg_off, max_training_steps=10, max_seconds=120,
+          actor_mode="thread", log_fn=records_off.append)
+    assert records_off
+    assert all("learning" not in r for r in records_off)
+    assert not (tmp_path / "off" / "nan_dump_player0.json").exists()
