@@ -20,8 +20,13 @@
 #                parity, dQ reference agreement, staleness stamps through
 #                shm/mp/ring-wrap, NaN forensics, record schema); the
 #                slow e2e slice runs with the full tier.
+#   make anakin — the fast-tier on-device acting suite
+#                (tests/test_anakin.py: jitted-env parity, block-layout
+#                parity with the host sink, replay-state identity, the
+#                fused loop, kill switch); the slow gridworld
+#                learnability slice runs with the full tier.
 
-.PHONY: t1 chaos telemetry learning check-fast-markers
+.PHONY: t1 chaos telemetry learning anakin check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -38,40 +43,33 @@ learning: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_learning_diag.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+anakin: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_anakin.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
+# One guard per suite: module:marker:min-collected:label (marker spelled
+# with underscores for spaces). A stray @pytest.mark.slow (or a marker
+# typo) silently drops tests from the fast tier; the count floor catches
+# it.
+FAST_MARKER_CHECKS := \
+	tests/test_ingest.py:not_slow:10:ingestion \
+	tests/test_chaos.py:chaos_and_not_slow:12:chaos \
+	tests/test_telemetry.py:not_slow:20:telemetry \
+	tests/test_learning_diag.py:not_slow:12:learning-diagnostics \
+	tests/test_anakin.py:not_slow:10:anakin
+
 check-fast-markers:
-	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_ingest.py \
-	    -m 'not slow' --collect-only -q -p no:cacheprovider 2>/dev/null \
-	    | grep -c '::'); \
-	if [ "$$n" -ge 10 ]; then \
-	    echo "fast-tier ingestion tests collected: $$n"; \
-	else \
-	    echo "ERROR: ingestion tests missing from the 'not slow' tier ($$n collected)"; \
-	    exit 1; \
-	fi
-	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
-	    -m 'chaos and not slow' --collect-only -q -p no:cacheprovider 2>/dev/null \
-	    | grep -c '::'); \
-	if [ "$$n" -ge 12 ]; then \
-	    echo "fast-tier chaos tests collected: $$n"; \
-	else \
-	    echo "ERROR: chaos tests missing from the 'chaos and not slow' tier ($$n collected)"; \
-	    exit 1; \
-	fi
-	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
-	    -m 'not slow' --collect-only -q -p no:cacheprovider 2>/dev/null \
-	    | grep -c '::'); \
-	if [ "$$n" -ge 20 ]; then \
-	    echo "fast-tier telemetry tests collected: $$n"; \
-	else \
-	    echo "ERROR: telemetry tests missing from the 'not slow' tier ($$n collected)"; \
-	    exit 1; \
-	fi
-	@n=$$(JAX_PLATFORMS=cpu python -m pytest tests/test_learning_diag.py \
-	    -m 'not slow' --collect-only -q -p no:cacheprovider 2>/dev/null \
-	    | grep -c '::'); \
-	if [ "$$n" -ge 12 ]; then \
-	    echo "fast-tier learning-diagnostics tests collected: $$n"; \
-	else \
-	    echo "ERROR: learning-diagnostics tests missing from the 'not slow' tier ($$n collected)"; \
-	    exit 1; \
-	fi
+	@for spec in $(FAST_MARKER_CHECKS); do \
+	    mod=$${spec%%:*}; rest=$${spec#*:}; \
+	    marker=$$(echo "$${rest%%:*}" | tr '_' ' '); rest=$${rest#*:}; \
+	    min=$${rest%%:*}; label=$${rest#*:}; \
+	    n=$$(JAX_PLATFORMS=cpu python -m pytest "$$mod" \
+	        -m "$$marker" --collect-only -q -p no:cacheprovider 2>/dev/null \
+	        | grep -c '::'); \
+	    if [ "$$n" -ge "$$min" ]; then \
+	        echo "fast-tier $$label tests collected: $$n"; \
+	    else \
+	        echo "ERROR: $$label tests missing from the '$$marker' tier ($$n collected)"; \
+	        exit 1; \
+	    fi; \
+	done

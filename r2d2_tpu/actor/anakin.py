@@ -1,0 +1,334 @@
+"""Fully on-device acting: batched jitted env + policy + block assembly
+fused into ONE compiled program (Podracer "Anakin", arxiv 2104.06272).
+
+The host actor fleet pays, per env step and per lane: a Python interpreter
+round-trip, a jit dispatch, numpy frame-stack rolls, and LocalBuffer list
+appends — the structural wall PERF.md quantifies (~1.8k env-steps/s for the
+whole CPU fleet vs 11k+ learner seq-updates/s/chip). Here one acting
+*segment* is a single ``lax.scan`` over ``block_length`` steps of N
+batched lanes — pure-JAX env step (envs/jax_env.py), network forward,
+ε-greedy, auto-reset — followed by in-graph assembly of one replay Block
+per lane, emitted with a leading N axis so ``replay_add_many`` ring-writes
+all N blocks in its one donated dispatch. Zero host transfers on the hot
+path; the colocated learner's params are read by reference.
+
+Semantics match the host pipeline exactly where they can be compared
+(parity-tested in tests/test_anakin.py against LocalBuffer block for
+block):
+
+  * timeline layout, burn-in carry across segments, stored hidden states
+    at each sequence's window start, n-step returns, and the gamma tail
+    encoding termination/bootstrap are the LocalBuffer rules
+    (actor/local_buffer.py) re-expressed as gathers;
+  * auto-reset follows envs/vector.py: the done step records the TRUE
+    terminal observation; the next step starts the new episode with a
+    duplicated-initial-frame stack, zero hidden, null last action;
+  * episode ends must land on block boundaries (Config validates
+    ``episode_len % block_length == 0``), which is exactly the host
+    loop's behavior on fixed-length episodes — emit-on-done and
+    emit-on-block-boundary coincide;
+  * the ONE deliberate divergence: initial priorities are a constant
+    stamp (``actor.anakin_priority``) instead of the actor's own TD
+    estimates — computing those on device would add a bootstrap unroll
+    per block; the learner's first sample of each sequence writes the
+    real TD priority back.
+"""
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from r2d2_tpu.models.network import NetworkApply
+from r2d2_tpu.replay.structs import Block, ReplaySpec
+
+
+class ActCarry(struct.PyTreeNode):
+    """Per-lane acting state carried across segments (leading N axis).
+
+    ``cur_stack``/``hidden``/``last_action`` are the policy's per-step
+    state (the scalar ActorPolicy's stacked/hidden/last_action, batched);
+    ``tail_*``/``burn0`` are the LocalBuffer's burn-in carry — the last
+    ``stack+burn_in`` frames, ``burn_in+1`` actions and hidden snapshots
+    of the timeline, RIGHT-ALIGNED in fixed-size buffers with ``burn0``
+    (the host's ``curr_burn_in``) marking how much of each is live."""
+
+    env_state: Any              # vmapped env pytree
+    cur_stack: jnp.ndarray      # (N, stack, H, W) uint8, oldest -> newest
+    hidden: jnp.ndarray         # (N, 2, hidden) f32 packed
+    last_action: jnp.ndarray    # (N,) int32, -1 = null
+    tail_frames: jnp.ndarray    # (N, stack + B, H, W) uint8
+    tail_la: jnp.ndarray        # (N, B + 1) int32
+    tail_hidden: jnp.ndarray    # (N, B + 1, 2, hidden) f32
+    burn0: jnp.ndarray          # (N,) int32 — live burn-in length
+    ep_return: jnp.ndarray      # (N,) f32 — return of the episode in flight
+    key: jax.Array
+
+
+def init_act_carry(env, spec: ReplaySpec, num_lanes: int,
+                   key: jax.Array) -> ActCarry:
+    """Fresh-episode carry for every lane: duplicated initial frames in
+    the stack (the host policy's observe_reset), zero hidden, null last
+    action, zero burn-in — the LocalBuffer.reset state, batched."""
+    k_env, k_run = jax.random.split(key)
+    env_state, obs = jax.vmap(env.reset)(jax.random.split(k_env, num_lanes))
+    obs = jnp.asarray(obs, jnp.uint8)
+    n, b, stack = num_lanes, spec.burn_in, spec.frame_stack
+    cur_stack = jnp.repeat(obs[:, None], stack, axis=1)
+    tail_frames = jnp.zeros(
+        (n, stack + b, spec.frame_height, spec.frame_width), jnp.uint8
+    ).at[:, b:].set(cur_stack)
+    return ActCarry(
+        env_state=env_state,
+        cur_stack=cur_stack,
+        hidden=jnp.zeros((n, 2, spec.hidden_dim), jnp.float32),
+        last_action=jnp.full((n,), -1, jnp.int32),
+        tail_frames=tail_frames,
+        tail_la=jnp.full((n, b + 1), -1, jnp.int32),
+        tail_hidden=jnp.zeros((n, b + 1, 2, spec.hidden_dim), jnp.float32),
+        burn0=jnp.zeros((n,), jnp.int32),
+        ep_return=jnp.zeros((n,), jnp.float32),
+        key=k_run,
+    )
+
+
+def _take_rows(buf: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane gather along the time axis: buf (N, T, ...), idx (N, R)."""
+    return jax.vmap(lambda b, i: jnp.take(b, i, axis=0))(buf, idx)
+
+
+def emit_blocks(spec: ReplaySpec, gamma: float, priority: float,
+                tail_frames: jnp.ndarray, tail_la: jnp.ndarray,
+                tail_hidden: jnp.ndarray, burn0: jnp.ndarray,
+                obs: jnp.ndarray, actions: jnp.ndarray,
+                rewards: jnp.ndarray, hiddens: jnp.ndarray,
+                terminal: jnp.ndarray, final_return: jnp.ndarray,
+                report_mask: jnp.ndarray, reset_obs: jnp.ndarray,
+                weight_version) -> Tuple[Block, tuple]:
+    """LocalBuffer.finish, re-expressed as array ops over one segment.
+
+    Inputs are lane-major: ``obs``/``actions``/``rewards``/``hiddens``
+    are (N, L, ...) per-step records (obs = TRUE next observation incl.
+    the terminal frame; hiddens = packed state AFTER each step), the
+    ``tail_*``/``burn0`` the previous segment's burn-in carry, and
+    ``terminal`` whether the segment's last step ended the episode.
+    Returns N fixed-shape Blocks (leading N axis — ``replay_add_many``'s
+    stacked-drain layout) plus the next segment's carry tails.
+
+    The timeline of block row position ``i`` is ``frames_all[i]`` where
+    ``frames_all = tail ++ segment`` — right-aligned tails make the
+    offset a single per-lane constant ``B - burn0`` (see ActCarry)."""
+    n, l_seg = actions.shape
+    b, f, lrn = spec.burn_in, spec.forward, spec.learning
+    s, stack = spec.seqs_per_block, spec.frame_stack
+    assert l_seg == spec.block_length
+
+    buf_frames = jnp.concatenate([tail_frames, obs], axis=1)
+    buf_la = jnp.concatenate([tail_la, actions], axis=1)
+    buf_hid = jnp.concatenate([tail_hidden, hiddens], axis=1)
+
+    # --- obs / last-action rows (zero-padded past the live timeline) ---
+    r_idx = jnp.arange(spec.obs_row_len, dtype=jnp.int32)
+    idx = b - burn0[:, None] + r_idx[None, :]
+    valid = r_idx[None, :] < stack + burn0[:, None] + l_seg
+    obs_row = jnp.where(
+        valid[:, :, None, None],
+        _take_rows(buf_frames, jnp.clip(idx, 0, buf_frames.shape[1] - 1)),
+        jnp.uint8(0))
+    la_idx = jnp.arange(spec.la_row_len, dtype=jnp.int32)
+    lidx = b - burn0[:, None] + la_idx[None, :]
+    lvalid = la_idx[None, :] < burn0[:, None] + l_seg + 1
+    la_row = jnp.where(
+        lvalid,
+        _take_rows(buf_la, jnp.clip(lidx, 0, buf_la.shape[1] - 1)),
+        jnp.int32(-1))
+
+    # --- per-sequence metadata (every slot full: L % learning == 0) ---
+    s_arr = jnp.arange(s, dtype=jnp.int32)
+    burn_in_s = jnp.minimum(s_arr[None, :] * lrn + burn0[:, None], b)
+    # hidden at each sequence's WINDOW START (seq_start - burn_in): in
+    # buffer coordinates the episode offset burn0 cancels out
+    hid_idx = b + s_arr[None, :] * lrn - burn_in_s
+    hidden_sel = _take_rows(buf_hid, hid_idx)
+
+    # --- n-step returns + gamma tail (ops/returns.py, vectorized) ---
+    padded = jnp.pad(rewards.astype(jnp.float32), ((0, 0), (0, f - 1)))
+    returns = sum(np.float32(gamma ** i) * padded[:, i:i + l_seg]
+                  for i in range(f))
+    rem = (l_seg - jnp.arange(l_seg, dtype=jnp.int32))       # steps to end
+    g_tail = jnp.asarray(gamma, jnp.float32) ** rem.astype(jnp.float32)
+    gammas = jnp.where(
+        rem[None, :] > f, np.float32(gamma ** f),
+        jnp.where(terminal[:, None], jnp.float32(0.0), g_tail[None, :]))
+
+    forward_s = jnp.minimum(f, l_seg + 1 - (s_arr + 1) * lrn)
+    sum_reward = jnp.where(terminal & report_mask,
+                           final_return, jnp.float32(jnp.nan))
+    blocks = Block(
+        obs_row=obs_row.astype(jnp.uint8),
+        last_action_row=la_row.astype(jnp.int32),
+        hidden=hidden_sel.astype(jnp.float32),
+        action=actions.reshape(n, s, lrn).astype(jnp.int32),
+        reward=returns.reshape(n, s, lrn).astype(jnp.float32),
+        gamma=gammas.reshape(n, s, lrn).astype(jnp.float32),
+        priority=jnp.full((n, s), priority, jnp.float32),
+        burn_in_steps=burn_in_s.astype(jnp.int32),
+        learning_steps=jnp.full((n, s), lrn, jnp.int32),
+        forward_steps=jnp.broadcast_to(forward_s.astype(jnp.int32), (n, s)),
+        seq_start=(burn0[:, None] + s_arr[None, :] * lrn).astype(jnp.int32),
+        num_sequences=jnp.full((n,), s, jnp.int32),
+        sum_reward=sum_reward.astype(jnp.float32),
+        weight_version=jnp.broadcast_to(
+            jnp.asarray(weight_version, jnp.int32), (n,)),
+    )
+
+    # --- burn-in carry to the next segment (LocalBuffer tail trim; a
+    # terminal lane restarts from LocalBuffer.reset instead) ---
+    t1 = terminal[:, None]
+    t3 = terminal[:, None, None, None]
+    reset_tail = jnp.concatenate([
+        jnp.zeros_like(tail_frames[:, :b]),
+        jnp.repeat(reset_obs[:, None], stack, axis=1)], axis=1)
+    new_tails = (
+        jnp.where(t3, reset_tail, buf_frames[:, -(stack + b):]),
+        jnp.where(t1, jnp.int32(-1),
+                  buf_la[:, -(b + 1):]).astype(jnp.int32),
+        jnp.where(t3, jnp.float32(0.0), buf_hid[:, -(b + 1):]),
+        jnp.where(terminal, jnp.int32(0),
+                  jnp.minimum(burn0 + l_seg, b)).astype(jnp.int32),
+    )
+    return blocks, new_tails
+
+
+def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
+                    num_lanes: int, epsilons, gamma: float,
+                    priority: float, near_greedy_eps: float) -> Callable:
+    """Build the jitted acting segment:
+
+        act(params, carry, weight_version) -> (carry, blocks, stats)
+
+    One call = ``block_length`` fused env+policy steps across all
+    ``num_lanes`` lanes + in-graph block assembly. ``blocks`` carries a
+    leading N axis (feed straight to ``replay_add_many``); ``stats`` are
+    small device scalars (episode counts / near-greedy return sums) the
+    host fetches lazily at log time. The carry is donated — its large
+    frame buffers update in place.
+
+    ``epsilons`` is the per-lane Ape-X ladder; lanes with ε <=
+    ``near_greedy_eps`` report episode returns (the host loop's
+    filtering rule). Exploration uses jax.random streams — same
+    distribution as the host's per-lane numpy generators, different
+    draws."""
+    eps_list = [float(e) for e in epsilons]
+    if len(eps_list) != num_lanes:
+        raise ValueError(f"need one epsilon per lane: got {len(eps_list)} "
+                         f"for {num_lanes} lanes")
+    eps = jnp.asarray(eps_list, jnp.float32)
+    report = np.asarray([e <= near_greedy_eps for e in eps_list])
+    action_dim = net.action_dim
+    if env.action_dim != action_dim:
+        raise ValueError(f"env action_dim {env.action_dim} != network "
+                         f"action_dim {action_dim}")
+    if env.episode_len % spec.block_length != 0:
+        # the same alignment Config validates for actor.on_device; direct
+        # callers must honor it too — the scan resets lanes only at the
+        # segment boundary, so a mid-segment done would step a finished
+        # episode instead of restarting it
+        raise ValueError(
+            f"env.episode_len {env.episode_len} must be a multiple of "
+            f"block_length {spec.block_length}")
+
+    def act(params, carry: ActCarry, weight_version):
+        # ONE speculative reset per segment, not per step: fixed-length
+        # episodes end only on segment boundaries (the alignment asserted
+        # above), so the auto-reset selection applies exactly once, after
+        # the scan. Hoisting it out of the body removes the dominant
+        # per-step cost for envs with expensive resets (JaxFakeEnv draws
+        # its whole target schedule at reset — ~block_length random ints
+        # per lane per step if left inside the scan).
+        k_seg, k_run = jax.random.split(carry.key)
+        carry = carry.replace(key=k_run)
+        reset_state, reset_obs = jax.vmap(env.reset)(
+            jax.random.split(k_seg, num_lanes))
+        reset_obs = jnp.asarray(reset_obs, jnp.uint8)
+
+        def body(c: ActCarry, _):
+            key, k_eps, k_expl, k_env = jax.random.split(c.key, 4)
+            # policy forward: T=1 window over the normalized frame stack
+            # (the BatchedActorPolicy's step, traced into the scan)
+            stacked = (c.cur_stack.astype(jnp.float32)
+                       / np.float32(255.0)).transpose(0, 2, 3, 1)
+            la_1h = jax.nn.one_hot(c.last_action, action_dim,
+                                   dtype=jnp.float32)
+            q, hid = net.module.apply(params, stacked[:, None],
+                                      la_1h[:, None], c.hidden)
+            greedy = jnp.argmax(q[:, 0], axis=-1).astype(jnp.int32)
+            explore = jax.random.uniform(k_eps, (num_lanes,)) < eps
+            randa = jax.random.randint(k_expl, (num_lanes,), 0, action_dim,
+                                       jnp.int32)
+            action = jnp.where(explore, randa, greedy)
+
+            es, obs, reward, done = jax.vmap(env.step)(
+                c.env_state, action, jax.random.split(k_env, num_lanes))
+            obs = jnp.asarray(obs, jnp.uint8)
+            reward = reward.astype(jnp.float32)
+            rolled = jnp.concatenate([c.cur_stack[:, 1:], obs[:, None]],
+                                     axis=1)
+            c = c.replace(
+                env_state=es,
+                cur_stack=rolled,
+                hidden=hid,
+                last_action=action,
+                ep_return=c.ep_return + reward,
+                key=key)
+            y = {"obs": obs, "action": action, "reward": reward,
+                 "done": done, "hidden": hid, "ep_ret": c.ep_return}
+            return c, y
+
+        out_carry, ys = jax.lax.scan(body, carry, None,
+                                     length=spec.block_length)
+        # auto-reset where the segment's last step ended the episode: the
+        # step's y already recorded the TRUE terminal obs; the carry
+        # restarts from envs/vector.py's reset state (duplicated initial
+        # frames, zero hidden, null last action)
+        terminal = ys["done"][-1]
+
+        def sel(a, b):
+            d = terminal.reshape(terminal.shape + (1,) * (a.ndim - 1))
+            return jnp.where(d, a, b)
+
+        out_carry = out_carry.replace(
+            env_state=jax.tree_util.tree_map(sel, reset_state,
+                                             out_carry.env_state),
+            cur_stack=sel(jnp.repeat(reset_obs[:, None], spec.frame_stack,
+                                     axis=1), out_carry.cur_stack),
+            hidden=sel(jnp.zeros_like(out_carry.hidden), out_carry.hidden),
+            last_action=sel(jnp.full_like(out_carry.last_action, -1),
+                            out_carry.last_action),
+            ep_return=sel(jnp.zeros_like(out_carry.ep_return),
+                          out_carry.ep_return))
+        # lane-major views for assembly
+        obs_nl = jnp.swapaxes(ys["obs"], 0, 1)
+        act_nl = jnp.swapaxes(ys["action"], 0, 1)
+        rew_nl = jnp.swapaxes(ys["reward"], 0, 1)
+        hid_nl = jnp.swapaxes(ys["hidden"], 0, 1)
+        report_m = jnp.asarray(report)
+        blocks, (tf, tl, th, b0) = emit_blocks(
+            spec, gamma, priority, carry.tail_frames, carry.tail_la,
+            carry.tail_hidden, carry.burn0, obs_nl, act_nl, rew_nl, hid_nl,
+            terminal, ys["ep_ret"][-1], report_m,
+            reset_obs, weight_version)
+        done_rep = ys["done"] & report_m[None, :]
+        stats = {
+            "episodes": jnp.sum(ys["done"]).astype(jnp.int32),
+            "reported_episodes": jnp.sum(done_rep).astype(jnp.int32),
+            "reported_return_sum": jnp.sum(
+                jnp.where(done_rep, ys["ep_ret"], 0.0)).astype(jnp.float32),
+        }
+        out_carry = out_carry.replace(tail_frames=tf, tail_la=tl,
+                                      tail_hidden=th, burn0=b0)
+        return out_carry, blocks, stats
+
+    return jax.jit(act, donate_argnums=1)

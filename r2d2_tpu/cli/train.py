@@ -4,6 +4,11 @@
     python -m r2d2_tpu.cli.train --env.game_name=ALE/Boxing --env.env_type=-v5
     python -m r2d2_tpu.cli.train --multiplayer.enabled=true  # self-play stacks
 
+    # fully on-device acting (Anakin): fused env+policy+emit scan colocated
+    # with the learner — no actor fleet (README "On-device acting")
+    python -m r2d2_tpu.cli.train --env.game_name=Grid --actor.on_device=true \
+        --env.episode_len=120 --replay.block_length=40
+
 Extra (non-config) flags:
     --actor-mode=thread|process   actor execution mode (default: process
                                   single-host, thread multihost)

@@ -32,6 +32,14 @@ class EnvConfig:
     frame_height: int = 84
     frame_width: int = 84
     frame_skip: int = 1
+    # Fixed episode length of the synthetic envs (Fake and the jitted
+    # Grid/JaxFake backends — envs/jax_env.py); engine-backed envs ignore
+    # it. The on-device acting path requires episode_len to be a multiple
+    # of replay.block_length so episode boundaries coincide with block
+    # boundaries (validated when actor.on_device is set).
+    episode_len: int = 120
+    # Grid side length of the jitted gridworld (env kind "Grid").
+    grid_size: int = 6
     # The reference's factory defaults clip_rewards=True (environment.py:82)
     # but every call site passes False — actors (worker.py:507) and eval
     # (test.py:97) — relying on invertible value rescaling for reward
@@ -231,6 +239,33 @@ class ActorConfig:
     # num_actors * envs_per_actor total lanes (vector_lane_epsilons), so the
     # exploration schedule matches an equally-sized scalar-actor fleet.
     envs_per_actor: int = 1
+    # -- Anakin-style fully on-device acting (runtime/anakin_loop.py) --
+    # True routes training through the fused act+train loop: a jitted
+    # lax.scan steps anakin_lanes batched PURE-JAX envs (envs/jax_env.py)
+    # through the policy forward for block_length steps, assembles the
+    # burn-in/learning blocks ON DEVICE, and ring-writes them straight
+    # into device replay via replay_add_many — zero host transfers on the
+    # acting hot path, weights read by reference from the colocated
+    # learner's train state (Podracer "Anakin", arxiv 2104.06272). False
+    # (default) = the legacy host actor fleet, byte-identical to pre-PR6.
+    on_device: bool = False
+    # Batched env lanes inside the fused acting scan. Each acting segment
+    # emits one block per lane, so lanes must be <= num_blocks (the
+    # replay_add_many scatter-alias bound). The Ape-X ε ladder spreads
+    # over the lanes exactly like an equally-sized scalar-actor fleet.
+    anakin_lanes: int = 64
+    # Acting segments dispatched per train dispatch once training has
+    # started (before learning_starts the loop acts continuously). >1
+    # tilts the interleave toward collection — the fused loop is
+    # synchronous, so this IS the collect:learn scheduling knob (the
+    # replay rate limiter still applies on top).
+    anakin_scans_per_train: int = 1
+    # Initial priority stamped on every device-assembled sequence
+    # (max-priority-style seeding). The host path seeds from the actor's
+    # own TD estimates; computing those on device would add a second
+    # bootstrap unroll per block, so the fused path stamps a constant and
+    # lets the learner's first write-back set the real priority.
+    anakin_priority: float = 1.0
     # Deterministic fault injection (tools/chaos.py): ';'-joined
     # ``slot:kind`` entries, e.g. "1:crash@block=3;2:hang@block=5;0:slowx4".
     # ``crash@block=N`` raises on the worker's N-th block emit (1-based),
@@ -484,6 +519,73 @@ class Config:
                 "window (runtime.seed + 100*actor_idx + lane); more lanes "
                 "would duplicate the next worker's env/RNG streams — scale "
                 "actor.num_actors instead")
+        if self.env.episode_len < 1:
+            raise ValueError(
+                f"env.episode_len ({self.env.episode_len}) must be >= 1")
+        if self.env.grid_size < 2:
+            raise ValueError(
+                f"env.grid_size ({self.env.grid_size}) must be >= 2")
+        if self.env.grid_size > min(self.env.frame_height,
+                                    self.env.frame_width):
+            raise ValueError(
+                f"env.grid_size ({self.env.grid_size}) must be <= the frame "
+                f"size ({self.env.frame_height}x{self.env.frame_width}): a "
+                "grid cell needs at least one pixel, or the gridworld "
+                "renders a uniform background (zero-information obs)")
+        if self.actor.anakin_lanes < 1:
+            raise ValueError(
+                f"actor.anakin_lanes ({self.actor.anakin_lanes}) must be "
+                ">= 1")
+        if self.actor.anakin_scans_per_train < 1:
+            raise ValueError(
+                f"actor.anakin_scans_per_train "
+                f"({self.actor.anakin_scans_per_train}) must be >= 1")
+        if self.actor.anakin_priority <= 0:
+            raise ValueError(
+                f"actor.anakin_priority ({self.actor.anakin_priority}) must "
+                "be > 0: zero-priority sequences are unsamplable, so a "
+                "freshly emitted block could never be trained on")
+        if self.actor.on_device:
+            # the fused acting path's structural preconditions fail HERE,
+            # at config construction, with the fix spelled out — not as an
+            # opaque shape error inside the jitted scan
+            if self.replay.placement != "device":
+                raise ValueError(
+                    "actor.on_device requires replay.placement='device': "
+                    "the acting scan ring-writes blocks straight into the "
+                    "HBM-resident replay (host placement would re-introduce "
+                    "the host round-trip the path exists to remove)")
+            if self.env.episode_len % self.replay.block_length != 0:
+                raise ValueError(
+                    f"actor.on_device requires env.episode_len "
+                    f"({self.env.episode_len}) to be a multiple of "
+                    f"replay.block_length ({self.replay.block_length}): the "
+                    "fused scan emits fixed block_length-step blocks, so "
+                    "episode ends must land on block boundaries (the host "
+                    "path's emit-on-done semantics)")
+            if self.actor.anakin_lanes > self.num_blocks:
+                raise ValueError(
+                    f"actor.anakin_lanes ({self.actor.anakin_lanes}) must "
+                    f"be <= num_blocks ({self.num_blocks}): each segment "
+                    "ring-writes one block per lane in a single "
+                    "replay_add_many dispatch, whose scatter rows must not "
+                    "alias — grow replay.capacity or lower the lane count")
+            if self.multiplayer.enabled:
+                raise ValueError(
+                    "actor.on_device is not supported with multiplayer "
+                    "(the jitted envs have no host/join engine wiring)")
+            if self.mesh.multihost:
+                raise ValueError(
+                    "actor.on_device is single-controller only (the fused "
+                    "loop is not integrated with the lockstep multihost "
+                    "trainer yet) — unset mesh.multihost")
+            if self.actor.fault_spec:
+                raise ValueError(
+                    "actor.fault_spec requires the host actor fleet: fault "
+                    "injection lives at the worker block sink "
+                    "(runtime/actor_loop.py), which the fused on-device "
+                    "loop never runs — a chaos run with actor.on_device "
+                    "would inject nothing and report vacuously healthy")
         if self.actor.fault_spec:
             from r2d2_tpu.tools.chaos import parse_fault_spec
             faults = parse_fault_spec(self.actor.fault_spec)

@@ -2,6 +2,13 @@
 
 Resolves an env id to a backend:
   * "Fake*"       — the hermetic deterministic env (tests/benchmarks);
+  * "JaxFake*" /
+    "Grid",
+    "JaxGrid*"    — the PURE-JAX envs (envs/jax_env.py) behind the host
+                    adapter, so the jitted dynamics run under the legacy
+                    actor loops too; ``create_jax_env`` resolves the same
+                    kinds to the raw jitted env for the on-device acting
+                    path (actor.on_device, runtime/anakin_loop.py);
   * "Vizdoom*"    — the ViZDoom binding (r2d2_tpu.envs.vizdoom_env), gated on
                     the vizdoom package;
   * anything else — gymnasium (ALE Atari ids like "ALE/Boxing-v5"), gated on
@@ -18,6 +25,31 @@ from r2d2_tpu.envs.fake import FakeR2D2Env
 from r2d2_tpu.envs.wrappers import ClipReward, GymnasiumAdapter, WarpFrame
 
 
+def _is_jax_grid(game_name: str) -> bool:
+    from r2d2_tpu.envs.jax_env import is_jax_grid_id
+    return is_jax_grid_id(game_name)
+
+
+def create_jax_env(cfg: EnvConfig):
+    """Resolve the env id to a PURE-JAX env (envs/jax_env.py protocol) for
+    the fused on-device acting path. The plain "Fake" kind resolves too —
+    JaxFakeEnv is its jitted port (parity-tested), so flipping
+    actor.on_device needs no env rename."""
+    from r2d2_tpu.envs.jax_env import (JaxFakeEnv, JaxGridWorld,
+                                       is_jax_grid_id)
+    env_id = cfg.env_id
+    if env_id.startswith(("JaxFake", "Fake")):
+        return JaxFakeEnv(episode_len=cfg.episode_len,
+                          height=cfg.frame_height, width=cfg.frame_width)
+    if is_jax_grid_id(cfg.game_name):
+        return JaxGridWorld(size=cfg.grid_size, episode_len=cfg.episode_len,
+                            height=cfg.frame_height, width=cfg.frame_width)
+    raise ValueError(
+        f"env id {env_id!r} has no pure-JAX implementation — the on-device "
+        "acting path (actor.on_device) supports the 'Fake'/'JaxFake' and "
+        "'Grid' kinds; engine-backed envs must use the host actor fleet")
+
+
 def create_env(cfg: EnvConfig, *, clip_rewards: Optional[bool] = None,
                multi_conf: str = "", is_host: bool = False, testing: bool = False,
                port: int = 5060, num_players: int = 1, name: str = "",
@@ -32,9 +64,14 @@ def create_env(cfg: EnvConfig, *, clip_rewards: Optional[bool] = None,
 
     if env_id.startswith("Fake"):
         env = FakeR2D2Env(height=cfg.frame_height, width=cfg.frame_width,
-                          seed=seed,
+                          episode_len=cfg.episode_len, seed=seed,
                           wiring=dict(is_host=is_host, port=port,
                                       num_players=num_players, name=name))
+    elif env_id.startswith("JaxFake") or _is_jax_grid(cfg.game_name):
+        # the jitted envs behind the host adapter: same dynamics as the
+        # on-device acting path, reachable from the legacy actor loops
+        from r2d2_tpu.envs.jax_env import HostJaxEnv
+        env = HostJaxEnv(create_jax_env(cfg), seed=seed)
     elif env_id.startswith("Vizdoom"):
         from r2d2_tpu.envs.vizdoom_env import make_vizdoom
         env = make_vizdoom(

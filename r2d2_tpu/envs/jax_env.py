@@ -1,0 +1,202 @@
+"""Pure-JAX environments: jit-safe pytree ``reset``/``step`` functions.
+
+The host envs (envs/fake.py, the engine backends) are Python objects whose
+``step`` crosses the host–device boundary every call — the structural wall
+PERF.md quantifies (~1.8k env-steps/s for the whole CPU actor fleet vs 11k+
+learner seq-updates/s/chip). Podracer's "Anakin" architecture (arxiv
+2104.06272) and GPU Atari emulation (arxiv 1907.08467) remove it by making
+the environment itself a compiled function, so batched env + policy +
+experience-emit fuse into ONE device program (actor/anakin.py).
+
+Protocol (duck-typed; both implementations are frozen dataclasses so they
+are hashable and capture cleanly in jitted closures):
+
+  * attributes ``action_dim``, ``episode_len``, ``height``, ``width``;
+  * ``reset(key) -> (state, obs)`` — a fresh episode; ``state`` is any
+    pytree of arrays, ``obs`` a (height, width) uint8 frame;
+  * ``step(state, action, key) -> (state, obs, reward, done)`` — one
+    transition; reward f32, done bool. ``done`` must be True exactly at
+    step ``episode_len`` (fixed-length episodes: the fused acting scan
+    relies on episode ends landing on block boundaries, validated via
+    ``episode_len % block_length == 0``).
+
+Both functions must be traceable (no Python side effects) and cheap to
+``vmap`` — the acting scan calls ``reset`` speculatively once per segment
+and selects it where the last step's ``done`` (auto-reset without control
+flow; episode ends land only on segment boundaries by the alignment
+contract above).
+
+``HostJaxEnv`` adapts a JaxEnv to the host gym-style API so the SAME
+dynamics run under the legacy actor loops (factory kinds "JaxFake"/"Grid")
+— which is what makes host-vs-device parity directly testable.
+"""
+
+import dataclasses
+from typing import Tuple
+
+
+def is_jax_grid_id(game_name: str) -> bool:
+    """True when ``EnvConfig.game_name`` names the built-in jitted
+    gridworld: exactly "Grid" or the "JaxGrid*" prefix. Deliberately NOT
+    a bare "Grid*" prefix — that would silently capture gymnasium games
+    like "GridWorld" that must keep routing to the gymnasium backend."""
+    return game_name == "Grid" or game_name.startswith("JaxGrid")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxFakeEnv:
+    """Jitted port of envs/fake.py FakeR2D2Env — identical dynamics.
+
+    The target action is encoded as a bright column band; choosing it
+    yields +1. The host env draws its target schedule with
+    ``np.random.default_rng(seed)``, which has no in-graph equivalent, so
+    ``reset`` draws the schedule with ``jax.random`` instead (a different
+    stream, same distribution). ``state_from_schedule`` accepts an
+    explicit schedule — the parity tests feed it the HOST env's schedule
+    and assert obs/reward/done equality step for step."""
+
+    action_dim: int = 6
+    episode_len: int = 120
+    height: int = 84
+    width: int = 84
+
+    def _obs(self, schedule: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
+        target = schedule[t]
+        band = self.width // self.action_dim
+        cols = jnp.arange(self.width, dtype=jnp.int32)
+        in_band = (cols >= target * band) & (cols < (target + 1) * band)
+        frame = jnp.where(in_band[None, :], jnp.uint8(224), jnp.uint8(32))
+        frame = jnp.broadcast_to(frame, (self.height, self.width))
+        # time texture row AFTER the band (host sets it last, overwriting)
+        return frame.at[t % self.height].set(jnp.uint8(128))
+
+    def state_from_schedule(self, schedule) -> dict:
+        """Parity-test hook: a state whose target schedule is exactly
+        ``schedule`` (e.g. a host FakeR2D2Env's ``_schedule``)."""
+        schedule = jnp.asarray(schedule, jnp.int32)
+        assert schedule.shape == (self.episode_len + 1,)
+        return {"schedule": schedule, "t": jnp.zeros((), jnp.int32)}
+
+    def reset(self, key: jax.Array) -> Tuple[dict, jnp.ndarray]:
+        schedule = jax.random.randint(
+            key, (self.episode_len + 1,), 0, self.action_dim, jnp.int32)
+        state = {"schedule": schedule, "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(schedule, state["t"])
+
+    def step(self, state: dict, action: jnp.ndarray, key: jax.Array):
+        del key  # deterministic given the schedule
+        t = state["t"]
+        reward = (action == state["schedule"][t]).astype(jnp.float32)
+        t1 = t + 1
+        state = {"schedule": state["schedule"], "t": t1}
+        return (state, self._obs(state["schedule"], t1), reward,
+                t1 >= self.episode_len)
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxGridWorld:
+    """Jitted gridworld with a REAL learning signal (the fake env's reward
+    is reactive-oracle-solvable; this one needs navigation).
+
+    A ``size`` x ``size`` grid rendered as a (height, width) frame: the
+    agent cell is bright (255), the goal cell mid-bright (128), background
+    dim (16). Actions: up/down/left/right/stay. Stepping onto the goal
+    yields +1 and teleports the agent to a random cell (goal fixed for the
+    episode), so return scales with how directly the policy navigates —
+    random-walk return is a small fraction of greedy-navigation return,
+    the gap the learnability tests assert."""
+
+    size: int = 6
+    episode_len: int = 120
+    height: int = 84
+    width: int = 84
+
+    # up / down / left / right / stay — class-level constant
+    action_dim: int = dataclasses.field(default=5, init=False)
+
+    def _obs(self, pos: jnp.ndarray, goal: jnp.ndarray) -> jnp.ndarray:
+        ch = self.height // self.size
+        cw = self.width // self.size
+        rows = jnp.arange(self.height, dtype=jnp.int32)
+        cols = jnp.arange(self.width, dtype=jnp.int32)
+        row_cell = rows // ch
+        col_cell = cols // cw
+        valid = (row_cell < self.size)[:, None] & (col_cell < self.size)[None, :]
+        agent = ((row_cell == pos[0])[:, None]
+                 & (col_cell == pos[1])[None, :] & valid)
+        goal_m = ((row_cell == goal[0])[:, None]
+                  & (col_cell == goal[1])[None, :] & valid)
+        return jnp.where(agent, jnp.uint8(255),
+                         jnp.where(goal_m, jnp.uint8(128),
+                                   jnp.uint8(16)))
+
+    def _nudge_off(self, cell: jnp.ndarray, other: jnp.ndarray) -> jnp.ndarray:
+        """Deterministic fix-up: if ``cell`` coincides with ``other``,
+        shift it one diagonal step (mod size) — avoids rejection loops in
+        traced code while keeping the two distinguishable."""
+        clash = jnp.all(cell == other)
+        return jnp.where(clash, (cell + 1) % self.size, cell)
+
+    def reset(self, key: jax.Array) -> Tuple[dict, jnp.ndarray]:
+        kp, kg = jax.random.split(key)
+        pos = jax.random.randint(kp, (2,), 0, self.size, jnp.int32)
+        goal = self._nudge_off(
+            jax.random.randint(kg, (2,), 0, self.size, jnp.int32), pos)
+        state = {"pos": pos, "goal": goal, "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(pos, goal)
+
+    def step(self, state: dict, action: jnp.ndarray, key: jax.Array):
+        deltas = jnp.array([[-1, 0], [1, 0], [0, -1], [0, 1], [0, 0]],
+                           jnp.int32)
+        pos = jnp.clip(state["pos"] + deltas[action], 0, self.size - 1)
+        reached = jnp.all(pos == state["goal"])
+        reward = reached.astype(jnp.float32)
+        respawn = self._nudge_off(
+            jax.random.randint(key, (2,), 0, self.size, jnp.int32),
+            state["goal"])
+        pos = jnp.where(reached, respawn, pos)
+        t1 = state["t"] + 1
+        new = {"pos": pos, "goal": state["goal"], "t": t1}
+        return (new, self._obs(pos, state["goal"]), reward,
+                t1 >= self.episode_len)
+
+
+class HostJaxEnv:
+    """Gym-style host adapter over a JaxEnv: the SAME compiled dynamics
+    behind the legacy scalar/vector actor API (reset()/step(a)/close()),
+    so the jitted envs are reachable from every existing path — and so
+    device-vs-host runs of one env are directly comparable."""
+
+    def __init__(self, env, seed: int = 0):
+        from r2d2_tpu.envs.fake import _DiscreteSpace
+        self.env = env
+        self.action_space = _DiscreteSpace(env.action_dim, seed)
+        self.episode_len = env.episode_len
+        self._key = jax.random.PRNGKey(seed)
+        self._state = None
+        self._reset_j = jax.jit(env.reset)
+        self._step_j = jax.jit(env.step)
+
+    @property
+    def unwrapped(self):
+        return self
+
+    def _split(self) -> jax.Array:
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def reset(self) -> np.ndarray:
+        self._state, obs = self._reset_j(self._split())
+        return np.asarray(obs)
+
+    def step(self, action: int):
+        self._state, obs, reward, done = self._step_j(
+            self._state, np.int32(action), self._split())
+        return np.asarray(obs), float(reward), bool(done), {}
+
+    def close(self) -> None:
+        pass

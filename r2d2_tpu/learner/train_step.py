@@ -326,6 +326,12 @@ def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
             opt_state=opt_state, step=new_step, key=train_state.key)
         return train_state, metrics
 
+    # Donation audit (ISSUE 6 satellite): train_state donated like every
+    # step factory; the BATCH deliberately is not — the host loop reads
+    # batch.idxes AFTER the step for the async priority write-back
+    # (learner_loop._host_step_once), so donating it would hand the
+    # write-back a dead buffer. The batch is also the prefetch thread's
+    # fresh device_put each step, so there is no ring to alias in place.
     return jax.jit(step, donate_argnums=0)
 
 

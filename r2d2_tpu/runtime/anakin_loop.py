@@ -1,0 +1,201 @@
+"""Colocated act+train loop for the fully on-device acting path.
+
+The orchestrator's host loop (runtime/orchestrator.py) spawns an actor
+FLEET: threads/processes stepping Python envs, blocks crossing a queue,
+weights crossing a shm service. This loop replaces all of it with a
+single-threaded alternation on ONE device (Podracer "Anakin", arxiv
+2104.06272):
+
+    act segment  — one jitted lax.scan: block_length steps of
+                   actor.anakin_lanes batched pure-JAX envs + the policy
+                   forward + in-graph block assembly (actor/anakin.py);
+    ring-write   — the segment's N stacked blocks enter device replay via
+                   the existing donated ``replay_add_many`` dispatch;
+    train        — the learner's fused step(s), exactly as the host loop
+                   dispatches them (same Learner, same diagnostics).
+
+Weights are published BY REFERENCE: each acting segment reads
+``learner.train_state.params`` directly — no weight service, no copy, and
+the actors are never more than one segment stale. Staleness accounting
+(PR5) keeps working: blocks are stamped with a pseudo publish count that
+advances every ``weight_publish_interval`` learner steps, the same clock a
+WeightPublisher would have ticked, and ``Learner.flush_metrics`` reads the
+same counter — so sample-age and replay-occupancy ages stay meaningful.
+
+Everything host-side is bookkeeping at SEGMENT cadence (N blocks, N*L env
+steps at a time): ring accounting, the replay rate limiter, TrainMetrics,
+telemetry stage timers (the new 'actor/act_scan' stage + the existing
+ingest/learner stages), checkpoints. Episode returns are summed on device
+and fetched lazily at log time. The loop is single-threaded and therefore
+DETERMINISTIC given seeds — the collect:learn interleave is pinned by
+``actor.anakin_scans_per_train`` (plus the rate limiter), not by host
+scheduling.
+"""
+
+import os
+import time
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+
+from r2d2_tpu.config import Config, apex_epsilon
+from r2d2_tpu.models.network import NetworkApply
+from r2d2_tpu.replay.device_replay import replay_add_many
+from r2d2_tpu.runtime.learner_loop import Learner
+from r2d2_tpu.runtime.metrics import TrainMetrics
+
+
+class AnakinStack:
+    """Duck-typed PlayerStack twin for the on-device path: the pieces the
+    callers actually touch (learner/metrics/telemetry + close())."""
+
+    def __init__(self, cfg: Config, learner: Learner, metrics: TrainMetrics,
+                 telemetry, carry):
+        self.cfg = cfg
+        self.player_idx = 0
+        self.learner = learner
+        self.metrics = metrics
+        self.telemetry = telemetry
+        self.carry = carry       # final ActCarry (inspection/tests)
+
+    def close(self) -> None:
+        self.learner.stop_background()
+        self.telemetry.close()
+
+
+def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
+                     max_seconds: Optional[float] = None,
+                     log_fn: Optional[Callable[[dict], None]] = None
+                     ) -> List[AnakinStack]:
+    """Run the fused act+train loop; returns [stack] (the Learner holds
+    final state) — the same contract as orchestrator.train, which
+    delegates here when ``actor.on_device`` is set."""
+    from r2d2_tpu.actor.anakin import init_act_carry, make_anakin_act
+    from r2d2_tpu.envs.factory import create_jax_env
+    from r2d2_tpu.telemetry import Telemetry
+
+    if not cfg.actor.on_device:
+        raise ValueError("run_anakin_train requires actor.on_device=True")
+    n_dev = len(jax.devices())
+    if cfg.mesh.resolved_dp(n_dev) > 1 or cfg.mesh.mp > 1:
+        raise NotImplementedError(
+            "actor.on_device currently runs the single-chip learner step; "
+            "mesh.dp/mp must be 1 (sharded anakin — per-shard lane groups "
+            "— is the natural next step but is not built yet)")
+
+    env = create_jax_env(cfg.env)
+    num_lanes = cfg.actor.anakin_lanes
+    net = NetworkApply(env.action_dim, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+
+    metrics = TrainMetrics(0, cfg.runtime.save_dir,
+                           resume=bool(cfg.runtime.resume))
+    telemetry = Telemetry.from_config(cfg, name="anakin-p0")
+    metrics.set_telemetry(telemetry)
+    if cfg.telemetry.enabled:
+        telemetry.start_drain(
+            os.path.join(cfg.runtime.save_dir or ".", "spans_player0.jsonl"),
+            append=bool(cfg.runtime.resume))
+
+    learner = Learner(cfg, net, 0, metrics=metrics)
+    spec = learner.spec
+    seg_steps = spec.block_length          # learning steps per lane-block
+    pub_interval = max(cfg.runtime.weight_publish_interval, 1)
+
+    def publish_count() -> int:
+        # by-reference publication clock: what a WeightPublisher would
+        # have counted had the learner pushed params every
+        # weight_publish_interval steps (1 = the initial params)
+        return 1 + learner.training_steps // pub_interval
+
+    learner.weight_version_fn = publish_count
+
+    epsilons = [apex_epsilon(i, num_lanes, cfg.actor.base_eps,
+                             cfg.actor.eps_alpha) for i in range(num_lanes)]
+    act_fn = make_anakin_act(
+        env, net, spec, num_lanes=num_lanes, epsilons=epsilons,
+        gamma=cfg.optim.gamma, priority=cfg.actor.anakin_priority,
+        near_greedy_eps=cfg.actor.near_greedy_eps)
+    carry = init_act_carry(env, spec, num_lanes,
+                           jax.random.PRNGKey(cfg.runtime.seed + 17))
+
+    pending_stats: list = []
+
+    def act_segment():
+        nonlocal carry
+        t0 = time.time()
+        carry, blocks, stats = act_fn(
+            learner.train_state.params, carry, np.int32(publish_count()))
+        t1 = time.time()
+        learner.replay_state = replay_add_many(
+            spec, learner.replay_state, blocks)
+        t2 = time.time()
+        telemetry.observe("actor/act_scan", t1 - t0)
+        telemetry.record_span("actor/act_scan", t0, t1,
+                              {"lanes": num_lanes, "steps": seg_steps})
+        telemetry.observe("ingest/commit", t2 - t1)
+        wv = publish_count()
+        for _ in range(num_lanes):
+            learner.ring.advance(seg_steps, wv)
+            metrics.on_block(seg_steps, None)
+        learner.env_steps += num_lanes * seg_steps
+        metrics.set_buffer_size(learner.ring.buffer_steps)
+        # commit latency only (t2-t1): the acting dispatch is its own
+        # stage; folding it in would make ingest_drain_latency_ms
+        # incomparable with the host path's pop-to-commit reading
+        metrics.on_ingest_drain(num_lanes, t2 - t1)
+        pending_stats.append(stats)
+
+    def flush_stats():
+        if not pending_stats:
+            return
+        fetched = jax.device_get(pending_stats)
+        pending_stats.clear()
+        count = int(sum(int(s["reported_episodes"]) for s in fetched))
+        total = float(sum(float(s["reported_return_sum"]) for s in fetched))
+        metrics.on_episodes(count, total)
+
+    start = time.time()
+    deadline = start + max_seconds if max_seconds else None
+    max_steps = max_training_steps or cfg.optim.training_steps
+    last_log = start
+    stack = AnakinStack(cfg, learner, metrics, telemetry, carry)
+    try:
+        if cfg.runtime.save_interval:
+            learner.save(0)
+        while ((deadline is None or time.time() < deadline)
+               and learner.training_steps < max_steps):
+            if learner.ingestion_paused:
+                # rate limiter: collection is ahead of the collect:learn
+                # budget; only train until it reopens (the gate cannot be
+                # closed here — paused implies it is open)
+                learner._note_pause(True)
+            else:
+                learner._note_pause(False)
+                scans = (cfg.actor.anakin_scans_per_train
+                         if learner.ready else 1)
+                for _ in range(scans):
+                    act_segment()
+            if learner.ready and learner.training_steps < max_steps:
+                learner.step()
+            now = time.time()
+            if now - last_log >= cfg.runtime.log_interval:
+                learner.flush_metrics()
+                flush_stats()
+                record = metrics.log(now - last_log)
+                if log_fn:
+                    log_fn({"player": 0, **record})
+                last_log = now
+        learner.flush_metrics()
+        flush_stats()
+    finally:
+        stack.carry = carry
+        try:
+            if cfg.runtime.save_interval:
+                learner.save_final()
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception("final checkpoint failed")
+        stack.close()
+    return [stack]

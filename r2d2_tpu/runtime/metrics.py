@@ -92,6 +92,15 @@ class TrainMetrics:
             self.episode_reward += float(episode_return)
             self.num_episodes += 1
 
+    def on_episodes(self, count: int, return_sum: float) -> None:
+        """Batched episode-return feed for the fused on-device acting path:
+        episode ends are counted on device and fetched as per-interval
+        (count, sum) aggregates, so the return average matches on_block's
+        per-episode feed without a host transfer per episode."""
+        if count > 0 and np.isfinite(return_sum):
+            self.episode_reward += float(return_sum)
+            self.num_episodes += int(count)
+
     def on_train_step(self, loss: float) -> None:
         """Called per learner step (ref worker.py:211-212)."""
         self.training_steps += 1

@@ -316,6 +316,15 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
     state). Blocking — the reference's train.py never returns either
     (train.py:60-66); here max_training_steps / max_seconds bound the run."""
     assert actor_mode in ("thread", "process")
+    if cfg.actor.on_device:
+        # Anakin-style fully on-device acting (ISSUE 6): the fused
+        # act+train loop replaces the whole actor fleet — no threads, no
+        # processes, no block queue, no weight service (actor_mode is
+        # moot). Everything below this guard is the legacy path,
+        # byte-identical when the knob is off.
+        from r2d2_tpu.runtime.anakin_loop import run_anakin_train
+        return run_anakin_train(cfg, max_training_steps=max_training_steps,
+                                max_seconds=max_seconds, log_fn=log_fn)
     if cfg.mesh.multihost:
         # DCN bring-up BEFORE any backend use, so jax.devices() sees the
         # whole slice (SURVEY §5.8; validated by the two-process loopback

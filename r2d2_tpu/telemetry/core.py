@@ -31,6 +31,7 @@ STAGES = (
     "actor/block_emit",           # whole block sink call (incl. queue wait)
     "actor/queue_put",            # time inside put_patient (back-pressure)
     "actor/weight_sync",          # weight_poll + policy.update_params
+    "actor/act_scan",             # fused on-device acting segment dispatch
     "ingest/ring_get",            # feeder drain: shm ring pop / queue get
     "ingest/stage",               # stager: stack + host->device + enqueue
     "ingest/commit",              # replay_add / add_many commit dispatch

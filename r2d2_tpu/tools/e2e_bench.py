@@ -348,6 +348,105 @@ def run_learning_ab(seconds: float, envs_per_actor: int, num_actors: int,
     return out
 
 
+# Anakin A/B shape: the acting-path STRUCTURAL overhead measurement. The
+# policy/env compute is shrunk until it is nearly free on this host (8px
+# frames, hidden 16, one conv), because the quantity under test is the
+# host-boundary cost per env step — interpreter round-trips, per-tick jit
+# dispatch, numpy rolls, LocalBuffer appends, queue hops — which the fused
+# on-device path removes. The host arm's floor is ~3 ms of that per-step
+# host work per 16-lane tick REGARDLESS of shape, so shrinking compute
+# isolates the structural term. Both arms run the IDENTICAL config except
+# the routing knobs. On the shared-silicon CPU container the fused arm is
+# still bounded by the same 2 cores that run the host arm's policy, which
+# caps the measurable ratio (see PERF.md "On-device acting"); on a TPU the
+# acting scan runs on accelerator silicon the host actor cannot use at
+# all, which is where the Podracer-class orders-of-magnitude appear.
+ANAKIN_AB_OVERRIDES = {
+    "env.frame_height": 8, "env.frame_width": 8,
+    "env.frame_stack": 2, "env.episode_len": 200,
+    "network.hidden_dim": 16, "network.cnn_out_dim": 16,
+    "network.conv_layers": ((4, 4, 4),),
+    # exact first-conv rewrite (models/network.py, parity-tested): on this
+    # CPU the 2-input-channel conv is the fused scan's hottest op and the
+    # s2d layout runs it ~25% faster; identical math in BOTH arms
+    "network.space_to_depth": True,
+    "sequence.burn_in_steps": 8, "sequence.learning_steps": 5,
+    "sequence.forward_steps": 3,
+    # capacity = anakin lanes x block_length: the ring must hold one full
+    # segment (one block per lane); kept identical in BOTH arms — ring
+    # size shapes the learner's compile/sample cost, so it is part of the
+    # matched config, which also caps the lane count at 1024
+    "replay.block_length": 200, "replay.capacity": 204_800,
+    "replay.batch_size": 8, "replay.learning_starts": 1_000,
+    "runtime.save_interval": 0, "runtime.log_interval": 2.0,
+}
+
+
+def run_anakin_ab(seconds: float, envs_per_actor: int = 16,
+                  anakin_lanes: int = 512,
+                  overrides: Optional[dict] = None,
+                  repeats: int = 2) -> dict:
+    """On-device acting A/B (ISSUE 6 acceptance): the host-vector actor
+    system vs the fused Anakin loop, same config, one artifact.
+
+    Three cells:
+      * ``host_vector``   — the legacy system: one process actor with
+        ``envs_per_actor`` lanes feeding the learner through the shm ring
+        (the PR1-era architecture at this shape);
+      * ``anakin``        — ``actor.on_device`` with ``anakin_lanes``
+        lanes, unthrottled (acting-rate headline);
+      * ``anakin_balanced`` — the fused loop rate-limited to a
+        collect:learn ratio that matches the host arm's learner cadence,
+        showing the SAME loop trains at full learner speed while still
+        collecting several times faster than the host arm.
+
+    Arms run INTERLEAVED ``repeats`` times and the headline ratios come
+    from per-arm medians, for the same reason ``run_learning_ab`` does:
+    single cells swing ±10% on the shared 2-core host, which is noise at
+    the ~62x acting headline but material for the ~1.3x balanced learner
+    ratio. Every cell's speeds stay in the artifact.
+
+    The headline ``env_steps_ratio`` is anakin / host_vector."""
+    base = dict(ANAKIN_AB_OVERRIDES)
+    base.update(overrides or {})
+    anakin_ov = dict(base)
+    anakin_ov.update({"actor.on_device": True,
+                      "actor.anakin_lanes": anakin_lanes})
+    bal_ov = dict(base)
+    bal_ov.update({"actor.on_device": True,
+                   "actor.anakin_lanes": max(anakin_lanes // 2, 1),
+                   "replay.max_env_steps_per_train_step": 1024.0})
+    cells = {"host_vector": [], "anakin": [], "anakin_balanced": []}
+    for _ in range(max(repeats, 1)):
+        cells["host_vector"].append(
+            run_e2e(seconds, envs_per_actor=envs_per_actor, num_actors=1,
+                    overrides=dict(base)))
+        cells["anakin"].append(run_e2e(seconds, overrides=dict(anakin_ov)))
+        cells["anakin_balanced"].append(
+            run_e2e(seconds, overrides=dict(bal_ov)))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {label: runs[-1] for label, runs in cells.items()}
+    out["repeats"] = max(repeats, 1)
+    out["env_steps_per_sec_cells"] = {
+        k: [c["env_steps_per_sec"] for c in v] for k, v in cells.items()}
+    out["learner_steps_per_sec_cells"] = {
+        k: [c["learner_steps_per_sec"] for c in v] for k, v in cells.items()}
+    host_env = med("host_vector", "env_steps_per_sec")
+    if host_env > 0:
+        out["env_steps_ratio"] = round(
+            med("anakin", "env_steps_per_sec") / host_env, 2)
+        out["env_steps_ratio_balanced"] = round(
+            med("anakin_balanced", "env_steps_per_sec") / host_env, 2)
+    host_lr = med("host_vector", "learner_steps_per_sec")
+    if host_lr > 0:
+        out["learner_steps_ratio_balanced"] = round(
+            med("anakin_balanced", "learner_steps_per_sec") / host_lr, 3)
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -372,6 +471,17 @@ def main(argv=None) -> int:
                         " artifact; 0: single e2e run at the config default")
     p.add_argument("--ingest-batch-blocks", type=int, default=8,
                    help="K for the A/B's batched cell")
+    p.add_argument("--anakin-ab", type=int, default=0,
+                   help="1: run the e2e phase as the on-device acting A/B "
+                        "instead — host-vector actor system vs the fused "
+                        "Anakin act+train loop at the structural-overhead "
+                        "shape (ANAKIN_AB_OVERRIDES), one artifact with "
+                        "env-steps/s and learner updates/s per arm")
+    p.add_argument("--anakin-lanes", type=int, default=512,
+                   help="batched env lanes for the A/B's on-device cell "
+                        "(512 is this host's steps/s sweet spot; raise "
+                        "replay.capacity via --override when raising this "
+                        "past capacity/block_length)")
     p.add_argument("--telemetry-ab", type=int, default=0,
                    help="1: run the e2e phase as a telemetry on/off A/B "
                         "instead (overhead budget < 2%% env-steps/s; one "
@@ -409,7 +519,12 @@ def main(argv=None) -> int:
         out["actor_sweep"] = run_actor_sweep(sweep, seconds=args.seconds,
                                              overrides=overrides)
     if args.e2e_seconds > 0:
-        if args.learning_ab:
+        if args.anakin_ab:
+            out["e2e_anakin_ab"] = run_anakin_ab(
+                args.e2e_seconds, args.envs_per_actor,
+                anakin_lanes=args.anakin_lanes, overrides=overrides,
+                repeats=args.ab_repeats)
+        elif args.learning_ab:
             out["e2e_learning_ab"] = run_learning_ab(
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,
                 overrides=overrides, repeats=args.ab_repeats)

@@ -1,0 +1,537 @@
+"""On-device acting path tests (ISSUE 6): jitted-env parity against the
+host envs, auto-reset/episode-accounting semantics, device block assembly
+parity with the host LocalBuffer sink, replay-state identity through the
+fused scan, config round-trip/validation, the orchestrator kill switch,
+and (slow) the gridworld learnability slice under the fused loop.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.actor.anakin import (ActCarry, emit_blocks, init_act_carry,
+                                   make_anakin_act)
+from r2d2_tpu.actor.local_buffer import LocalBuffer
+from r2d2_tpu.config import Config, apex_epsilon
+from r2d2_tpu.envs.factory import create_env, create_jax_env
+from r2d2_tpu.envs.fake import FakeR2D2Env
+from r2d2_tpu.envs.jax_env import HostJaxEnv, JaxFakeEnv, JaxGridWorld
+from r2d2_tpu.models.network import NetworkApply
+from r2d2_tpu.replay.structs import ReplaySpec
+
+
+def small_cfg(**overrides) -> Config:
+    cfg = Config().replace(**{
+        "env.game_name": "Fake",
+        "env.frame_height": 12, "env.frame_width": 12, "env.frame_stack": 2,
+        "env.episode_len": 40,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2),),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "actor.on_device": True, "actor.anakin_lanes": 3,
+        "runtime.save_interval": 0,
+    })
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def small_net(cfg: Config, action_dim: int = 6) -> NetworkApply:
+    return NetworkApply(action_dim, cfg.network, cfg.env.frame_stack,
+                        cfg.env.frame_height, cfg.env.frame_width)
+
+
+# ---- jitted env vs host env parity --------------------------------------
+
+
+def test_jax_fake_env_matches_host_step_for_step():
+    """The jitted fake env is a PORT of envs/fake.py: driven with the
+    HOST env's schedule and the same actions, obs/reward/done agree
+    exactly for a full episode plus the terminal frame."""
+    host = FakeR2D2Env(height=24, width=24, episode_len=30, seed=7)
+    jenv = JaxFakeEnv(episode_len=30, height=24, width=24)
+    state = jenv.state_from_schedule(host._schedule)
+    step = jax.jit(jenv.step)
+
+    obs_h = host.reset()
+    np.testing.assert_array_equal(
+        obs_h, np.asarray(jenv._obs(state["schedule"], state["t"])))
+    rng = np.random.default_rng(0)
+    for t in range(30):
+        a = int(rng.integers(6))
+        oh, rh, dh, _ = host.step(a)
+        state, oj, rj, dj = step(state, np.int32(a), jax.random.PRNGKey(t))
+        np.testing.assert_array_equal(oh, np.asarray(oj), err_msg=f"t={t}")
+        assert rh == float(rj) and dh == bool(dj), f"t={t}"
+    assert bool(dj)   # fixed-length episode ends exactly at episode_len
+
+
+def test_jax_fake_env_reward_follows_schedule():
+    jenv = JaxFakeEnv(episode_len=8, height=12, width=12)
+    state, _ = jax.jit(jenv.reset)(jax.random.PRNGKey(0))
+    sched = np.asarray(state["schedule"])
+    step = jax.jit(jenv.step)
+    for t in range(8):
+        # playing the schedule's target always pays +1
+        state, _, r, _ = step(state, np.int32(sched[t]),
+                              jax.random.PRNGKey(t))
+        assert float(r) == 1.0
+
+
+def test_grid_env_semantics():
+    """Reward fires exactly on stepping onto the goal; the agent respawns
+    off-goal; the goal stays fixed; episodes end at episode_len; frames
+    render the two cells at distinct intensities."""
+    env = JaxGridWorld(size=4, episode_len=10, height=16, width=16)
+    state, obs = jax.jit(env.reset)(jax.random.PRNGKey(2))
+    obs = np.asarray(obs)
+    assert set(np.unique(obs)) <= {16, 128, 255}
+    assert (obs == 255).sum() == 4 * 4   # one agent cell (4x4 px)
+    assert (obs == 128).sum() == 4 * 4   # one goal cell
+    step = jax.jit(env.step)
+    goal = np.asarray(state["goal"]).copy()
+    total = 0.0
+    for t in range(10):
+        pos = np.asarray(state["pos"])
+        # drive straight at the goal: move along the first differing axis
+        if pos[0] != goal[0]:
+            a = 0 if pos[0] > goal[0] else 1
+        elif pos[1] != goal[1]:
+            a = 2 if pos[1] > goal[1] else 3
+        else:  # pragma: no cover - respawn guarantees off-goal
+            a = 4
+        state, obs, r, d = step(state, np.int32(a), jax.random.PRNGKey(t))
+        total += float(r)
+        np.testing.assert_array_equal(np.asarray(state["goal"]), goal)
+        # after a goal hit the agent respawns AWAY from the goal
+        assert not np.array_equal(np.asarray(state["pos"]), goal)
+        assert bool(d) == (t == 9)
+    assert total >= 1.0   # goal-seeking collects reward within one episode
+
+
+def test_host_adapter_and_factory_registration():
+    cfg = small_cfg(**{"env.game_name": "Grid", "env.grid_size": 4})
+    env = create_env(cfg.env, seed=0)
+    assert isinstance(env, HostJaxEnv)
+    assert env.action_space.n == 5
+    obs = env.reset()
+    assert obs.shape == (12, 12) and obs.dtype == np.uint8
+    obs2, r, d, info = env.step(1)
+    assert obs2.shape == (12, 12) and isinstance(r, float) and not d
+    env.close()
+
+    # "JaxFake" resolves the jitted fake behind the adapter; plain "Fake"
+    # keeps the host numpy env (legacy path unchanged)
+    jf = create_env(dataclasses.replace(cfg.env, game_name="JaxFake"), seed=0)
+    assert isinstance(jf, HostJaxEnv) and jf.action_space.n == 6
+    fk = create_env(dataclasses.replace(cfg.env, game_name="Fake"), seed=0)
+    assert isinstance(fk.unwrapped, FakeR2D2Env)
+
+    assert isinstance(create_jax_env(cfg.env), JaxGridWorld)
+    assert isinstance(
+        create_jax_env(dataclasses.replace(cfg.env, game_name="Fake")),
+        JaxFakeEnv)
+    with pytest.raises(ValueError, match="no pure-JAX"):
+        create_jax_env(dataclasses.replace(cfg.env, game_name="Vizdoom"))
+
+
+# ---- config knobs --------------------------------------------------------
+
+
+def test_config_roundtrip_and_pre_pr6_dicts():
+    cfg = small_cfg(**{"actor.anakin_lanes": 5,
+                       "actor.anakin_scans_per_train": 2,
+                       "actor.anakin_priority": 0.5})
+    again = Config.from_dict(json.loads(cfg.to_json()))
+    assert again.actor.on_device and again.actor.anakin_lanes == 5
+    assert again.actor.anakin_scans_per_train == 2
+    assert again.actor.anakin_priority == 0.5
+    assert again.env.episode_len == 40 and again.env.grid_size == 6
+
+    # a pre-PR6 checkpoint config (no anakin/env knobs) loads with defaults
+    d = Config().to_dict()
+    for key in ("on_device", "anakin_lanes", "anakin_scans_per_train",
+                "anakin_priority"):
+        d["actor"].pop(key)
+    d["env"].pop("episode_len")
+    d["env"].pop("grid_size")
+    old = Config.from_dict(d)
+    assert old.actor.on_device is False
+    assert old.actor.anakin_lanes == 64
+    assert old.env.episode_len == 120 and old.env.grid_size == 6
+
+
+def test_config_validates_on_device_preconditions():
+    with pytest.raises(ValueError, match="multiple of"):
+        small_cfg(**{"env.episode_len": 30})       # 30 % 20 != 0
+    with pytest.raises(ValueError, match="num_blocks"):
+        small_cfg(**{"actor.anakin_lanes": 41})    # > 800/20 blocks
+    with pytest.raises(ValueError, match="placement"):
+        small_cfg(**{"replay.placement": "host"})
+    with pytest.raises(ValueError, match="anakin_priority"):
+        small_cfg(**{"actor.anakin_priority": 0.0})
+    with pytest.raises(ValueError, match="anakin_scans_per_train"):
+        small_cfg(**{"actor.anakin_scans_per_train": 0})
+    # the same knobs are unconstrained while on_device is off
+    off = small_cfg(**{"actor.on_device": False, "env.episode_len": 30})
+    assert not off.actor.on_device
+
+
+# ---- device block assembly vs the host LocalBuffer sink ------------------
+
+
+def _drive_parity(spec: ReplaySpec, n_segments: int, ep_blocks: int,
+                  num_lanes: int = 2, seed: int
+                  = 0):
+    """Feed IDENTICAL synthetic transition streams to the host LocalBuffer
+    (add/finish per lane) and the device assembler (emit_blocks per
+    segment, tails carried), returning (host_blocks[lane][seg],
+    device_blocks[seg], terminals[seg])."""
+    rng = np.random.default_rng(seed)
+    n, l_seg = num_lanes, spec.block_length
+    h = w = spec.frame_height
+    a_dim, hid = 6, spec.hidden_dim
+    gamma = 0.997
+
+    lbs = [LocalBuffer(spec, a_dim, gamma) for _ in range(n)]
+    init_obs = rng.integers(0, 255, (n, h, w)).astype(np.uint8)
+    for i in range(n):
+        lbs[i].reset(init_obs[i])
+    stack, b = spec.frame_stack, spec.burn_in
+    tails = (
+        np.zeros((n, stack + b, h, w), np.uint8),
+        np.full((n, b + 1), -1, np.int32),
+        np.zeros((n, b + 1, 2, hid), np.float32),
+        np.zeros((n,), np.int32),
+    )
+    tails[0][:, b:] = np.repeat(init_obs[:, None], stack, axis=1)
+    ep_ret = np.zeros((n,), np.float32)
+
+    host_blocks = [[] for _ in range(n)]
+    dev_blocks, terminals = [], []
+    for seg in range(n_segments):
+        obs = rng.integers(0, 255, (n, l_seg, h, w)).astype(np.uint8)
+        actions = rng.integers(0, a_dim, (n, l_seg)).astype(np.int32)
+        rewards = rng.normal(size=(n, l_seg)).astype(np.float32)
+        hiddens = rng.normal(size=(n, l_seg, 2, hid)).astype(np.float32)
+        terminal = np.full((n,), ((seg + 1) % ep_blocks) == 0)
+        reset_obs = rng.integers(0, 255, (n, h, w)).astype(np.uint8)
+        ep_ret = ep_ret + rewards.sum(axis=1)
+
+        for i in range(n):
+            for t in range(l_seg):
+                lbs[i].add(int(actions[i, t]), float(rewards[i, t]),
+                           obs[i, t], np.zeros(a_dim, np.float32),
+                           hiddens[i, t])
+            if terminal[i]:
+                host_blocks[i].append(lbs[i].finish(None))
+                lbs[i].reset(reset_obs[i])
+            else:
+                host_blocks[i].append(
+                    lbs[i].finish(np.zeros(a_dim, np.float32)))
+
+        blocks, tails = emit_blocks(
+            spec, gamma, 1.0, *[jnp.asarray(x) for x in tails],
+            jnp.asarray(obs), jnp.asarray(actions), jnp.asarray(rewards),
+            jnp.asarray(hiddens), jnp.asarray(terminal),
+            jnp.asarray(ep_ret), jnp.ones(n, bool), jnp.asarray(reset_obs),
+            seg + 100)
+        tails = [np.asarray(x) for x in tails]
+        dev_blocks.append(jax.tree_util.tree_map(np.asarray, blocks))
+        terminals.append(terminal)
+        ep_ret = np.where(terminal, 0.0, ep_ret).astype(np.float32)
+    return host_blocks, dev_blocks, terminals
+
+
+def test_block_layout_parity_with_host_sink():
+    """Every field of every device-assembled block matches the host
+    LocalBuffer's, across segments spanning burn-in carry AND episode
+    resets — except priority (deliberately a constant stamp)."""
+    cfg = small_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    host_blocks, dev_blocks, terminals = _drive_parity(
+        spec, n_segments=4, ep_blocks=2)   # episode = 2 blocks
+
+    for seg in range(4):
+        for i in range(2):
+            hb = host_blocks[i][seg]
+            db = jax.tree_util.tree_map(lambda x: x[i], dev_blocks[seg])
+            np.testing.assert_array_equal(db.obs_row, hb.obs_row)
+            np.testing.assert_array_equal(db.last_action_row,
+                                          hb.last_action_row)
+            np.testing.assert_array_equal(db.action, hb.action)
+            np.testing.assert_array_equal(db.hidden, hb.hidden)
+            np.testing.assert_allclose(db.reward, hb.reward, atol=2e-5)
+            np.testing.assert_allclose(db.gamma, hb.gamma, atol=2e-6)
+            np.testing.assert_array_equal(db.burn_in_steps,
+                                          hb.burn_in_steps)
+            np.testing.assert_array_equal(db.learning_steps,
+                                          hb.learning_steps)
+            np.testing.assert_array_equal(db.forward_steps,
+                                          hb.forward_steps)
+            np.testing.assert_array_equal(db.seq_start, hb.seq_start)
+            assert int(db.num_sequences) == int(hb.num_sequences)
+            assert int(db.weight_version) == seg + 100
+            assert (db.priority == 1.0).all()   # the constant stamp
+            if terminals[seg][i]:
+                np.testing.assert_allclose(float(db.sum_reward),
+                                           float(hb.sum_reward), rtol=1e-5)
+            else:
+                assert np.isnan(float(db.sum_reward))
+                assert np.isnan(float(hb.sum_reward))
+
+
+def test_emit_blocks_zero_burn_in():
+    """burn_in=0 collapses the carry buffers to their minimal shapes —
+    the degenerate layout must still match the host assembler."""
+    cfg = small_cfg(**{"sequence.burn_in_steps": 0})
+    spec = ReplaySpec.from_config(cfg)
+    host_blocks, dev_blocks, _ = _drive_parity(spec, n_segments=2,
+                                               ep_blocks=2)
+    for seg in range(2):
+        for i in range(2):
+            hb = host_blocks[i][seg]
+            db = jax.tree_util.tree_map(lambda x: x[i], dev_blocks[seg])
+            np.testing.assert_array_equal(db.obs_row, hb.obs_row)
+            np.testing.assert_array_equal(db.burn_in_steps,
+                                          hb.burn_in_steps)
+            np.testing.assert_allclose(db.reward, hb.reward, atol=2e-5)
+
+
+# ---- the fused acting scan ----------------------------------------------
+
+
+def _make_act(cfg: Config, num_lanes: int):
+    env = create_jax_env(cfg.env)
+    spec = ReplaySpec.from_config(cfg)
+    net = small_net(cfg, env.action_dim)
+    params = net.init(jax.random.PRNGKey(0))
+    eps = [apex_epsilon(i, num_lanes, cfg.actor.base_eps,
+                        cfg.actor.eps_alpha) for i in range(num_lanes)]
+    act = make_anakin_act(env, net, spec, num_lanes=num_lanes,
+                          epsilons=eps, gamma=cfg.optim.gamma,
+                          priority=cfg.actor.anakin_priority,
+                          near_greedy_eps=cfg.actor.near_greedy_eps)
+    carry = init_act_carry(env, spec, num_lanes, jax.random.PRNGKey(1))
+    return env, spec, net, params, act, carry
+
+
+def test_act_scan_emits_full_blocks_and_autoresets():
+    """One acting segment per block: shapes, full sequence slots, stamped
+    weight_version; at the episode-boundary segment every lane reports
+    done exactly once, the carry resets (zero hidden / null last action /
+    duplicated reset frames / zero burn-in), and mid-episode segments
+    carry burn-in forward — the envs/vector.py auto-reset semantics."""
+    cfg = small_cfg()            # episode_len 40 = 2 blocks of 20
+    n = 3
+    env, spec, net, params, act, carry = _make_act(cfg, n)
+
+    # segment 1: mid-episode (no lane done)
+    carry, blocks, stats = act(params, carry, np.int32(4))
+    assert blocks.obs_row.shape == (n, spec.obs_row_len, 12, 12)
+    assert (np.asarray(blocks.num_sequences) == spec.seqs_per_block).all()
+    assert (np.asarray(blocks.learning_steps) == spec.learning).all()
+    assert (np.asarray(blocks.weight_version) == 4).all()
+    assert (np.asarray(blocks.priority) == cfg.actor.anakin_priority).all()
+    assert int(stats["episodes"]) == 0
+    assert (np.asarray(carry.burn0)
+            == min(spec.block_length, spec.burn_in)).all()
+    # gamma tail bootstraps (no termination): strictly positive
+    assert (np.asarray(blocks.gamma) > 0).all()
+
+    # segment 2: ends the episode in every lane
+    carry, blocks, stats = act(params, carry, np.int32(5))
+    assert int(stats["episodes"]) == n
+    assert (np.asarray(carry.burn0) == 0).all()
+    assert (np.asarray(carry.hidden) == 0).all()
+    assert (np.asarray(carry.last_action) == -1).all()
+    # terminal gamma tail: the last forward window is zeroed
+    g = np.asarray(blocks.gamma)
+    assert (g[:, -1, -1] == 0).all()
+    # frame stack restarted with the new episode's duplicated initial obs
+    cs = np.asarray(carry.cur_stack)
+    for k in range(1, spec.frame_stack):
+        np.testing.assert_array_equal(cs[:, 0], cs[:, k])
+    # the new episode's burn-in tail holds those same frames
+    np.testing.assert_array_equal(
+        np.asarray(carry.tail_frames)[:, spec.burn_in:], cs)
+
+
+def test_act_scan_replay_state_identity_with_sequential_adds():
+    """Ring-writing one fused segment's N stacked blocks via
+    replay_add_many equals N sequential replay_add calls — the device
+    path reuses the parity-exact ingestion primitive, asserted end to
+    end here."""
+    from r2d2_tpu.replay.device_replay import (replay_add, replay_add_many,
+                                               replay_init)
+    cfg = small_cfg()
+    n = 3
+    env, spec, net, params, act, carry = _make_act(cfg, n)
+    carry, blocks, _ = act(params, carry, np.int32(1))
+
+    many = replay_add_many(spec, replay_init(spec), blocks)
+    seq = replay_init(spec)
+    for i in range(n):
+        one = jax.tree_util.tree_map(lambda x: np.asarray(x)[i], blocks)
+        from r2d2_tpu.replay.structs import Block
+        seq = replay_add(spec, seq, Block(**{
+            f.name: getattr(one, f.name)
+            for f in dataclasses.fields(Block)}))
+    for name in many.__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(many, name)), np.asarray(getattr(seq, name)),
+            err_msg=name)
+
+
+def test_act_scan_near_greedy_report_filter():
+    """Only lanes at ε <= near_greedy_eps report episode returns (the
+    host loop's filtering), and the per-segment stats aggregate exactly
+    those lanes."""
+    cfg = small_cfg()
+    n = 4
+    env, spec, net, params, act, carry = _make_act(cfg, n)
+    eps = [apex_epsilon(i, n, cfg.actor.base_eps, cfg.actor.eps_alpha)
+           for i in range(n)]
+    reporting = sum(e <= cfg.actor.near_greedy_eps for e in eps)
+    assert 0 < reporting < n     # the ladder straddles the threshold
+    carry, blocks, _ = act(params, carry, np.int32(1))     # mid-episode
+    carry, blocks, stats = act(params, carry, np.int32(1))  # boundary
+    assert int(stats["episodes"]) == n
+    assert int(stats["reported_episodes"]) == reporting
+    sr = np.asarray(blocks.sum_reward)
+    assert np.isfinite(sr).sum() == reporting
+    finite_sum = float(np.nansum(np.where(np.isfinite(sr), sr, 0.0)))
+    np.testing.assert_allclose(float(stats["reported_return_sum"]),
+                               finite_sum, rtol=1e-5)
+
+
+# ---- the fused act+train loop -------------------------------------------
+
+
+def test_anakin_loop_trains_end_to_end(tmp_path):
+    """The colocated loop: acting segments fill device replay, the gate
+    opens, train steps run, metrics/records flow — all in-process with
+    zero host actors."""
+    from r2d2_tpu.runtime.orchestrator import train
+    cfg = small_cfg(**{
+        "replay.capacity": 400, "replay.learning_starts": 60,
+        "actor.anakin_lanes": 2, "env.episode_len": 20,
+        "replay.block_length": 10, "replay.batch_size": 4,
+        "runtime.save_dir": str(tmp_path), "runtime.log_interval": 0.2,
+    })
+    records = []
+    stacks = train(cfg, max_training_steps=6, max_seconds=120,
+                   log_fn=records.append)
+    lr = stacks[0].learner
+    assert lr.training_steps >= 6
+    assert lr.env_steps >= cfg.replay.learning_starts
+    assert lr.ring.buffer_steps > 0
+    # records are emitted at log-interval boundaries (the final partial
+    # interval flushes metrics without a record, like the host loop)
+    assert records and records[-1]["buffer_size"] > 0
+    assert any(r["training_steps"] >= 1 for r in records)
+
+
+def test_on_device_kill_switch_routes_and_legacy_untouched(monkeypatch):
+    """actor.on_device=False (the default) never touches the anakin loop;
+    True delegates before any fleet/queue/weight-service construction."""
+    from r2d2_tpu.runtime import anakin_loop, orchestrator
+    assert Config().actor.on_device is False
+
+    sentinel = object()
+    called = {}
+
+    def fake_run(cfg, **kw):
+        called["cfg"] = cfg
+        return sentinel
+
+    monkeypatch.setattr(anakin_loop, "run_anakin_train", fake_run)
+    out = orchestrator.train(small_cfg(), max_training_steps=1)
+    assert out is sentinel and called["cfg"].actor.on_device
+
+    # off: the delegation must NOT fire (legacy path runs; bound to a
+    # trivially short thread-mode run)
+    def boom(cfg, **kw):  # pragma: no cover - failure path
+        raise AssertionError("anakin loop reached with on_device=False")
+
+    monkeypatch.setattr(anakin_loop, "run_anakin_train", boom)
+    cfg_off = small_cfg(**{"actor.on_device": False,
+                           "actor.num_actors": 1,
+                           "replay.learning_starts": 40})
+    stacks = orchestrator.train(cfg_off, max_training_steps=1,
+                                max_seconds=25, actor_mode="thread")
+    assert stacks[0].learner.training_steps >= 0
+
+
+# ---- learnability (slow) -------------------------------------------------
+
+GRID_TRAIN_STEPS = 2000
+
+
+def _grid_cfg(save_dir: str) -> Config:
+    return Config().replace(**{
+        "env.game_name": "Grid", "env.grid_size": 5,
+        "env.frame_height": 20, "env.frame_width": 20,
+        "env.frame_stack": 2, "env.episode_len": 40,
+        "network.hidden_dim": 32, "network.cnn_out_dim": 64,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 32_000, "replay.block_length": 40,
+        "replay.batch_size": 16, "replay.learning_starts": 2_000,
+        "replay.max_env_steps_per_train_step": 16.0,
+        "actor.on_device": True, "actor.anakin_lanes": 32,
+        "optim.lr": 1e-3, "optim.gamma": 0.99,
+        "runtime.save_interval": 0, "runtime.log_interval": 8.0,
+        "runtime.save_dir": save_dir,
+    })
+
+
+def _grid_train(save_dir: str) -> dict:
+    from r2d2_tpu.runtime.anakin_loop import run_anakin_train
+    records = []
+    stacks = run_anakin_train(_grid_cfg(save_dir),
+                              max_training_steps=GRID_TRAIN_STEPS,
+                              max_seconds=600, log_fn=records.append)
+    returns = [r["avg_episode_return"] for r in records
+               if r.get("avg_episode_return") is not None]
+    return {"training_steps": int(stacks[0].learner.training_steps),
+            "returns": returns}
+
+
+@pytest.mark.slow
+def test_grid_learnability_under_fused_loop(tmp_path):
+    """The jitted gridworld visibly LEARNS under the fused act+train
+    loop: the near-greedy lanes' behavior return grows several-fold from
+    the first logged interval to the last (measured 0.09 -> 1.15 over
+    2000 steps on the 2-core container; asserted with wide margins).
+    Runs in a subprocess on a plain single-device CPU backend — the
+    suite's 8-virtual-device pin triples single-core wall time."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["training_steps"] >= GRID_TRAIN_STEPS
+    returns = result["returns"]
+    assert len(returns) >= 2, returns
+    early, late = returns[0], returns[-1]
+    assert late >= max(3.0 * early, early + 0.3), returns
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from r2d2_tpu.utils.platform import pin_platform
+    pin_platform()
+    print(json.dumps(_grid_train(sys.argv[1])))
