@@ -25,8 +25,20 @@
 #                parity with the host sink, replay-state identity, the
 #                fused loop, kill switch); the slow gridworld
 #                learnability slice runs with the full tier.
+#   make sentinel — the fast-tier resource/compile/alerting suite
+#                (tests/test_sentinel.py: rule-engine semantics, retrace
+#                detection on a shape-churning jit, board RSS
+#                aggregation, resource monitor + forensics dump, record
+#                schema stability); the slow chaos-driven e2e slices
+#                (injected hang → actor_stall alert) run with the full
+#                tier.
+#   make regress — the bench regression gate: tools/regress.py compares
+#                the tree's E2E_*/BENCH_* artifacts against
+#                BASELINE.json's 'bench' snapshot (per-metric noise
+#                tolerances; exit 1 on any regression).
 
-.PHONY: t1 chaos telemetry learning anakin check-fast-markers
+.PHONY: t1 chaos telemetry learning anakin sentinel regress \
+	check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -47,6 +59,14 @@ anakin: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_anakin.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+sentinel: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_sentinel.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
+regress:
+	JAX_PLATFORMS=cpu python -m r2d2_tpu.tools.regress \
+	    --baseline BASELINE.json --dir .
+
 # One guard per suite: module:marker:min-collected:label (marker spelled
 # with underscores for spaces). A stray @pytest.mark.slow (or a marker
 # typo) silently drops tests from the fast tier; the count floor catches
@@ -56,7 +76,8 @@ FAST_MARKER_CHECKS := \
 	tests/test_chaos.py:chaos_and_not_slow:12:chaos \
 	tests/test_telemetry.py:not_slow:20:telemetry \
 	tests/test_learning_diag.py:not_slow:12:learning-diagnostics \
-	tests/test_anakin.py:not_slow:10:anakin
+	tests/test_anakin.py:not_slow:10:anakin \
+	tests/test_sentinel.py:not_slow:20:sentinel
 
 check-fast-markers:
 	@for spec in $(FAST_MARKER_CHECKS); do \

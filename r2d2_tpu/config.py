@@ -379,6 +379,48 @@ class TelemetryConfig:
     # continues (the reference's silent-NaN failure mode, made loud),
     # "halt" raises after the dump so the run stops at the poisoned step.
     nan_policy: str = "warn"
+    # -- resource & compilation observability (ISSUE 7) --
+    # Pillar kill switch: per-device memory_stats sampling, buffer
+    # attribution, host/actor RSS+CPU, the compile/retrace telemetry, and
+    # the record's 'resources' + 'alerts' blocks. False (or the master
+    # `enabled` off) yields periodic records byte-identical to the
+    # pre-PR7 schema (stability-tested).
+    resources_enabled: bool = True
+    # Seconds between resource samples (a handful of dict reads and one
+    # /proc line — benched within noise at this cadence, PERF.md).
+    resources_interval_s: float = 10.0
+    # One-shot OOM forensics floor: the first sample seeing any device's
+    # HBM headroom below this fraction writes resource_dump_player{p}.json
+    # (the nan_dump pattern — the attribution picture an OOM kill would
+    # destroy). 0 disables the dump.
+    resources_headroom_warn_frac: float = 0.05
+    # XLA compilation telemetry sub-switch (telemetry/compile.py):
+    # per-function compile counts + wall time, post-warm-up retrace
+    # detection with the offending avals, and the stager's AOT coverage
+    # report, nested under the record's resources block.
+    compile_enabled: bool = True
+    # Alert engine sub-switch (telemetry/alerts.py): the declarative rule
+    # set evaluated per periodic record, emitting the record's 'alerts'
+    # block + alerts_player{p}.jsonl. Requires resources_enabled (the
+    # machine-side rules read the resources block; tools/sentinel.py
+    # re-evaluates offline regardless).
+    alerts_enabled: bool = True
+    # Rolling-median window (records) for the drop/growth rules; a rule
+    # arms only once its metric has been healthy for a full window.
+    alerts_window: int = 8
+    # env/learner throughput below this fraction of its rolling median
+    # fires *_throughput_drop.
+    alerts_throughput_drop_frac: float = 0.5
+    # Max heartbeat age (seconds) before heartbeat_stale fires.
+    alerts_heartbeat_age_s: float = 120.0
+    # sample_age p50 above this multiple of its rolling median fires
+    # staleness_growth.
+    alerts_staleness_growth_factor: float = 4.0
+    # Minimum per-device HBM headroom fraction before hbm_headroom fires.
+    alerts_hbm_headroom_frac: float = 0.05
+    # Post-warm-up retraces within one log interval at/above this count
+    # fire retrace_storm.
+    alerts_retrace_storm: int = 3
 
 
 @dataclass(frozen=True)
@@ -625,6 +667,39 @@ class Config:
             raise ValueError(
                 f"telemetry.nan_policy ({self.telemetry.nan_policy!r}) must "
                 "be 'warn' or 'halt'")
+        if self.telemetry.resources_interval_s <= 0:
+            raise ValueError("telemetry.resources_interval_s must be > 0")
+        if not 0 <= self.telemetry.resources_headroom_warn_frac < 1:
+            raise ValueError(
+                f"telemetry.resources_headroom_warn_frac "
+                f"({self.telemetry.resources_headroom_warn_frac}) must be "
+                "in [0, 1)")
+        if self.telemetry.alerts_window < 2:
+            raise ValueError(
+                f"telemetry.alerts_window ({self.telemetry.alerts_window}) "
+                "must be >= 2")
+        if not 0 < self.telemetry.alerts_throughput_drop_frac <= 1:
+            raise ValueError(
+                f"telemetry.alerts_throughput_drop_frac "
+                f"({self.telemetry.alerts_throughput_drop_frac}) must be "
+                "in (0, 1]")
+        if self.telemetry.alerts_heartbeat_age_s < 0:
+            raise ValueError(
+                "telemetry.alerts_heartbeat_age_s must be >= 0")
+        if self.telemetry.alerts_staleness_growth_factor <= 1:
+            raise ValueError(
+                f"telemetry.alerts_staleness_growth_factor "
+                f"({self.telemetry.alerts_staleness_growth_factor}) must "
+                "be > 1")
+        if not 0 <= self.telemetry.alerts_hbm_headroom_frac < 1:
+            raise ValueError(
+                f"telemetry.alerts_hbm_headroom_frac "
+                f"({self.telemetry.alerts_hbm_headroom_frac}) must be in "
+                "[0, 1)")
+        if self.telemetry.alerts_retrace_storm < 1:
+            raise ValueError(
+                f"telemetry.alerts_retrace_storm "
+                f"({self.telemetry.alerts_retrace_storm}) must be >= 1")
         if self.multiplayer.enabled and self.actor.envs_per_actor > 1:
             raise ValueError(
                 "actor.envs_per_actor > 1 is not supported with multiplayer "

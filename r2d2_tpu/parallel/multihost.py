@@ -250,16 +250,20 @@ def _make_gspmd_lockstep_ingest(spec: ReplaySpec, mesh):
 
 
 def _write_host_telemetry_row(path: str, rank: int, tele,
-                              t_start: float) -> None:
+                              t_start: float, resources=None) -> None:
     """One per-host aggregated telemetry row per log interval. Rank 0's
     stage summary rides the main TrainMetrics record (it owns the
     player's metrics files); every other rank appends compact rows here so
     a pod-wide view exists without breaking the rank-0-deduplicates-side-
-    effects rule — tools/inspect.py reads both."""
+    effects rule — tools/inspect.py reads both. With the resource pillar
+    on (ISSUE 7) the row also carries this host's ``resources`` block
+    (its own devices + RSS/CPU — resource state is host-local)."""
     import json
     row = {"t": round(time.time() - t_start, 3), "rank": rank,
            "stages": tele.interval_summary(),
            "telemetry_dropped_spans": tele.spans.dropped}
+    if resources is not None:
+        row["resources"] = resources.block()
     with open(path, "a") as f:
         f.write(json.dumps(row) + "\n")
 
@@ -700,6 +704,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     # actor k must not orphan the k-1 already-running actor processes on a
     # live shm ring — the finally unwinds them (round-4 review)
     fleet = None
+    resources = None
+    compile_mon = None
     try:
         if cfg.telemetry.enabled:
             resume = bool(cfg.runtime.resume)
@@ -737,6 +743,42 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                                         cfg.optim.lr)
                      if metrics is not None and learn_diag is not None
                      else None)
+        # system-health pillar (ISSUE 7), rank-aware: EVERY rank samples
+        # its own devices/host/actor-slots (resource state is host-local,
+        # like the health and stage telemetry above) and owns its own
+        # compile monitor (compile events are process-global per rank
+        # process). Rank 0's block + the alert engine ride the main
+        # TrainMetrics record — the rank-0-deduplicates-side-effects rule
+        # — while other ranks' compact blocks join their per-host
+        # telemetry rows.
+        if cfg.telemetry.enabled and cfg.telemetry.resources_enabled:
+            from r2d2_tpu.telemetry import (AlertEngine, CompileMonitor,
+                                            ResourceMonitor, active_monitor,
+                                            default_rules)
+            from r2d2_tpu.telemetry.resources import (clear_player_buffers,
+                                                      pytree_nbytes,
+                                                      register_buffer)
+            clear_player_buffers(pid)   # previous same-process run's entries
+            register_buffer(f"p{pid}/train_state", pytree_nbytes(ts))
+            if not host_mode:
+                register_buffer(f"p{pid}/replay_ring", pytree_nbytes(rs))
+            if cfg.telemetry.compile_enabled and active_monitor() is None:
+                compile_mon = CompileMonitor().install()
+            resources = ResourceMonitor(
+                pid, cfg.runtime.save_dir or ".",
+                interval_s=cfg.telemetry.resources_interval_s,
+                headroom_warn_frac=(
+                    cfg.telemetry.resources_headroom_warn_frac),
+                board=tele_board, compile_monitor=compile_mon)
+            if metrics is not None:
+                metrics.set_resources(resources.block)
+                if cfg.telemetry.alerts_enabled:
+                    metrics.set_sentinel(AlertEngine(
+                        default_rules(cfg.telemetry),
+                        jsonl_path=os.path.join(
+                            cfg.runtime.save_dir or ".",
+                            f"alerts_player{pid}.jsonl"),
+                        resume=bool(cfg.runtime.resume)))
         pub_count = ((lambda: publisher.publish_count)
                      if publisher is not None
                      else (lambda: store.publish_count))
@@ -952,6 +994,14 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             if now - last_supervise >= rt.supervise_interval_s:
                 fleet.supervise()   # every host tends its own actor fleet
                 last_supervise = now
+                if resources is not None:
+                    # resource sampling rides the supervision cadence,
+                    # exactly like the single-host PlayerStack
+                    resources.maybe_sample(now)
+                if compile_mon is not None and step_count > step_base:
+                    # this process has trained: the lockstep program (and
+                    # the actor policies it feeds) compiled during warm-up
+                    compile_mon.mark_warm()
             if now - last_log >= rt.log_interval:
                 if metrics is not None:
                     flush_losses()
@@ -967,7 +1017,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                     # observability: one aggregated per-host row per
                     # interval
                     _write_host_telemetry_row(host_rows_path, rank, tele,
-                                              t_run_start)
+                                              t_run_start,
+                                              resources=resources)
                 last_log = now
         flush_losses()
         # preemption-safe final checkpoint (same contract as the
@@ -1005,6 +1056,10 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         tele.close()         # stops the drain thread, final flush
         if tele_board is not None:
             tele_board.close()
+        if compile_mon is not None:
+            # restore the pxla logger exactly (level/propagation) and
+            # release this rank process's active-monitor slot
+            compile_mon.uninstall()
 
     return {"step": step_count, "env_steps": resumed_env + info["env_steps"],
             "buffer_steps": info["buffer_steps"], "params": ts.params,

@@ -44,10 +44,10 @@ def _guard_device_capacity(spec: ReplaySpec) -> None:
     dev = jax.devices()[0]
     limit = None
     if dev.platform == "tpu":
-        try:
-            limit = (dev.memory_stats() or {}).get("bytes_limit")
-        except Exception:       # memory_stats is backend-optional
-            limit = None
+        # the ONE memory_stats wrapper (telemetry/resources.py): same
+        # backend-optional semantics — {} when the backend reports nothing
+        from r2d2_tpu.telemetry.resources import device_memory_stats
+        limit = device_memory_stats(dev).get("bytes_limit")
     if limit and ring > 0.9 * limit:
         hint = ""
         if spec.exact_gather:

@@ -120,6 +120,36 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
     carry = init_act_carry(env, spec, num_lanes,
                            jax.random.PRNGKey(cfg.runtime.seed + 17))
 
+    # system-health pillar (ISSUE 7), the on-device twin of the
+    # PlayerStack wiring: resource sampler (the Learner registered ring +
+    # train-state footprints; the lane carry registers here), the compile/
+    # retrace monitor, and the alert engine. No actor fleet, so no board
+    # gauges — this process's RSS/CPU is the whole host picture.
+    resources = None
+    compile_mon = None
+    if cfg.telemetry.enabled and cfg.telemetry.resources_enabled:
+        from r2d2_tpu.telemetry import (AlertEngine, CompileMonitor,
+                                        ResourceMonitor, active_monitor,
+                                        default_rules)
+        from r2d2_tpu.telemetry.resources import (pytree_nbytes,
+                                                  register_buffer)
+        register_buffer("p0/anakin_carry", pytree_nbytes(carry))
+        if cfg.telemetry.compile_enabled and active_monitor() is None:
+            compile_mon = CompileMonitor().install()
+        resources = ResourceMonitor(
+            0, cfg.runtime.save_dir or ".",
+            interval_s=cfg.telemetry.resources_interval_s,
+            headroom_warn_frac=cfg.telemetry.resources_headroom_warn_frac,
+            compile_monitor=compile_mon,
+            aot_coverage_fn=learner.aot_coverage)
+        metrics.set_resources(resources.block)
+        if cfg.telemetry.alerts_enabled:
+            metrics.set_sentinel(AlertEngine(
+                default_rules(cfg.telemetry),
+                jsonl_path=os.path.join(cfg.runtime.save_dir or ".",
+                                        "alerts_player0.jsonl"),
+                resume=bool(cfg.runtime.resume)))
+
     pending_stats: list = []
 
     def act_segment():
@@ -180,6 +210,15 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
             if learner.ready and learner.training_steps < max_steps:
                 learner.step()
             now = time.time()
+            if resources is not None:
+                # resource sampling rides the loop at the same cheap-time-
+                # check cadence the PlayerStack's supervise pass uses
+                resources.maybe_sample(now)
+            if compile_mon is not None and learner.training_steps:
+                # warm-up ends once training has started: act_fn and the
+                # train program have compiled; any further compile of a
+                # known fn with new avals is a retrace (idempotent latch)
+                compile_mon.mark_warm()
             if now - last_log >= cfg.runtime.log_interval:
                 learner.flush_metrics()
                 flush_stats()
@@ -198,4 +237,8 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
             import logging
             logging.getLogger(__name__).exception("final checkpoint failed")
         stack.close()
+        if compile_mon is not None:
+            # restore the pxla logger exactly and release the process-
+            # global active-monitor slot (same contract as PlayerStack)
+            compile_mon.uninstall()
     return [stack]

@@ -230,6 +230,27 @@ class Learner:
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
                 self.replay_state)
         self._ingest_stop = threading.Event()
+        # buffer attribution (ISSUE 7): register this player's device
+        # footprints with the process registry so the periodic record's
+        # resources block names owners instead of one opaque HBM total.
+        # Names are re-registered on a rebuilt Learner (same-name
+        # overwrite), and registration is read-side-only — with
+        # resources off nothing ever reads it, so the record schema
+        # stays byte-identical.
+        if cfg.telemetry.enabled and cfg.telemetry.resources_enabled:
+            from r2d2_tpu.telemetry.resources import (clear_player_buffers,
+                                                      pytree_nbytes,
+                                                      register_buffer)
+            # drop the previous incarnation's entries first: same-name
+            # overwrite doesn't cover components the rebuilt stack
+            # LACKS (e.g. an earlier run's stager staging window when
+            # this run drains per-block)
+            clear_player_buffers(player_idx)
+            register_buffer(f"p{player_idx}/train_state",
+                            pytree_nbytes(self.train_state))
+            if self.replay_state is not None:
+                register_buffer(f"p{player_idx}/replay_ring",
+                                pytree_nbytes(self.replay_state))
         # depth 2: one batch committing + one transfer in flight bounds
         # staged memory at 2K blocks while keeping the pipeline full
         self._ingest_q: queue_mod.Queue = queue_mod.Queue(maxsize=2)
@@ -420,28 +441,59 @@ class Learner:
         return replay_add_many.lower(
             self.spec, self._replay_shapes, blocks).compile()
 
-    def _precompile_add_many(self) -> None:
-        """AOT-compile add_many for every power-of-two bucket up to K (the
-        only batch sizes the stager drains) — runs once in the stager
-        thread at startup, i.e. during the warm-up fill, so a ~1.5 s XLA
-        compile never stalls mid-run ingestion (measured: a lazy mid-run
-        compile backs the feeder up enough to park the actors)."""
-        # pow2 buckets PLUS K itself: a non-pow2 ingest_batch_blocks is
-        # the steady-state drain size under load and would otherwise hit
-        # the lazy mid-run compile exactly when load first reaches K
+    def _aot_bucket_sizes(self) -> list:
+        """The add_many batch sizes the stager drains — every power-of-two
+        bucket up to K PLUS K itself: a non-pow2 ingest_batch_blocks is
+        the steady-state drain size under load and would otherwise hit
+        the lazy mid-run compile exactly when load first reaches K. One
+        recipe shared by the startup precompile and the coverage report
+        (telemetry/compile.py), so the report can never drift from what
+        the precompile actually targets."""
         sizes = []
         kb = 1
         while kb < self._ingest_k:
             sizes.append(kb)
             kb *= 2
         sizes.append(self._ingest_k)
-        for kb in sizes:
+        return sizes
+
+    def aot_coverage(self) -> Optional[dict]:
+        """AOT-precompile coverage of the stager's add_many buckets
+        (ISSUE 7): expected bucket sizes vs actually-compiled executables
+        — a non-empty ``missing`` list means a mid-run lazy compile is
+        still possible, the exact hazard the precompile exists to
+        prevent. None on the legacy per-block path (no stager)."""
+        if self._ingest_k <= 1:
+            return None
+        from r2d2_tpu.telemetry.compile import aot_coverage
+        return aot_coverage(self._aot_bucket_sizes(),
+                            list(self._add_many_cache))
+
+    def _precompile_add_many(self) -> None:
+        """AOT-compile add_many for every stager bucket size — runs once
+        in the stager thread at startup, i.e. during the warm-up fill, so
+        a ~1.5 s XLA compile never stalls mid-run ingestion (measured: a
+        lazy mid-run compile backs the feeder up enough to park the
+        actors)."""
+        for kb in self._aot_bucket_sizes():
             if self._ingest_stop.is_set():
                 break
             if kb not in self._add_many_cache:
                 self._add_many_cache[kb] = self._compile_add_many(kb)
 
     def _start_stager(self, queue) -> None:
+        cfg = self.cfg
+        if cfg.telemetry.enabled and cfg.telemetry.resources_enabled:
+            # staging-window attribution (ISSUE 7): the pipeline holds at
+            # most 2 staged batches of K blocks (queue depth 2) — the
+            # bound, not a live gauge; registered once at stager start
+            from r2d2_tpu.replay.structs import empty_block_np
+            from r2d2_tpu.telemetry.resources import register_buffer
+            block_bytes = sum(a.nbytes
+                              for a in empty_block_np(self.spec).values())
+            register_buffer(f"p{self.player_idx}/ingest_staging",
+                            2 * self._ingest_k * block_bytes)
+
         def stage_loop():
             try:
                 self._precompile_add_many()

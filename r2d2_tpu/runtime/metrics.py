@@ -83,6 +83,14 @@ class TrainMetrics:
         # key on its presence, like the 'stages' block)
         self._learning = None
 
+        # system-health pillar (ISSUE 7): a resources-block provider
+        # (ResourceMonitor.block) and the alert engine, both attached by
+        # the orchestrating loop. None = the blocks are OMITTED and the
+        # record schema is byte-identical to pre-PR7 (the
+        # telemetry.resources_enabled kill switch; stability-tested).
+        self._resources_fn = None
+        self._sentinel = None
+
     # -- feed points --
 
     def on_block(self, learning_steps: int, episode_return: Optional[float]) -> None:
@@ -142,6 +150,21 @@ class TrainMetrics:
         None = nothing this interval (no training steps, or diagnostics
         disabled) and the record carries no 'learning' key."""
         self._learning = block
+
+    def set_resources(self, provider) -> None:
+        """Attach the resources-block provider (ISSUE 7): a callable
+        returning the ResourceMonitor's ``block()`` dict — called once
+        per log() so EVERY periodic record carries a ``resources``
+        entry while the pillar is enabled."""
+        self._resources_fn = provider
+
+    def set_sentinel(self, engine) -> None:
+        """Attach the alert engine (ISSUE 7): log() evaluates the rule
+        set against the assembled record — alerts see the same interval
+        they alert on — and the record carries the resulting ``alerts``
+        block; firings append to alerts_player{p}.jsonl inside the
+        engine."""
+        self._sentinel = engine
 
     def set_actor_health(self, snapshot: dict) -> None:
         """Supervision counters (WorkerHealth.snapshot + stall-dump count)
@@ -242,6 +265,15 @@ class TrainMetrics:
             # above are unaffected either way (schema-stability-tested).
             record["stages"] = self.telemetry.interval_summary()
             record["telemetry_dropped_spans"] = self.telemetry.spans.dropped
+        if self._resources_fn is not None:
+            # machine-side block (ISSUE 7): devices/host/buffer footprints
+            # + the compile sub-block. Before the sentinel, which reads it.
+            record["resources"] = self._resources_fn()
+        if self._sentinel is not None:
+            # the alert pass sees the COMPLETE record of its own interval
+            # (throughput, health, learning, resources); firings also
+            # append to alerts_player{p}.jsonl inside the engine
+            record["alerts"] = self._sentinel.evaluate(record)
         if self._jsonl_path:
             with open(self._jsonl_path, "a") as f:
                 f.write(json.dumps(record) + "\n")

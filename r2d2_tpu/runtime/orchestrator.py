@@ -76,6 +76,9 @@ class PlayerStack:
         self.publisher = None
         self.store = None
         self.queue: Optional[BlockQueue] = None
+        self.resources = None
+        self.compile_monitor = None
+        self.sentinel = None
         # LAST: telemetry board shm + the span-drain's file I/O. Anything
         # raising after an shm allocation would leak the segment (train()
         # only closes stacks that made it into its list), so the file I/O
@@ -105,6 +108,49 @@ class PlayerStack:
                     append=resume)
             except BaseException:
                 self.tele_board.close()
+                self.heartbeats.close()
+                raise
+        # system-health pillar (ISSUE 7): resource sampler + compile/
+        # retrace monitor + the alert engine, all behind the
+        # telemetry.resources_enabled kill switch — off, none of the
+        # three exists and the periodic record stays byte-identical to
+        # the pre-PR7 schema. The Learner registered its buffer
+        # footprints during construction above; the sampler reads the
+        # shared registry and the actor gauges off the telemetry board.
+        # Compile events are process-global, so only the FIRST stack of a
+        # multiplayer process installs the monitor. Wired LAST (the alert
+        # stream truncation is file I/O): a failure here must unwind the
+        # shm segments allocated above.
+        if cfg.telemetry.enabled and cfg.telemetry.resources_enabled:
+            from r2d2_tpu.telemetry import (AlertEngine, CompileMonitor,
+                                            ResourceMonitor, active_monitor,
+                                            default_rules)
+            try:
+                if (cfg.telemetry.compile_enabled
+                        and active_monitor() is None):
+                    self.compile_monitor = CompileMonitor().install()
+                self.resources = ResourceMonitor(
+                    player_idx, cfg.runtime.save_dir or ".",
+                    interval_s=cfg.telemetry.resources_interval_s,
+                    headroom_warn_frac=(
+                        cfg.telemetry.resources_headroom_warn_frac),
+                    board=self.tele_board,
+                    compile_monitor=self.compile_monitor,
+                    aot_coverage_fn=self.learner.aot_coverage)
+                self.metrics.set_resources(self.resources.block)
+                if cfg.telemetry.alerts_enabled:
+                    self.sentinel = AlertEngine(
+                        default_rules(cfg.telemetry),
+                        jsonl_path=os.path.join(
+                            cfg.runtime.save_dir or ".",
+                            f"alerts_player{player_idx}.jsonl"),
+                        resume=bool(cfg.runtime.resume))
+                    self.metrics.set_sentinel(self.sentinel)
+            except BaseException:
+                if self.compile_monitor is not None:
+                    self.compile_monitor.uninstall()
+                if self.tele_board is not None:
+                    self.tele_board.close()
                 self.heartbeats.close()
                 raise
 
@@ -235,6 +281,17 @@ class PlayerStack:
         from r2d2_tpu.runtime.feeder import supervise_workers
         if self._stop.is_set():
             return 0
+        if self.resources is not None:
+            # resource sampling rides the supervision cadence (a cheap
+            # time check; the sample itself is a handful of dict reads
+            # per telemetry.resources_interval_s)
+            self.resources.maybe_sample()
+        if self.compile_monitor is not None and self.learner.training_steps:
+            # warm-up ends when training has started: the train program
+            # and the actor policies have compiled by now, so any further
+            # compile of a known fn with new avals is a retrace (mark_warm
+            # is idempotent — called every pass, latches once)
+            self.compile_monitor.mark_warm()
         restart = self.cfg.runtime.restart_dead_actors
         restarted = 0
         # threads are scanned even with restarts off (respawn=None), like
@@ -307,6 +364,10 @@ class PlayerStack:
         self.telemetry.close()   # stops the drain thread, final flush
         if self.tele_board is not None:
             self.tele_board.close()
+        if self.compile_monitor is not None:
+            # restore the pxla logger exactly (level/propagation) and
+            # release the process-global active-monitor slot
+            self.compile_monitor.uninstall()
 
 
 def train(cfg: Config, *, max_training_steps: Optional[int] = None,

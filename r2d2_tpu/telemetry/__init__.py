@@ -23,9 +23,20 @@ triggers, and tools/profile_step.py all share it).
 into the jitted train step (|TD|/priority/Q histograms on the shared
 bucket layout, grad norms, the stored-state ΔQ check, staleness, NaN
 forensics) aggregated into the periodic record's ``learning`` block.
+
+``resources.py`` / ``compile.py`` / ``alerts.py`` (ISSUE 7) are the
+SYSTEM-HEALTH pillar: per-device memory + buffer attribution + host
+RSS/CPU in the record's ``resources`` block, XLA compile/retrace
+telemetry nested under it, and the declarative alert engine producing
+the ``alerts`` block + ``alerts_player{p}.jsonl`` (tools/sentinel.py is
+the offline/CLI face).
 """
 
+from r2d2_tpu.telemetry.alerts import (AlertEngine, AlertRule,
+                                       default_rules, record_value)
 from r2d2_tpu.telemetry.board import TelemetryBoard
+from r2d2_tpu.telemetry.compile import (CompileMonitor, active_monitor,
+                                        aot_coverage)
 from r2d2_tpu.telemetry.core import (NULL_TELEMETRY, STAGE_INDEX, STAGES,
                                      StageTimers, Telemetry,
                                      summarize_matrix)
@@ -35,13 +46,20 @@ from r2d2_tpu.telemetry.histogram import (NBUCKETS, LogHistogram,
                                           value_summary)
 from r2d2_tpu.telemetry.learning import LearningAggregator, LearningDiag
 from r2d2_tpu.telemetry.profiler import ProfilerCapture, trace
+from r2d2_tpu.telemetry.resources import (BufferRegistry, ResourceMonitor,
+                                          device_memory_stats, host_usage,
+                                          pytree_nbytes, register_buffer)
 from r2d2_tpu.telemetry.spans import SpanTracer, chrome_trace_events
 
 __all__ = [
     "NBUCKETS", "NULL_TELEMETRY", "STAGES", "STAGE_INDEX",
+    "AlertEngine", "AlertRule", "BufferRegistry", "CompileMonitor",
     "LearningAggregator", "LearningDiag", "LogHistogram",
-    "ProfilerCapture", "SpanTracer", "StageTimers",
-    "Telemetry", "TelemetryBoard", "bucket_bounds", "bucket_index",
-    "bucket_mid", "chrome_trace_events", "percentile", "summarize",
+    "ProfilerCapture", "ResourceMonitor", "SpanTracer", "StageTimers",
+    "Telemetry", "TelemetryBoard", "active_monitor", "aot_coverage",
+    "bucket_bounds",
+    "bucket_index", "bucket_mid", "chrome_trace_events",
+    "default_rules", "device_memory_stats", "host_usage", "percentile",
+    "pytree_nbytes", "record_value", "register_buffer", "summarize",
     "summarize_matrix", "trace", "value_summary",
 ]

@@ -1,0 +1,230 @@
+"""Declarative alerting over the periodic metrics records (ISSUE 7).
+
+Everything the stack measures — throughput, heartbeat ages, sample
+staleness, HBM headroom, retraces, NaNs — already lands in the periodic
+``metrics_player{p}.jsonl`` record; until now NOTHING watched it, so a
+throughput collapse or retrace storm was only noticed when a human read
+a JSONL. This module is the watcher: a small rule engine evaluated once
+per record, at the log boundary, inside :meth:`TrainMetrics.log` — so
+every record carries an ``alerts`` block and every firing appends one
+line to ``alerts_player{p}.jsonl`` (the machine-readable side
+tools/sentinel.py and the inspector read).
+
+Rules are DATA (:class:`AlertRule`): a kind, a key path into the record,
+and a bound — no subclassing per alert. Four kinds cover the failure
+modes the ISSUE names:
+
+  * ``threshold`` — value crosses a bound (heartbeat age, HBM headroom
+    with ``below=True``, per-interval retrace count, non-finite steps);
+  * ``drop``      — value falls below ``bound x`` the rolling median of
+    the previous ``window`` records (throughput collapse; warm-up zeros
+    never enter the median, so the rule arms only once the metric has
+    actually been healthy for a full window);
+  * ``growth``    — value exceeds ``bound x`` the rolling median
+    (sample-age/staleness creep);
+  * ``counter``   — a CUMULATIVE counter increased since the last record
+    (watchdog hang detections, restarts). Pure edge semantics: one
+    increment fires exactly once; the baseline starts at zero, so events
+    that precede the first log boundary (a warm-up hang) alert on the
+    first record that carries them.
+
+Level-triggered kinds (threshold/drop/growth) fire on the
+inactive→active EDGE and stay silently active until the condition
+clears — a persistent condition produces one alert line, not one per
+interval; recovery re-arms the rule.
+"""
+
+import json
+import os
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_KINDS = ("threshold", "drop", "growth", "counter")
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """One declarative rule. ``path`` walks nested dicts of the periodic
+    record (``("learning", "sample_age", "p50")``); missing keys / None
+    values leave the rule inactive (never a false fire on a record that
+    simply lacks the block)."""
+
+    name: str
+    kind: str                    # threshold | drop | growth | counter
+    path: Tuple[str, ...]
+    bound: float
+    severity: str = "warn"       # warn | crit
+    below: bool = False          # threshold: fire when value <= bound
+    window: int = 8              # drop/growth rolling-median window
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"alert rule {self.name!r}: unknown kind {self.kind!r} "
+                f"(expected one of {_KINDS})")
+        if self.kind in ("drop", "growth") and self.window < 2:
+            raise ValueError(
+                f"alert rule {self.name!r}: window must be >= 2")
+
+
+def record_value(record: dict, path: Sequence[str]) -> Optional[float]:
+    """Walk a key path into the record; None for missing/None/non-numeric
+    leaves (absent blocks must read as 'no data', not as zero)."""
+    node: Any = record
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    if node is None or isinstance(node, (dict, list, str)):
+        return None
+    try:
+        return float(node)
+    except (TypeError, ValueError):
+        return None
+
+
+def default_rules(tcfg) -> Tuple[AlertRule, ...]:
+    """The stock rule set, parameterized by the TelemetryConfig
+    ``alerts_*`` knobs — what the orchestrator/anakin/multihost loops
+    install. tools/sentinel.py builds the same set for offline runs."""
+    w = tcfg.alerts_window
+    return (
+        # throughput collapse vs the run's own recent history: the 2012.04210
+        # signal — a parked fleet or wedged stager shows here first
+        AlertRule("env_throughput_drop", "drop", ("buffer_speed",),
+                  tcfg.alerts_throughput_drop_frac, "crit", window=w),
+        AlertRule("learner_throughput_drop", "drop", ("training_speed",),
+                  tcfg.alerts_throughput_drop_frac, "crit", window=w),
+        # an actor the watchdog had to declare hung (cumulative counter:
+        # one hang -> exactly one alert)
+        AlertRule("actor_stall", "counter", ("actor_hangs_detected",),
+                  1.0, "crit"),
+        AlertRule("actor_restart", "counter", ("actor_restarts",), 1.0,
+                  "warn"),
+        AlertRule("heartbeat_stale", "threshold", ("heartbeat_age_max_s",),
+                  tcfg.alerts_heartbeat_age_s, "warn"),
+        # replay staleness creep: sample ages growing past a multiple of
+        # their own recent median (weight publication or ingestion lagging)
+        AlertRule("staleness_growth", "growth",
+                  ("learning", "sample_age", "p50"),
+                  tcfg.alerts_staleness_growth_factor, "warn", window=w),
+        # machine-side rules (the resources block, ISSUE 7 tentpole)
+        AlertRule("hbm_headroom", "threshold",
+                  ("resources", "hbm_headroom_frac_min"),
+                  tcfg.alerts_hbm_headroom_frac, "crit", below=True),
+        AlertRule("retrace_storm", "threshold",
+                  ("resources", "compile", "retraces_interval"),
+                  float(tcfg.alerts_retrace_storm), "crit"),
+        AlertRule("nan", "threshold", ("learning", "nonfinite_steps"),
+                  1.0, "crit"),
+    )
+
+
+@dataclass
+class _RuleState:
+    active: bool = False
+    history: deque = field(default_factory=deque)
+    last_counter: Optional[float] = None
+
+
+class AlertEngine:
+    """Evaluates the rule set against each periodic record; returns the
+    record's ``alerts`` block and appends fired alerts to the JSONL
+    stream. One engine per metrics stream (player), attached via
+    :meth:`TrainMetrics.set_sentinel`."""
+
+    def __init__(self, rules: Sequence[AlertRule],
+                 jsonl_path: Optional[str] = None, resume: bool = False):
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate alert rule names in {names}")
+        self.rules = tuple(rules)
+        self._state = {r.name: _RuleState(
+            history=deque(maxlen=r.window)) for r in self.rules}
+        self.fired_total = 0
+        self._jsonl_path = jsonl_path
+        if jsonl_path:
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+            if not resume:
+                # fresh run truncates, resume appends — the TrainMetrics
+                # JSONL contract
+                open(jsonl_path, "w").close()
+
+    @property
+    def active(self) -> List[str]:
+        return sorted(n for n, s in self._state.items() if s.active)
+
+    def evaluate(self, record: dict) -> dict:
+        """One pass over all rules → the record's ``alerts`` block:
+        ``{"active": [names], "fired": [alert dicts]}``. Consumes the
+        record in order (counter baselines, history windows advance)."""
+        fired: List[dict] = []
+        for rule in self.rules:
+            value = record_value(record, rule.path)
+            st = self._state[rule.name]
+            was_active = st.active
+            active, detail = self._eval(rule, st, value)
+            st.active = active
+            if active and not was_active:
+                alert = {"rule": rule.name, "severity": rule.severity,
+                         "value": value, "bound": rule.bound, **detail}
+                fired.append(alert)
+        if fired:
+            self.fired_total += len(fired)
+            self._append(record, fired)
+        return {"active": self.active, "fired": fired}
+
+    def _eval(self, rule: AlertRule, st: _RuleState,
+              value: Optional[float]) -> Tuple[bool, dict]:
+        if rule.kind == "counter":
+            # cumulative counter: edge per increase of >= bound. The
+            # baseline starts at ZERO, not at the first observation —
+            # health counters are process-local and start at 0 in fresh
+            # and resumed runs alike, and a hang detected during warm-up
+            # (before the first log boundary) must still alert when the
+            # first record arrives already carrying the count.
+            if value is None:
+                return False, {}
+            prev, st.last_counter = st.last_counter, value
+            prev = 0.0 if prev is None else prev
+            if value - prev >= rule.bound:
+                return True, {"delta": value - prev}
+            return False, {}
+        if value is None:
+            # no data: level rules hold their state (a training pause must
+            # not read as recovery + refire); history simply doesn't grow
+            return st.active, {}
+        if rule.kind == "threshold":
+            hit = value <= rule.bound if rule.below else value >= rule.bound
+            return hit, {}
+        # drop / growth: compare against the rolling median of PREVIOUS
+        # healthy observations, then admit the value to the window
+        baseline = None
+        if len(st.history) == st.history.maxlen:
+            baseline = float(np.median(st.history))
+        active = st.active
+        detail: dict = {}
+        if baseline is not None and baseline > 0:
+            if rule.kind == "drop":
+                active = value < rule.bound * baseline
+            else:
+                active = value > rule.bound * baseline
+            detail = {"baseline": round(baseline, 3)}
+        # zeros never enter the median: a warm-up/paused interval would
+        # otherwise poison the 'healthy' baseline both kinds compare to
+        if value > 0 and not active:
+            st.history.append(value)
+        return active, detail if active else {}
+
+    def _append(self, record: dict, fired: List[dict]) -> None:
+        if not self._jsonl_path:
+            return
+        with open(self._jsonl_path, "a") as f:
+            for alert in fired:
+                row = {"t": record.get("t"),
+                       "training_steps": record.get("training_steps"),
+                       "env_steps": record.get("env_steps"), **alert}
+                f.write(json.dumps(row) + "\n")

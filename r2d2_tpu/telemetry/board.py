@@ -29,6 +29,12 @@ import numpy as np
 
 from r2d2_tpu.telemetry.histogram import NBUCKETS
 
+# Per-slot resource gauge columns appended after the histogram table
+# (ISSUE 7): [rss_bytes, cpu_ms_cumulative]. Same publish cadence and
+# torn-read tolerance as the histograms; the ResourceMonitor reads them
+# per sample and differences cpu_ms into a utilization percentage.
+N_GAUGES = 2
+
 
 class TelemetryBoard:
     def __init__(self, n_slots: int, n_stages: Optional[int] = None,
@@ -41,13 +47,16 @@ class TelemetryBoard:
         self._owner = _attach_name is None
         self._shm = None
         self._arr = None
+        self._gauges = None
         self._final = None     # post-close snapshot for post-mortem reads
         self._prev = None      # owner-side last-read snapshot (take_deltas)
         if self._owner:
             self._shm = shared_memory.SharedMemory(
-                create=True, size=n_slots * n_stages * NBUCKETS * 8)
+                create=True,
+                size=n_slots * (n_stages * NBUCKETS + N_GAUGES) * 8)
             self._bind()
             self._arr[:] = 0
+            self._gauges[:] = 0
         else:
             self._name = _attach_name
 
@@ -66,6 +75,9 @@ class TelemetryBoard:
     def _bind(self) -> None:
         self._arr = np.ndarray((self.n_slots, self.n_stages * NBUCKETS),
                                np.int64, self._shm.buf)
+        self._gauges = np.ndarray(
+            (self.n_slots, N_GAUGES), np.int64, self._shm.buf,
+            offset=self.n_slots * self.n_stages * NBUCKETS * 8)
 
     def _ensure(self) -> np.ndarray:
         if self._shm is None:
@@ -87,11 +99,30 @@ class TelemetryBoard:
         return (self._ensure().copy()
                 .reshape(self.n_slots, self.n_stages, NBUCKETS))
 
+    def publish_gauges(self, slot: int, rss_bytes: int, cpu_ms: int) -> None:
+        """Worker-side resource gauges for this slot (ISSUE 7): current
+        RSS and cumulative CPU milliseconds — published on the telemetry
+        flush cadence alongside the histogram row."""
+        self._ensure()
+        self._gauges[slot, 0] = int(rss_bytes)
+        self._gauges[slot, 1] = int(cpu_ms)
+
+    def read_gauges(self) -> Optional[np.ndarray]:
+        """Snapshot of the gauge table, (n_slots, N_GAUGES) int64; None
+        once the board is closed (gauges are live-only — the histogram
+        _final snapshot exists for post-mortem percentile reads, which
+        gauges don't serve)."""
+        if self._shm is None and self._final is not None:
+            return None
+        self._ensure()
+        return self._gauges.copy()
+
     def reset_slot(self, slot: int) -> None:
         """Fresh incarnation (actor respawn): zero the row so the new
         worker's cumulative counts start clean. The reader's reset
         detection handles the discontinuity."""
         self._ensure()[slot] = 0
+        self._gauges[slot] = 0
 
     def take_deltas(self) -> np.ndarray:
         """Owner-side interval read: per-stage counts observed fleet-wide
@@ -113,6 +144,7 @@ class TelemetryBoard:
             return
         self._final = self._arr.copy()
         self._arr = None
+        self._gauges = None
         self._shm.close()
         if self._owner:
             try:

@@ -92,7 +92,8 @@ class Telemetry:
 
     def __init__(self, enabled: bool = True, ring_size: int = 4096,
                  flush_interval_s: float = 5.0, spans: bool = True,
-                 name: str = "main", board=None, slot: Optional[int] = None):
+                 name: str = "main", board=None, slot: Optional[int] = None,
+                 resource_gauges: bool = False):
         self.enabled = enabled
         self.name = name
         self.flush_interval_s = flush_interval_s
@@ -100,6 +101,9 @@ class Telemetry:
         self.spans = SpanTracer(ring_size, enabled=enabled and spans)
         self._board = board          # worker side: publish target
         self._slot = slot
+        # worker side (ISSUE 7): publish this process's RSS / cumulative
+        # CPU into the board's gauge columns on the same flush cadence
+        self._resource_gauges = resource_gauges
         self._agg_board = None       # owner side: aggregation source
         self._spans_path: Optional[str] = None
         self._drain_stop: Optional[threading.Event] = None
@@ -113,7 +117,8 @@ class Telemetry:
         t = cfg.telemetry
         return cls(enabled=t.enabled, ring_size=t.ring_size,
                    flush_interval_s=t.flush_interval_s, spans=t.spans,
-                   name=name, board=board, slot=slot)
+                   name=name, board=board, slot=slot,
+                   resource_gauges=getattr(t, "resources_enabled", False))
 
     # -- hot-path entry points --
 
@@ -142,6 +147,13 @@ class Telemetry:
             return
         if self._board is not None and self._slot is not None:
             self._board.publish(self._slot, self.timers.cumulative())
+            if self._resource_gauges and hasattr(self._board,
+                                                 "publish_gauges"):
+                from r2d2_tpu.telemetry.resources import host_usage
+                u = host_usage()
+                self._board.publish_gauges(
+                    self._slot, u["rss_bytes"] or 0,
+                    int(u["cpu_s"] * 1e3))
         if self._spans_path:
             events = self.spans.drain()
             if events:

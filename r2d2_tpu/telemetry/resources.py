@@ -1,0 +1,347 @@
+"""Resource observability (ISSUE 7): the MACHINE-side telemetry pillar.
+
+PR 4/5 made the pipeline and the learning dynamics visible; the hardware
+stayed a black box — HBM was read ad hoc in exactly two places (the
+device-replay capacity guard and the soak's ``_mem_stats``), host memory
+nowhere, and "how much of the ring's 5.7 GiB is actually the ring"
+answerable only by grepping PERF.md. This module centralizes all of it:
+
+  * :func:`device_memory_stats` — the ONE ``memory_stats()`` wrapper
+    (backend-optional: TPU reports byte counters, CPU returns nothing —
+    callers get ``{}`` instead of an exception either way). The
+    device-replay HBM guard and tools/soak.py both call through here.
+  * :class:`BufferRegistry` — subsystems REGISTER their device-buffer
+    footprints (replay ring, params+opt state, the stager's staging
+    window, the anakin lane carry) so a memory report attributes
+    bytes-in-use to owners instead of printing one opaque total. The
+    architectural-implications study (arXiv 2012.04210) makes exactly
+    this point: distributed-RL throughput tuning starts from knowing
+    which component owns the resource.
+  * :class:`ResourceMonitor` — periodic sampler behind
+    ``telemetry.resources_enabled``: per-device memory stats with
+    host-side peak/headroom tracking, learner-process RSS/CPU, per-actor-
+    slot RSS/CPU read from the :class:`TelemetryBoard` gauge columns
+    (actor processes publish them on the telemetry flush cadence), and
+    the buffer-attribution table. Produces the periodic record's
+    ``resources`` block, and owns the one-shot OOM/headroom forensics
+    dump (``resource_dump_player{p}.json``) mirroring the PR-5
+    ``nan_dump`` pattern: the first sample that sees device headroom
+    below ``telemetry.resources_headroom_warn_frac`` writes the full
+    attribution picture to disk — the post-mortem an OOM kill would
+    otherwise destroy.
+
+Sampling cost is a handful of dict reads and one ``/proc`` line per
+``telemetry.resources_interval_s`` — benched within noise on the
+interleaved A/B (tools/e2e_bench.py --resources-ab, PERF.md).
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+# The byte counters worth carrying in summaries (full memory_stats also
+# includes allocator internals nobody alerts on).
+SUMMARY_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size")
+
+
+def device_memory_stats(device=None, keys=None) -> Dict[str, int]:
+    """``device.memory_stats()`` with the backend-optional contract made
+    explicit: a dict of int-valued counters, ``{}`` when the backend
+    reports nothing (CPU), the device is unavailable, or the call raises.
+    ``keys`` filters to a subset (e.g. :data:`SUMMARY_KEYS`)."""
+    try:
+        if device is None:
+            import jax
+            device = jax.devices()[0]
+        stats = device.memory_stats() or {}
+    except Exception:       # memory_stats is backend-optional by contract
+        return {}
+    out = {}
+    for k, v in stats.items():
+        if keys is not None and k not in keys:
+            continue
+        try:
+            out[k] = int(v)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def pytree_nbytes(tree) -> int:
+    """Total byte footprint of every array leaf in a pytree — the number a
+    subsystem registers for its buffers."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def host_usage() -> Dict[str, Any]:
+    """This process's host footprint: RSS bytes (``/proc/self/statm``;
+    peak-RSS fallback from getrusage where /proc is absent), cumulative
+    CPU seconds (user+system, children excluded), and live threads."""
+    rss = None
+    try:
+        with open("/proc/self/statm") as f:
+            rss = int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        try:
+            import resource
+            import sys
+            # ru_maxrss is a PEAK, not current — still better than
+            # nothing on /proc-less platforms; KiB on Linux/BSD but
+            # BYTES on macOS, the main platform that takes this branch
+            scale = 1 if sys.platform == "darwin" else 1024
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * scale
+        except Exception:
+            rss = None
+    t = os.times()
+    return {"rss_bytes": rss, "cpu_s": t.user + t.system,
+            "threads": threading.active_count()}
+
+
+class BufferRegistry:
+    """Named device-buffer footprints, registered by their owners.
+    Re-registering a name overwrites (a Learner rebuilt in the same
+    process replaces its own entries); names are conventionally
+    ``p{player}/component`` so multiplayer stacks coexist."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, int] = {}
+
+    def register(self, name: str, nbytes: int) -> None:
+        with self._lock:
+            self._entries[name] = int(nbytes)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._entries.pop(name, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def clear_prefix(self, prefix: str) -> None:
+        with self._lock:
+            for k in [k for k in self._entries if k.startswith(prefix)]:
+                del self._entries[k]
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._entries)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._entries.values())
+
+
+# Process-wide default registry: owners (Learner, stager, anakin loop)
+# register at construction without threading a handle through every
+# signature; the ResourceMonitor reads it unless given its own.
+BUFFERS = BufferRegistry()
+
+
+def register_buffer(name: str, nbytes: int) -> None:
+    BUFFERS.register(name, nbytes)
+
+
+def clear_player_buffers(player_idx: int) -> None:
+    """Drop every ``p{player}/`` registration before a rebuilt stack
+    re-registers its own. Same-name overwrite covers components that
+    exist in both incarnations; this covers the ones that DON'T — e.g.
+    an e2e A/B whose host arm registered an ingest staging window and
+    whose anakin arm has no stager would otherwise carry the stale entry
+    in every resources block of the second arm."""
+    BUFFERS.clear_prefix(f"p{player_idx}/")
+
+
+class ResourceMonitor:
+    """Periodic resource sampler + the record's ``resources`` block.
+
+    ``maybe_sample`` is called on the supervision cadence (cheap time
+    check); ``block()`` once per log interval builds the record entry
+    from the newest sample. ``stats_fn`` injects a device-stats source
+    for tests (the CPU backend reports nothing real)."""
+
+    def __init__(self, player_idx: int = 0, save_dir: str = ".",
+                 interval_s: float = 10.0,
+                 headroom_warn_frac: float = 0.05,
+                 registry: Optional[BufferRegistry] = None,
+                 board=None,
+                 compile_monitor=None,
+                 aot_coverage_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 stats_fn: Optional[Callable[[Any], Dict[str, int]]] = None):
+        self.player_idx = player_idx
+        self.save_dir = save_dir or "."
+        self.interval_s = interval_s
+        self.headroom_warn_frac = headroom_warn_frac
+        self.registry = registry if registry is not None else BUFFERS
+        self._board = board
+        self.compile_monitor = compile_monitor
+        self._aot_fn = aot_coverage_fn
+        self._stats_fn = stats_fn or device_memory_stats
+        self.dumped = False                  # one-shot forensics latch
+        self._last_sample_t: Optional[float] = None
+        self._devices: List[dict] = []
+        self._peak_seen: Dict[int, int] = {}   # host-side running peak
+        self._host: Dict[str, Any] = {}
+        self._prev_host_cpu: Optional[tuple] = None   # (t, cpu_s)
+        self._host_cpu_pct: Optional[float] = None
+        self._actor_prev: Optional[np.ndarray] = None  # (slots, 2) gauges
+        self._actor_prev_t: Optional[float] = None
+        self._actors: Optional[dict] = None
+
+    # -- sampling --
+
+    def maybe_sample(self, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        if (self._last_sample_t is not None
+                and now - self._last_sample_t < self.interval_s):
+            return False
+        self.sample(now)
+        return True
+
+    def sample(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        self._last_sample_t = now
+        try:
+            import jax
+            devices = jax.local_devices()
+        except Exception:
+            devices = []
+        devs = []
+        for d in devices:
+            stats = self._stats_fn(d)
+            entry: Dict[str, Any] = {"id": int(getattr(d, "id", 0)),
+                                     "platform": getattr(d, "platform", "?")}
+            for k in SUMMARY_KEYS:
+                if k in stats:
+                    entry[k] = stats[k]
+            in_use, limit = entry.get("bytes_in_use"), entry.get("bytes_limit")
+            if in_use is not None:
+                # host-side running peak: survives backends whose
+                # peak_bytes_in_use resets across allocator epochs
+                prev = self._peak_seen.get(entry["id"], 0)
+                self._peak_seen[entry["id"]] = max(prev, in_use)
+                entry["peak_seen"] = self._peak_seen[entry["id"]]
+            if in_use is not None and limit:
+                entry["headroom_frac"] = round(1.0 - in_use / limit, 4)
+            devs.append(entry)
+        self._devices = devs
+        host = host_usage()
+        if self._prev_host_cpu is not None:
+            pt, pc = self._prev_host_cpu
+            dt = now - pt
+            if dt > 0:
+                self._host_cpu_pct = round(
+                    100.0 * (host["cpu_s"] - pc) / dt, 1)
+        self._prev_host_cpu = (now, host["cpu_s"])
+        self._host = host
+        self._sample_actors(now)
+        self._check_headroom()
+
+    def _sample_actors(self, now: float) -> None:
+        board = self._board
+        if board is None or not hasattr(board, "read_gauges"):
+            return
+        g = board.read_gauges()
+        if g is None:
+            return
+        rss = [int(x) for x in g[:, 0]]
+        cpu_ms = g[:, 1].astype(np.float64)
+        cpu_pct: List[Optional[float]] = [None] * len(rss)
+        if self._actor_prev is not None and self._actor_prev_t is not None:
+            dt = now - self._actor_prev_t
+            if dt > 0:
+                delta = (cpu_ms - self._actor_prev[:, 1]) / 1e3
+                # a respawned slot restarts its cumulative counter; a
+                # negative delta reads as the fresh value (same rule as
+                # the board's histogram reset detection)
+                delta = np.where(delta < 0, cpu_ms / 1e3, delta)
+                cpu_pct = [round(100.0 * float(d) / dt, 1) for d in delta]
+        self._actor_prev = g.astype(np.float64)
+        self._actor_prev_t = now
+        self._actors = {"rss_bytes": rss, "cpu_pct": cpu_pct}
+
+    def _check_headroom(self) -> None:
+        """The OOM-forensics trigger: first sample under the headroom
+        floor writes ONE dump with the full attribution picture (the
+        nan_dump pattern — the data an actual OOM kill would destroy)."""
+        if self.dumped or self.headroom_warn_frac <= 0:
+            return
+        low = [d for d in self._devices
+               if d.get("headroom_frac") is not None
+               and d["headroom_frac"] < self.headroom_warn_frac]
+        if low:
+            self.dump(reason=f"device headroom below "
+                             f"{self.headroom_warn_frac:.0%}: "
+                             + ", ".join(f"dev{d['id']}="
+                                         f"{d['headroom_frac']:.1%}"
+                                         for d in low))
+
+    @property
+    def dump_path(self) -> str:
+        return os.path.join(self.save_dir,
+                            f"resource_dump_player{self.player_idx}.json")
+
+    def dump(self, reason: str = "requested") -> Optional[str]:
+        """One-shot forensics dump (idempotent, like the NaN dump)."""
+        if self.dumped:
+            return None
+        self.dumped = True
+        record = {"time": time.time(), "reason": reason,
+                  **self.block(consume_compile=False)}
+        try:
+            os.makedirs(self.save_dir, exist_ok=True)
+            with open(self.dump_path, "w") as f:
+                json.dump(record, f, indent=2)
+        except OSError:
+            logging.getLogger(__name__).exception(
+                "failed writing resource forensics dump")
+            return None
+        logging.getLogger(__name__).warning(
+            "player %d: resource forensics dumped to %s (%s)",
+            self.player_idx, self.dump_path, reason)
+        return self.dump_path
+
+    # -- the record block --
+
+    def block(self, consume_compile: bool = True) -> dict:
+        """The periodic record's ``resources`` entry, from the newest
+        sample (sampling first if none was ever taken). The compile
+        sub-block consumes the CompileMonitor's interval counters, so
+        call once per log boundary."""
+        if self._last_sample_t is None:
+            self.sample()
+        headrooms = [d["headroom_frac"] for d in self._devices
+                     if d.get("headroom_frac") is not None]
+        out: Dict[str, Any] = {
+            "devices": self._devices,
+            "hbm_headroom_frac_min": min(headrooms) if headrooms else None,
+            "host": {"rss_bytes": self._host.get("rss_bytes"),
+                     "cpu_pct": self._host_cpu_pct,
+                     "threads": self._host.get("threads")},
+            "buffers": self.registry.snapshot(),
+            "buffers_total": self.registry.total(),
+        }
+        if self._actors is not None:
+            out["actor_slots"] = self._actors
+        if self.compile_monitor is not None:
+            comp = (self.compile_monitor.interval_summary()
+                    if consume_compile
+                    else self.compile_monitor.totals())
+            aot = self._aot_fn() if self._aot_fn is not None else None
+            if aot is not None:
+                comp["aot"] = aot
+            out["compile"] = comp
+        return out

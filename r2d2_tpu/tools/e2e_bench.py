@@ -28,10 +28,13 @@ Phases:
      ``replay_add_many`` dispatch per K blocks, background stager) vs the
      legacy per-block path — with blocks/s ingested, drain latency, and
      rate-limiter pause time from the ingestion counters, in one artifact.
-  4. **Telemetry / learning A/Bs** (``--telemetry-ab`` / ``--learning-ab``):
-     the same e2e system with the respective kill switch on vs off — the
-     < 2% overhead budgets for the PR-4 stage telemetry and the PR-5
-     fused learning diagnostics (histograms, staleness, ΔQ cadence).
+  4. **Telemetry / learning / resources A/Bs** (``--telemetry-ab`` /
+     ``--learning-ab`` / ``--resources-ab``): the same e2e system with the
+     respective kill switch on vs off — the < 2% overhead budgets for the
+     PR-4 stage telemetry, the PR-5 fused learning diagnostics
+     (histograms, staleness, ΔQ cadence), and the PR-7 machine-side
+     pillar (memory sampling, RSS/CPU gauges, compile/retrace capture,
+     the per-record alert pass).
 
 Output: ONE JSON line (the driver artifact), also written to ``--out``.
 Hermetic on any backend — the fake env and (for the e2e phase) a
@@ -202,6 +205,14 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         else:
             learning.update(
                 {k: v for k, v in clean.items() if v is not None})
+    # system-health evidence (ISSUE 7): the newest resources block plus
+    # the run's alert tally — proof the pillar actually flowed (or, with
+    # the kill switch off, that the records carried neither key)
+    resources = next((r["resources"] for r in reversed(records)
+                      if r.get("resources")), None)
+    alerts_fired = sum(len((r.get("alerts") or {}).get("fired") or [])
+                       for r in records)
+    alerts_present = any("alerts" in r for r in records)
     return {
         "seconds": round(elapsed, 1),
         "num_actors": num_actors,
@@ -227,6 +238,9 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "records": len(records),
         "stages": stages,
         "learning": learning,
+        "resources": resources,
+        "alerts_present": alerts_present,
+        "alerts_fired": alerts_fired,
         "config": {k: ov[k] for k in sorted(ov)},
     }
 
@@ -345,6 +359,69 @@ def run_learning_ab(seconds: float, envs_per_actor: int, num_actors: int,
     out["sample_age_on"] = lb.get("sample_age")
     out["learning_block_off"] = any(
         c.get("learning") for c in cells["learning_off"])
+    return out
+
+
+def run_resources_ab(seconds: float, envs_per_actor: int, num_actors: int,
+                     overrides: Optional[dict] = None,
+                     repeats: int = 2) -> dict:
+    """Resource/compile/alerts overhead A/B (ISSUE 7 acceptance): the
+    SAME e2e system with ``telemetry.resources_enabled`` on vs off, in
+    one artifact. Budget under test: the machine-side pillar — periodic
+    ``memory_stats`` sampling + buffer attribution, per-actor-slot
+    RSS/CPU gauges through the shm board, the compile/retrace log
+    listener, and the per-record alert-rule pass — costs < 2% on BOTH
+    env-steps/s and learner updates/s (the PR4 budget). Cells run
+    INTERLEAVED off/on ``repeats`` times with per-arm medians, exactly
+    like the learning A/B (single cells swing ±10% on the 2-core host).
+    The ON cells carry the ``resources`` block + the alert tally as
+    evidence the pillar actually flowed; the OFF cells prove the records
+    carried neither key (the kill-switch schema contract)."""
+    cells = {"resources_off": [], "resources_on": []}
+    for _ in range(max(repeats, 1)):
+        for label, on in (("resources_off", False), ("resources_on", True)):
+            ov = dict(overrides or {})
+            ov["telemetry.resources_enabled"] = on
+            # sample every interval at this short log cadence — the
+            # PRODUCTION default (10 s) samples less often, so benching
+            # the tighter cadence bounds the real overhead from above
+            ov.setdefault("telemetry.resources_interval_s", 2.0)
+            cells[label].append(run_e2e(seconds, envs_per_actor,
+                                        num_actors, overrides=ov))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"resources_off": cells["resources_off"][-1],
+           "resources_on": cells["resources_on"][-1],
+           "repeats": max(repeats, 1),
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("resources_off", "env_steps_per_sec") > 0:
+        ratio = (med("resources_on", "env_steps_per_sec")
+                 / med("resources_off", "env_steps_per_sec"))
+        out["env_steps_ratio"] = round(ratio, 3)
+        out["overhead_pct"] = round((1.0 - ratio) * 100.0, 2)
+    if med("resources_off", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio"] = round(
+            med("resources_on", "learner_steps_per_sec")
+            / med("resources_off", "learner_steps_per_sec"), 3)
+    on_cells = cells["resources_on"]
+    out["resources_block_on"] = any(c.get("resources") for c in on_cells)
+    out["alerts_block_on"] = any(c.get("alerts_present") for c in on_cells)
+    out["alerts_fired_on"] = sum(c.get("alerts_fired") or 0
+                                 for c in on_cells)
+    rb = next((c["resources"] for c in reversed(on_cells)
+               if c.get("resources")), None)
+    out["compile_block_on"] = bool(rb and rb.get("compile"))
+    out["resources_block_off"] = any(
+        c.get("resources") for c in cells["resources_off"])
+    out["alerts_block_off"] = any(
+        c.get("alerts_present") for c in cells["resources_off"])
     return out
 
 
@@ -493,6 +570,12 @@ def main(argv=None) -> int:
                         "budget < 2%% on env-steps/s AND learner "
                         "updates/s; the ON cell carries the 'learning' "
                         "block as end-to-end evidence)")
+    p.add_argument("--resources-ab", type=int, default=0,
+                   help="1: run the e2e phase as a resource/compile/alerts "
+                        "on/off A/B instead (telemetry.resources_enabled; "
+                        "budget < 2%% on env-steps/s AND learner "
+                        "updates/s; the ON cells carry the 'resources' "
+                        "block + alert tally as end-to-end evidence)")
     p.add_argument("--ab-repeats", type=int, default=2,
                    help="interleaved off/on pairs for the learning A/B "
                         "(medians per arm; small-host noise control)")
@@ -524,6 +607,10 @@ def main(argv=None) -> int:
                 args.e2e_seconds, args.envs_per_actor,
                 anakin_lanes=args.anakin_lanes, overrides=overrides,
                 repeats=args.ab_repeats)
+        elif args.resources_ab:
+            out["e2e_resources_ab"] = run_resources_ab(
+                args.e2e_seconds, args.envs_per_actor, args.num_actors,
+                overrides=overrides, repeats=args.ab_repeats)
         elif args.learning_ab:
             out["e2e_learning_ab"] = run_learning_ab(
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,

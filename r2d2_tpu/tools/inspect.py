@@ -7,7 +7,13 @@ Reads what a training run leaves in ``runtime.save_dir``:
     (throughput counters, health counters, and the telemetry 'stages'
     block with fleet-wide P50/P95/P99 per pipeline stage);
   * ``telemetry_host{r}.jsonl``  — per-host stage rows under multihost;
-  * ``spans_*.jsonl``            — drained span events per process.
+  * ``spans_*.jsonl``            — drained span events per process;
+  * ``alerts_player{p}.jsonl``   — the sentinel's fired alerts (the
+    record's ``alerts`` panel is the live view, this file the history).
+
+On-device (anakin) runs render too: one metrics file, no heartbeat
+board, the fused ``actor/act_scan`` stage — the fleet-health panel is
+replaced by a mode tag instead of showing empty.
 
 Dashboard mode tails the records and redraws one screen per interval —
 run it in a second terminal against a live soak. Export mode
@@ -32,6 +38,7 @@ from r2d2_tpu.tools.logparse import parse_jsonl
 
 # stages in display order; anything else in the record appends after
 _STAGE_ORDER = [
+    "actor/act_scan",
     "actor/forward", "actor/env_step", "actor/block_emit",
     "actor/queue_put", "actor/weight_sync",
     "ingest/ring_get", "ingest/stage", "ingest/commit",
@@ -62,19 +69,34 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None
         f"updates/s={record.get('training_speed') or 0.0:7.2f}  "
         f"loss={_fmt(record.get('loss'), 8)}  "
         f"return={_fmt(record.get('avg_episode_return'), 8)}")
-    health = [f"{k.split('actor_')[-1]}={record[k]}" for k in (
-        "actor_restarts", "actor_hangs_detected", "actor_breaker_trips",
-        "actor_parked_slots") if record.get(k)]
+    stages = record.get("stages") or {}
+    # on-device (anakin) runs have no actor fleet: one metrics file, no
+    # heartbeat board, the fused 'actor/act_scan' stage instead of the
+    # per-worker actor stages — label the mode instead of rendering
+    # fleet-health panels that can only ever show empty
+    on_device = "actor/act_scan" in stages
+    health = [] if on_device else [
+        f"{k.split('actor_')[-1]}={record[k]}" for k in (
+            "actor_restarts", "actor_hangs_detected", "actor_breaker_trips",
+            "actor_parked_slots") if record.get(k)]
     ingest = (f"ingest: blocks={record.get('ingest_blocks_total', 0)} "
               f"blocks/drain={_fmt(record.get('ingest_blocks_per_drain'), 6)}"
               f" queue={record.get('ingest_queue_depth', 0)} "
               f"pause={record.get('ingest_pause_time', 0.0)}s")
+    if on_device:
+        ingest = "mode: on-device (anakin, fused act+train)   " + ingest
     lines.append(ingest + ("   health: " + " ".join(health) if health else ""))
     lb = record.get("learning")
     if lb:
         lines.append("")
         lines.append(render_learning(lb))
-    stages = record.get("stages") or {}
+    rb = record.get("resources")
+    if rb:
+        lines.append("")
+        lines.append(render_resources(rb))
+    ab = record.get("alerts")
+    if ab is not None:
+        lines.append(render_alerts(ab))
     if stages:
         lines.append("")
         lines.append(f"{'stage':<28}{'count':>8}{'p50 ms':>10}"
@@ -142,6 +164,81 @@ def render_learning(lb: dict) -> str:
     if lb.get("nonfinite_steps"):
         lines.append(f"  !! NON-FINITE steps this interval: "
                      f"{lb['nonfinite_steps']} (see nan_dump_player*.json)")
+    return "\n".join(lines)
+
+
+def render_resources(rb: dict) -> str:
+    """The machine-side panel (ISSUE 7): per-device HBM + headroom, host
+    RSS/CPU, the buffer-attribution table, and the compile/retrace
+    sub-block — one compact block per record."""
+    lines = []
+    devs = rb.get("devices") or []
+    dev_bits = []
+    for d in devs[:4]:
+        if d.get("bytes_in_use") is None:
+            continue
+        bit = f"dev{d.get('id')}={d['bytes_in_use'] / 2**20:.0f}MiB"
+        if d.get("headroom_frac") is not None:
+            bit += f" ({100 * d['headroom_frac']:.0f}% free)"
+        dev_bits.append(bit)
+    host = rb.get("host") or {}
+    host_bits = []
+    if host.get("rss_bytes") is not None:
+        host_bits.append(f"rss={host['rss_bytes'] / 2**20:.0f}MiB")
+    if host.get("cpu_pct") is not None:
+        host_bits.append(f"cpu={host['cpu_pct']:.0f}%")
+    if host.get("threads") is not None:
+        host_bits.append(f"threads={host['threads']}")
+    lines.append("resources: "
+                 + (" ".join(dev_bits) if dev_bits
+                    else "(no device byte counters — CPU backend)")
+                 + ("   host: " + " ".join(host_bits) if host_bits else ""))
+    slots = rb.get("actor_slots") or {}
+    if slots.get("rss_bytes"):
+        rss = [f"{b / 2**20:.0f}" for b in slots["rss_bytes"]]
+        cpu = ["-" if c is None else f"{c:.0f}"
+               for c in slots.get("cpu_pct") or []]
+        lines.append(f"  actor slots rss MiB: [{' '.join(rss)}]"
+                     + (f"  cpu %: [{' '.join(cpu)}]" if cpu else ""))
+    bufs = rb.get("buffers") or {}
+    if bufs:
+        top = sorted(bufs.items(), key=lambda kv: -kv[1])[:6]
+        lines.append("  buffers: " + " ".join(
+            f"{name}={b / 2**20:.0f}MiB" for name, b in top)
+            + f"  total={rb.get('buffers_total', 0) / 2**20:.0f}MiB")
+    comp = rb.get("compile")
+    if comp:
+        line = (f"  compile: total={comp.get('compiles_total', 0)} "
+                f"({comp.get('compile_time_s_total', 0.0):.1f}s) "
+                f"interval={comp.get('compiles', 0)} "
+                f"retraces={comp.get('retraces_total', 0)}"
+                + (" [warm]" if comp.get("warm") else " [warming up]"))
+        aot = comp.get("aot") or {}
+        if aot.get("missing"):
+            line += f"  !! AOT buckets missing: {aot['missing']}"
+        lines.append(line)
+        last = comp.get("last_retrace")
+        if comp.get("retraces_interval") and last:
+            lines.append(f"  !! RETRACE {last.get('fn')} "
+                         f"{(last.get('avals') or '')[:80]}")
+    return "\n".join(lines)
+
+
+def render_alerts(ab: dict) -> str:
+    """The sentinel panel (ISSUE 7): rules active now + firings this
+    interval; silent when everything is healthy."""
+    active = ab.get("active") or []
+    fired = ab.get("fired") or []
+    if not active and not fired:
+        return "alerts: none active"
+    lines = [f"alerts ACTIVE: {' '.join(active)}"]
+    for a in fired:
+        bit = f"  -> FIRED {a.get('severity', '?').upper()} {a.get('rule')}"
+        if a.get("value") is not None:
+            bit += f" value={a['value']:.4g} bound={a.get('bound')}"
+        if a.get("baseline") is not None:
+            bit += f" baseline={a['baseline']:.4g}"
+        lines.append(bit)
     return "\n".join(lines)
 
 
@@ -214,6 +311,16 @@ def main(argv=None) -> int:
                 sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
             print(f"== {path} (record {len(records)}) ==")
             print(frame, flush=True)
+            # the alert stream's newest firings (machine-readable side of
+            # the record's 'alerts' panel; absent pre-PR7 or with the
+            # pillar off)
+            apath = os.path.join(args.dir,
+                                 f"alerts_player{args.player}.jsonl")
+            if os.path.exists(apath):
+                for row in parse_jsonl(apath, limit=3):
+                    print(f"  alert@t={row.get('t', 0):.0f}s "
+                          f"{row.get('severity', '?')}: {row.get('rule')} "
+                          f"value={row.get('value')}", flush=True)
         if not args.follow:
             return 0
         time.sleep(args.interval)

@@ -83,6 +83,57 @@ def learning_series(records: List[dict]) -> dict:
     return out
 
 
+def alerts_series(path: str, limit: Optional[int] = None) -> dict:
+    """Time series of an ``alerts_player{p}.jsonl`` stream (ISSUE 7) —
+    one entry per FIRED alert, oldest first, with ``parse_jsonl``'s
+    partial-line tolerance (the sentinel tails live files). Keys: t,
+    training_steps, env_steps, rule, severity, value, bound."""
+    out = {k: [] for k in ("t", "training_steps", "env_steps", "rule",
+                           "severity", "value", "bound")}
+    for row in parse_jsonl(path, limit=limit):
+        for k in out:
+            out[k].append(row.get(k))
+    return out
+
+
+def resources_series(records: List[dict]) -> dict:
+    """Time series of the ``resources`` block (ISSUE 7) across a metrics
+    JSONL stream, aligned on the records that CARRY one (pre-PR7 records
+    and kill-switched runs are skipped, not holes) — the same contract as
+    :func:`learning_series`. Keys: t, training_steps, hbm_headroom (the
+    min across devices), bytes_in_use (summed across devices), host_rss,
+    host_cpu_pct, buffers_total, compiles, compile_time_s, retraces
+    (cumulative), and alerts_active (count, from the sibling ``alerts``
+    block when present). Values are None where a record's block lacked
+    that entry (e.g. device counters on a CPU backend)."""
+    out = {k: [] for k in (
+        "t", "training_steps", "hbm_headroom", "bytes_in_use", "host_rss",
+        "host_cpu_pct", "buffers_total", "compiles", "compile_time_s",
+        "retraces", "alerts_active")}
+    for r in records:
+        rb = r.get("resources")
+        if not rb:
+            continue
+        in_use = [d.get("bytes_in_use") for d in rb.get("devices") or []]
+        in_use = [b for b in in_use if b is not None]
+        host = rb.get("host") or {}
+        comp = rb.get("compile") or {}
+        alerts = r.get("alerts") or {}
+        out["t"].append(r.get("t"))
+        out["training_steps"].append(r.get("training_steps"))
+        out["hbm_headroom"].append(rb.get("hbm_headroom_frac_min"))
+        out["bytes_in_use"].append(sum(in_use) if in_use else None)
+        out["host_rss"].append(host.get("rss_bytes"))
+        out["host_cpu_pct"].append(host.get("cpu_pct"))
+        out["buffers_total"].append(rb.get("buffers_total"))
+        out["compiles"].append(comp.get("compiles_total"))
+        out["compile_time_s"].append(comp.get("compile_time_s_total"))
+        out["retraces"].append(comp.get("retraces_total"))
+        out["alerts_active"].append(len(alerts.get("active") or [])
+                                    if alerts else None)
+    return out
+
+
 def parse_jsonl(path: str, limit: Optional[int] = None) -> List[dict]:
     """All records of a metrics/telemetry JSONL stream, oldest first
     (``limit`` keeps only the newest N). Partial trailing lines — a writer

@@ -1,0 +1,152 @@
+"""Alerting sentinel CLI: evaluate the declarative rule set over a
+metrics stream, offline or live (ISSUE 7).
+
+The in-run engine (telemetry/alerts.py) rides ``TrainMetrics.log`` — every
+periodic record carries an ``alerts`` block and firings append to
+``alerts_player{p}.jsonl``. This tool is the same engine pointed at the
+FILES, for the two cases the in-run engine cannot serve:
+
+  * **post-mortem / pre-PR7 streams** (``--replay``, the default): replay
+    an existing ``metrics_player{p}.jsonl`` through a FRESH engine and
+    print every firing — triage a finished or crashed run, or a run that
+    predates the pillar / ran with it kill-switched. Exit code 1 when any
+    ``crit`` rule fired, so a soak wrapper can gate on it.
+  * **live watch** (``--follow``): tail the stream and evaluate records
+    as they land — a sentinel process beside a run whose in-run engine is
+    disabled (or whose save_dir you can only read).
+
+Rule bounds come from the same ``telemetry.alerts_*`` knobs the run uses,
+overridable per flag-less dotted ``--override key=value`` pairs (e.g.
+``telemetry.alerts_retrace_storm=5``). ``--rules`` prints the effective
+rule table and exits.
+
+    python -m r2d2_tpu.tools.sentinel --dir models                # replay
+    python -m r2d2_tpu.tools.sentinel --dir models --follow       # live
+    python -m r2d2_tpu.tools.sentinel --rules
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def build_engine(overrides=None, jsonl_path=None, resume=True):
+    """A fresh AlertEngine on the stock rule set, bounds from the default
+    TelemetryConfig plus dotted overrides — exactly what an in-run engine
+    would have used at those knob values. ``resume=True`` (the CLI
+    default) APPENDS to ``jsonl_path``: pointing --out at a run's live
+    ``alerts_player{p}.jsonl`` must merge, never wipe, its history."""
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.telemetry import AlertEngine, default_rules
+    cfg = Config().replace(**(overrides or {}))
+    return AlertEngine(default_rules(cfg.telemetry), jsonl_path=jsonl_path,
+                       resume=resume)
+
+
+def replay_stream(records, engine, emit=print) -> dict:
+    """Run every record through the engine; returns a summary dict
+    ({"records", "fired", "crit", "by_rule"}) and emits one line per
+    firing."""
+    fired_total = 0
+    crit = 0
+    by_rule = {}
+    for record in records:
+        block = engine.evaluate(record)
+        for alert in block["fired"]:
+            fired_total += 1
+            by_rule[alert["rule"]] = by_rule.get(alert["rule"], 0) + 1
+            if alert.get("severity") == "crit":
+                crit += 1
+            emit(f"t={record.get('t', 0):8.1f}s step="
+                 f"{record.get('training_steps', 0):>8} "
+                 f"{alert.get('severity', '?'):>4} {alert['rule']}"
+                 + (f" value={alert['value']:.4g}"
+                    if alert.get("value") is not None else "")
+                 + (f" bound={alert.get('bound')}" if "bound" in alert
+                    else "")
+                 + (f" baseline={alert['baseline']:.4g}"
+                    if alert.get("baseline") is not None else ""))
+    return {"records": len(records), "fired": fired_total, "crit": crit,
+            "by_rule": by_rule}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from r2d2_tpu.tools.logparse import parse_jsonl
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default="models",
+                   help="the run's save_dir (metrics_player{p}.jsonl)")
+    p.add_argument("--player", type=int, default=0)
+    p.add_argument("--follow", action="store_true",
+                   help="tail the stream and evaluate records as they land")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="poll cadence in follow mode")
+    p.add_argument("--out", default="",
+                   help="also append firings to this alerts JSONL "
+                        "(existing history is kept, never truncated)")
+    p.add_argument("--rules", action="store_true",
+                   help="print the effective rule table and exit")
+    p.add_argument("--override", action="append", default=[],
+                   help="dotted config override key=value (repeatable), "
+                        "e.g. telemetry.alerts_retrace_storm=5")
+    args = p.parse_args(argv)
+
+    overrides = {}
+    for ov in args.override:
+        k, _, v = ov.partition("=")
+        try:
+            overrides[k] = json.loads(v)
+        except (json.JSONDecodeError, ValueError):
+            overrides[k] = v
+
+    engine = build_engine(overrides, jsonl_path=args.out or None)
+    if args.rules:
+        print(f"{'rule':<24}{'kind':<11}{'severity':<9}{'bound':>10}  path")
+        for r in engine.rules:
+            print(f"{r.name:<24}{r.kind:<11}{r.severity:<9}"
+                  f"{r.bound:>10}  {'.'.join(r.path)}"
+                  + (" (below)" if r.below else ""))
+        return 0
+
+    path = os.path.join(args.dir, f"metrics_player{args.player}.jsonl")
+    if not args.follow:
+        try:
+            records = parse_jsonl(path)
+        except FileNotFoundError:
+            print(f"no metrics stream at {path}", file=sys.stderr)
+            return 2
+        summary = replay_stream(records, engine)
+        print(f"-- {summary['records']} records, {summary['fired']} "
+              f"alert(s) ({summary['crit']} crit): "
+              + (" ".join(f"{k}x{v}"
+                          for k, v in sorted(summary["by_rule"].items()))
+                 or "clean"))
+        return 1 if summary["crit"] else 0
+
+    seen = 0
+    while True:
+        try:
+            records = parse_jsonl(path)
+        except FileNotFoundError:
+            records = []
+            print(f"waiting for {path} ...")
+        if len(records) < seen:
+            # the stream SHRANK: a fresh (non-resume) run truncated the
+            # metrics file — evaluate the new run from its first record
+            # with a fresh engine, so the old run's counter baselines and
+            # median windows don't poison the new one
+            print(f"stream restarted ({seen} -> {len(records)} records), "
+                  "resetting rule state")
+            engine = build_engine(overrides, jsonl_path=args.out or None)
+            seen = 0
+        if len(records) > seen:
+            replay_stream(records[seen:], engine)
+            seen = len(records)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

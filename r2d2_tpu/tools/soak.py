@@ -38,14 +38,12 @@ import numpy as np
 
 
 def _mem_stats():
-    import jax
-    try:
-        stats = jax.devices()[0].memory_stats() or {}
-    except Exception:
-        return {}
-    return {k: int(v) for k, v in stats.items()
-            if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
-                     "largest_alloc_size")}
+    # the ONE memory_stats wrapper (telemetry/resources.py), same
+    # backend-optional fallback this helper always had: {} on CPU or when
+    # the call raises, the summary byte counters otherwise
+    from r2d2_tpu.telemetry.resources import (SUMMARY_KEYS,
+                                              device_memory_stats)
+    return device_memory_stats(keys=SUMMARY_KEYS)
 
 
 def run_soak(duration_s: float = 1800.0, capacity: int = 500_000,

@@ -316,24 +316,31 @@ def test_ring_recovery_runs_with_restarts_disabled(tmp_path):
 
     stack.processes = [DeadProc()]
     stack.queue = StubQueue()
-    sched = stack._ring_recovery
-    assert stack.supervise() == 0                # no restart...
-    assert sched._after is not None              # ...but recovery scheduled
-    sched._after = 0.0                           # skip the 6s slot grace
-    assert stack.supervise() == 0
-    assert stack.queue.recoveries == 1
-    # the death was < 6s ago: a follow-up pass re-arms (the slot may not
-    # have been stale for the pass that just ran)
-    assert sched._after is not None
-    sched._last_death = 0.0                      # grace has long passed
-    sched._after = 0.0
-    assert stack.supervise() == 0
-    assert stack.queue.recoveries == 2
-    assert sched._after is None                  # disarmed
-    # the same permanently-dead process must not reschedule every tick
-    assert stack.supervise() == 0
-    assert sched._after is None
-    assert stack.queue.recoveries == 2
+    try:
+        sched = stack._ring_recovery
+        assert stack.supervise() == 0            # no restart...
+        assert sched._after is not None          # ...but recovery scheduled
+        sched._after = 0.0                       # skip the 6s slot grace
+        assert stack.supervise() == 0
+        assert stack.queue.recoveries == 1
+        # the death was < 6s ago: a follow-up pass re-arms (the slot may
+        # not have been stale for the pass that just ran)
+        assert sched._after is not None
+        sched._last_death = 0.0                  # grace has long passed
+        sched._after = 0.0
+        assert stack.supervise() == 0
+        assert stack.queue.recoveries == 2
+        assert sched._after is None              # disarmed
+        # the same permanently-dead process must not reschedule every tick
+        assert stack.supervise() == 0
+        assert sched._after is None
+        assert stack.queue.recoveries == 2
+    finally:
+        # release the stack's process-wide state (shm boards, span drain,
+        # the compile monitor's logger hook) — the stubs aren't closeable
+        stack.processes = []
+        stack.queue = None
+        stack.close()
 
 
 def test_thread_actor_envs_closed_on_stop(tmp_path, monkeypatch):
