@@ -25,6 +25,14 @@
 #                parity with the host sink, replay-state identity, the
 #                fused loop, kill switch); the slow gridworld
 #                learnability slice runs with the full tier.
+#   make anakin-sharded — the fast-tier sharded-anakin suite
+#                (tests/test_anakin_sharded.py: dp=2 replay-state
+#                identity vs the per-shard sequential reference,
+#                per-shard RNG independence, global ε-ladder layout,
+#                relaxed mesh validation, the composed loop + per-shard
+#                telemetry block, the shard_imbalance rule); the slow
+#                dp=2 gridworld learnability slice runs with the full
+#                tier.
 #   make sentinel — the fast-tier resource/compile/alerting suite
 #                (tests/test_sentinel.py: rule-engine semantics, retrace
 #                detection on a shape-churning jit, board RSS
@@ -37,8 +45,8 @@
 #                BASELINE.json's 'bench' snapshot (per-metric noise
 #                tolerances; exit 1 on any regression).
 
-.PHONY: t1 chaos telemetry learning anakin sentinel regress \
-	check-fast-markers
+.PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
+	regress check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -59,6 +67,10 @@ anakin: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_anakin.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+anakin-sharded: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_anakin_sharded.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
 sentinel: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_sentinel.py -q \
 	    -m 'not slow' -p no:cacheprovider
@@ -77,6 +89,7 @@ FAST_MARKER_CHECKS := \
 	tests/test_telemetry.py:not_slow:20:telemetry \
 	tests/test_learning_diag.py:not_slow:12:learning-diagnostics \
 	tests/test_anakin.py:not_slow:10:anakin \
+	tests/test_anakin_sharded.py:not_slow:8:anakin-sharded \
 	tests/test_sentinel.py:not_slow:20:sentinel
 
 check-fast-markers:

@@ -27,11 +27,21 @@ block):
     ``episode_len % block_length == 0``), which is exactly the host
     loop's behavior on fixed-length episodes — emit-on-done and
     emit-on-block-boundary coincide;
-  * the ONE deliberate divergence: initial priorities are a constant
-    stamp (``actor.anakin_priority``) instead of the actor's own TD
-    estimates — computing those on device would add a bootstrap unroll
-    per block; the learner's first sample of each sequence writes the
-    real TD priority back.
+  * initial priorities: by default a constant stamp
+    (``actor.anakin_priority``) instead of the actor's own TD estimates
+    — the learner's first sample of each sequence writes the real TD
+    priority back. ``actor.anakin_priority="td"`` opts into the host
+    path's seeding semantics IN-GRAPH: per-step n-step TD errors from
+    the acting policy's own Q-values (recorded along the scan, plus one
+    extra bootstrap forward at the segment end — ~1/block_length of the
+    scan's cost), mixed per sequence with the learner's eta rule
+    (ops/priority.py). Parity with LocalBuffer's
+    ``initial_priorities``/``mixed_td_errors_ragged`` is tested.
+
+The dp-sharded composition (``mesh.dp > 1``) lives in
+parallel/sharded.py: the same act core runs per shard over its lane
+group inside one shard_map program, writing into the shard's local
+replay — see ``make_sharded_anakin_act``.
 """
 
 from typing import Any, Callable, Tuple
@@ -99,14 +109,16 @@ def _take_rows(buf: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return jax.vmap(lambda b, i: jnp.take(b, i, axis=0))(buf, idx)
 
 
-def emit_blocks(spec: ReplaySpec, gamma: float, priority: float,
+def emit_blocks(spec: ReplaySpec, gamma: float, priority,
                 tail_frames: jnp.ndarray, tail_la: jnp.ndarray,
                 tail_hidden: jnp.ndarray, burn0: jnp.ndarray,
                 obs: jnp.ndarray, actions: jnp.ndarray,
                 rewards: jnp.ndarray, hiddens: jnp.ndarray,
                 terminal: jnp.ndarray, final_return: jnp.ndarray,
                 report_mask: jnp.ndarray, reset_obs: jnp.ndarray,
-                weight_version) -> Tuple[Block, tuple]:
+                weight_version, *, q_seg: jnp.ndarray = None,
+                q_boot: jnp.ndarray = None,
+                priority_eta: float = 0.9) -> Tuple[Block, tuple]:
     """LocalBuffer.finish, re-expressed as array ops over one segment.
 
     Inputs are lane-major: ``obs``/``actions``/``rewards``/``hiddens``
@@ -116,6 +128,13 @@ def emit_blocks(spec: ReplaySpec, gamma: float, priority: float,
     ``terminal`` whether the segment's last step ended the episode.
     Returns N fixed-shape Blocks (leading N axis — ``replay_add_many``'s
     stacked-drain layout) plus the next segment's carry tails.
+
+    ``priority`` is either a positive float (constant stamp on every
+    sequence) or the string "td": the host assembler's initial-priority
+    rule (ops/returns.py initial_priorities + the eta max/mean mix) from
+    ``q_seg`` (N, L, A) — the acting policy's Q at each step's state —
+    and ``q_boot`` (N, A), the bootstrap Q at the state after the last
+    step (zeros where the episode terminated, LocalBuffer.finish(None)).
 
     The timeline of block row position ``i`` is ``frames_all[i]`` where
     ``frames_all = tail ++ segment`` — right-aligned tails make the
@@ -163,6 +182,26 @@ def emit_blocks(spec: ReplaySpec, gamma: float, priority: float,
         rem[None, :] > f, np.float32(gamma ** f),
         jnp.where(terminal[:, None], jnp.float32(0.0), g_tail[None, :]))
 
+    if isinstance(priority, str):
+        # "td": per-step |n-step TD| from the acting policy's own
+        # Q-values — initial_priorities vectorized. The bootstrap value
+        # for step t is max_a Q at row min(t + mf, L) of the (L+1)-row
+        # Q timeline (segment states + the post-segment bootstrap row),
+        # which IS the host's [mf : size+1] slice edge-padded to size.
+        mf = min(f, l_seg)
+        max_rows = jnp.concatenate(
+            [q_seg, q_boot[:, None]], axis=1).max(axis=-1)     # (N, L+1)
+        boot_idx = jnp.minimum(
+            jnp.arange(l_seg, dtype=jnp.int32) + mf, l_seg)
+        chosen = jnp.take_along_axis(
+            q_seg, actions[:, :, None].astype(jnp.int32), axis=2)[..., 0]
+        td = jnp.abs(returns + gammas * max_rows[:, boot_idx] - chosen)
+        td_s = td.reshape(n, s, lrn)
+        prio = (np.float32(priority_eta) * td_s.max(axis=-1)
+                + np.float32(1.0 - priority_eta) * td_s.mean(axis=-1))
+    else:
+        prio = jnp.full((n, s), priority, jnp.float32)
+
     forward_s = jnp.minimum(f, l_seg + 1 - (s_arr + 1) * lrn)
     sum_reward = jnp.where(terminal & report_mask,
                            final_return, jnp.float32(jnp.nan))
@@ -173,7 +212,7 @@ def emit_blocks(spec: ReplaySpec, gamma: float, priority: float,
         action=actions.reshape(n, s, lrn).astype(jnp.int32),
         reward=returns.reshape(n, s, lrn).astype(jnp.float32),
         gamma=gammas.reshape(n, s, lrn).astype(jnp.float32),
-        priority=jnp.full((n, s), priority, jnp.float32),
+        priority=prio.astype(jnp.float32),
         burn_in_steps=burn_in_s.astype(jnp.int32),
         learning_steps=jnp.full((n, s), lrn, jnp.int32),
         forward_steps=jnp.broadcast_to(forward_s.astype(jnp.int32), (n, s)),
@@ -202,31 +241,24 @@ def emit_blocks(spec: ReplaySpec, gamma: float, priority: float,
     return blocks, new_tails
 
 
-def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
-                    num_lanes: int, epsilons, gamma: float,
-                    priority: float, near_greedy_eps: float) -> Callable:
-    """Build the jitted acting segment:
+def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
+                  num_lanes: int, gamma: float, priority,
+                  priority_eta: float = 0.9) -> Callable:
+    """The traceable acting segment, parameterized by per-lane arrays:
 
-        act(params, carry, weight_version) -> (carry, blocks, stats)
+        core(params, carry, weight_version, eps, report)
+            -> (carry, blocks, stats)
 
-    One call = ``block_length`` fused env+policy steps across all
-    ``num_lanes`` lanes + in-graph block assembly. ``blocks`` carries a
-    leading N axis (feed straight to ``replay_add_many``); ``stats`` are
-    small device scalars (episode counts / near-greedy return sums) the
-    host fetches lazily at log time. The carry is donated — its large
-    frame buffers update in place.
-
-    ``epsilons`` is the per-lane Ape-X ladder; lanes with ε <=
-    ``near_greedy_eps`` report episode returns (the host loop's
-    filtering rule). Exploration uses jax.random streams — same
-    distribution as the host's per-lane numpy generators, different
-    draws."""
-    eps_list = [float(e) for e in epsilons]
-    if len(eps_list) != num_lanes:
-        raise ValueError(f"need one epsilon per lane: got {len(eps_list)} "
-                         f"for {num_lanes} lanes")
-    eps = jnp.asarray(eps_list, jnp.float32)
-    report = np.asarray([e <= near_greedy_eps for e in eps_list])
+    ``eps`` (num_lanes,) f32 and ``report`` (num_lanes,) bool are traced
+    (or constant-folded) inputs rather than baked Python constants, so
+    the SAME core serves both compositions: ``make_anakin_act`` closes
+    over the full static ladder (the 1x1-mesh path), and the dp-sharded
+    program (parallel/sharded.py make_sharded_anakin_act) feeds each
+    shard its slice of the GLOBAL ladder inside shard_map."""
+    td_priority = isinstance(priority, str)
+    if td_priority and priority != "td":
+        raise ValueError(f"priority must be a positive float or 'td', "
+                         f"got {priority!r}")
     action_dim = net.action_dim
     if env.action_dim != action_dim:
         raise ValueError(f"env action_dim {env.action_dim} != network "
@@ -240,7 +272,7 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
             f"env.episode_len {env.episode_len} must be a multiple of "
             f"block_length {spec.block_length}")
 
-    def act(params, carry: ActCarry, weight_version):
+    def core(params, carry: ActCarry, weight_version, eps, report):
         # ONE speculative reset per segment, not per step: fixed-length
         # episodes end only on segment boundaries (the alignment asserted
         # above), so the auto-reset selection applies exactly once, after
@@ -285,6 +317,8 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
                 key=key)
             y = {"obs": obs, "action": action, "reward": reward,
                  "done": done, "hidden": hid, "ep_ret": c.ep_return}
+            if td_priority:
+                y["q"] = q[:, 0]     # Q at the state the action was taken in
             return c, y
 
         out_carry, ys = jax.lax.scan(body, carry, None,
@@ -294,6 +328,21 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
         # restarts from envs/vector.py's reset state (duplicated initial
         # frames, zero hidden, null last action)
         terminal = ys["done"][-1]
+
+        q_boot = None
+        if td_priority:
+            # bootstrap Q at the PRE-reset end-of-segment state — the
+            # value the host caller passes to LocalBuffer.finish; zeroed
+            # where the episode terminated (finish(None)). One extra T=1
+            # forward per segment, ~1/block_length of the scan's cost.
+            stacked_b = (out_carry.cur_stack.astype(jnp.float32)
+                         / np.float32(255.0)).transpose(0, 2, 3, 1)
+            la_b = jax.nn.one_hot(out_carry.last_action, action_dim,
+                                  dtype=jnp.float32)
+            qb, _ = net.module.apply(params, stacked_b[:, None],
+                                     la_b[:, None], out_carry.hidden)
+            q_boot = jnp.where(terminal[:, None], jnp.float32(0.0),
+                               qb[:, 0])
 
         def sel(a, b):
             d = terminal.reshape(terminal.shape + (1,) * (a.ndim - 1))
@@ -319,7 +368,9 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
             spec, gamma, priority, carry.tail_frames, carry.tail_la,
             carry.tail_hidden, carry.burn0, obs_nl, act_nl, rew_nl, hid_nl,
             terminal, ys["ep_ret"][-1], report_m,
-            reset_obs, weight_version)
+            reset_obs, weight_version,
+            q_seg=(jnp.swapaxes(ys["q"], 0, 1) if td_priority else None),
+            q_boot=q_boot, priority_eta=priority_eta)
         done_rep = ys["done"] & report_m[None, :]
         stats = {
             "episodes": jnp.sum(ys["done"]).astype(jnp.int32),
@@ -330,5 +381,43 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
         out_carry = out_carry.replace(tail_frames=tf, tail_la=tl,
                                       tail_hidden=th, burn0=b0)
         return out_carry, blocks, stats
+
+    return core
+
+
+def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
+                    num_lanes: int, epsilons, gamma: float,
+                    priority, near_greedy_eps: float,
+                    priority_eta: float = 0.9) -> Callable:
+    """Build the jitted acting segment (1x1-mesh composition):
+
+        act(params, carry, weight_version) -> (carry, blocks, stats)
+
+    One call = ``block_length`` fused env+policy steps across all
+    ``num_lanes`` lanes + in-graph block assembly. ``blocks`` carries a
+    leading N axis (feed straight to ``replay_add_many``); ``stats`` are
+    small device scalars (episode counts / near-greedy return sums) the
+    host fetches lazily at log time. The carry is donated — its large
+    frame buffers update in place.
+
+    ``epsilons`` is the per-lane Ape-X ladder; lanes with ε <=
+    ``near_greedy_eps`` report episode returns (the host loop's
+    filtering rule). Exploration uses jax.random streams — same
+    distribution as the host's per-lane numpy generators, different
+    draws. ``priority`` is the constant stamp or "td" (see
+    emit_blocks); ``priority_eta`` is the learner's max/mean mix."""
+    eps_list = [float(e) for e in epsilons]
+    if len(eps_list) != num_lanes:
+        raise ValueError(f"need one epsilon per lane: got {len(eps_list)} "
+                         f"for {num_lanes} lanes")
+    eps = jnp.asarray(eps_list, jnp.float32)
+    report = np.asarray([e <= near_greedy_eps for e in eps_list])
+    core = make_act_core(env, net, spec, num_lanes=num_lanes, gamma=gamma,
+                         priority=priority, priority_eta=priority_eta)
+
+    def act(params, carry: ActCarry, weight_version):
+        # the static ladder constant-folds into the program — the dp=1
+        # path compiles the same program it did before the core split
+        return core(params, carry, weight_version, eps, report)
 
     return jax.jit(act, donate_argnums=1)

@@ -249,10 +249,14 @@ class ActorConfig:
     # learner's train state (Podracer "Anakin", arxiv 2104.06272). False
     # (default) = the legacy host actor fleet, byte-identical to pre-PR6.
     on_device: bool = False
-    # Batched env lanes inside the fused acting scan. Each acting segment
-    # emits one block per lane, so lanes must be <= num_blocks (the
+    # Batched env lanes inside the fused acting scan — the GLOBAL count:
+    # under a dp-wide mesh (mesh.dp > 1) the lanes partition into dp
+    # equal per-shard groups (anakin_lanes % dp == 0), each acting into
+    # its shard's local replay. Each segment emits one block per lane,
+    # so the PER-SHARD group (lanes/dp) must be <= num_blocks (the
     # replay_add_many scatter-alias bound). The Ape-X ε ladder spreads
-    # over the lanes exactly like an equally-sized scalar-actor fleet.
+    # over the global lanes exactly like an equally-sized scalar-actor
+    # fleet, regardless of dp.
     anakin_lanes: int = 64
     # Acting segments dispatched per train dispatch once training has
     # started (before learning_starts the loop acts continuously). >1
@@ -260,12 +264,16 @@ class ActorConfig:
     # synchronous, so this IS the collect:learn scheduling knob (the
     # replay rate limiter still applies on top).
     anakin_scans_per_train: int = 1
-    # Initial priority stamped on every device-assembled sequence
-    # (max-priority-style seeding). The host path seeds from the actor's
-    # own TD estimates; computing those on device would add a second
-    # bootstrap unroll per block, so the fused path stamps a constant and
-    # lets the learner's first write-back set the real priority.
-    anakin_priority: float = 1.0
+    # Initial priority of device-assembled sequences. A positive float
+    # (default) stamps every sequence with that constant
+    # (max-priority-style seeding) and lets the learner's first
+    # write-back set the real priority. "td" computes the host path's
+    # seeding IN-GRAPH instead: per-step n-step TD errors from the
+    # acting policy's own Q-values (recorded along the scan + one extra
+    # bootstrap forward per segment), mixed per sequence with
+    # optim.priority_eta — fresh experience enters the tree already
+    # ranked, at ~1/block_length extra acting compute.
+    anakin_priority: Any = 1.0
     # Deterministic fault injection (tools/chaos.py): ';'-joined
     # ``slot:kind`` entries, e.g. "1:crash@block=3;2:hang@block=5;0:slowx4".
     # ``crash@block=N`` raises on the worker's N-th block emit (1-based),
@@ -421,6 +429,14 @@ class TelemetryConfig:
     # Post-warm-up retraces within one log interval at/above this count
     # fire retrace_storm.
     alerts_retrace_storm: int = 3
+    # Sharded-anakin balance: max/min per-shard ingested env-steps over
+    # the log interval (the record's anakin.shard_imbalance) at/above
+    # this ratio fires shard_imbalance. Today's lockstep fused program
+    # keeps the ratio at exactly 1.0 (full blocks on every shard every
+    # segment) — the rule is the standing guard for compositions that
+    # can skew it (ragged per-shard emission, elastic meshes), where a
+    # lagging shard drags the whole lockstep program to its pace.
+    alerts_shard_imbalance: float = 1.5
 
 
 @dataclass(frozen=True)
@@ -582,7 +598,13 @@ class Config:
             raise ValueError(
                 f"actor.anakin_scans_per_train "
                 f"({self.actor.anakin_scans_per_train}) must be >= 1")
-        if self.actor.anakin_priority <= 0:
+        if isinstance(self.actor.anakin_priority, str):
+            if self.actor.anakin_priority != "td":
+                raise ValueError(
+                    f"actor.anakin_priority ({self.actor.anakin_priority!r})"
+                    " must be 'td' (in-graph n-step TD seeding from the "
+                    "acting policy's Q-values) or a positive constant stamp")
+        elif self.actor.anakin_priority <= 0:
             raise ValueError(
                 f"actor.anakin_priority ({self.actor.anakin_priority}) must "
                 "be > 0: zero-priority sequences are unsamplable, so a "
@@ -605,10 +627,35 @@ class Config:
                     "fused scan emits fixed block_length-step blocks, so "
                     "episode ends must land on block boundaries (the host "
                     "path's emit-on-done semantics)")
-            if self.actor.anakin_lanes > self.num_blocks:
+            if self.mesh.mp > 1:
+                raise ValueError(
+                    "actor.on_device composes with data-parallel meshes "
+                    "only: the fused acting scan runs per-shard lane "
+                    "groups over mesh.dp, but model parallelism (mesh.mp "
+                    f"= {self.mesh.mp}) shards the network's feature dims "
+                    "through the GSPMD learner step, which the acting "
+                    "scan does not run under — set mesh.mp=1 (mesh.dp > 1 "
+                    "is fine) or actor.on_device=false")
+            if self.mesh.dp > 1 and \
+                    self.actor.anakin_lanes % self.mesh.dp != 0:
+                # the lane/shard divisibility contract, enforced HERE so
+                # a bad pairing fails at config construction, not as a
+                # reshape error inside the traced shard_map program
                 raise ValueError(
                     f"actor.anakin_lanes ({self.actor.anakin_lanes}) must "
-                    f"be <= num_blocks ({self.num_blocks}): each segment "
+                    f"be divisible by mesh.dp ({self.mesh.dp}): the fused "
+                    "acting scan partitions the lanes into equal "
+                    "per-shard groups (anakin_lanes % dp == 0) — adjust "
+                    "actor.anakin_lanes or mesh.dp")
+            # mesh.dp=-1 (all devices) resolves at runtime; the loop
+            # re-checks both contracts against the resolved dp there
+            per_shard = (self.actor.anakin_lanes // self.mesh.dp
+                         if self.mesh.dp > 1 else self.actor.anakin_lanes)
+            if self.mesh.dp >= 1 and per_shard > self.num_blocks:
+                raise ValueError(
+                    f"actor.anakin_lanes ({self.actor.anakin_lanes}) must "
+                    f"leave each shard's lane group ({per_shard}) <= "
+                    f"num_blocks ({self.num_blocks}): each segment "
                     "ring-writes one block per lane in a single "
                     "replay_add_many dispatch, whose scatter rows must not "
                     "alias — grow replay.capacity or lower the lane count")
@@ -700,6 +747,12 @@ class Config:
             raise ValueError(
                 f"telemetry.alerts_retrace_storm "
                 f"({self.telemetry.alerts_retrace_storm}) must be >= 1")
+        if self.telemetry.alerts_shard_imbalance <= 1:
+            raise ValueError(
+                f"telemetry.alerts_shard_imbalance "
+                f"({self.telemetry.alerts_shard_imbalance}) must be > 1 "
+                "(a max/min per-shard env-steps ratio; 1.0 = perfectly "
+                "balanced)")
         if self.multiplayer.enabled and self.actor.envs_per_actor > 1:
             raise ValueError(
                 "actor.envs_per_actor > 1 is not supported with multiplayer "
@@ -802,6 +855,14 @@ def _coerce(key: str, value: str, annotation: str) -> Any:
                 "';'-separated out_channels,kernel,stride triples, e.g. "
                 "8,4,2;16,3,1")
         return layers
+    if str(annotation) == "Any":
+        # union knob (actor.anakin_priority: a float stamp or "td") —
+        # numeric strings become floats, anything else stays a string
+        # and Config.__post_init__ validates the allowed spellings
+        try:
+            return float(value)
+        except ValueError:
+            return value
     target_type = _SCALAR_ANNOTATIONS.get(str(annotation).replace("Optional[str]", "str"))
     if target_type is None:
         raise SystemExit(

@@ -8,11 +8,13 @@ block-wise per chip, per-shard prioritized sampling, and gradient pmean over
 ICI; multi-host extends the same mesh over DCN via jax.distributed.
 """
 
-from r2d2_tpu.parallel.mesh import make_mesh, init_distributed
+from r2d2_tpu.parallel.mesh import make_mesh, init_distributed, dp_sharding
 from r2d2_tpu.parallel.sharded import (
     make_sharded_learner_step,
     make_sharded_replay_add,
     make_sharded_replay_add_many,
+    make_sharded_anakin_act,
+    init_sharded_act_carry,
     sharded_replay_init,
     sharded_buffer_steps,
 )
@@ -22,9 +24,10 @@ from r2d2_tpu.parallel.tensor_parallel import (
 )
 
 __all__ = [
-    "make_mesh", "init_distributed",
+    "make_mesh", "init_distributed", "dp_sharding",
     "make_sharded_learner_step", "make_sharded_replay_add",
     "make_sharded_replay_add_many",
+    "make_sharded_anakin_act", "init_sharded_act_carry",
     "sharded_replay_init", "sharded_buffer_steps",
     "make_tp_external_batch_step", "state_shardings",
     "train_multihost", "make_sp_lstm",

@@ -9,9 +9,17 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from r2d2_tpu.config import MeshConfig
+
+
+def dp_sharding(mesh: Mesh) -> NamedSharding:
+    """The leading-dp-axis placement every shard-per-chip pytree uses
+    (sharded replay state, the sharded anakin lane carry): one sharding
+    construction point so the replay ring and the acting carry cannot
+    disagree about the axis layout."""
+    return NamedSharding(mesh, PartitionSpec("dp"))
 
 
 def init_distributed(cfg: MeshConfig) -> None:

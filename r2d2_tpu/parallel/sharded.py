@@ -36,7 +36,8 @@ from r2d2_tpu.config import OptimConfig
 from r2d2_tpu.learner.train_step import TrainState, make_loss_fn, make_optimizer
 from r2d2_tpu.models.network import NetworkApply
 from r2d2_tpu.ops.sum_tree import tree_update
-from r2d2_tpu.replay.device_replay import replay_init, replay_sample, replay_add
+from r2d2_tpu.replay.device_replay import (
+    replay_init, replay_sample, replay_add, replay_add_many)
 from r2d2_tpu.replay.structs import Block, ReplaySpec, ReplayState
 
 
@@ -51,11 +52,12 @@ def _unshard0(tree):
 
 def sharded_replay_init(spec: ReplaySpec, mesh: Mesh) -> ReplayState:
     """Global replay state with leading dp axis, placed shard-per-chip."""
+    from r2d2_tpu.parallel.mesh import dp_sharding
     dp = mesh.shape["dp"]
     state = replay_init(spec)
     state = jax.tree_util.tree_map(
         lambda x: jnp.broadcast_to(x[None], (dp,) + x.shape), state)
-    sharding = NamedSharding(mesh, P("dp"))
+    sharding = dp_sharding(mesh)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), state)
 
 
@@ -81,6 +83,122 @@ def make_sharded_replay_add(spec: ReplaySpec, mesh: Mesh):
         return add(state, block, jnp.asarray([shard_idx], jnp.int32))
 
     return jax.jit(add_fn, donate_argnums=0)
+
+
+def _lane_group_size(num_lanes: int, dp: int) -> int:
+    """The per-shard lane count, with the ONE divisibility check both
+    sharded-anakin entry points share (Config and the loop re-state it
+    earlier for explicit/resolved mesh.dp — this is the library-level
+    backstop for direct callers)."""
+    if num_lanes % dp != 0:
+        raise ValueError(
+            f"anakin lanes ({num_lanes}) must divide evenly across the "
+            f"mesh's dp={dp} shards (lanes % dp == 0)")
+    return num_lanes // dp
+
+
+def init_sharded_act_carry(env, spec: ReplaySpec, num_lanes: int,
+                           mesh: Mesh, key):
+    """The sharded twin of actor/anakin.py init_act_carry: one fresh
+    per-shard carry of ``num_lanes / dp`` lanes per chip, stacked on a
+    leading dp axis and placed shard-per-chip. Shard s's RNG chain is
+    ``fold_in(key, s)`` — the SAME construction tests reproduce when
+    they build the per-shard reference path — so every shard's env
+    schedules, ε draws and exploration streams are independent."""
+    from r2d2_tpu.actor.anakin import init_act_carry
+    from r2d2_tpu.parallel.mesh import dp_sharding
+    dp = mesh.shape["dp"]
+    lps = _lane_group_size(num_lanes, dp)
+    carries = [init_act_carry(env, spec, lps, jax.random.fold_in(key, s))
+               for s in range(dp)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *carries)
+    return jax.device_put(stacked, dp_sharding(mesh))
+
+
+def make_sharded_anakin_act(env, net, spec: ReplaySpec, *, mesh: Mesh,
+                            num_lanes: int, epsilons, gamma: float,
+                            priority, near_greedy_eps: float,
+                            priority_eta: float = 0.9):
+    """The dp-sharded fused acting segment (ISSUE 8 tentpole):
+
+        act(params, carry, replay_state, weight_version)
+            -> (carry, replay_state, shard_stats)
+
+    ONE shard_map dispatch: each shard runs the SAME act core as the
+    1x1-mesh path (actor/anakin.py make_act_core) over its own lane
+    group of ``num_lanes / dp`` lanes — pure-JAX env steps, policy
+    forward, ε-greedy, auto-reset, in-graph block assembly — then
+    ring-writes its group's blocks STRAIGHT into its local replay shard
+    via ``replay_add_many``. No host round-trip, no cross-shard block
+    traffic: the only replicated inputs are the params and the publish
+    clock, and nothing is reduced across shards (stats come back
+    per-shard).
+
+    Semantics vs dp=1:
+
+      * the Ape-X ε ladder spans the GLOBAL lane count — shard s gets
+        the contiguous slice [s*lps, (s+1)*lps) of the ``num_lanes``-
+        wide ladder, exactly like a vector-actor fleet's lane split
+        (config.vector_lane_epsilons), so dp changes WHERE lanes run,
+        never the exploration schedule;
+      * per-shard RNG chains come from the carry built by
+        ``init_sharded_act_carry`` (fold_in(key, shard)) — shards
+        explore and reset independently;
+      * ``shard_stats`` carries (dp,)-shaped per-shard reductions
+        (episodes, reported episodes/return sums, env steps) so the
+        telemetry layer can surface per-shard balance without a
+        cross-shard reduce inside the program.
+
+    Carry and replay state are donated (the multi-GB obs buffers update
+    in place, per shard)."""
+    from r2d2_tpu.actor.anakin import make_act_core
+    import numpy as np
+    dp = mesh.shape["dp"]
+    eps_list = [float(e) for e in epsilons]
+    if len(eps_list) != num_lanes:
+        raise ValueError(
+            f"need one epsilon per GLOBAL lane: got {len(eps_list)} for "
+            f"{num_lanes} lanes (the ladder spans all shards)")
+    lps = _lane_group_size(num_lanes, dp)
+    if lps > spec.num_blocks:
+        raise ValueError(
+            f"per-shard lane group ({lps} = {num_lanes} lanes / dp={dp}) "
+            f"must be <= num_blocks ({spec.num_blocks}): each segment "
+            "ring-writes one block per lane into the shard's local ring, "
+            "whose scatter rows must not alias")
+    eps_shards = jnp.asarray(eps_list, jnp.float32).reshape(dp, lps)
+    report_shards = jnp.asarray(
+        np.asarray([e <= near_greedy_eps for e in eps_list],
+                   bool).reshape(dp, lps))
+    core = make_act_core(env, net, spec, num_lanes=lps, gamma=gamma,
+                         priority=priority, priority_eta=priority_eta)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P("dp"), P("dp"), P(), P("dp"), P("dp")),
+        out_specs=(P("dp"), P("dp"), P("dp")), check_vma=False)
+    def step(params, carry, replay_global, weight_version, eps, report):
+        local_carry = _shard0(carry)
+        local_replay = _shard0(replay_global)
+        new_carry, blocks, stats = core(params, local_carry,
+                                        weight_version, eps[0], report[0])
+        local_replay = replay_add_many(spec, local_replay, blocks)
+        shard_stats = {k: v[None] for k, v in stats.items()}
+        # measured from the blocks that actually entered this shard's
+        # ring, NOT a trace-time constant: under today's lockstep
+        # program every shard emits full blocks every segment (so the
+        # downstream imbalance ratio reads exactly 1.0 — asserted in
+        # tests), but the signal follows the DATA, so a composition
+        # that emits ragged/partial blocks per shard skews it for real
+        shard_stats["env_steps"] = jnp.sum(
+            blocks.learning_steps).astype(jnp.int32)[None]
+        return (_unshard0(new_carry), _unshard0(local_replay), shard_stats)
+
+    def act(params, carry, replay_state, weight_version):
+        return step(params, carry, replay_state, weight_version,
+                    eps_shards, report_shards)
+
+    return jax.jit(act, donate_argnums=(1, 2))
 
 
 def make_sharded_replay_add_many(spec: ReplaySpec, mesh: Mesh):

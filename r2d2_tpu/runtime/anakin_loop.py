@@ -3,7 +3,7 @@
 The orchestrator's host loop (runtime/orchestrator.py) spawns an actor
 FLEET: threads/processes stepping Python envs, blocks crossing a queue,
 weights crossing a shm service. This loop replaces all of it with a
-single-threaded alternation on ONE device (Podracer "Anakin", arxiv
+single-threaded alternation on the device mesh (Podracer "Anakin", arxiv
 2104.06272):
 
     act segment  — one jitted lax.scan: block_length steps of
@@ -13,6 +13,16 @@ single-threaded alternation on ONE device (Podracer "Anakin", arxiv
                    the existing donated ``replay_add_many`` dispatch;
     train        — the learner's fused step(s), exactly as the host loop
                    dispatches them (same Learner, same diagnostics).
+
+Mesh composition (ISSUE 8): with ``mesh.dp > 1`` the act segment and the
+ring-write fuse into ONE shard_map dispatch over the Learner's mesh
+(parallel/sharded.py make_sharded_anakin_act) — the lanes partition into
+dp per-shard groups, each acting with its own RNG chain and its slice of
+the GLOBAL ε ladder, writing straight into its local replay shard; the
+learner's dp-sharded step then trains on the same mesh. Aggregate acting
+throughput scales with dp while the learner gains its sharded-batch
+throughput (PERF.md round 12). Only ``mesh.mp > 1`` and multihost remain
+out of scope for the fused loop.
 
 Weights are published BY REFERENCE: each acting segment reads
 ``learner.train_state.params`` directly — no weight service, no copy, and
@@ -78,14 +88,34 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
     if not cfg.actor.on_device:
         raise ValueError("run_anakin_train requires actor.on_device=True")
     n_dev = len(jax.devices())
-    if cfg.mesh.resolved_dp(n_dev) > 1 or cfg.mesh.mp > 1:
+    dp = cfg.mesh.resolved_dp(n_dev)
+    num_lanes = cfg.actor.anakin_lanes
+    if cfg.mesh.mp > 1:
         raise NotImplementedError(
-            "actor.on_device currently runs the single-chip learner step; "
-            "mesh.dp/mp must be 1 (sharded anakin — per-shard lane groups "
-            "— is the natural next step but is not built yet)")
+            "actor.on_device composes with data-parallel meshes only: the "
+            "fused acting scan runs per-shard lane groups over mesh.dp, "
+            "but model parallelism (mesh.mp > 1) shards the network's "
+            "feature dims through the GSPMD learner step, which the "
+            "acting scan does not run under — set mesh.mp=1 (mesh.dp > 1 "
+            "is fine) or actor.on_device=false")
+    if cfg.mesh.multihost:
+        raise NotImplementedError(
+            "actor.on_device is single-controller only: the fused loop "
+            "owns the whole mesh from one process, while "
+            "mesh.multihost=True runs the lockstep per-host trainer "
+            "(parallel/multihost.py) — unset mesh.multihost, or use the "
+            "host actor fleet for multihost runs")
+    # the lane/shard contracts again, against the RESOLVED dp — Config
+    # enforces both at construction for explicit mesh.dp, but dp=-1
+    # (all devices) only resolves here
+    if num_lanes % dp != 0:
+        raise ValueError(
+            f"actor.anakin_lanes ({num_lanes}) must be divisible by the "
+            f"resolved mesh.dp ({dp}): each shard owns an equal lane "
+            "group (anakin_lanes % dp == 0) — adjust actor.anakin_lanes "
+            "or mesh.dp")
 
     env = create_jax_env(cfg.env)
-    num_lanes = cfg.actor.anakin_lanes
     net = NetworkApply(env.action_dim, cfg.network, cfg.env.frame_stack,
                        cfg.env.frame_height, cfg.env.frame_width)
 
@@ -101,6 +131,11 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
     learner = Learner(cfg, net, 0, metrics=metrics)
     spec = learner.spec
     seg_steps = spec.block_length          # learning steps per lane-block
+    if num_lanes // dp > spec.num_blocks:
+        raise ValueError(
+            f"per-shard lane group ({num_lanes // dp} = {num_lanes} lanes "
+            f"/ dp={dp}) must be <= num_blocks ({spec.num_blocks}): grow "
+            "replay.capacity or lower actor.anakin_lanes")
     pub_interval = max(cfg.runtime.weight_publish_interval, 1)
 
     def publish_count() -> int:
@@ -111,14 +146,33 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
 
     learner.weight_version_fn = publish_count
 
+    # the ε ladder spans the GLOBAL lane count whatever the mesh: dp
+    # changes where lanes run, never the Ape-X exploration schedule
     epsilons = [apex_epsilon(i, num_lanes, cfg.actor.base_eps,
                              cfg.actor.eps_alpha) for i in range(num_lanes)]
-    act_fn = make_anakin_act(
-        env, net, spec, num_lanes=num_lanes, epsilons=epsilons,
-        gamma=cfg.optim.gamma, priority=cfg.actor.anakin_priority,
-        near_greedy_eps=cfg.actor.near_greedy_eps)
-    carry = init_act_carry(env, spec, num_lanes,
-                           jax.random.PRNGKey(cfg.runtime.seed + 17))
+    act_key = jax.random.PRNGKey(cfg.runtime.seed + 17)
+    if dp > 1:
+        # sharded anakin (ISSUE 8): the act scan + per-shard ring-write
+        # fused into ONE shard_map dispatch over the Learner's mesh —
+        # each shard's lane group feeds its local replay shard directly,
+        # alongside the same mesh's dp-sharded learner step
+        from r2d2_tpu.parallel import (init_sharded_act_carry,
+                                       make_sharded_anakin_act)
+        act_fn = make_sharded_anakin_act(
+            env, net, spec, mesh=learner.mesh, num_lanes=num_lanes,
+            epsilons=epsilons, gamma=cfg.optim.gamma,
+            priority=cfg.actor.anakin_priority,
+            near_greedy_eps=cfg.actor.near_greedy_eps,
+            priority_eta=cfg.optim.priority_eta)
+        carry = init_sharded_act_carry(env, spec, num_lanes, learner.mesh,
+                                       act_key)
+    else:
+        act_fn = make_anakin_act(
+            env, net, spec, num_lanes=num_lanes, epsilons=epsilons,
+            gamma=cfg.optim.gamma, priority=cfg.actor.anakin_priority,
+            near_greedy_eps=cfg.actor.near_greedy_eps,
+            priority_eta=cfg.optim.priority_eta)
+        carry = init_act_carry(env, spec, num_lanes, act_key)
 
     # system-health pillar (ISSUE 7), the on-device twin of the
     # PlayerStack wiring: resource sampler (the Learner registered ring +
@@ -155,25 +209,36 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
     def act_segment():
         nonlocal carry
         t0 = time.time()
-        carry, blocks, stats = act_fn(
-            learner.train_state.params, carry, np.int32(publish_count()))
-        t1 = time.time()
-        learner.replay_state = replay_add_many(
-            spec, learner.replay_state, blocks)
-        t2 = time.time()
+        if dp > 1:
+            # act + ring-write fused in one sharded dispatch: each
+            # shard's blocks land in its local replay without ever
+            # leaving the shard, so there is no separate commit stage
+            carry, learner.replay_state, stats = act_fn(
+                learner.train_state.params, carry, learner.replay_state,
+                np.int32(publish_count()))
+            t1 = t2 = time.time()
+        else:
+            carry, blocks, stats = act_fn(
+                learner.train_state.params, carry,
+                np.int32(publish_count()))
+            t1 = time.time()
+            learner.replay_state = replay_add_many(
+                spec, learner.replay_state, blocks)
+            t2 = time.time()
+            # commit latency only (t2-t1): the acting dispatch is its
+            # own stage; folding it in would make ingest_drain_latency_ms
+            # incomparable with the host path's pop-to-commit reading
+            telemetry.observe("ingest/commit", t2 - t1)
         telemetry.observe("actor/act_scan", t1 - t0)
         telemetry.record_span("actor/act_scan", t0, t1,
-                              {"lanes": num_lanes, "steps": seg_steps})
-        telemetry.observe("ingest/commit", t2 - t1)
+                              {"lanes": num_lanes, "steps": seg_steps,
+                               "shards": dp})
         wv = publish_count()
         for _ in range(num_lanes):
             learner.ring.advance(seg_steps, wv)
             metrics.on_block(seg_steps, None)
         learner.env_steps += num_lanes * seg_steps
         metrics.set_buffer_size(learner.ring.buffer_steps)
-        # commit latency only (t2-t1): the acting dispatch is its own
-        # stage; folding it in would make ingest_drain_latency_ms
-        # incomparable with the host path's pop-to-commit reading
         metrics.on_ingest_drain(num_lanes, t2 - t1)
         pending_stats.append(stats)
 
@@ -182,9 +247,33 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
             return
         fetched = jax.device_get(pending_stats)
         pending_stats.clear()
-        count = int(sum(int(s["reported_episodes"]) for s in fetched))
-        total = float(sum(float(s["reported_return_sum"]) for s in fetched))
-        metrics.on_episodes(count, total)
+        # per-shard interval reductions (dp=1 stats are scalars — one
+        # "shard"): episode counts/returns feed the return average, the
+        # per-shard rows + imbalance ratio feed the record's anakin
+        # block (telemetry/alerts.py shard_imbalance, inspect.py panel)
+        eps_counts = np.sum([np.atleast_1d(s["reported_episodes"])
+                             for s in fetched], axis=0)
+        ret_sums = np.sum([np.atleast_1d(s["reported_return_sum"])
+                           for s in fetched], axis=0)
+        episodes = np.sum([np.atleast_1d(s["episodes"])
+                           for s in fetched], axis=0)
+        metrics.on_episodes(int(eps_counts.sum()), float(ret_sums.sum()))
+        if dp > 1:
+            shard_env = np.sum([np.atleast_1d(s["env_steps"])
+                                for s in fetched], axis=0)
+        else:
+            shard_env = np.asarray([len(fetched) * num_lanes * seg_steps])
+        lo = float(shard_env.min())
+        metrics.set_anakin({
+            "dp": dp,
+            "lanes_per_shard": num_lanes // dp,
+            "shard_env_steps": [int(v) for v in shard_env],
+            "shard_episodes": [int(v) for v in episodes],
+            "shard_reported_episodes": [int(v) for v in eps_counts],
+            "shard_return_sum": [round(float(v), 4) for v in ret_sums],
+            "shard_imbalance": (round(float(shard_env.max()) / lo, 4)
+                                if lo > 0 else None),
+        })
 
     start = time.time()
     deadline = start + max_seconds if max_seconds else None

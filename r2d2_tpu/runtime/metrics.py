@@ -83,6 +83,12 @@ class TrainMetrics:
         # key on its presence, like the 'stages' block)
         self._learning = None
 
+        # sharded-anakin composition block (ISSUE 8): per-shard rows +
+        # the env-step imbalance ratio, set at each stats flush by the
+        # fused loop; emitted once per record then cleared, OMITTED on
+        # every non-anakin run (consumers key on its presence)
+        self._anakin = None
+
         # system-health pillar (ISSUE 7): a resources-block provider
         # (ResourceMonitor.block) and the alert engine, both attached by
         # the orchestrating loop. None = the blocks are OMITTED and the
@@ -150,6 +156,13 @@ class TrainMetrics:
         None = nothing this interval (no training steps, or diagnostics
         disabled) and the record carries no 'learning' key."""
         self._learning = block
+
+    def set_anakin(self, block: Optional[dict]) -> None:
+        """Attach the interval's sharded-anakin block (per-shard env
+        steps / episodes / return sums + the max/min env-step imbalance
+        ratio — runtime/anakin_loop.py flush_stats); None = nothing this
+        interval and the record carries no 'anakin' key."""
+        self._anakin = block
 
     def set_resources(self, provider) -> None:
         """Attach the resources-block provider (ISSUE 7): a callable
@@ -257,6 +270,12 @@ class TrainMetrics:
             # emission so a training pause doesn't replay stale numbers
             record["learning"] = self._learning
             self._learning = None
+        if self._anakin is not None:
+            # ONE anakin block per interval (ISSUE 8), consumed like the
+            # learning block; emitted before the sentinel pass so the
+            # shard_imbalance rule sees its own interval
+            record["anakin"] = self._anakin
+            self._anakin = None
         if self.telemetry.enabled:
             # ONE aggregated block per interval covering the whole fleet:
             # learner-local stage timers merged with the actor board's

@@ -120,6 +120,18 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
                   float(tcfg.alerts_retrace_storm), "crit"),
         AlertRule("nan", "threshold", ("learning", "nonfinite_steps"),
                   1.0, "crit"),
+        # sharded-anakin balance (ISSUE 8): max/min per-shard env-steps
+        # over the interval, measured from the blocks each shard's ring
+        # actually received. Today's lockstep program emits full blocks
+        # on every shard every segment, so this reads exactly 1.0 and
+        # the rule stays silent BY CONSTRUCTION — it is the standing
+        # guard for the compositions that can skew it (ragged/partial
+        # per-shard emission, elastic meshes with parked shards), where
+        # the lockstep program would run at the slowest shard's pace.
+        # Inactive on non-anakin runs (no block).
+        AlertRule("shard_imbalance", "threshold",
+                  ("anakin", "shard_imbalance"),
+                  tcfg.alerts_shard_imbalance, "warn"),
     )
 
 

@@ -28,7 +28,13 @@ Phases:
      ``replay_add_many`` dispatch per K blocks, background stager) vs the
      legacy per-block path — with blocks/s ingested, drain latency, and
      rate-limiter pause time from the ingestion counters, in one artifact.
-  4. **Telemetry / learning / resources A/Bs** (``--telemetry-ab`` /
+  4. **Sharded-anakin A/B** (``--sharded-anakin-ab``): the fused
+     act+train loop on a 1x1 mesh vs the same total lane count
+     partitioned across a dp-wide (CPU-emulated) mesh — per-shard lane
+     groups acting into local replay shards alongside the dp-sharded
+     learner step — with per-arm medians and the env/learner scaling
+     ratios in one artifact (``E2E_r12.json``).
+  5. **Telemetry / learning / resources A/Bs** (``--telemetry-ab`` /
      ``--learning-ab`` / ``--resources-ab``): the same e2e system with the
      respective kill switch on vs off — the < 2% overhead budgets for the
      PR-4 stage telemetry, the PR-5 fused learning diagnostics
@@ -205,6 +211,11 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         else:
             learning.update(
                 {k: v for k, v in clean.items() if v is not None})
+    # sharded-anakin evidence (ISSUE 8): the newest per-shard block (dp,
+    # lanes/shard, per-shard env steps, imbalance); absent on non-anakin
+    # runs
+    anakin = next((r["anakin"] for r in reversed(records)
+                   if r.get("anakin")), None)
     # system-health evidence (ISSUE 7): the newest resources block plus
     # the run's alert tally — proof the pillar actually flowed (or, with
     # the kill switch off, that the records carried neither key)
@@ -238,6 +249,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "records": len(records),
         "stages": stages,
         "learning": learning,
+        "anakin": anakin,
         "resources": resources,
         "alerts_present": alerts_present,
         "alerts_fired": alerts_fired,
@@ -524,12 +536,102 @@ def run_anakin_ab(seconds: float, envs_per_actor: int = 16,
     return out
 
 
+def run_sharded_anakin_ab(seconds: float, anakin_lanes: int = 1024,
+                          dp: int = 2, overrides: Optional[dict] = None,
+                          repeats: int = 3) -> dict:
+    """Sharded-anakin scaling A/B (ISSUE 8 acceptance): the fused
+    act+train loop on a 1x1 mesh vs the IDENTICAL config on a dp-wide
+    mesh — same ``anakin_lanes`` total, partitioned into per-shard lane
+    groups acting into their local replay shards while the learner runs
+    its dp-sharded step on the same mesh. Three cells:
+
+      * ``anakin_dp1``     — actor.on_device at ``anakin_lanes`` on
+        mesh.dp=1 (the PR6 fused loop at the same total lane count);
+      * ``anakin_sharded`` — the same lanes on mesh.dp=``dp``
+        (``anakin_lanes/dp`` per shard);
+      * ``anakin_dp1_half_lanes`` — mesh.dp=1 at ``anakin_lanes/dp``
+        lanes, i.e. ONE shard's group on one device: the strongest
+        single-mesh reference (a lone fused program tops out near this
+        lane count — growing it past the cache-friendly width REGRESSES
+        per-step cost, which is exactly why scaling continues through
+        shards, not lanes), and the honest denominator for the
+        weak-scaling reading.
+
+    The headline ``env_steps_ratio_sharded`` compares the equal-lane
+    arms; ``env_steps_ratio_sharded_vs_half`` quotes the sharded arm
+    against the half-lane single-mesh reference so the scaling claim
+    can never hide behind an oversized dp=1 denominator. On CPU the
+    mesh is emulated
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=N``, which
+    ``main`` sets automatically when it owns the process); the claim
+    under test — aggregate env-steps/s scaling with dp at
+    equal-or-better learner updates/s — carries to real chips, where
+    each shard owns its own silicon. Arms run INTERLEAVED ``repeats``
+    times with per-arm medians (the run_learning_ab noise treatment);
+    every cell's speeds stay in the artifact."""
+    import jax
+    if len(jax.devices()) < dp:
+        raise SystemExit(
+            f"--sharded-anakin-ab needs >= {dp} devices but only "
+            f"{len(jax.devices())} are visible; on CPU run with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={dp} "
+            "(python -m r2d2_tpu.tools.e2e_bench sets this itself when "
+            "launched as the main program)")
+    base = dict(ANAKIN_AB_OVERRIDES)
+    base.update({"actor.on_device": True,
+                 "actor.anakin_lanes": anakin_lanes})
+    base.update(overrides or {})
+    dp1_ov = dict(base, **{"mesh.dp": 1})
+    dpn_ov = dict(base, **{"mesh.dp": dp})
+    half_ov = dict(base, **{"mesh.dp": 1,
+                            "actor.anakin_lanes": anakin_lanes // dp})
+    cells = {"anakin_dp1": [], "anakin_sharded": [],
+             "anakin_dp1_half_lanes": []}
+    for _ in range(max(repeats, 1)):
+        cells["anakin_dp1"].append(run_e2e(seconds, overrides=dict(dp1_ov)))
+        cells["anakin_sharded"].append(
+            run_e2e(seconds, overrides=dict(dpn_ov)))
+        cells["anakin_dp1_half_lanes"].append(
+            run_e2e(seconds, overrides=dict(half_ov)))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {label: runs[-1] for label, runs in cells.items()}
+    out["dp"] = dp
+    out["anakin_lanes"] = anakin_lanes
+    out["repeats"] = max(repeats, 1)
+    out["env_steps_per_sec_cells"] = {
+        k: [c["env_steps_per_sec"] for c in v] for k, v in cells.items()}
+    out["learner_steps_per_sec_cells"] = {
+        k: [c["learner_steps_per_sec"] for c in v] for k, v in cells.items()}
+    out["dp1_env_steps_per_sec"] = round(
+        med("anakin_dp1", "env_steps_per_sec"), 1)
+    out["sharded_env_steps_per_sec"] = round(
+        med("anakin_sharded", "env_steps_per_sec"), 1)
+    out["dp1_learner_steps_per_sec"] = round(
+        med("anakin_dp1", "learner_steps_per_sec"), 2)
+    out["sharded_learner_steps_per_sec"] = round(
+        med("anakin_sharded", "learner_steps_per_sec"), 2)
+    out["half_lanes_env_steps_per_sec"] = round(
+        med("anakin_dp1_half_lanes", "env_steps_per_sec"), 1)
+    if out["dp1_env_steps_per_sec"] > 0:
+        out["env_steps_ratio_sharded"] = round(
+            out["sharded_env_steps_per_sec"]
+            / out["dp1_env_steps_per_sec"], 3)
+    if out["dp1_learner_steps_per_sec"] > 0:
+        out["learner_steps_ratio_sharded"] = round(
+            out["sharded_learner_steps_per_sec"]
+            / out["dp1_learner_steps_per_sec"], 3)
+    if out["half_lanes_env_steps_per_sec"] > 0:
+        out["env_steps_ratio_sharded_vs_half"] = round(
+            out["sharded_env_steps_per_sec"]
+            / out["half_lanes_env_steps_per_sec"], 3)
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
-
-    from r2d2_tpu.utils import pin_platform
-    pin_platform()
-    import jax
 
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--sweep", default="1,4,16",
@@ -559,6 +661,25 @@ def main(argv=None) -> int:
                         "(512 is this host's steps/s sweet spot; raise "
                         "replay.capacity via --override when raising this "
                         "past capacity/block_length)")
+    p.add_argument("--sharded-anakin-ab", type=int, default=0,
+                   help="1: run the e2e phase as the sharded-anakin "
+                        "scaling A/B instead — the fused act+train loop "
+                        "at --sharded-lanes on mesh.dp=1 vs the SAME "
+                        "lanes partitioned across a --sharded-dp mesh "
+                        "(CPU: emulated devices, forced automatically), "
+                        "plus a half-lane dp=1 reference arm, one "
+                        "artifact with per-arm medians and the "
+                        "env/learner scaling ratios")
+    p.add_argument("--sharded-dp", type=int, default=2,
+                   help="mesh width for the sharded-anakin A/B's dp arm")
+    p.add_argument("--sharded-lanes", type=int, default=1024,
+                   help="TOTAL lanes for the sharded-anakin A/B (both "
+                        "main arms; the reference arm runs half) — "
+                        "divisible by --sharded-dp, and the FULL count "
+                        "must stay <= capacity/block_length (the "
+                        "equal-lane dp=1 arm holds all of them on one "
+                        "ring; raise replay.capacity via --override "
+                        "when raising this)")
     p.add_argument("--telemetry-ab", type=int, default=0,
                    help="1: run the e2e phase as a telemetry on/off A/B "
                         "instead (overhead budget < 2%% env-steps/s; one "
@@ -585,6 +706,18 @@ def main(argv=None) -> int:
                    help="dotted config override key=value (repeatable)")
     args = p.parse_args(argv)
 
+    if args.sharded_anakin_ab:
+        # the emulated-mesh recipe (README "On-device acting"): the CPU
+        # platform must present >= dp devices BEFORE the backend
+        # initializes — harmless on real accelerators (the flag only
+        # shapes the host platform). argparse runs first so this can
+        # land before the jax import below.
+        from r2d2_tpu.utils.platform import force_host_device_count
+        force_host_device_count(max(args.sharded_dp, 2))
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
+    import jax
+
     overrides = {}
     for ov in args.override:
         k, _, v = ov.partition("=")
@@ -602,7 +735,12 @@ def main(argv=None) -> int:
         out["actor_sweep"] = run_actor_sweep(sweep, seconds=args.seconds,
                                              overrides=overrides)
     if args.e2e_seconds > 0:
-        if args.anakin_ab:
+        if args.sharded_anakin_ab:
+            out["e2e_sharded_anakin_ab"] = run_sharded_anakin_ab(
+                args.e2e_seconds, anakin_lanes=args.sharded_lanes,
+                dp=args.sharded_dp, overrides=overrides,
+                repeats=args.ab_repeats)
+        elif args.anakin_ab:
             out["e2e_anakin_ab"] = run_anakin_ab(
                 args.e2e_seconds, args.envs_per_actor,
                 anakin_lanes=args.anakin_lanes, overrides=overrides,

@@ -13,7 +13,9 @@ Reads what a training run leaves in ``runtime.save_dir``:
 
 On-device (anakin) runs render too: one metrics file, no heartbeat
 board, the fused ``actor/act_scan`` stage — the fleet-health panel is
-replaced by a mode tag instead of showing empty.
+replaced by a mode tag instead of showing empty; a dp-sharded run adds
+one row per shard (env steps / episodes / return sums) from the
+record's ``anakin`` block.
 
 Dashboard mode tails the records and redraws one screen per interval —
 run it in a second terminal against a live soak. Export mode
@@ -86,6 +88,9 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None
     if on_device:
         ingest = "mode: on-device (anakin, fused act+train)   " + ingest
     lines.append(ingest + ("   health: " + " ".join(health) if health else ""))
+    an = record.get("anakin")
+    if an:
+        lines.append(render_anakin(an))
     lb = record.get("learning")
     if lb:
         lines.append("")
@@ -119,6 +124,35 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None
         lines.append(f"host rank {row.get('rank')}: {n} stages at "
                      f"t={row.get('t', 0):.1f}s "
                      f"(telemetry_host{row.get('rank')}.jsonl)")
+    return "\n".join(lines)
+
+
+def render_anakin(an: dict) -> str:
+    """The sharded-anakin composition panel (ISSUE 8): one row per
+    shard (env steps, episodes, return sums this interval) plus the
+    env-step imbalance ratio the shard_imbalance alert watches."""
+    imb = an.get("shard_imbalance")
+    head = (f"anakin mesh: dp={an.get('dp')} "
+            f"lanes/shard={an.get('lanes_per_shard')}"
+            + (f"  imbalance={imb:.2f}" if imb is not None else ""))
+    lines = [head]
+    env = an.get("shard_env_steps") or []
+    eps = an.get("shard_episodes") or []
+    rep = an.get("shard_reported_episodes") or []
+    ret = an.get("shard_return_sum") or []
+
+    def at(seq, i):
+        return seq[i] if i < len(seq) else None
+
+    for i, steps in enumerate(env):
+        bits = [f"  shard {i}: env-steps={steps}"]
+        if at(eps, i) is not None:
+            bits.append(f"episodes={eps[i]}")
+        if at(rep, i) is not None:
+            bits.append(f"reported={rep[i]}")
+        if at(ret, i) is not None:
+            bits.append(f"return-sum={ret[i]:.2f}")
+        lines.append(" ".join(bits))
     return "\n".join(lines)
 
 

@@ -190,11 +190,13 @@ def test_config_validates_on_device_preconditions():
 
 def _drive_parity(spec: ReplaySpec, n_segments: int, ep_blocks: int,
                   num_lanes: int = 2, seed: int
-                  = 0):
+                  = 0, td_priority: bool = False):
     """Feed IDENTICAL synthetic transition streams to the host LocalBuffer
     (add/finish per lane) and the device assembler (emit_blocks per
     segment, tails carried), returning (host_blocks[lane][seg],
-    device_blocks[seg], terminals[seg])."""
+    device_blocks[seg], terminals[seg]). ``td_priority`` feeds BOTH
+    sides the same synthetic Q streams (per-step rows + the segment-end
+    bootstrap) and runs the device assembler in priority="td" mode."""
     rng = np.random.default_rng(seed)
     n, l_seg = num_lanes, spec.block_length
     h = w = spec.frame_height
@@ -225,25 +227,36 @@ def _drive_parity(spec: ReplaySpec, n_segments: int, ep_blocks: int,
         terminal = np.full((n,), ((seg + 1) % ep_blocks) == 0)
         reset_obs = rng.integers(0, 255, (n, h, w)).astype(np.uint8)
         ep_ret = ep_ret + rewards.sum(axis=1)
+        qs = rng.normal(size=(n, l_seg, a_dim)).astype(np.float32)
+        q_boot = rng.normal(size=(n, a_dim)).astype(np.float32)
 
         for i in range(n):
             for t in range(l_seg):
                 lbs[i].add(int(actions[i, t]), float(rewards[i, t]),
-                           obs[i, t], np.zeros(a_dim, np.float32),
+                           obs[i, t],
+                           qs[i, t] if td_priority
+                           else np.zeros(a_dim, np.float32),
                            hiddens[i, t])
             if terminal[i]:
                 host_blocks[i].append(lbs[i].finish(None))
                 lbs[i].reset(reset_obs[i])
             else:
-                host_blocks[i].append(
-                    lbs[i].finish(np.zeros(a_dim, np.float32)))
+                host_blocks[i].append(lbs[i].finish(
+                    q_boot[i] if td_priority
+                    else np.zeros(a_dim, np.float32)))
 
         blocks, tails = emit_blocks(
-            spec, gamma, 1.0, *[jnp.asarray(x) for x in tails],
+            spec, gamma, "td" if td_priority else 1.0,
+            *[jnp.asarray(x) for x in tails],
             jnp.asarray(obs), jnp.asarray(actions), jnp.asarray(rewards),
             jnp.asarray(hiddens), jnp.asarray(terminal),
             jnp.asarray(ep_ret), jnp.ones(n, bool), jnp.asarray(reset_obs),
-            seg + 100)
+            seg + 100,
+            # the act builder zeroes the bootstrap on terminal lanes
+            # (LocalBuffer.finish(None)); the direct driver does it here
+            q_seg=jnp.asarray(qs),
+            q_boot=jnp.asarray(np.where(terminal[:, None], 0.0, q_boot)
+                               .astype(np.float32)))
         tails = [np.asarray(x) for x in tails]
         dev_blocks.append(jax.tree_util.tree_map(np.asarray, blocks))
         terminals.append(terminal)
@@ -304,6 +317,79 @@ def test_emit_blocks_zero_burn_in():
             np.testing.assert_array_equal(db.burn_in_steps,
                                           hb.burn_in_steps)
             np.testing.assert_allclose(db.reward, hb.reward, atol=2e-5)
+
+
+# ---- TD initial-priority mode (ISSUE 8 satellite) ------------------------
+
+
+def test_emit_blocks_td_priority_matches_local_buffer():
+    """priority="td": the in-graph n-step TD seeding reproduces the host
+    assembler's initial_priorities + eta-mix per sequence, across
+    segments spanning burn-in carry AND episode resets — while every
+    other field stays parity-exact."""
+    cfg = small_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    host_blocks, dev_blocks, _ = _drive_parity(
+        spec, n_segments=4, ep_blocks=2, td_priority=True)
+    for seg in range(4):
+        for i in range(2):
+            hb = host_blocks[i][seg]
+            db = jax.tree_util.tree_map(lambda x: x[i], dev_blocks[seg])
+            np.testing.assert_allclose(db.priority, hb.priority,
+                                       atol=2e-4, rtol=1e-4)
+            np.testing.assert_array_equal(db.obs_row, hb.obs_row)
+            np.testing.assert_allclose(db.reward, hb.reward, atol=2e-5)
+    # the estimates actually rank: not one constant stamp
+    prios = np.concatenate([np.asarray(b.priority).reshape(-1)
+                            for b in dev_blocks])
+    assert np.unique(np.round(prios, 5)).size > 1
+
+
+def test_act_scan_td_priority_only_changes_priorities():
+    """The td-mode acting program draws the SAME RNG chain as the
+    constant-stamp program (the extra bootstrap forward is
+    deterministic), so from identical carries every emitted field
+    matches except the priorities — which become varying, finite,
+    non-negative TD estimates."""
+    cfg = small_cfg()
+    n = 3
+    env, spec, net, params, act_const, _ = _make_act(cfg, n)
+    eps = [apex_epsilon(i, n, cfg.actor.base_eps, cfg.actor.eps_alpha)
+           for i in range(n)]
+    act_td = make_anakin_act(
+        env, net, spec, num_lanes=n, epsilons=eps, gamma=cfg.optim.gamma,
+        priority="td", near_greedy_eps=cfg.actor.near_greedy_eps,
+        priority_eta=cfg.optim.priority_eta)
+    carry_c = init_act_carry(env, spec, n, jax.random.PRNGKey(1))
+    carry_t = init_act_carry(env, spec, n, jax.random.PRNGKey(1))
+    for wv in (1, 2):    # segment 2 crosses the episode boundary
+        carry_c, blocks_c, _ = act_const(params, carry_c, np.int32(wv))
+        carry_t, blocks_t, _ = act_td(params, carry_t, np.int32(wv))
+        for name in blocks_c.__dataclass_fields__:
+            a = np.asarray(getattr(blocks_c, name))
+            b = np.asarray(getattr(blocks_t, name))
+            if name == "priority":
+                assert (a == cfg.actor.anakin_priority).all()
+                assert np.isfinite(b).all() and (b >= 0).all()
+                assert np.unique(np.round(b, 6)).size > 1
+            else:
+                np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_td_priority_config_knob():
+    cfg = small_cfg(**{"actor.anakin_priority": "td"})
+    again = Config.from_dict(json.loads(cfg.to_json()))
+    assert again.actor.anakin_priority == "td"
+    with pytest.raises(ValueError, match="anakin_priority"):
+        small_cfg(**{"actor.anakin_priority": "tdx"})
+    # CLI coercion of the union knob: numeric -> float, "td" -> str
+    from r2d2_tpu.config import parse_overrides
+    assert parse_overrides(
+        Config(), ["--actor.anakin_priority=td"]
+    ).actor.anakin_priority == "td"
+    assert parse_overrides(
+        Config(), ["--actor.anakin_priority=0.5"]
+    ).actor.anakin_priority == 0.5
 
 
 # ---- the fused acting scan ----------------------------------------------

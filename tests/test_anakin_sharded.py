@@ -1,0 +1,342 @@
+"""Sharded-anakin composition tests (ISSUE 8): the fused act+train loop
+across a dp-wide (emulated) mesh — replay-state identity against the
+per-shard sequential reference, per-shard RNG independence, the global
+ε-ladder layout, the relaxed mesh validation + config round-trip, the
+composed loop end to end with the per-shard telemetry block, the
+shard_imbalance alert rule, and (slow) the gridworld learnability slice
+under dp=2.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config, apex_epsilon
+from r2d2_tpu.envs.factory import create_jax_env
+from r2d2_tpu.models.network import NetworkApply
+from r2d2_tpu.replay.structs import ReplaySpec
+
+
+def sharded_cfg(**overrides) -> Config:
+    cfg = Config().replace(**{
+        "env.game_name": "Fake",
+        "env.frame_height": 12, "env.frame_width": 12, "env.frame_stack": 2,
+        "env.episode_len": 40,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2),),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "actor.on_device": True, "actor.anakin_lanes": 4,
+        "mesh.dp": 2,
+        "runtime.save_interval": 0,
+    })
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def _build_sharded(cfg: Config):
+    from r2d2_tpu.parallel import (init_sharded_act_carry, make_mesh,
+                                   make_sharded_anakin_act,
+                                   sharded_replay_init)
+    spec = ReplaySpec.from_config(cfg)
+    env = create_jax_env(cfg.env)
+    net = NetworkApply(env.action_dim, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    params = net.init(jax.random.PRNGKey(0))
+    mesh = make_mesh(cfg.mesh)
+    n = cfg.actor.anakin_lanes
+    eps = [apex_epsilon(i, n, cfg.actor.base_eps, cfg.actor.eps_alpha)
+           for i in range(n)]
+    act = make_sharded_anakin_act(
+        env, net, spec, mesh=mesh, num_lanes=n, epsilons=eps,
+        gamma=cfg.optim.gamma, priority=cfg.actor.anakin_priority,
+        near_greedy_eps=cfg.actor.near_greedy_eps)
+    key = jax.random.PRNGKey(1)
+    carry = init_sharded_act_carry(env, spec, n, mesh, key)
+    replay = sharded_replay_init(spec, mesh)
+    return env, spec, net, params, mesh, eps, act, carry, replay, key
+
+
+# ---- config: the relaxed mesh check --------------------------------------
+
+
+def test_config_accepts_dp_mesh_and_roundtrips():
+    """on_device + mesh.dp>1 is now a VALID pairing (the PR6 loop
+    rejected any non-1x1 mesh); the knobs round-trip through JSON."""
+    cfg = sharded_cfg()
+    assert cfg.actor.on_device and cfg.mesh.dp == 2
+    again = Config.from_dict(json.loads(cfg.to_json()))
+    assert again.actor.on_device and again.mesh.dp == 2
+    assert again.actor.anakin_lanes == 4
+
+
+def test_config_validates_lane_shard_contracts():
+    # divisibility at CONFIG time, not trace time
+    with pytest.raises(ValueError, match="divisible by mesh.dp"):
+        sharded_cfg(**{"actor.anakin_lanes": 5})
+    # the scatter-alias bound is per SHARD under a dp mesh: 80 lanes /
+    # dp=2 = 40 per shard == num_blocks passes, 41 per shard fails
+    ok = sharded_cfg(**{"actor.anakin_lanes": 80})
+    assert ok.actor.anakin_lanes // ok.mesh.dp == ok.num_blocks
+    with pytest.raises(ValueError, match="num_blocks"):
+        sharded_cfg(**{"actor.anakin_lanes": 82})
+    # model parallelism stays rejected, naming the knob to flip
+    with pytest.raises(ValueError, match="data-parallel"):
+        sharded_cfg(**{"mesh.mp": 2, "mesh.dp": 1})
+
+
+def test_loop_validates_resolved_dp_contracts():
+    """mesh.dp=-1 resolves at runtime — the loop re-checks divisibility
+    against the resolved width with the knob named in the error."""
+    from r2d2_tpu.runtime.anakin_loop import run_anakin_train
+    cfg = sharded_cfg(**{"mesh.dp": -1, "actor.anakin_lanes": 9})
+    if 9 % len(jax.devices()) == 0:   # pragma: no cover - 8-device suite
+        pytest.skip("9 lanes divide evenly across this device count")
+    with pytest.raises(ValueError, match="resolved mesh.dp"):
+        run_anakin_train(cfg, max_training_steps=1, max_seconds=5)
+
+
+# ---- replay-state identity + RNG independence ----------------------------
+
+
+def test_sharded_replay_identity_with_per_shard_sequential_adds():
+    """The ONE sharded dispatch (act + local ring-write per shard) lands
+    bit-identical replay contents to the reference construction: each
+    shard's lane group run through the single-mesh act path (same
+    fold_in(key, shard) chain, same GLOBAL ε-ladder slice) with its
+    blocks added sequentially to a standalone replay state."""
+    from r2d2_tpu.actor.anakin import init_act_carry, make_anakin_act
+    from r2d2_tpu.replay.device_replay import replay_add_many, replay_init
+    cfg = sharded_cfg()
+    (env, spec, net, params, mesh, eps, act, carry, replay,
+     key) = _build_sharded(cfg)
+    dp, n = 2, cfg.actor.anakin_lanes
+    lps = n // dp
+    n_segments = 3     # spans an episode boundary (40 = 2 x 20)
+    for seg in range(n_segments):
+        carry, replay, stats = act(params, carry, replay,
+                                   np.int32(seg + 1))
+    glob = jax.device_get(replay)
+
+    for s in range(dp):
+        act1 = make_anakin_act(
+            env, net, spec, num_lanes=lps,
+            epsilons=eps[s * lps:(s + 1) * lps], gamma=cfg.optim.gamma,
+            priority=cfg.actor.anakin_priority,
+            near_greedy_eps=cfg.actor.near_greedy_eps)
+        c1 = init_act_carry(env, spec, lps, jax.random.fold_in(key, s))
+        ref = replay_init(spec)
+        for seg in range(n_segments):
+            c1, blocks, _ = act1(params, c1, np.int32(seg + 1))
+            ref = replay_add_many(spec, ref, blocks)
+        ref = jax.device_get(ref)
+        for name in glob.__dataclass_fields__:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(glob, name))[s],
+                np.asarray(getattr(ref, name)),
+                err_msg=f"shard {s} field {name}")
+
+
+def test_per_shard_rng_independence():
+    """Shards explore independently: with identical lane counts and the
+    same params, the two shards' stored experience must differ — env
+    schedules (obs rows) AND action streams (fold_in(key, shard) chains,
+    not one chain replicated)."""
+    cfg = sharded_cfg()
+    (env, spec, net, params, mesh, eps, act, carry, replay,
+     key) = _build_sharded(cfg)
+    carry, replay, _ = act(params, carry, replay, np.int32(1))
+    glob = jax.device_get(replay)
+    obs = np.asarray(glob.obs)
+    actions = np.asarray(glob.action)
+    lps = cfg.actor.anakin_lanes // 2
+    assert not np.array_equal(obs[0, :lps], obs[1, :lps])
+    assert not np.array_equal(actions[0, :lps], actions[1, :lps])
+    # and lanes WITHIN a shard differ too (per-lane env keys)
+    assert not np.array_equal(obs[0, 0], obs[0, 1])
+
+
+# ---- global ε-ladder layout ----------------------------------------------
+
+
+def test_epsilon_ladder_spans_global_lanes():
+    """The Ape-X ladder covers the GLOBAL lane count: with 4 lanes over
+    2 shards, the two near-greedy lanes (ε <= near_greedy_eps) are BOTH
+    in shard 1 — a per-shard ladder would put one reporter in each
+    shard. Asserted through the per-shard reported-episode counts at the
+    episode-boundary segment."""
+    cfg = sharded_cfg()
+    n = cfg.actor.anakin_lanes
+    eps = [apex_epsilon(i, n, cfg.actor.base_eps, cfg.actor.eps_alpha)
+           for i in range(n)]
+    report = [e <= cfg.actor.near_greedy_eps for e in eps]
+    assert report == [False, False, True, True]   # the global layout
+    (env, spec, net, params, mesh, _, act, carry, replay,
+     key) = _build_sharded(cfg)
+    carry, replay, _ = act(params, carry, replay, np.int32(1))
+    carry, replay, stats = act(params, carry, replay, np.int32(2))
+    stats = jax.device_get(stats)
+    assert stats["episodes"].tolist() == [2, 2]
+    assert stats["reported_episodes"].tolist() == [0, 2]
+    assert float(stats["reported_return_sum"][0]) == 0.0
+    assert stats["env_steps"].tolist() == [40, 40]
+
+
+# ---- the composed loop ---------------------------------------------------
+
+
+def test_sharded_anakin_loop_trains_end_to_end(tmp_path):
+    """The composed path through orchestrator.train: per-shard acting
+    fills the dp-sharded replay, the gate opens, the dp-sharded learner
+    trains, and the records carry the per-shard anakin block with a
+    balanced imbalance ratio."""
+    from r2d2_tpu.runtime.orchestrator import train
+    cfg = sharded_cfg(**{
+        "replay.capacity": 400, "replay.learning_starts": 60,
+        "actor.anakin_lanes": 4, "env.episode_len": 20,
+        "replay.block_length": 10, "replay.batch_size": 4,
+        "runtime.save_dir": str(tmp_path), "runtime.log_interval": 0.2,
+    })
+    records = []
+    stacks = train(cfg, max_training_steps=6, max_seconds=180,
+                   log_fn=records.append)
+    lr = stacks[0].learner
+    assert lr.training_steps >= 6
+    assert lr.mesh is not None and lr.mesh.shape["dp"] == 2
+    assert lr.env_steps >= cfg.replay.learning_starts
+    an = next((r["anakin"] for r in records if r.get("anakin")), None)
+    assert an is not None
+    assert an["dp"] == 2 and an["lanes_per_shard"] == 2
+    assert len(an["shard_env_steps"]) == 2
+    assert an["shard_imbalance"] == 1.0   # lockstep lane groups
+    # the sentinel saw the block and stayed quiet
+    alerts = [a["rule"] for r in records
+              for a in (r.get("alerts") or {}).get("fired") or []]
+    assert "shard_imbalance" not in alerts
+
+
+def test_dp1_loop_emits_single_shard_anakin_block(tmp_path):
+    """The 1x1-mesh fused loop reports the same block shape with one
+    row, so inspectors and the alert rule read both compositions."""
+    from r2d2_tpu.runtime.anakin_loop import run_anakin_train
+    cfg = sharded_cfg(**{
+        "mesh.dp": 1,
+        "replay.capacity": 400, "replay.learning_starts": 60,
+        "actor.anakin_lanes": 2, "env.episode_len": 20,
+        "replay.block_length": 10, "replay.batch_size": 4,
+        "runtime.save_dir": str(tmp_path), "runtime.log_interval": 0.2,
+    })
+    records = []
+    run_anakin_train(cfg, max_training_steps=4, max_seconds=120,
+                     log_fn=records.append)
+    an = next((r["anakin"] for r in records if r.get("anakin")), None)
+    assert an is not None and an["dp"] == 1
+    assert len(an["shard_env_steps"]) == 1
+    assert an["shard_imbalance"] == 1.0
+
+
+# ---- the shard_imbalance alert rule --------------------------------------
+
+
+def test_shard_imbalance_alert_rule():
+    from r2d2_tpu.telemetry.alerts import AlertEngine, default_rules
+    t = Config().telemetry
+    eng = AlertEngine(default_rules(t))
+    by_name = {r.name: r for r in eng.rules}
+    rule = by_name["shard_imbalance"]
+    assert rule.path == ("anakin", "shard_imbalance")
+    assert rule.bound == t.alerts_shard_imbalance
+    # balanced interval: quiet; no block at all (host runs): quiet
+    assert eng.evaluate({"anakin": {"shard_imbalance": 1.0}})["fired"] == []
+    assert eng.evaluate({})["fired"] == []
+    # a skewed interval fires once, then holds while the skew persists
+    out = eng.evaluate({"anakin": {"shard_imbalance": 2.0}})
+    assert [a["rule"] for a in out["fired"]] == ["shard_imbalance"]
+    out = eng.evaluate({"anakin": {"shard_imbalance": 2.0}})
+    assert out["fired"] == [] and "shard_imbalance" in out["active"]
+
+
+def test_shard_imbalance_knob_validated():
+    with pytest.raises(ValueError, match="alerts_shard_imbalance"):
+        Config().replace(**{"telemetry.alerts_shard_imbalance": 1.0})
+    cfg = Config().replace(**{"telemetry.alerts_shard_imbalance": 2.5})
+    again = Config.from_dict(json.loads(cfg.to_json()))
+    assert again.telemetry.alerts_shard_imbalance == 2.5
+    # pre-PR8 serialized configs load with the default
+    d = Config().to_dict()
+    d["telemetry"].pop("alerts_shard_imbalance")
+    assert Config.from_dict(d).telemetry.alerts_shard_imbalance == 1.5
+
+
+# ---- learnability under the sharded composition (slow) -------------------
+
+GRID_TRAIN_STEPS = 2000
+
+
+def _grid_cfg(save_dir: str) -> Config:
+    return Config().replace(**{
+        "env.game_name": "Grid", "env.grid_size": 5,
+        "env.frame_height": 20, "env.frame_width": 20,
+        "env.frame_stack": 2, "env.episode_len": 40,
+        "network.hidden_dim": 32, "network.cnn_out_dim": 64,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 32_000, "replay.block_length": 40,
+        "replay.batch_size": 16, "replay.learning_starts": 2_000,
+        "replay.max_env_steps_per_train_step": 16.0,
+        "actor.on_device": True, "actor.anakin_lanes": 32,
+        "mesh.dp": 2,
+        "optim.lr": 1e-3, "optim.gamma": 0.99,
+        "runtime.save_interval": 0, "runtime.log_interval": 8.0,
+        "runtime.save_dir": save_dir,
+    })
+
+
+def _grid_train(save_dir: str) -> dict:
+    from r2d2_tpu.runtime.anakin_loop import run_anakin_train
+    records = []
+    stacks = run_anakin_train(_grid_cfg(save_dir),
+                              max_training_steps=GRID_TRAIN_STEPS,
+                              max_seconds=600, log_fn=records.append)
+    returns = [r["avg_episode_return"] for r in records
+               if r.get("avg_episode_return") is not None]
+    return {"training_steps": int(stacks[0].learner.training_steps),
+            "returns": returns}
+
+
+@pytest.mark.slow
+def test_grid_learnability_under_sharded_loop(tmp_path):
+    """The jitted gridworld still LEARNS when the fused loop is sharded
+    dp=2: per-shard exploration feeding per-shard replay trains one
+    (replicated) policy whose behavior return grows several-fold.
+    Subprocess on a 2-device CPU platform (the dp=2 mesh, no more — the
+    suite's 8-device pin triples single-core wall time)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["training_steps"] >= GRID_TRAIN_STEPS
+    returns = result["returns"]
+    assert len(returns) >= 2, returns
+    early, late = returns[0], returns[-1]
+    assert late >= max(3.0 * early, early + 0.3), returns
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from r2d2_tpu.utils.platform import pin_platform
+    pin_platform()
+    print(json.dumps(_grid_train(sys.argv[1])))
