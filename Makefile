@@ -40,13 +40,29 @@
 #                schema stability); the slow chaos-driven e2e slices
 #                (injected hang → actor_stall alert) run with the full
 #                tier.
-#   make regress — the bench regression gate: tools/regress.py compares
-#                the tree's E2E_*/BENCH_* artifacts against
-#                BASELINE.json's 'bench' snapshot (per-metric noise
-#                tolerances; exit 1 on any regression).
+#   make costmodel — the fast-tier cost-model/roofline suite
+#                (tests/test_costmodel.py: XLA cost-table extraction
+#                across step factories incl. a sharded emulated-mesh
+#                program, named_scope presence in lowered HLO,
+#                traceparse on the checked-in miniature trace, roofline
+#                report + analytic golden file, the costs gate, record
+#                schema stability under the kill switch).
+#   make regress — the regression gate: tools/regress.py compares the
+#                tree's E2E_*/BENCH_* artifacts against BASELINE.json's
+#                'bench' snapshot (per-metric noise tolerances) AND the
+#                freshly recomputed XLA cost table against its 'costs'
+#                snapshot (exact match — compute regressions fail even
+#                on wall-clock-noisy hosts); exit 1 on any failure.
+#   make costs — write the per-program XLA cost table to COSTS.json
+#                (telemetry/costmodel.py, CPU-pinned 2-device mesh).
+#   make roofline — generate the roofline report (JSON + table) into
+#                ROOFLINE.json: per-component flops/bytes/arithmetic
+#                intensity/%-of-peak + the serial-chain model
+#                (tools/roofline.py; gate preset on CPU, reference
+#                shape on TPU).
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
-	regress check-fast-markers
+	costmodel regress costs roofline check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -75,9 +91,21 @@ sentinel: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_sentinel.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+costmodel: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
 regress:
 	JAX_PLATFORMS=cpu python -m r2d2_tpu.tools.regress \
 	    --baseline BASELINE.json --dir .
+
+costs:
+	JAX_PLATFORMS=cpu python -m r2d2_tpu.telemetry.costmodel \
+	    --out COSTS.json
+
+roofline:
+	JAX_PLATFORMS=cpu python -m r2d2_tpu.tools.roofline \
+	    --out ROOFLINE.json
 
 # One guard per suite: module:marker:min-collected:label (marker spelled
 # with underscores for spaces). A stray @pytest.mark.slow (or a marker
@@ -90,7 +118,8 @@ FAST_MARKER_CHECKS := \
 	tests/test_learning_diag.py:not_slow:12:learning-diagnostics \
 	tests/test_anakin.py:not_slow:10:anakin \
 	tests/test_anakin_sharded.py:not_slow:8:anakin-sharded \
-	tests/test_sentinel.py:not_slow:20:sentinel
+	tests/test_sentinel.py:not_slow:20:sentinel \
+	tests/test_costmodel.py:not_slow:10:cost-model
 
 check-fast-markers:
 	@for spec in $(FAST_MARKER_CHECKS); do \

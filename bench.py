@@ -146,25 +146,15 @@ def model_flops_per_step(cfg, action_dim: int, use_double: bool) -> float:
     """Analytic model FLOPs for one train step (fwd + bwd ~= 3x fwd MACs*2),
     counting the conv torso, FC, LSTM, and head matmuls over the full
     (batch x seq_window) unroll. Elementwise/decode/Adam FLOPs are noise
-    against these and are not counted."""
-    net, env = cfg.network, cfg.env
-    h, w, c = env.frame_height, env.frame_width, env.frame_stack
-    macs = 0.0
-    for features, kernel, stride in net.conv_layers:
-        h = (h - kernel) // stride + 1
-        w = (w - kernel) // stride + 1
-        macs += h * w * features * kernel * kernel * c
-        c = features
-    macs += h * w * c * net.cnn_out_dim                       # FC
-    lstm_in = net.cnn_out_dim + action_dim
-    macs += 4 * net.hidden_dim * (lstm_in + net.hidden_dim)   # LSTM gates
-    macs += net.hidden_dim * net.hidden_dim + net.hidden_dim * action_dim
-    if net.use_dueling:
-        macs += net.hidden_dim * net.hidden_dim + net.hidden_dim
-    per_token = 2.0 * macs                                    # FLOPs = 2*MACs
-    tokens = cfg.replay.batch_size * cfg.sequence.seq_len
-    unrolls = 3.0 + (1.0 if use_double else 0.0)              # fwd+bwd (+target fwd)
-    return per_token * tokens * unrolls
+    against these and are not counted.
+
+    The math lives in telemetry/costmodel.py (ONE source for this count,
+    the roofline tool, and the cost-regression gate), reconciled against
+    XLA ``cost_analysis()`` there: the first conv's input gradient is
+    never computed (obs needs no grad — XLA DCEs it), which the pre-PR9
+    count here overstated by 5-7% at the reference shape."""
+    from r2d2_tpu.telemetry.costmodel import model_flops_per_step as _mfps
+    return _mfps(cfg, action_dim, use_double)
 
 
 def make_synthetic_block(spec, rng):

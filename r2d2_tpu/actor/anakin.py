@@ -139,6 +139,18 @@ def emit_blocks(spec: ReplaySpec, gamma: float, priority,
     The timeline of block row position ``i`` is ``frames_all[i]`` where
     ``frames_all = tail ++ segment`` — right-aligned tails make the
     offset a single per-lane constant ``B - burn0`` (see ActCarry)."""
+    with jax.named_scope("emit_blocks"):
+        return _emit_blocks_body(
+            spec, gamma, priority, tail_frames, tail_la, tail_hidden, burn0,
+            obs, actions, rewards, hiddens, terminal, final_return,
+            report_mask, reset_obs, weight_version, q_seg=q_seg,
+            q_boot=q_boot, priority_eta=priority_eta)
+
+
+def _emit_blocks_body(spec, gamma, priority, tail_frames, tail_la,
+                      tail_hidden, burn0, obs, actions, rewards, hiddens,
+                      terminal, final_return, report_mask, reset_obs,
+                      weight_version, *, q_seg, q_boot, priority_eta):
     n, l_seg = actions.shape
     b, f, lrn = spec.burn_in, spec.forward, spec.learning
     s, stack = spec.seqs_per_block, spec.frame_stack
@@ -243,7 +255,7 @@ def emit_blocks(spec: ReplaySpec, gamma: float, priority,
 
 def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
                   num_lanes: int, gamma: float, priority,
-                  priority_eta: float = 0.9) -> Callable:
+                  priority_eta: float = 0.9, unroll: int = 1) -> Callable:
     """The traceable acting segment, parameterized by per-lane arrays:
 
         core(params, carry, weight_version, eps, report)
@@ -254,7 +266,14 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
     the SAME core serves both compositions: ``make_anakin_act`` closes
     over the full static ladder (the 1x1-mesh path), and the dp-sharded
     program (parallel/sharded.py make_sharded_anakin_act) feeds each
-    shard its slice of the GLOBAL ladder inside shard_map."""
+    shard its slice of the GLOBAL ladder inside shard_map.
+
+    ``unroll`` feeds the acting scan's ``lax.scan(..., unroll=)``:
+    identical math (parity-tested), >1 trades compile time for fewer
+    loop-iteration boundaries. ``unroll=block_length`` is also how the
+    cost model (telemetry/costmodel.py) builds its fully-unrolled twin —
+    XLA's cost analysis counts a while-loop body once, so only the
+    unrolled program's FLOP count reflects executed acting work."""
     td_priority = isinstance(priority, str)
     if td_priority and priority != "td":
         raise ValueError(f"priority must be a positive float or 'td', "
@@ -282,29 +301,34 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
         # per lane per step if left inside the scan).
         k_seg, k_run = jax.random.split(carry.key)
         carry = carry.replace(key=k_run)
-        reset_state, reset_obs = jax.vmap(env.reset)(
-            jax.random.split(k_seg, num_lanes))
-        reset_obs = jnp.asarray(reset_obs, jnp.uint8)
+        with jax.named_scope("env_reset"):
+            reset_state, reset_obs = jax.vmap(env.reset)(
+                jax.random.split(k_seg, num_lanes))
+            reset_obs = jnp.asarray(reset_obs, jnp.uint8)
 
         def body(c: ActCarry, _):
             key, k_eps, k_expl, k_env = jax.random.split(c.key, 4)
             # policy forward: T=1 window over the normalized frame stack
-            # (the BatchedActorPolicy's step, traced into the scan)
-            stacked = (c.cur_stack.astype(jnp.float32)
-                       / np.float32(255.0)).transpose(0, 2, 3, 1)
-            la_1h = jax.nn.one_hot(c.last_action, action_dim,
-                                   dtype=jnp.float32)
-            q, hid = net.module.apply(params, stacked[:, None],
-                                      la_1h[:, None], c.hidden)
-            greedy = jnp.argmax(q[:, 0], axis=-1).astype(jnp.int32)
-            explore = jax.random.uniform(k_eps, (num_lanes,)) < eps
-            randa = jax.random.randint(k_expl, (num_lanes,), 0, action_dim,
-                                       jnp.int32)
-            action = jnp.where(explore, randa, greedy)
+            # (the BatchedActorPolicy's step, traced into the scan);
+            # "act_forward" scopes the ε-greedy selection — the network
+            # itself carries its own torso/lstm/head component scopes
+            with jax.named_scope("act_forward"):
+                stacked = (c.cur_stack.astype(jnp.float32)
+                           / np.float32(255.0)).transpose(0, 2, 3, 1)
+                la_1h = jax.nn.one_hot(c.last_action, action_dim,
+                                       dtype=jnp.float32)
+                q, hid = net.module.apply(params, stacked[:, None],
+                                          la_1h[:, None], c.hidden)
+                greedy = jnp.argmax(q[:, 0], axis=-1).astype(jnp.int32)
+                explore = jax.random.uniform(k_eps, (num_lanes,)) < eps
+                randa = jax.random.randint(k_expl, (num_lanes,), 0,
+                                           action_dim, jnp.int32)
+                action = jnp.where(explore, randa, greedy)
 
-            es, obs, reward, done = jax.vmap(env.step)(
-                c.env_state, action, jax.random.split(k_env, num_lanes))
-            obs = jnp.asarray(obs, jnp.uint8)
+            with jax.named_scope("env_step"):
+                es, obs, reward, done = jax.vmap(env.step)(
+                    c.env_state, action, jax.random.split(k_env, num_lanes))
+                obs = jnp.asarray(obs, jnp.uint8)
             reward = reward.astype(jnp.float32)
             rolled = jnp.concatenate([c.cur_stack[:, 1:], obs[:, None]],
                                      axis=1)
@@ -322,7 +346,8 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
             return c, y
 
         out_carry, ys = jax.lax.scan(body, carry, None,
-                                     length=spec.block_length)
+                                     length=spec.block_length,
+                                     unroll=unroll)
         # auto-reset where the segment's last step ended the episode: the
         # step's y already recorded the TRUE terminal obs; the carry
         # restarts from envs/vector.py's reset state (duplicated initial
@@ -388,7 +413,7 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
 def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
                     num_lanes: int, epsilons, gamma: float,
                     priority, near_greedy_eps: float,
-                    priority_eta: float = 0.9) -> Callable:
+                    priority_eta: float = 0.9, unroll: int = 1) -> Callable:
     """Build the jitted acting segment (1x1-mesh composition):
 
         act(params, carry, weight_version) -> (carry, blocks, stats)
@@ -413,7 +438,8 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
     eps = jnp.asarray(eps_list, jnp.float32)
     report = np.asarray([e <= near_greedy_eps for e in eps_list])
     core = make_act_core(env, net, spec, num_lanes=num_lanes, gamma=gamma,
-                         priority=priority, priority_eta=priority_eta)
+                         priority=priority, priority_eta=priority_eta,
+                         unroll=unroll)
 
     def act(params, carry: ActCarry, weight_version):
         # the static ladder constant-folds into the program — the dp=1

@@ -429,6 +429,17 @@ class TelemetryConfig:
     # Post-warm-up retraces within one log interval at/above this count
     # fire retrace_storm.
     alerts_retrace_storm: int = 3
+    # -- cost model & roofline (ISSUE 9) --
+    # Kill switch for the periodic record's one-shot 'costs' block: the
+    # analytic per-component (torso/lstm/head/sum-tree/replay) FLOPs +
+    # bytes summary of the configured train step, attached by the
+    # Learner at its first metrics flush (pure config math — no compile,
+    # no device work). Off (or with the master `enabled` off) the record
+    # schema is byte-identical to pre-PR9. The offline XLA cost tools
+    # (`make costs` / `make roofline` / the `make regress` costs gate —
+    # telemetry/costmodel.py, tools/roofline.py) are unaffected: they
+    # run out-of-process against the config, not the live run.
+    costmodel_enabled: bool = True
     # Sharded-anakin balance: max/min per-shard ingested env-steps over
     # the log interval (the record's anakin.shard_imbalance) at/above
     # this ratio fires shard_imbalance. Today's lockstep fused program

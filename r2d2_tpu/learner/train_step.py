@@ -89,13 +89,14 @@ def _decode_inputs(net: NetworkApply, spec: ReplaySpec, batch: SampleBatch,
     obs intermediate that XLA would cast at the conv boundary anyway
     (PERF.md profile: that transpose+cast copy was ~2.5 ms/step)."""
     from r2d2_tpu.ops.pallas_kernels import stack_frames
-    stacked = stack_frames(batch.obs, spec.seq_window, spec.frame_stack,
-                           use_pallas=use_pallas,
-                           out_dtype=net.module.compute_dtype,
-                           out_height=spec.frame_height,
-                           out_width=spec.frame_width, nhwc=nhwc)
-    last_action = jax.nn.one_hot(batch.last_action, net.action_dim,
-                                 dtype=jnp.float32)
+    with jax.named_scope("obs_decode"):
+        stacked = stack_frames(batch.obs, spec.seq_window, spec.frame_stack,
+                               use_pallas=use_pallas,
+                               out_dtype=net.module.compute_dtype,
+                               out_height=spec.frame_height,
+                               out_width=spec.frame_width, nhwc=nhwc)
+        last_action = jax.nn.one_hot(batch.last_action, net.action_dim,
+                                     dtype=jnp.float32)
     return stacked, last_action
 
 
@@ -140,43 +141,56 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
             q_online = _unrolled_q(net, spec, params, batch, use_pallas,
                                    nhwc)
 
-        tpos = target_q_positions(batch.burn_in_steps, batch.learning_steps,
-                                  batch.forward_steps, spec.learning, spec.forward)
-        opos = online_q_positions(batch.burn_in_steps, spec.learning)
-        mask = learning_step_mask(batch.learning_steps, spec.learning)  # (B,L)
+        # the target unroll stays on the non-fused double path below, so
+        # it is computed BEFORE entering the loss scope — its ops keep
+        # their torso/lstm/head component scopes un-nested
+        if use_double and not fused_dual:
+            q_target_all = _unrolled_q(net, spec, target_params, batch,
+                                       use_pallas, nhwc)
 
-        # --- bootstrap value at t+n (no gradient; ref worker.py:335-339) ---
-        q_online_tn = jax.lax.stop_gradient(
-            jnp.take_along_axis(q_online, tpos[:, :, None], axis=1))  # (B,L,A)
-        if use_double:
-            a_star = jnp.argmax(q_online_tn, axis=-1)               # (B,L)
-            if not fused_dual:
-                q_target_all = _unrolled_q(net, spec, target_params, batch,
-                                           use_pallas, nhwc)
-            q_target_all = jax.lax.stop_gradient(q_target_all)
-            q_target_tn = jnp.take_along_axis(q_target_all, tpos[:, :, None], axis=1)
-            q_next = jnp.take_along_axis(
-                q_target_tn, a_star[:, :, None], axis=2)[:, :, 0]
-        else:
-            q_next = jnp.max(q_online_tn, axis=-1)                  # (B,L)
-        q_next = jax.lax.stop_gradient(q_next)
+        # "loss" component scope (ISSUE 9): everything below is gathers
+        # + masked reductions over the unrolled Q — cheap, but
+        # attributable (telemetry/traceparse.py) rather than landing in
+        # the trace's unattributed bucket
+        with jax.named_scope("loss"):
+            tpos = target_q_positions(batch.burn_in_steps,
+                                      batch.learning_steps,
+                                      batch.forward_steps, spec.learning,
+                                      spec.forward)
+            opos = online_q_positions(batch.burn_in_steps, spec.learning)
+            mask = learning_step_mask(batch.learning_steps, spec.learning)
 
-        target = value_rescale(
-            batch.reward + batch.gamma * inverse_value_rescale(
-                q_next, optim.value_rescale_eps),
-            optim.value_rescale_eps)                                # (B,L)
+            # --- bootstrap value at t+n (no grad; ref worker.py:335-339) ---
+            q_online_tn = jax.lax.stop_gradient(
+                jnp.take_along_axis(q_online, tpos[:, :, None], axis=1))
+            if use_double:
+                a_star = jnp.argmax(q_online_tn, axis=-1)           # (B,L)
+                q_target_all = jax.lax.stop_gradient(q_target_all)
+                q_target_tn = jnp.take_along_axis(
+                    q_target_all, tpos[:, :, None], axis=1)
+                q_next = jnp.take_along_axis(
+                    q_target_tn, a_star[:, :, None], axis=2)[:, :, 0]
+            else:
+                q_next = jnp.max(q_online_tn, axis=-1)              # (B,L)
+            q_next = jax.lax.stop_gradient(q_next)
 
-        # --- online Q(s_t, a_t) over learning steps (ref worker.py:344) ---
-        q_learn = jnp.take_along_axis(q_online, opos[:, :, None], axis=1)
-        q_chosen = jnp.take_along_axis(
-            q_learn, batch.action[:, :, None], axis=2)[:, :, 0]     # (B,L)
+            target = value_rescale(
+                batch.reward + batch.gamma * inverse_value_rescale(
+                    q_next, optim.value_rescale_eps),
+                optim.value_rescale_eps)                            # (B,L)
 
-        td = (target - q_chosen) * mask
-        num_valid = jnp.maximum(jnp.sum(mask), 1.0)
-        # IS-weighted 0.5*MSE, mean over valid steps (ref worker.py:168,346)
-        loss = 0.5 * jnp.sum(batch.is_weights[:, None] * td**2) / num_valid
+            # --- online Q(s_t, a_t) over learning steps (worker.py:344) ---
+            q_learn = jnp.take_along_axis(q_online, opos[:, :, None], axis=1)
+            q_chosen = jnp.take_along_axis(
+                q_learn, batch.action[:, :, None], axis=2)[:, :, 0]  # (B,L)
 
-        priorities = mixed_td_errors_masked(jnp.abs(td), mask, optim.priority_eta)
+            td = (target - q_chosen) * mask
+            num_valid = jnp.maximum(jnp.sum(mask), 1.0)
+            # IS-weighted 0.5*MSE over valid steps (ref worker.py:168,346)
+            loss = 0.5 * jnp.sum(batch.is_weights[:, None] * td**2) / num_valid
+
+            priorities = mixed_td_errors_masked(jnp.abs(td), mask,
+                                                optim.priority_eta)
         aux = {
             "priorities": priorities,
             "mean_abs_td": jnp.sum(jnp.abs(td)) / num_valid,
@@ -220,14 +234,18 @@ def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
         # so a dp=1 mesh reproduces the single-chip sample stream exactly
         # (tested in tests/test_parallel.py)
         sample_key = jax.random.fold_in(sample_base, 0)
-        # nested-jit calls trace inline into this one program
-        batch = replay_sample(spec, replay_state, sample_key)
+        # nested-jit calls trace inline into this one program; the
+        # component scope covers the window gather + stratified descent
+        # (tree_sample carries its own nested sum_tree scope)
+        with jax.named_scope("replay_sample"):
+            batch = replay_sample(spec, replay_state, sample_key)
 
         (loss, aux), grads = grad_fn(
             train_state.params, train_state.target_params, batch)
-        updates, opt_state = tx.update(grads, train_state.opt_state,
-                                       train_state.params)
-        params = optax.apply_updates(train_state.params, updates)
+        with jax.named_scope("optimizer"):
+            updates, opt_state = tx.update(grads, train_state.opt_state,
+                                           train_state.params)
+            params = optax.apply_updates(train_state.params, updates)
 
         # priority write-back, atomic with the sample (no staleness window)
         tree = tree_update(
@@ -291,9 +309,10 @@ def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
     def step(train_state: TrainState, batch: SampleBatch):
         (loss, aux), grads = grad_fn(
             train_state.params, train_state.target_params, batch)
-        updates, opt_state = tx.update(grads, train_state.opt_state,
-                                       train_state.params)
-        params = optax.apply_updates(train_state.params, updates)
+        with jax.named_scope("optimizer"):
+            updates, opt_state = tx.update(grads, train_state.opt_state,
+                                           train_state.params)
+            params = optax.apply_updates(train_state.params, updates)
 
         new_step = train_state.step + 1
         if use_double:

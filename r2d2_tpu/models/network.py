@@ -285,6 +285,11 @@ class R2D2Network(nn.Module):
 
         # Torso over the flattened (B*T) frame batch — one big conv batch is
         # the MXU-friendly shape (vs per-step convs inside the scan).
+        # The module names ("torso"/"lstm"/"head") double as the
+        # component annotation contract (ISSUE 9): flax emits each as a
+        # jax.named_scope, so every HLO op carries the component in its
+        # op_name metadata and xprof traces attribute device time per
+        # component (telemetry/traceparse.py keys on these exact tokens).
         flat = obs_seq.astype(dtype).reshape(batch * seq, *obs_seq.shape[2:])
         latent = ConvTorso(cfg.cnn_out_dim, cfg.conv_layers, dtype,
                            space_to_depth=cfg.space_to_depth,
@@ -345,8 +350,13 @@ def dual_sequence_q(net: "NetworkApply", params_a, params_b,
     flat = obs_seq.astype(dtype).reshape(batch * seq, *obs_seq.shape[2:])
     torso = ConvTorso(cfg.cnn_out_dim, cfg.conv_layers, dtype,
                       space_to_depth=cfg.space_to_depth)
-    lat_a = torso.apply({"params": params_a["params"]["torso"]}, flat)
-    lat_b = torso.apply({"params": params_b["params"]["torso"]}, flat)
+    # explicit component scopes: unlike the module path, these raw
+    # .apply calls carry no flax module names, so the trace→component
+    # mapping (telemetry/traceparse.py) would see the fused-dual
+    # program's ops as unattributed without them
+    with jax.named_scope("torso"):
+        lat_a = torso.apply({"params": params_a["params"]["torso"]}, flat)
+        lat_b = torso.apply({"params": params_b["params"]["torso"]}, flat)
     la = last_action_seq.astype(dtype)
 
     def rnn_in(lat):
@@ -361,10 +371,6 @@ def dual_sequence_q(net: "NetworkApply", params_a, params_b,
 
     wi_a, wr_a, b_a = lstm_bits(params_a)
     wi_b, wr_b, b_b = lstm_bits(params_b)
-    xp_a = (rnn_in(lat_a) @ wi_a).swapaxes(0, 1)        # (T, B, 4H)
-    xp_b = (rnn_in(lat_b) @ wi_b).swapaxes(0, 1)
-    ca, ha = unpack_hidden(hidden_a.astype(dtype))
-    cb, hb = unpack_hidden(hidden_b.astype(dtype))
 
     def step(carry, xs):
         ca, ha, cb, hb = carry
@@ -373,8 +379,14 @@ def dual_sequence_q(net: "NetworkApply", params_a, params_b,
         cb, hb = lstm_cell_step(xpb, cb, hb, wr_b, b_b)
         return (ca, ha, cb, hb), (ha, hb)
 
-    _, (out_a, out_b) = jax.lax.scan(step, (ca, ha, cb, hb), (xp_a, xp_b),
-                                     unroll=cfg.scan_unroll)
+    with jax.named_scope("lstm"):
+        xp_a = (rnn_in(lat_a) @ wi_a).swapaxes(0, 1)    # (T, B, 4H)
+        xp_b = (rnn_in(lat_b) @ wi_b).swapaxes(0, 1)
+        ca, ha = unpack_hidden(hidden_a.astype(dtype))
+        cb, hb = unpack_hidden(hidden_b.astype(dtype))
+        _, (out_a, out_b) = jax.lax.scan(step, (ca, ha, cb, hb),
+                                         (xp_a, xp_b),
+                                         unroll=cfg.scan_unroll)
 
     head = DuelingHead(net.action_dim, cfg.hidden_dim, cfg.use_dueling, dtype)
 
@@ -383,7 +395,8 @@ def dual_sequence_q(net: "NetworkApply", params_a, params_b,
                        outs.swapaxes(0, 1).reshape(batch * seq, cfg.hidden_dim))
         return q.reshape(batch, seq, net.action_dim)
 
-    return head_q(params_a, out_a), head_q(params_b, out_b)
+    with jax.named_scope("head"):
+        return head_q(params_a, out_a), head_q(params_b, out_b)
 
 
 class NetworkApply:

@@ -53,16 +53,21 @@ def tree_update(
     code path change (ref priority_tree.py:17). Duplicate parent writes in the
     bottom-up sweep all carry the same recomputed value, so scatter-set is safe.
     """
-    td_errors = td_errors.astype(tree.dtype)
-    priorities = jnp.where(
-        td_errors != 0.0, jnp.abs(td_errors) ** prio_exponent, 0.0
-    )
-    node = idxes.astype(jnp.int32) + 2 ** (num_layers - 1) - 1
-    tree = tree.at[node].set(priorities)
-    for _ in range(num_layers - 1):
-        node = (node - 1) // 2
-        tree = tree.at[node].set(tree[2 * node + 1] + tree[2 * node + 2])
-    return tree
+    # "sum_tree" component scope (ISSUE 9): these scatter/gather chains
+    # trace inline into the fused learner step, so without the scope
+    # their device time would land in the step's unattributed bucket
+    # (telemetry/traceparse.py keys on the token)
+    with jax.named_scope("sum_tree_update"):
+        td_errors = td_errors.astype(tree.dtype)
+        priorities = jnp.where(
+            td_errors != 0.0, jnp.abs(td_errors) ** prio_exponent, 0.0
+        )
+        node = idxes.astype(jnp.int32) + 2 ** (num_layers - 1) - 1
+        tree = tree.at[node].set(priorities)
+        for _ in range(num_layers - 1):
+            node = (node - 1) // 2
+            tree = tree.at[node].set(tree[2 * node + 1] + tree[2 * node + 2])
+        return tree
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3))
@@ -84,6 +89,12 @@ def tree_sample(
     training is gated on replay.learning_starts exactly as the reference gates
     on ReplayBuffer.ready (ref worker.py:214-218).
     """
+    with jax.named_scope("sum_tree_sample"):
+        return _tree_sample_body(num_layers, tree, is_exponent, num_samples,
+                                 key)
+
+
+def _tree_sample_body(num_layers, tree, is_exponent, num_samples, key):
     p_sum = tree[0]
     interval = p_sum / num_samples
     jitter = jax.random.uniform(

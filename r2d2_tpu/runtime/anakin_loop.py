@@ -275,12 +275,24 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
                                 if lo > 0 else None),
         })
 
+    # mid-run profiler capture (ISSUE 9 satellite): the fused on-device
+    # loop is the exact path the kernel campaign profiles, yet only the
+    # host-actor orchestrator had the capture triggers — wire the SAME
+    # three (first-interval profile_dir, one-shot profile_at_step,
+    # SIGUSR2 on demand) via the shared CaptureTriggers helper, so the
+    # subtle arming/pending/restore rules exist once. Captures land
+    # where telemetry/traceparse.py expects them.
+    from r2d2_tpu.telemetry.profiler import CaptureTriggers
+    triggers = CaptureTriggers(cfg.runtime)
+
     start = time.time()
     deadline = start + max_seconds if max_seconds else None
     max_steps = max_training_steps or cfg.optim.training_steps
     last_log = start
     stack = AnakinStack(cfg, learner, metrics, telemetry, carry)
     try:
+        triggers.install()
+        triggers.start_first_interval()
         if cfg.runtime.save_interval:
             learner.save(0)
         while ((deadline is None or time.time() < deadline)
@@ -299,6 +311,7 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
             if learner.ready and learner.training_steps < max_steps:
                 learner.step()
             now = time.time()
+            triggers.poll(now, learner.training_steps)
             if resources is not None:
                 # resource sampling rides the loop at the same cheap-time-
                 # check cadence the PlayerStack's supervise pass uses
@@ -318,6 +331,7 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
         learner.flush_metrics()
         flush_stats()
     finally:
+        triggers.uninstall()   # stop any live capture, restore SIGUSR2
         stack.carry = carry
         try:
             if cfg.runtime.save_interval:

@@ -254,6 +254,8 @@ class Learner:
         # depth 2: one batch committing + one transfer in flight bounds
         # staged memory at 2K blocks while keeping the pipeline full
         self._ingest_q: queue_mod.Queue = queue_mod.Queue(maxsize=2)
+        # one-shot 'costs' record block (ISSUE 9), latched at first flush
+        self._costs_attached = False
         self._ingest_error: Optional[BaseException] = None
         self._staged_env_steps = 0        # popped but not yet committed
         self._staged_blocks = 0
@@ -765,6 +767,27 @@ class Learner:
         record's 'learning' block — and run the NaN forensics there (a
         nan_policy=halt raises out of this flush, stopping the run at the
         log boundary that first observed the poisoned step)."""
+        if (not self._costs_attached and self.cfg.telemetry.enabled
+                and self.cfg.telemetry.costmodel_enabled):
+            # one-shot cost-model block (ISSUE 9): analytic per-component
+            # flops/bytes for THIS config — pure host math, no compile —
+            # attached at the first flush so the run's very first record
+            # carries the compute anatomy the roofline tool elaborates
+            self._costs_attached = True
+            from r2d2_tpu.telemetry.costmodel import analytic_component_costs
+            # self.net holds the RESOLVED bf16 tri-state, so the byte
+            # estimates match what this run actually moves
+            costs = analytic_component_costs(
+                self.cfg, self.net.action_dim,
+                act_bytes=2 if self.net.config.bf16 else 4)
+            self.metrics.set_costs({
+                "model_flops_per_step": costs["model_flops_per_step"],
+                "tokens_per_step": costs["tokens_per_step"],
+                "components": {
+                    name: {"flops": c["flops"], "bytes": c["bytes"]}
+                    for name, c in costs["components"].items()},
+                "serial_chain": costs["serial_chain"],
+            })
         if self._pending_losses:
             t0 = time.time()
             arrays = jax.device_get(self._pending_losses)

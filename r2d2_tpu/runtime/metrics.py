@@ -89,6 +89,15 @@ class TrainMetrics:
         # every non-anakin run (consumers key on its presence)
         self._anakin = None
 
+        # cost-model block (ISSUE 9): the analytic per-component
+        # flops/bytes summary of the configured step, set ONCE by the
+        # Learner's first flush and emitted on the next record only (it
+        # is static per config — re-emitting every interval would bloat
+        # the JSONL with constants); OMITTED entirely under the
+        # telemetry.costmodel_enabled kill switch (schema byte-identical
+        # to pre-PR9, stability-tested)
+        self._costs = None
+
         # system-health pillar (ISSUE 7): a resources-block provider
         # (ResourceMonitor.block) and the alert engine, both attached by
         # the orchestrating loop. None = the blocks are OMITTED and the
@@ -163,6 +172,13 @@ class TrainMetrics:
         ratio — runtime/anakin_loop.py flush_stats); None = nothing this
         interval and the record carries no 'anakin' key."""
         self._anakin = block
+
+    def set_costs(self, block: Optional[dict]) -> None:
+        """Attach the one-shot cost-model block (ISSUE 9): analytic
+        per-component flops/bytes + the serial-chain model for the
+        configured step (telemetry/costmodel.analytic_component_costs).
+        Emitted on exactly one record then cleared; None = no block."""
+        self._costs = block
 
     def set_resources(self, provider) -> None:
         """Attach the resources-block provider (ISSUE 7): a callable
@@ -276,6 +292,12 @@ class TrainMetrics:
             # shard_imbalance rule sees its own interval
             record["anakin"] = self._anakin
             self._anakin = None
+        if self._costs is not None:
+            # ONE costs block per run (ISSUE 9), consumed on emission —
+            # the numbers are pure config constants, so one record
+            # carries them and the stream stays lean
+            record["costs"] = self._costs
+            self._costs = None
         if self.telemetry.enabled:
             # ONE aggregated block per interval covering the whole fleet:
             # learner-local stage timers merged with the actor board's

@@ -423,19 +423,15 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
     # artifacts). Only the main thread may install handlers; restored below.
     prev_handlers = {}
     stacks: List[PlayerStack] = []
-    # profiler capture state (telemetry/profiler.py owns the trace
-    # lifecycle — start/stop are idempotent, so the finally below can
-    # always stop without tracking which trigger started it). Triggers:
+    # profiler capture triggers (telemetry/profiler.CaptureTriggers —
+    # ONE shared implementation with the fused anakin loop, ISSUE 9):
     # legacy first-interval (profile_dir set), runtime.profile_at_step
     # (one-shot, fires when the learner step counter first reaches it),
-    # and SIGUSR2 (on demand, any number of times).
-    from r2d2_tpu.telemetry import ProfilerCapture
-    prof = ProfilerCapture()
-    prof_dir = cfg.runtime.profile_dir or os.path.join(
-        cfg.runtime.save_dir or ".", "xprof")
-    prof_window = min(cfg.runtime.log_interval, 30.0)
-    profile_at_armed = cfg.runtime.profile_at_step > 0
-    profile_request = threading.Event()
+    # and SIGUSR2 (on demand, any number of times). Start/stop are
+    # idempotent, so the finally below can always uninstall without
+    # tracking which trigger started a capture.
+    from r2d2_tpu.telemetry.profiler import CaptureTriggers
+    triggers = CaptureTriggers(cfg.runtime)
     try:
         # Everything after handler installation sits inside this try so the
         # finally always restores them — even when stack construction or
@@ -459,15 +455,10 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
                 except (ValueError, OSError):
                     pass
 
-            def _on_usr2(signum, frame):
-                # handler only flags; the loop starts the capture outside
-                # signal context (jax.profiler is not async-signal-safe)
-                profile_request.set()
-            try:
-                prev_handlers[signal.SIGUSR2] = signal.signal(
-                    signal.SIGUSR2, _on_usr2)
-            except (ValueError, OSError, AttributeError):
-                pass
+        # SIGUSR2 flag handler (main-thread check inside; restore in
+        # triggers.uninstall — the handler only flags, the loop starts
+        # the capture outside signal context)
+        triggers.install()
 
         # player_id >= 0: this job runs exactly ONE player of the
         # population (per-player-job composition — README "Multiplayer at
@@ -532,8 +523,7 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
         # (SURVEY §5.1 — the reference has no profiling at all); capture
         # lifecycle owned by ProfilerCapture so an exception anywhere can
         # neither leave a trace running nor stop a dead one
-        if cfg.runtime.profile_dir:
-            prof.start(cfg.runtime.profile_dir, prof_window)
+        triggers.start_first_interval()
 
         while (not timed_out() and not stop.is_set()
                and any(st.learner.training_steps < max_steps for st in stacks)):
@@ -542,23 +532,11 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
                 if st.learner.ready and st.learner.training_steps < max_steps:
                     st.learner.step()
             now = time.time()
-            prof.poll(now)
-            if profile_at_armed and any(
-                    st.learner.training_steps
-                    >= cfg.runtime.profile_at_step for st in stacks):
-                # mid-run steady-state capture (one-shot): the step
-                # counter first crossed runtime.profile_at_step. Disarm
-                # only on a REAL start — start() refuses while another
-                # capture (e.g. the first-interval one) is still live,
-                # and the knob's capture must then fire once it ends,
-                # not be silently lost.
-                if prof.start(prof_dir, prof_window):
-                    profile_at_armed = False
-            if profile_request.is_set():
-                # SIGUSR2: on demand; the request stays pending across a
-                # still-live capture window for the same reason
-                if prof.start(prof_dir, prof_window):
-                    profile_request.clear()
+            # mid-run capture triggers: end an elapsed window, fire the
+            # one-shot profile_at_step when ANY player's step counter
+            # first crosses it, service a pending SIGUSR2 request
+            triggers.poll(now, max(
+                (st.learner.training_steps for st in stacks), default=0))
             if supervise_due():
                 for st in stacks:
                     st.supervise()
@@ -572,7 +550,7 @@ def train(cfg: Config, *, max_training_steps: Optional[int] = None,
         for st in stacks:
             st.learner.flush_metrics()
     finally:
-        prof.stop()   # idempotent: no-op unless a capture is live
+        triggers.uninstall()  # stop any live capture, restore SIGUSR2
         stop.set()
         for st in stacks:
             # preemption-safe final checkpoint: a clean stop (SIGTERM/

@@ -30,6 +30,14 @@ RSS/CPU in the record's ``resources`` block, XLA compile/retrace
 telemetry nested under it, and the declarative alert engine producing
 the ``alerts`` block + ``alerts_player{p}.jsonl`` (tools/sentinel.py is
 the offline/CLI face).
+
+``costmodel.py`` / ``traceparse.py`` (ISSUE 9) are the COMPUTE pillar:
+XLA ``cost_analysis()``/``memory_analysis()`` per-program cost tables
+across every step factory (the ``make regress`` exact-match costs gate
++ the tools/roofline.py report), the analytic per-component flops/bytes
+model behind the record's one-shot ``costs`` block, and the
+trace→component device-time attribution over the named_scope
+annotations threaded through the model/step/acting code.
 """
 
 from r2d2_tpu.telemetry.alerts import (AlertEngine, AlertRule,
@@ -37,6 +45,10 @@ from r2d2_tpu.telemetry.alerts import (AlertEngine, AlertRule,
 from r2d2_tpu.telemetry.board import TelemetryBoard
 from r2d2_tpu.telemetry.compile import (CompileMonitor, active_monitor,
                                         aot_coverage)
+from r2d2_tpu.telemetry.costmodel import (analytic_component_costs,
+                                          collect_cost_table,
+                                          compare_cost_tables, peak_spec,
+                                          program_cost)
 from r2d2_tpu.telemetry.core import (NULL_TELEMETRY, STAGE_INDEX, STAGES,
                                      StageTimers, Telemetry,
                                      summarize_matrix)
@@ -50,16 +62,20 @@ from r2d2_tpu.telemetry.resources import (BufferRegistry, ResourceMonitor,
                                           device_memory_stats, host_usage,
                                           pytree_nbytes, register_buffer)
 from r2d2_tpu.telemetry.spans import SpanTracer, chrome_trace_events
+from r2d2_tpu.telemetry.traceparse import attribute_trace, component_of
 
 __all__ = [
     "NBUCKETS", "NULL_TELEMETRY", "STAGES", "STAGE_INDEX",
     "AlertEngine", "AlertRule", "BufferRegistry", "CompileMonitor",
     "LearningAggregator", "LearningDiag", "LogHistogram",
     "ProfilerCapture", "ResourceMonitor", "SpanTracer", "StageTimers",
-    "Telemetry", "TelemetryBoard", "active_monitor", "aot_coverage",
+    "Telemetry", "TelemetryBoard", "active_monitor",
+    "analytic_component_costs", "aot_coverage", "attribute_trace",
     "bucket_bounds",
     "bucket_index", "bucket_mid", "chrome_trace_events",
-    "default_rules", "device_memory_stats", "host_usage", "percentile",
+    "collect_cost_table", "compare_cost_tables", "component_of",
+    "default_rules", "device_memory_stats", "host_usage", "peak_spec",
+    "percentile", "program_cost",
     "pytree_nbytes", "record_value", "register_buffer", "summarize",
     "summarize_matrix", "trace", "value_summary",
 ]

@@ -12,9 +12,14 @@ SIGUSR2 triggers (runtime/orchestrator.py), and the step profiler
 """
 
 import logging
+import os
+import signal
+import threading
 import time
 from contextlib import contextmanager
 from typing import Optional
+
+_UNSET = object()   # a previous signal handler can legitimately BE None
 
 
 class ProfilerCapture:
@@ -67,6 +72,79 @@ class ProfilerCapture:
         except RuntimeError as e:
             logging.getLogger(__name__).warning(
                 "profiler stop_trace failed: %s", e)
+
+
+class CaptureTriggers:
+    """The three standard mid-run capture triggers around ONE
+    ProfilerCapture — shared by the host orchestrator loop and the fused
+    anakin loop (ISSUE 9) so the subtle rules exist exactly once:
+
+      * first-interval capture when ``runtime.profile_dir`` is set;
+      * one-shot ``runtime.profile_at_step``: disarms only on a REAL
+        start — ``ProfilerCapture.start`` refuses while another capture
+        is live, and the knob's capture must then fire once it ends,
+        not be silently lost;
+      * SIGUSR2 on demand: the handler only flags (jax.profiler is not
+        async-signal-safe; the loop starts the capture at its next
+        ``poll``), and a request stays pending across a live window for
+        the same reason; the previous handler is restored exactly at
+        ``uninstall`` (including a ``None``/not-from-Python one).
+
+    Captures land in ``runtime.profile_dir`` or ``{save_dir}/xprof`` —
+    where telemetry/traceparse.py expects them.
+    """
+
+    def __init__(self, runtime_cfg):
+        self.prof = ProfilerCapture()
+        self.out_dir = runtime_cfg.profile_dir or os.path.join(
+            runtime_cfg.save_dir or ".", "xprof")
+        self.window = min(runtime_cfg.log_interval, 30.0)
+        self._first_interval_dir = runtime_cfg.profile_dir
+        self._at_step = runtime_cfg.profile_at_step
+        self._armed = self._at_step > 0
+        self._request = threading.Event()
+        self._prev_usr2 = _UNSET
+
+    def install(self) -> "CaptureTriggers":
+        """Install the SIGUSR2 flag handler (main thread only — signal
+        rules); safe no-op anywhere else. Returns self."""
+        if threading.current_thread() is threading.main_thread():
+            def _on_usr2(signum, frame):
+                self._request.set()
+            try:
+                self._prev_usr2 = signal.signal(signal.SIGUSR2, _on_usr2)
+            except (ValueError, OSError, AttributeError):
+                self._prev_usr2 = _UNSET
+        return self
+
+    def start_first_interval(self) -> None:
+        """The legacy profile_dir-armed capture of the first training
+        interval; no-op when the knob is unset."""
+        if self._first_interval_dir:
+            self.prof.start(self._first_interval_dir, self.window)
+
+    def poll(self, now: float, training_steps: int) -> None:
+        """One per-loop tick: end an elapsed window, fire the one-shot
+        step trigger, service a pending SIGUSR2 request."""
+        self.prof.poll(now)
+        if self._armed and training_steps >= self._at_step:
+            if self.prof.start(self.out_dir, self.window):
+                self._armed = False
+        if self._request.is_set():
+            if self.prof.start(self.out_dir, self.window):
+                self._request.clear()
+
+    def uninstall(self) -> None:
+        """Stop any live capture (idempotent) and restore the previous
+        SIGUSR2 handler exactly."""
+        self.prof.stop()
+        if self._prev_usr2 is not _UNSET:
+            try:
+                signal.signal(signal.SIGUSR2,
+                              self._prev_usr2 or signal.SIG_DFL)
+            except (ValueError, OSError, TypeError):
+                pass
+            self._prev_usr2 = _UNSET
 
 
 @contextmanager

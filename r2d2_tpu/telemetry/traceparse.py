@@ -1,0 +1,236 @@
+"""xprof/Chrome-trace → component device-time attribution (ISSUE 9).
+
+The profiler captures (``runtime.profile_at_step`` / SIGUSR2 /
+``profile_dir`` — telemetry/profiler.ProfilerCapture) leave Chrome-trace
+JSON under ``plugins/profile/<ts>/*.trace.json.gz``; the spans exporter
+(tools/inspect.py --export-trace) writes the same format. PR 4 could
+only render those as raw per-op rows (tools/profile_step.summarize_trace)
+— every optimization round still mapped ops back to model components BY
+HAND. This module closes the loop: the ``jax.named_scope`` component
+annotations threaded through models/network.py, learner/train_step.py,
+ops/sum_tree.py and actor/anakin.py ride each HLO op's ``op_name``
+metadata into the trace event args, so every complete ('X') device event
+maps to a component — torso / lstm / head / sum_tree / replay /
+obs_decode / loss / optimizer / emit_blocks / env_step / act_forward —
+and whatever matches nothing is reported as ``unattributed``, never
+dropped (the acceptance bar: >= 80% of a learner-step capture's device
+time attributed, the rest visible).
+
+    python -m r2d2_tpu.telemetry.traceparse --trace models/xprof
+    python -m r2d2_tpu.telemetry.traceparse --trace t.trace.json.gz --out a.json
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# (token, component), matched IN ORDER against the event's name + args
+# text — most specific first: the network scopes nest inside act_forward
+# and loss, and must win over their enclosing scope.
+COMPONENT_TOKENS: Tuple[Tuple[str, str], ...] = (
+    ("torso", "torso"),
+    ("lstm", "lstm"),
+    ("head", "head"),
+    ("sum_tree", "sum_tree"),
+    ("emit_blocks", "emit_blocks"),
+    ("env_step", "env_step"),
+    ("env_reset", "env_step"),
+    ("obs_decode", "obs_decode"),
+    ("stack_frames", "obs_decode"),
+    ("replay_sample", "replay"),
+    ("replay_add", "replay"),
+    ("optimizer", "optimizer"),
+    ("loss", "loss"),
+    ("act_forward", "act_forward"),
+)
+
+UNATTRIBUTED = "unattributed"
+
+
+def component_of(text: str) -> Optional[str]:
+    """First component whose token appears in ``text`` (ordered — the
+    nested network scopes beat their enclosing acting/loss scopes)."""
+    for token, comp in COMPONENT_TOKENS:
+        if token in text:
+            return comp
+    return None
+
+
+def load_trace_events(path: str) -> List[dict]:
+    """Trace events from a Chrome-trace ``.json``/``.json.gz`` file, or
+    the NEWEST ``*.trace.json.gz`` under a capture directory (the
+    ProfilerCapture ``out_dir`` layout: ``plugins/profile/<ts>/...``)."""
+    if os.path.isdir(path):
+        candidates = sorted(
+            glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
+                      recursive=True)
+            + glob.glob(os.path.join(path, "**", "*.trace.json"),
+                        recursive=True),
+            key=os.path.getmtime)
+        if not candidates:
+            raise FileNotFoundError(
+                f"no *.trace.json(.gz) under {path!r} — did the capture "
+                "run? (runtime.profile_at_step / SIGUSR2 write here)")
+        path = candidates[-1]
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        doc = json.load(f)
+    return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def _event_text(e: dict) -> str:
+    """Everything attributable about one event: its name plus every
+    string arg value (xprof puts the HLO op_name metadata — where the
+    named_scope path lives — in args like ``long_name``/``tf_op``)."""
+    parts = [str(e.get("name", ""))]
+    for v in (e.get("args") or {}).values():
+        if isinstance(v, str):
+            parts.append(v)
+    return " ".join(parts)
+
+
+def device_pids(events: Iterable[dict]) -> Dict[int, str]:
+    """pid → process name for the accelerator planes ("/device:..." and
+    not a host-CPU plane). Empty when the capture has no device plane
+    (CPU backend) — callers then fall back to all pids."""
+    names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            names[e.get("pid")] = str((e.get("args") or {}).get("name", ""))
+    return {pid: n for pid, n in names.items()
+            if "/device:" in n and "CPU" not in n.upper()}
+
+
+# thread (tid) lines on a device plane that MIRROR or ENCLOSE the
+# per-op "XLA Ops" events rather than adding new time: xprof derives
+# "XLA Modules" (one span per module execution), "Steps", and the
+# framework view lines ("TensorFlow Name Scope" / "TensorFlow Ops" /
+# "Framework Name Scope" / "Framework Ops", one nested span per scope
+# level, plus "Source code") from the same op stream — summing any of
+# them double- or triple-counts every op's time and sinks the enclosing
+# spans into 'unattributed'. Matched by substring on the thread name.
+# "steps" is matched EXACTLY (below), not as a substring — a user
+# thread named e.g. "env steps" must not be silently excluded
+_AGGREGATE_THREAD_TOKENS = ("xla modules", "name scope",
+                            "tensorflow ops", "framework ops",
+                            "source code")
+
+
+def _op_tids(events: Iterable[dict]) -> Dict[tuple, bool]:
+    """(pid, tid) → include? from thread_name metadata: derived/
+    aggregate lines excluded; unnamed threads included (the spans
+    exporter and the test fixtures carry no thread names)."""
+    include: Dict[tuple, bool] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            name = str((e.get("args") or {}).get("name", "")).strip().lower()
+            include[(e.get("pid"), e.get("tid"))] = not (
+                name == "steps"
+                or any(tok in name for tok in _AGGREGATE_THREAD_TOKENS))
+    return include
+
+
+def attribute_trace(events_or_path, all_tracks: bool = False,
+                    top_ops: int = 8) -> Dict[str, Any]:
+    """Map a capture's complete ('X') device events to components.
+
+    Returns a machine-readable summary: per-component total device time,
+    share, and the top ops inside it; ``unattributed`` is a component
+    row like any other (never dropped — its share is the attribution
+    gap the >= 80% acceptance bar watches). ``host_fallback`` flags a
+    capture with no device plane (CPU backend / spans export), where
+    ALL tracks were used instead."""
+    events = (load_trace_events(events_or_path)
+              if isinstance(events_or_path, str) else list(events_or_path))
+    dev = device_pids(events)
+    host_fallback = not dev and not all_tracks
+    use_all = all_tracks or host_fallback
+    op_tids = _op_tids(events)
+
+    comp_us: Dict[str, float] = defaultdict(float)
+    comp_ops: Dict[str, Dict[str, List[float]]] = defaultdict(
+        lambda: defaultdict(lambda: [0.0, 0]))
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if not use_all and e.get("pid") not in dev:
+            continue
+        if not op_tids.get((e.get("pid"), e.get("tid")), True):
+            continue      # enclosing-span line (XLA Modules / Steps)
+        dur = float(e.get("dur", 0.0))
+        if dur <= 0:
+            continue
+        comp = component_of(_event_text(e)) or UNATTRIBUTED
+        total += dur
+        comp_us[comp] += dur
+        row = comp_ops[comp][str(e.get("name", "?"))]
+        row[0] += dur
+        row[1] += 1
+
+    components = {}
+    for comp, us in sorted(comp_us.items(), key=lambda kv: -kv[1]):
+        ops = sorted(((n, d, int(c)) for n, (d, c) in comp_ops[comp].items()),
+                     key=lambda r: -r[1])[:top_ops]
+        components[comp] = {
+            "time_us": round(us, 3),
+            "share": round(us / total, 6) if total else 0.0,
+            "ops": [{"name": n, "time_us": round(d, 3), "count": c}
+                    for n, d, c in ops],
+        }
+    unattributed = comp_us.get(UNATTRIBUTED, 0.0)
+    return {
+        "schema": 1,
+        "total_us": round(total, 3),
+        "attributed_frac": (round((total - unattributed) / total, 6)
+                            if total else 0.0),
+        "unattributed_us": round(unattributed, 3),
+        "host_fallback": bool(host_fallback),
+        "device_planes": sorted(dev.values()),
+        "components": components,
+    }
+
+
+def format_attribution(summary: Dict[str, Any]) -> str:
+    lines = [f"{'component':<14}{'time ms':>12}{'share':>9}"]
+    for comp, row in summary["components"].items():
+        lines.append(f"{comp:<14}{row['time_us'] / 1e3:>12.3f}"
+                     f"{100 * row['share']:>8.1f}%")
+    lines.append(f"attributed: {100 * summary['attributed_frac']:.1f}% of "
+                 f"{summary['total_us'] / 1e3:.3f} ms device time"
+                 + ("  [no device plane — all tracks]"
+                    if summary["host_fallback"] else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--trace", required=True,
+                   help="capture dir (runtime save_dir/xprof) or a "
+                        "*.trace.json(.gz) file")
+    p.add_argument("--out", default="",
+                   help="write the attribution summary JSON here")
+    p.add_argument("--all-tracks", action="store_true",
+                   help="attribute every pid, not just device planes")
+    p.add_argument("--top", type=int, default=8,
+                   help="ops kept per component")
+    args = p.parse_args(argv)
+
+    summary = attribute_trace(args.trace, all_tracks=args.all_tracks,
+                              top_ops=args.top)
+    print(format_attribution(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,9 +57,13 @@ def _fmt(v, width: int = 10) -> str:
     return str(v).rjust(width)
 
 
-def render_record(record: dict, host_rows: Optional[List[dict]] = None
-                  ) -> str:
-    """One dashboard frame from the newest aggregated record."""
+def render_record(record: dict, host_rows: Optional[List[dict]] = None,
+                  costs: Optional[dict] = None,
+                  roofline: Optional[dict] = None) -> str:
+    """One dashboard frame from the newest aggregated record. ``costs``
+    is the run's one-shot cost-model block (it rides exactly one record,
+    so the caller digs it out of the stream's history); ``roofline`` the
+    newest roofline artifact found next to the metrics (ISSUE 9)."""
     lines = []
     lines.append(
         f"t={record.get('t', 0):8.1f}s  "
@@ -99,6 +103,10 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None
     if rb:
         lines.append("")
         lines.append(render_resources(rb))
+    cb = costs or record.get("costs")
+    if cb or roofline:
+        lines.append("")
+        lines.append(render_costs(cb, roofline))
     ab = record.get("alerts")
     if ab is not None:
         lines.append(render_alerts(ab))
@@ -154,6 +162,71 @@ def render_anakin(an: dict) -> str:
             bits.append(f"return-sum={ret[i]:.2f}")
         lines.append(" ".join(bits))
     return "\n".join(lines)
+
+
+def render_costs(cb: Optional[dict], roofline: Optional[dict]) -> str:
+    """The cost-model / roofline panel (ISSUE 9): per-component FLOP
+    shares from the run's one-shot ``costs`` block, joined with
+    %-of-peak from the newest roofline artifact when one sits next to
+    the metrics stream (tools/roofline.py --out)."""
+    lines = []
+    rl_comps = {}
+    # the artifact is discovered by mtime alone (run dir or cwd) — guard
+    # against joining a DIFFERENT shape's roofline (e.g. the gate-preset
+    # ROOFLINE.json from `make roofline` next to a reference-shape run):
+    # the record's costs block and the artifact both carry the analytic
+    # model FLOPs, which pin the shape
+    if roofline and cb and cb.get("model_flops_per_step"):
+        rl_mfps = (roofline.get("parity") or {}).get("model_flops_per_step")
+        if rl_mfps and abs(rl_mfps - cb["model_flops_per_step"]) \
+                > 0.05 * cb["model_flops_per_step"]:
+            lines.append("costs: (roofline artifact is for a different "
+                         "shape — ignored; rerun `make roofline` against "
+                         "this config)")
+            roofline = None
+    if roofline:
+        ls = (roofline.get("learner_step") or {})
+        rl_comps = ls.get("components") or {}
+        peak = roofline.get("peak") or {}
+        # name the artifact's preset in the header, and say so when the
+        # run carries no costs block to validate the shape against (the
+        # costmodel kill switch off) — mtime discovery must never let a
+        # different-shape artifact masquerade as the live run's stats
+        bits = [f"roofline[{roofline.get('preset', '?')}]"
+                f"@{peak.get('device_kind', '?')}"]
+        if not (cb or {}).get("model_flops_per_step"):
+            bits.append("(shape unverified vs this run)")
+        if ls.get("measured_ms"):
+            bits.append(f"step={ls['measured_ms']:.2f}ms")
+        if ls.get("pct_of_peak_total") is not None:
+            bits.append(f"{ls['pct_of_peak_total']:.1f}% of peak")
+        if peak.get("nominal"):
+            bits.append("[nominal peaks]")
+        par = (roofline.get("parity") or {}).get("ratio")
+        if par is not None:
+            bits.append(f"parity={par:.3f}")
+        lines.append("costs: " + " ".join(bits))
+    comps = (cb or {}).get("components") or rl_comps
+    if comps:
+        total = sum(c.get("flops", 0.0) for c in comps.values()) or 1.0
+        row = []
+        for name, c in sorted(comps.items(),
+                              key=lambda kv: -kv[1].get("flops", 0.0)):
+            bit = f"{name}={100 * c.get('flops', 0.0) / total:.0f}%"
+            rc = rl_comps.get(name) or {}
+            if rc.get("pct_of_peak") is not None:
+                bit += f"({rc['pct_of_peak']:.1f}%pk)"
+            row.append(bit)
+        prefix = "  flops: " if lines else "costs: "
+        lines.append(prefix + " ".join(row))
+    if cb and cb.get("model_flops_per_step"):
+        sc = cb.get("serial_chain") or {}
+        lines.append(
+            f"  model {cb['model_flops_per_step'] / 1e9:.3f} GFLOP/step"
+            + (f"  serial chain {sc.get('iterations')} iters "
+               f"({100 * sc.get('share_of_total', 0):.1f}% of FLOPs)"
+               if sc else ""))
+    return "\n".join(lines) if lines else "costs: (none)"
 
 
 def render_learning(lb: dict) -> str:
@@ -276,6 +349,32 @@ def render_alerts(ab: dict) -> str:
     return "\n".join(lines)
 
 
+def newest_roofline(run_dir: str) -> Optional[dict]:
+    """The newest roofline artifact next to the metrics stream (or in
+    the working directory — where `make roofline` drops it)."""
+    paths = [p for d in (run_dir, ".") for pat in
+             ("ROOFLINE*.json", "roofline*.json")
+             for p in glob.glob(os.path.join(d, pat))]
+    if not paths:
+        return None
+    try:
+        # getmtime inside the guard: a follow-mode dashboard can race a
+        # `make roofline` rewrite (or a deletion) between glob and stat
+        with open(max(set(paths), key=os.path.getmtime)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def costs_record(records: List[dict]) -> Optional[dict]:
+    """The one-shot ``costs`` block from wherever in the stream it rode
+    (the first record after the learner's first flush)."""
+    for rec in reversed(records):
+        if rec.get("costs"):
+            return rec["costs"]
+    return None
+
+
 def newest_host_rows(run_dir: str) -> List[dict]:
     rows = []
     for path in sorted(glob.glob(os.path.join(run_dir,
@@ -340,7 +439,9 @@ def main(argv=None) -> int:
             continue
         if records and len(records) != last_len:
             last_len = len(records)
-            frame = render_record(records[-1], newest_host_rows(args.dir))
+            frame = render_record(records[-1], newest_host_rows(args.dir),
+                                  costs=costs_record(records),
+                                  roofline=newest_roofline(args.dir))
             if args.follow and sys.stdout.isatty():
                 sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
             print(f"== {path} (record {len(records)}) ==")

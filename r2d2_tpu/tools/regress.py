@@ -26,9 +26,21 @@ same tree pass. Watched metrics are HIGHER-IS-BETTER by construction
 (throughputs, speedups, on/off ratios); improvements never fail, they
 just become the new floor at the next ``--update``.
 
+Cost gate (ISSUE 9): alongside the wall-clock bench metrics, the
+``costs`` section of BASELINE.json snapshots the XLA per-program cost
+table (telemetry/costmodel.gate_table — flops / bytes accessed / buffer
+sizes of every step factory at a pinned tiny config, CPU-pinned so the
+numbers are backend-independent). Unlike the noise-tolerant bench gate,
+the costs comparison is EXACT-match (analytic counts are deterministic):
+a refactor that silently doubles a step's FLOPs or bytes fails ``make
+regress`` even on wall-clock-noisy hosts, in BOTH directions. ``--update``
+re-baselines it like the bench metrics; ``--skip-costs`` skips the
+recompute (it costs ~20-30 s of tiny-config compiles).
+
     python -m r2d2_tpu.tools.regress                      # gate (make regress)
     python -m r2d2_tpu.tools.regress --update             # re-baseline
     python -m r2d2_tpu.tools.regress --artifacts E2E_r11.json
+    python -m r2d2_tpu.tools.regress --skip-costs         # bench only
 """
 
 import glob
@@ -154,8 +166,13 @@ def main(argv=None) -> int:
                    help="override the per-metric tolerance table with one "
                         "relative-drop bound for everything")
     p.add_argument("--update", action="store_true",
-                   help="snapshot the current artifacts' metrics into the "
-                        "baseline's 'bench' section and exit")
+                   help="snapshot the current artifacts' metrics (and the "
+                        "cost table) into the baseline and exit")
+    p.add_argument("--skip-costs", action="store_true",
+                   help="skip the XLA cost-table gate/update (saves the "
+                        "~20-30 s of tiny-config compiles)")
+    p.add_argument("--costs-rtol", type=float, default=1e-6,
+                   help="relative tolerance of the exact-match costs gate")
     p.add_argument("--quiet", action="store_true",
                    help="only print regressions and the verdict")
     args = p.parse_args(argv)
@@ -169,24 +186,42 @@ def main(argv=None) -> int:
 
     current = collect(args.dir, names=args.artifacts)
 
+    def current_costs():
+        # CPU-pinned with a >= 2-device virtual mesh (the sharded
+        # variant) so the snapshot is identical on a TPU host and the
+        # test container; a no-op when a wide-enough backend is already
+        # initialized (the pin only binds before first backend init)
+        from r2d2_tpu.telemetry.costmodel import gate_table
+        from r2d2_tpu.utils.platform import pin_cpu_platform
+        pin_cpu_platform(2)
+        return gate_table()
+
     if args.update:
         baseline_doc["bench"] = current
+        n = sum(len(m) for m in current.values())
+        msg = (f"baselined {n} metrics from {len(current)} artifact(s) "
+               f"into {args.baseline}")
+        if not args.skip_costs:
+            baseline_doc["costs"] = current_costs()
+            msg += (f" + {len(baseline_doc['costs']['programs'])} "
+                    "cost-table program(s)")
         with open(args.baseline, "w") as f:
             json.dump(baseline_doc, f, indent=2)
             f.write("\n")
-        n = sum(len(m) for m in current.values())
-        print(f"baselined {n} metrics from {len(current)} artifact(s) "
-              f"into {args.baseline}")
+        print(msg)
         return 0
 
     bench = baseline_doc.get("bench")
-    if not bench:
+    costs_gated = bool(baseline_doc.get("costs")) and not args.skip_costs
+    if not bench and not costs_gated:
+        # an EMPTY bench section is fine once the costs gate exists —
+        # fail only when there is nothing at all to gate against
         print(f"{args.baseline} has no 'bench' section — run with "
               "--update first to snapshot the current artifacts",
               file=sys.stderr)
         return 2
 
-    rows = compare(bench, current, tolerance=args.tolerance)
+    rows = compare(bench or {}, current, tolerance=args.tolerance)
     bad = [r for r in rows if r["status"] != "ok"]
     for r in rows:
         if args.quiet and r["status"] == "ok":
@@ -196,8 +231,27 @@ def main(argv=None) -> int:
                  if r["status"] == "REGRESSION" else "")
         print(f"{r['status']:>10}  {r['artifact']}:{r['metric']} "
               f"base={r['baseline']:.10g} cur={cur}{extra}")
-    print(f"-- {len(rows)} metric(s) checked, {len(bad)} failing")
-    return 1 if bad else 0
+
+    cost_rows, cost_bad = [], []
+    if costs_gated:
+        from r2d2_tpu.telemetry.costmodel import compare_cost_tables
+        cost_rows = compare_cost_tables(baseline_doc["costs"],
+                                        current_costs(),
+                                        rtol=args.costs_rtol)
+        cost_bad = [r for r in cost_rows if r["status"] != "ok"]
+        for r in cost_rows:
+            if args.quiet and r["status"] == "ok":
+                continue
+            cur = "-" if r["current"] is None else f"{r['current']:.10g}"
+            extra = (f"  ({r['delta_pct']:+}% vs an exact-match gate)"
+                     if r["status"] == "CHANGED" else "")
+            print(f"{r['status']:>10}  costs:{r['program']}.{r['metric']} "
+                  f"base={r['baseline']:.10g} cur={cur}{extra}")
+
+    print(f"-- {len(rows)} bench metric(s) checked, {len(bad)} failing; "
+          f"{len(cost_rows)} cost metric(s) checked, "
+          f"{len(cost_bad)} changed")
+    return 1 if (bad or cost_bad) else 0
 
 
 if __name__ == "__main__":
