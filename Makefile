@@ -40,6 +40,15 @@
 #                schema stability); the slow chaos-driven e2e slices
 #                (injected hang → actor_stall alert) run with the full
 #                tier.
+#   make replaydiag — the fast-tier replay-observability suite
+#                (tests/test_replay_diag.py: device-vs-host leaf-histogram
+#                parity, sample-count ring across wrap + batched
+#                overwrite, lane stamps through the queue transports and
+#                the sharded anakin path, eviction lifetimes vs a
+#                sequential reference, the new alert rules, kill-switch
+#                record-schema stability); the slow e2e slice (populated
+#                replay_diag block, nonzero never-sampled fraction) runs
+#                with the full tier.
 #   make costmodel — the fast-tier cost-model/roofline suite
 #                (tests/test_costmodel.py: XLA cost-table extraction
 #                across step factories incl. a sharded emulated-mesh
@@ -62,7 +71,7 @@
 #                shape on TPU).
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
-	costmodel regress costs roofline check-fast-markers
+	replaydiag costmodel regress costs roofline check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -89,6 +98,10 @@ anakin-sharded: check-fast-markers
 
 sentinel: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_sentinel.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
+replaydiag: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_replay_diag.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
 costmodel: check-fast-markers
@@ -119,6 +132,7 @@ FAST_MARKER_CHECKS := \
 	tests/test_anakin.py:not_slow:10:anakin \
 	tests/test_anakin_sharded.py:not_slow:8:anakin-sharded \
 	tests/test_sentinel.py:not_slow:20:sentinel \
+	tests/test_replay_diag.py:not_slow:10:replay-diag \
 	tests/test_costmodel.py:not_slow:10:cost-model
 
 check-fast-markers:

@@ -118,7 +118,8 @@ def emit_blocks(spec: ReplaySpec, gamma: float, priority,
                 report_mask: jnp.ndarray, reset_obs: jnp.ndarray,
                 weight_version, *, q_seg: jnp.ndarray = None,
                 q_boot: jnp.ndarray = None,
-                priority_eta: float = 0.9) -> Tuple[Block, tuple]:
+                priority_eta: float = 0.9,
+                lanes: jnp.ndarray = None) -> Tuple[Block, tuple]:
     """LocalBuffer.finish, re-expressed as array ops over one segment.
 
     Inputs are lane-major: ``obs``/``actions``/``rewards``/``hiddens``
@@ -136,6 +137,9 @@ def emit_blocks(spec: ReplaySpec, gamma: float, priority,
     and ``q_boot`` (N, A), the bootstrap Q at the state after the last
     step (zeros where the episode terminated, LocalBuffer.finish(None)).
 
+    ``lanes`` (N,) int32 is each lane's GLOBAL ε-ladder index — the
+    block's lane-provenance stamp (ISSUE 10); None stamps -1 = unknown.
+
     The timeline of block row position ``i`` is ``frames_all[i]`` where
     ``frames_all = tail ++ segment`` — right-aligned tails make the
     offset a single per-lane constant ``B - burn0`` (see ActCarry)."""
@@ -144,13 +148,14 @@ def emit_blocks(spec: ReplaySpec, gamma: float, priority,
             spec, gamma, priority, tail_frames, tail_la, tail_hidden, burn0,
             obs, actions, rewards, hiddens, terminal, final_return,
             report_mask, reset_obs, weight_version, q_seg=q_seg,
-            q_boot=q_boot, priority_eta=priority_eta)
+            q_boot=q_boot, priority_eta=priority_eta, lanes=lanes)
 
 
 def _emit_blocks_body(spec, gamma, priority, tail_frames, tail_la,
                       tail_hidden, burn0, obs, actions, rewards, hiddens,
                       terminal, final_return, report_mask, reset_obs,
-                      weight_version, *, q_seg, q_boot, priority_eta):
+                      weight_version, *, q_seg, q_boot, priority_eta,
+                      lanes=None):
     n, l_seg = actions.shape
     b, f, lrn = spec.burn_in, spec.forward, spec.learning
     s, stack = spec.seqs_per_block, spec.frame_stack
@@ -233,6 +238,9 @@ def _emit_blocks_body(spec, gamma, priority, tail_frames, tail_la,
         sum_reward=sum_reward.astype(jnp.float32),
         weight_version=jnp.broadcast_to(
             jnp.asarray(weight_version, jnp.int32), (n,)),
+        lane=(jnp.broadcast_to(jnp.asarray(lanes, jnp.int32), (n,))
+              if lanes is not None
+              else jnp.full((n,), -1, jnp.int32)),
     )
 
     # --- burn-in carry to the next segment (LocalBuffer tail trim; a
@@ -258,7 +266,7 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
                   priority_eta: float = 0.9, unroll: int = 1) -> Callable:
     """The traceable acting segment, parameterized by per-lane arrays:
 
-        core(params, carry, weight_version, eps, report)
+        core(params, carry, weight_version, eps, report, lanes=None)
             -> (carry, blocks, stats)
 
     ``eps`` (num_lanes,) f32 and ``report`` (num_lanes,) bool are traced
@@ -266,7 +274,9 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
     the SAME core serves both compositions: ``make_anakin_act`` closes
     over the full static ladder (the 1x1-mesh path), and the dp-sharded
     program (parallel/sharded.py make_sharded_anakin_act) feeds each
-    shard its slice of the GLOBAL ladder inside shard_map.
+    shard its slice of the GLOBAL ladder inside shard_map. ``lanes``
+    (num_lanes,) int32 is the matching slice of GLOBAL lane indices —
+    the blocks' lane-provenance stamp (ISSUE 10); None stamps -1.
 
     ``unroll`` feeds the acting scan's ``lax.scan(..., unroll=)``:
     identical math (parity-tested), >1 trades compile time for fewer
@@ -291,7 +301,8 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
             f"env.episode_len {env.episode_len} must be a multiple of "
             f"block_length {spec.block_length}")
 
-    def core(params, carry: ActCarry, weight_version, eps, report):
+    def core(params, carry: ActCarry, weight_version, eps, report,
+             lanes=None):
         # ONE speculative reset per segment, not per step: fixed-length
         # episodes end only on segment boundaries (the alignment asserted
         # above), so the auto-reset selection applies exactly once, after
@@ -395,7 +406,7 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
             terminal, ys["ep_ret"][-1], report_m,
             reset_obs, weight_version,
             q_seg=(jnp.swapaxes(ys["q"], 0, 1) if td_priority else None),
-            q_boot=q_boot, priority_eta=priority_eta)
+            q_boot=q_boot, priority_eta=priority_eta, lanes=lanes)
         done_rep = ys["done"] & report_m[None, :]
         stats = {
             "episodes": jnp.sum(ys["done"]).astype(jnp.int32),
@@ -413,7 +424,8 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
 def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
                     num_lanes: int, epsilons, gamma: float,
                     priority, near_greedy_eps: float,
-                    priority_eta: float = 0.9, unroll: int = 1) -> Callable:
+                    priority_eta: float = 0.9, unroll: int = 1,
+                    lane_base: int = 0) -> Callable:
     """Build the jitted acting segment (1x1-mesh composition):
 
         act(params, carry, weight_version) -> (carry, blocks, stats)
@@ -430,7 +442,10 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
     filtering rule). Exploration uses jax.random streams — same
     distribution as the host's per-lane numpy generators, different
     draws. ``priority`` is the constant stamp or "td" (see
-    emit_blocks); ``priority_eta`` is the learner's max/mean mix."""
+    emit_blocks); ``priority_eta`` is the learner's max/mean mix.
+    ``lane_base`` offsets the blocks' lane-provenance stamps (ISSUE 10)
+    when these lanes are one slice of a wider global ladder — how the
+    sharded-anakin parity tests reproduce one shard's stamps."""
     eps_list = [float(e) for e in epsilons]
     if len(eps_list) != num_lanes:
         raise ValueError(f"need one epsilon per lane: got {len(eps_list)} "
@@ -443,7 +458,9 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
 
     def act(params, carry: ActCarry, weight_version):
         # the static ladder constant-folds into the program — the dp=1
-        # path compiles the same program it did before the core split
-        return core(params, carry, weight_version, eps, report)
+        # path compiles the same program it did before the core split.
+        # Lane stamps are the ladder positions themselves (ISSUE 10).
+        return core(params, carry, weight_version, eps, report,
+                    lanes=lane_base + jnp.arange(num_lanes, dtype=jnp.int32))
 
     return jax.jit(act, donate_argnums=1)

@@ -16,7 +16,8 @@ import re
 
 import numpy as np
 
-from r2d2_tpu.tools.logparse import learning_series, parse_jsonl, parse_log
+from r2d2_tpu.tools.logparse import (learning_series, parse_jsonl, parse_log,
+                                     replay_diag_series)
 
 
 def plot_learning(file_path: str, out: str, show: bool) -> None:
@@ -69,6 +70,60 @@ def plot_learning(file_path: str, out: str, show: bool) -> None:
         plt.show()
 
 
+def plot_replay_diag(file_path: str, out: str, show: bool) -> None:
+    """--replay-diag mode: render the replay-pathology series (sum-tree
+    health, never-sampled-before-eviction fraction, lane composition —
+    ISSUE 10) from each player's ``metrics_player{i}.jsonl``."""
+    import matplotlib
+    if not show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    paths = sorted(glob.glob(os.path.join(file_path,
+                                          "metrics_player*.jsonl")))
+    series = []
+    for path in paths:
+        s = replay_diag_series(parse_jsonl(path))
+        if s["t"]:
+            player = re.search(r"metrics_player(\d+)\.jsonl", path).group(1)
+            series.append((player, s))
+    if not series:
+        raise SystemExit(
+            f"no metrics_player*.jsonl with a 'replay_diag' block under "
+            f"{file_path!r} — run with telemetry.replay_diag_enabled=true")
+
+    fig, axes = plt.subplots(3, len(series), squeeze=False,
+                             figsize=(7 * len(series), 9))
+    for col, (player, s) in enumerate(series):
+        t = np.asarray([x or 0.0 for x in s["t"]]) / 60.0
+
+        def draw(ax, keys, ylabel):
+            for key in keys:
+                ys = np.asarray([np.nan if v is None else v for v in s[key]],
+                                float)
+                if np.isfinite(ys).any():
+                    ax.plot(t, ys, ".-", label=key)
+            ax.set_ylabel(ylabel)
+            ax.legend(loc="upper right", fontsize=8)
+
+        # fractions (0..1) share panels; the unbounded lifetime count
+        # gets its own axis — on a shared one it would autoscale the
+        # never-sampled fraction (THE pathology signal) into a flat line
+        draw(axes[0][col], ["ess_frac", "frac_at_max"],
+             "sum-tree health (fractions)")
+        axes[0][col].set_title(f"player {player}")
+        draw(axes[1][col], ["never_sampled_frac", "starved_frac",
+                            "max_share"], "pathology fractions")
+        draw(axes[2][col], ["mean_lifetime"],
+             "eviction lifetime (times sampled)")
+        axes[2][col].set_xlabel("training time (minutes)")
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out}")
+    if show:
+        plt.show()
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--file_path", default=".",
@@ -87,12 +142,22 @@ def main(argv=None) -> None:
                    help="plot the learning-diagnostics series (dQ, "
                         "sample-age, grad norm) from metrics_player*.jsonl "
                         "instead of the reward curves")
+    p.add_argument("--replay-diag", action="store_true",
+                   help="plot the replay-pathology series (sum-tree "
+                        "health, never-sampled fraction, lane "
+                        "composition) from metrics_player*.jsonl instead "
+                        "of the reward curves")
     args = p.parse_args(argv)
 
     if args.learning:
         out = args.out if args.out != "training_curves.png" \
             else "learning_curves.png"
         plot_learning(args.file_path, out, args.show)
+        return
+    if args.replay_diag:
+        out = args.out if args.out != "training_curves.png" \
+            else "replay_diag_curves.png"
+        plot_replay_diag(args.file_path, out, args.show)
         return
 
     import matplotlib

@@ -448,6 +448,38 @@ class TelemetryConfig:
     # can skew it (ragged per-shard emission, elastic meshes), where a
     # lagging shard drags the whole lockstep program to its pace.
     alerts_shard_imbalance: float = 1.5
+    # -- replay & data-pathology observability (ISSUE 10) --
+    # Pillar kill switch for the replay diagnostics fused into the jitted
+    # sample/update path (telemetry/replaydiag.py): sum-tree / priority
+    # health (leaf histogram, effective sample size, collapse
+    # indicators), per-slot sample-lifetime accounting (the
+    # never-sampled-before-eviction fraction), and the per-ε-lane
+    # composition of sampled batches. Off (or with the master `enabled`
+    # off) the step factories compile WITHOUT the diagnostic state and
+    # outputs, and the periodic record carries no 'replay_diag' block —
+    # byte-identical to the PR9 schema (stability-tested).
+    replay_diag_enabled: bool = True
+    # Learner steps between sum-tree health snapshots (lax.cond inside
+    # the fused step: the leaf-histogram scatter and eviction-counter
+    # reads execute only on interval steps; the every-step residue is
+    # one (B,)-scatter sample-count increment and a (lanes,)-bincount).
+    replay_diag_interval: int = 50
+    # Effective-sample-size fraction (ESS / active leaves) of the
+    # sampling distribution below which priority_collapse fires: the
+    # tree's mass has concentrated on this few of its live sequences.
+    alerts_replay_ess_frac: float = 0.05
+    # Fraction of live leaves sitting at the tree's max priority at/above
+    # which priority_saturation fires (a mass of ties at max means
+    # prioritization has stopped discriminating).
+    alerts_priority_saturation: float = 0.5
+    # never_sampled_frac above this multiple of its own rolling median
+    # fires never_sampled_growth (replay sized/prioritized wrong: an
+    # increasing share of experience is evicted unseen).
+    alerts_never_sampled_growth: float = 2.0
+    # Fraction of the global ε-ladder lanes contributing ZERO sequences
+    # to the interval's sampled batches at/above which lane_starvation
+    # fires.
+    alerts_lane_starved_frac: float = 0.5
 
 
 @dataclass(frozen=True)
@@ -764,6 +796,30 @@ class Config:
                 f"({self.telemetry.alerts_shard_imbalance}) must be > 1 "
                 "(a max/min per-shard env-steps ratio; 1.0 = perfectly "
                 "balanced)")
+        if self.telemetry.replay_diag_interval < 1:
+            raise ValueError(
+                f"telemetry.replay_diag_interval "
+                f"({self.telemetry.replay_diag_interval}) must be >= 1")
+        if not 0 < self.telemetry.alerts_replay_ess_frac < 1:
+            raise ValueError(
+                f"telemetry.alerts_replay_ess_frac "
+                f"({self.telemetry.alerts_replay_ess_frac}) must be in "
+                "(0, 1)")
+        if not 0 < self.telemetry.alerts_priority_saturation <= 1:
+            raise ValueError(
+                f"telemetry.alerts_priority_saturation "
+                f"({self.telemetry.alerts_priority_saturation}) must be in "
+                "(0, 1]")
+        if self.telemetry.alerts_never_sampled_growth <= 1:
+            raise ValueError(
+                f"telemetry.alerts_never_sampled_growth "
+                f"({self.telemetry.alerts_never_sampled_growth}) must be "
+                "> 1 (a multiple of the fraction's rolling median)")
+        if not 0 < self.telemetry.alerts_lane_starved_frac <= 1:
+            raise ValueError(
+                f"telemetry.alerts_lane_starved_frac "
+                f"({self.telemetry.alerts_lane_starved_frac}) must be in "
+                "(0, 1]")
         if self.multiplayer.enabled and self.actor.envs_per_actor > 1:
             raise ValueError(
                 "actor.envs_per_actor > 1 is not supported with multiplayer "

@@ -208,7 +208,8 @@ def make_loss_fn(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
 
 
 def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
-                      use_double: bool, jit: bool = True, diag=None):
+                      use_double: bool, jit: bool = True, diag=None,
+                      rdiag=None):
     """Build the fused step:
 
         step(train_state, replay_state) -> (train_state, replay_state, metrics)
@@ -223,6 +224,13 @@ def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
     so the steady-state path is untouched) target-parameter distance and
     the stored-state ΔQ check. None compiles the pre-diagnostics program
     byte-for-byte — the telemetry.learning_enabled kill switch.
+
+    ``rdiag`` (telemetry.ReplayDiag or None): the replay-observability
+    pillar (ISSUE 10) fused the same way — the per-slot sample-count
+    increment + lane-composition bincount every step, and the sum-tree
+    health snapshot / eviction-accumulator read under lax.cond every
+    ``rdiag.interval`` steps. Same kill-switch contract
+    (telemetry.replay_diag_enabled).
     """
     loss_fn = make_loss_fn(net, spec, optim, use_double)
     tx = make_optimizer(optim)
@@ -278,6 +286,15 @@ def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
                 net, spec, diag, new_step, train_state.params,
                 train_state.target_params, batch, aux, grads, loss,
                 grad_norm, replay_state=replay_state))
+        if rdiag is not None:
+            # replay-pathology pillar (ISSUE 10): sample-count ring +
+            # lane bincount every step, tree-health snapshot on the
+            # rdiag.interval cadence — after the priority write-back so
+            # the snapshot reflects this step's tree
+            from r2d2_tpu.telemetry.replaydiag import fused_replay_diag
+            replay_state, rd = fused_replay_diag(
+                spec, rdiag, new_step, replay_state, batch)
+            metrics.update(rd)
         train_state = train_state.replace(
             params=params, target_params=target_params,
             opt_state=opt_state, step=new_step, key=key)
@@ -290,7 +307,7 @@ def make_learner_step(net: NetworkApply, spec: ReplaySpec, optim: OptimConfig,
 
 def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
                              optim: OptimConfig, use_double: bool,
-                             diag=None):
+                             diag=None, rdiag=None):
     """Train step for host-placement replay (config replay.placement="host"):
     the batch is sampled by HostReplay on the CPU (native C++ sum tree) and
     fed across the host boundary, mirroring the reference's architecture
@@ -340,6 +357,12 @@ def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
                 net, spec, diag, new_step, train_state.params,
                 train_state.target_params, batch, aux, grads, loss,
                 grad_norm, replay_state=None))
+        if rdiag is not None and batch.lane is not None and rdiag.lanes > 0:
+            # host placement carries only the lane-composition half of the
+            # replay pillar in-graph; sum-tree health / eviction lifetimes
+            # come from the HostReplay numpy twin at the metrics flush
+            from r2d2_tpu.telemetry.replaydiag import lane_counts
+            metrics["rd/lane_counts"] = lane_counts(batch.lane, rdiag.lanes)
         train_state = train_state.replace(
             params=params, target_params=target_params,
             opt_state=opt_state, step=new_step, key=train_state.key)
@@ -356,7 +379,7 @@ def make_external_batch_step(net: NetworkApply, spec: ReplaySpec,
 
 def make_multi_learner_step(net: NetworkApply, spec: ReplaySpec,
                             optim: OptimConfig, use_double: bool,
-                            steps_per_dispatch: int, diag=None):
+                            steps_per_dispatch: int, diag=None, rdiag=None):
     """K fused steps per dispatch via lax.scan — one host round-trip buys K
     training steps.
 
@@ -372,7 +395,7 @@ def make_multi_learner_step(net: NetworkApply, spec: ReplaySpec,
     step counter, so interval steps fire inside the scan too).
     """
     inner = make_learner_step(net, spec, optim, use_double, jit=False,
-                              diag=diag)
+                              diag=diag, rdiag=rdiag)
 
     def multi_step(train_state: TrainState, replay_state: ReplayState):
         def body(carry, _):

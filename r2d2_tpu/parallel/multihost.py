@@ -659,7 +659,11 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 # generation stamp, same contract as the single-host
                 # thread spawner (reader_id matches weight_poll below)
                 weight_version=lambda reader_id=i:
-                    store.reader_version(reader_id))
+                    store.reader_version(reader_id),
+                # lane provenance (ISSUE 10): gidx is the GLOBAL worker
+                # index across the multihost fleet — the ladder layout
+                # the ε spread above uses
+                lane_base=gidx * cfg.actor.envs_per_actor)
 
             def loop(env=env, policy=policy, run_loop=run_loop,
                      reader_id=i, sink=sink, should_stop=should_stop):

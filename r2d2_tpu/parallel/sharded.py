@@ -180,8 +180,15 @@ def make_sharded_anakin_act(env, net, spec: ReplaySpec, *, mesh: Mesh,
     def step(params, carry, replay_global, weight_version, eps, report):
         local_carry = _shard0(carry)
         local_replay = _shard0(replay_global)
+        # lane provenance (ISSUE 10): shard s owns the contiguous slice
+        # [s*lps, (s+1)*lps) of the GLOBAL ladder — the same layout the
+        # eps reshape above encodes — so the stamps are derivable from
+        # the axis index, no extra input
+        my_lanes = (jax.lax.axis_index("dp") * lps
+                    + jnp.arange(lps, dtype=jnp.int32))
         new_carry, blocks, stats = core(params, local_carry,
-                                        weight_version, eps[0], report[0])
+                                        weight_version, eps[0], report[0],
+                                        lanes=my_lanes)
         local_replay = replay_add_many(spec, local_replay, blocks)
         shard_stats = {k: v[None] for k, v in stats.items()}
         # measured from the blocks that actually entered this shard's
@@ -275,7 +282,8 @@ def _post_gradient_update(tx, optim: OptimConfig, use_double: bool,
 
 def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
                               optim: OptimConfig, use_double: bool, mesh: Mesh,
-                              steps_per_dispatch: int = 1, diag=None):
+                              steps_per_dispatch: int = 1, diag=None,
+                              rdiag=None):
     """The dp-sharded fused step. Same contract as make_learner_step.
 
     ``steps_per_dispatch`` > 1 scans K per-shard steps inside the shard_map
@@ -298,6 +306,14 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
     histograms psum across shards (one GLOBAL-batch histogram), scalars
     pmean, staleness via reduced pmin/pmax/pmean version stats (the raw
     per-sequence stamp vectors differ per shard and are omitted here).
+
+    ``rdiag`` (telemetry.ReplayDiag or None): the replay-observability
+    pillar (ISSUE 10) over the PER-SHARD rings — sample-count /
+    eviction accounting stays shard-local, lane bincounts psum to one
+    global composition, and the sum-tree snapshots all_gather to
+    ``rd/shard_*`` arrays (leading dp axis) so the record carries BOTH
+    per-shard and merged tree-health views (the prerequisite
+    instrumentation for rebalancing a sharded replay, ROADMAP item 3).
     """
     loss_fn = make_loss_fn(net, spec, optim, use_double)
     tx = make_optimizer(optim)
@@ -342,6 +358,16 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
             # grad-group norms are computed from the pmean'd grads —
             # already replicated, no reduction needed
 
+        if rdiag is not None:
+            from r2d2_tpu.telemetry.replaydiag import (fused_replay_diag,
+                                                       shard_replay_diag)
+            replay_state, rd = fused_replay_diag(
+                spec, rdiag, train_state.step + 1, replay_state, batch)
+            # gather/psum OUTSIDE the lax.cond (off-interval NaNs reduce
+            # to NaNs, which the host aggregator skips) so no collective
+            # ever sits inside a branch
+            ld.update(shard_replay_diag(rd, "dp"))
+
         train_state, metrics = _post_gradient_update(
             tx, optim, use_double, train_state, grads, key, loss,
             jax.lax.pmean(aux["mean_abs_td"], "dp"),
@@ -356,7 +382,8 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
     # manual collectives instead.
     if mesh.shape.get("mp", 1) > 1:
         return _make_gspmd_learner_step(net, spec, optim, use_double, mesh,
-                                        steps_per_dispatch, diag=diag)
+                                        steps_per_dispatch, diag=diag,
+                                        rdiag=rdiag)
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -382,7 +409,8 @@ def make_sharded_learner_step(net: NetworkApply, spec: ReplaySpec,
 
 def _make_gspmd_learner_step(net: NetworkApply, spec: ReplaySpec,
                              optim: OptimConfig, use_double: bool, mesh: Mesh,
-                             steps_per_dispatch: int = 1, diag=None):
+                             steps_per_dispatch: int = 1, diag=None,
+                             rdiag=None):
     """The dp x mp fused step, expressed entirely in GSPMD terms.
 
     Identical math and RNG chain to the manual shard_map path (per-shard
@@ -435,6 +463,23 @@ def _make_gspmd_learner_step(net: NetworkApply, spec: ReplaySpec,
                 train_state.target_params, shard0(batches), shard0(aux_v),
                 grads, loss_v.mean(), _optax.global_norm(grads),
                 replay_state=shard0(replay_global))
+
+        if rdiag is not None:
+            from r2d2_tpu.telemetry.replaydiag import fused_replay_diag
+            # vmap over shards keeps sample-count/eviction accounting
+            # shard-local; the (dp, …) outputs ARE the per-shard views
+            # (the manual path reaches the same layout via all_gather)
+            replay_global, rdm = jax.vmap(
+                lambda rs, b: fused_replay_diag(
+                    spec, rdiag, train_state.step + 1, rs, b)
+            )(replay_global, batches)
+            replay_global = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, replay_sharding), replay_global)
+            if "rd/lane_counts" in rdm:
+                ld["rd/lane_counts"] = rdm.pop("rd/lane_counts").sum(0)
+            ld.update({k.replace("rd/", "rd/shard_"): v
+                       for k, v in rdm.items()})
 
         train_state, metrics = _post_gradient_update(
             tx, optim, use_double, train_state, grads, key, loss_v.mean(),

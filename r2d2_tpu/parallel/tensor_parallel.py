@@ -62,7 +62,7 @@ def state_shardings(train_state: TrainState, mesh: Mesh,
 def make_tp_external_batch_step(net: NetworkApply, spec: ReplaySpec,
                                 optim: OptimConfig, use_double: bool,
                                 mesh: Mesh, min_shard_width: int = 32,
-                                diag=None):
+                                diag=None, rdiag=None):
     """Returns (step, place_state, place_batch).
 
     ``place_state(ts)`` / ``place_batch(batch)`` lay host values onto the
@@ -77,11 +77,12 @@ def make_tp_external_batch_step(net: NetworkApply, spec: ReplaySpec,
         raise ValueError(
             f"replay.batch_size={spec.batch_size} is not divisible by the "
             f"mesh dp={dp} — the batch axis cannot shard evenly")
-    # diag (telemetry.LearningDiag) threads through like every other
-    # step factory: the TP path must not silently disable the learning
-    # diagnostics (or the NaN guard) that plain host placement carries
+    # diag/rdiag thread through like every other step factory: the TP
+    # path must not silently disable the learning diagnostics (or the
+    # NaN guard, or the replay pillar's lane counts) that plain host
+    # placement carries
     step = make_external_batch_step(net, spec, optim, use_double,
-                                    diag=diag)
+                                    diag=diag, rdiag=rdiag)
     batch_sharding = NamedSharding(mesh, P("dp"))   # device_put broadcasts
                                                     # one sharding over the
                                                     # whole batch pytree

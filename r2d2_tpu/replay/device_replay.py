@@ -81,6 +81,19 @@ def _gib(b: float) -> str:
 def replay_init(spec: ReplaySpec) -> ReplayState:
     _guard_device_capacity(spec)
     n, s, l = spec.num_blocks, spec.seqs_per_block, spec.learning
+    # replay diagnostics state (ISSUE 10): allocated only under the
+    # pillar's kill switch — absent (None) leaves drop from the pytree,
+    # so the compiled add/sample/step programs are byte-identical to the
+    # pre-diagnostics ones when it is off
+    diag = {}
+    if spec.replay_diag:
+        diag = dict(
+            sample_count=jnp.zeros((n,), jnp.int32),
+            added_at=jnp.zeros((n,), jnp.int32),
+            add_count=jnp.zeros((), jnp.int32),
+            evict_stats=jnp.zeros((5,), jnp.float32),
+            evict_life_hist=jnp.zeros((64,), jnp.int32),
+        )
     return ReplayState(
         tree=jnp.zeros(2**spec.tree_layers - 1, jnp.float32),
         # stored_frame_height/_width: tile-padded under spec.exact_gather
@@ -97,6 +110,8 @@ def replay_init(spec: ReplaySpec) -> ReplayState:
         seq_start=jnp.zeros((n, s), jnp.int32),
         weight_version=jnp.full((n,), -1, jnp.int32),
         block_ptr=jnp.zeros((), jnp.int32),
+        lane=jnp.full((n,), -1, jnp.int32),
+        **diag,
     )
 
 
@@ -143,6 +158,41 @@ def replay_add_many(spec: ReplaySpec, state: ReplayState,
     idxes = (rows[:, None] * spec.seqs_per_block
              + jnp.arange(spec.seqs_per_block, dtype=jnp.int32)[None, :]
              ).reshape(-1)
+    # eviction accounting (ISSUE 10): read the overwritten rows' lifetime
+    # state BEFORE the tree update clobbers their leaf priorities. Rows
+    # are distinct (k <= num_blocks, asserted above) so the batched read
+    # sees exactly what K sequential adds would have seen row by row —
+    # parity-tested against the sequential reference.
+    diag = {}
+    if spec.replay_diag and state.sample_count is not None:
+        with jax.named_scope("replay_diag_evict"):
+            live = (jnp.sum(state.learning_steps[rows], axis=1) > 0)  # (k,)
+            counts = state.sample_count[rows].astype(jnp.float32)
+            # row j is overwritten by the batch's j-th add, so its age is
+            # measured against add_count + j — exactly the counter value
+            # the sequential path would have seen (parity-tested)
+            ages = (state.add_count + jnp.arange(k, dtype=jnp.int32)
+                    - state.added_at[rows]).astype(jnp.float32)
+            leaf0 = 2 ** (spec.tree_layers - 1) - 1
+            prio_row = jnp.max(
+                state.tree[leaf0 + idxes].reshape(k, spec.seqs_per_block),
+                axis=1)
+            livef = live.astype(jnp.float32)
+            from r2d2_tpu.telemetry.histogram import value_counts
+            diag = dict(
+                sample_count=state.sample_count.at[rows].set(0),
+                added_at=state.added_at.at[rows].set(
+                    state.add_count + jnp.arange(k, dtype=jnp.int32)),
+                add_count=state.add_count + k,
+                evict_stats=state.evict_stats + jnp.stack([
+                    jnp.sum(livef),
+                    jnp.sum(livef * (counts == 0)),
+                    jnp.sum(livef * counts),
+                    jnp.sum(livef * ages),
+                    jnp.sum(livef * prio_row)]),
+                evict_life_hist=state.evict_life_hist + value_counts(
+                    counts, mask=(live & (counts > 0)).astype(jnp.int32)),
+            )
     tree = tree_update(spec.tree_layers, state.tree, spec.prio_exponent,
                        blocks.priority.reshape(-1), idxes)
     obs_rows = blocks.obs_row
@@ -168,6 +218,10 @@ def replay_add_many(spec: ReplaySpec, state: ReplayState,
         weight_version=state.weight_version.at[rows].set(
             blocks.weight_version.astype(jnp.int32)),
         block_ptr=(ptr + k) % spec.num_blocks,
+        **({"lane": state.lane.at[rows].set(
+            blocks.lane.astype(jnp.int32))}
+           if state.lane is not None else {}),
+        **diag,
     )
 
 
@@ -224,6 +278,10 @@ def replay_sample(spec: ReplaySpec, state: ReplayState, key: jax.Array) -> Sampl
         is_weights=is_weights,
         idxes=idxes,
         weight_version=state.weight_version[block_idx],
+        # lane provenance rides every batch (like weight_version); an
+        # externally-built state without the ring field yields None and
+        # consumers skip it
+        lane=(state.lane[block_idx] if state.lane is not None else None),
     )
 
 

@@ -52,13 +52,34 @@ class HostReplay:
         self.forward_steps = np.zeros((n, s), np.int32)
         self.seq_start = np.zeros((n, s), np.int32)
         self.weight_version = np.full((n,), -1, np.int32)
+        self.lane = np.full((n,), -1, np.int32)
         # single authority for pointer/step accounting; in host placement
         # the Learner reads this same instance (no mirrored pointer)
         self.ring = RingAccountant(n)
+        # replay diagnostics (ISSUE 10), the numpy twin of the device
+        # path's in-graph accounting: per-slot sample counts + birth
+        # stamps, cumulative eviction accumulators (same 5-element layout
+        # as ReplayState.evict_stats), a lifetime histogram on the shared
+        # 64-bucket log layout, and a leaf-priority mirror — the native
+        # C++ tree does not expose its leaves, so the mirror (one scatter
+        # per update) is what sum-tree health reads under either backend.
+        self._diag = spec.replay_diag
+        if self._diag:
+            self.sample_count = np.zeros((n,), np.int64)
+            self.added_at = np.zeros((n,), np.int64)
+            self.evict_stats = np.zeros((5,), np.float64)
+            self.evict_life_hist = np.zeros((64,), np.int64)
+            self.leaf_prio = np.zeros((spec.num_sequences,), np.float64)
 
     # -- sum-tree indirection (native C++ or numpy) --
 
     def _tree_update(self, td_errors: np.ndarray, idxes: np.ndarray) -> None:
+        if self._diag:
+            # the leaf mirror applies the EXACT priority rule the trees do
+            # (tree_update/tree_update_np): p = |td|**alpha, 0 stays 0
+            td = np.asarray(td_errors, np.float64)
+            self.leaf_prio[np.asarray(idxes, np.int64)] = np.where(
+                td != 0.0, np.abs(td) ** self.spec.prio_exponent, 0.0)
         if self._native is not None:
             self._native.update(self.spec.prio_exponent, td_errors, idxes)
         else:
@@ -77,9 +98,28 @@ class HostReplay:
         spec = self.spec
         with self.lock:
             wv = int(np.asarray(block.weight_version))
+            if self._diag:
+                # eviction accounting for the slot about to be
+                # overwritten — BEFORE advance/tree writes, mirroring the
+                # device path's read-before-update order
+                slot = self.ring.ptr
+                if self.ring.slot_steps[slot] > 0:
+                    life = int(self.sample_count[slot])
+                    age = float(self.ring.total_adds - self.added_at[slot])
+                    lo = slot * spec.seqs_per_block
+                    prio = float(
+                        self.leaf_prio[lo:lo + spec.seqs_per_block].max())
+                    self.evict_stats += [1.0, float(life == 0), float(life),
+                                         age, prio]
+                    if life > 0:
+                        from r2d2_tpu.telemetry.histogram import bucket_index
+                        self.evict_life_hist[bucket_index(float(life))] += 1
+                self.sample_count[self.ring.ptr] = 0
+                self.added_at[self.ring.ptr] = self.ring.total_adds
             ptr = self.ring.advance(
                 int(np.asarray(block.learning_steps).sum()), wv)
             self.weight_version[ptr] = wv
+            self.lane[ptr] = int(np.asarray(block.lane))
             idxes = ptr * spec.seqs_per_block + np.arange(spec.seqs_per_block, dtype=np.int64)
             self._tree_update(np.asarray(block.priority, np.float64), idxes)
             self.obs[ptr] = block.obs_row
@@ -103,6 +143,10 @@ class HostReplay:
             idxes = idxes.astype(np.int64)
             b = idxes // spec.seqs_per_block
             s = idxes % spec.seqs_per_block
+            if self._diag:
+                # times-sampled per block row (duplicates accumulate —
+                # np.add.at, matching the device scatter-add)
+                np.add.at(self.sample_count, b, 1)
 
             burn_in = self.burn_in_steps[b, s]
             learning = self.learning_steps[b, s]
@@ -131,6 +175,7 @@ class HostReplay:
                     is_weights=is_weights.astype(np.float32),
                     idxes=idxes.astype(np.int32),
                     weight_version=self.weight_version[b],
+                    lane=self.lane[b],
                 ),
                 self.ring.total_adds,
             )
@@ -160,6 +205,45 @@ class HostReplay:
                 idxes, td_errors = idxes[mask], td_errors[mask]
             if idxes.size:
                 self._tree_update(td_errors, idxes)
+
+    def diag_raw(self) -> Optional[dict]:
+        """Raw replay-diagnostics readings for the host-placement learner
+        (ISSUE 10) — the numpy twin of the device path's interval
+        snapshot, in the SAME layout the ReplayDiagAggregator derives
+        from: 5-element tree moments [active, sum, sum_sq, max, at_max],
+        the leaf-priority histogram over active leaves (shared 64-bucket
+        log layout, parity-tested against the device value_counts), and
+        the eviction accumulators — READ AND RESET, like the device
+        path's snapshot, so the aggregator integrates cumulative totals
+        in one place. None when the diagnostics are off for this spec."""
+        if not self._diag:
+            return None
+        from r2d2_tpu.telemetry.histogram import value_counts_np
+        from r2d2_tpu.telemetry.replaydiag import _AT_MAX_RTOL
+        with self.lock:
+            leaves = self.leaf_prio
+            active_mask = leaves > 0
+            active = float(active_mask.sum())
+            mx = float(leaves.max()) if active else 0.0
+            at_max = float(np.sum(
+                active_mask & (leaves >= mx * (1.0 - _AT_MAX_RTOL)))) \
+                if active else 0.0
+            # vectorized (one log10 + bincount): this runs under the
+            # replay lock, so a per-leaf Python loop would stall
+            # sample()/add() for the whole flush on production rings
+            hist = value_counts_np(leaves, mask=active_mask)
+            ev, self.evict_stats = self.evict_stats, np.zeros(
+                (5,), np.float64)
+            lh, self.evict_life_hist = self.evict_life_hist, np.zeros(
+                (64,), np.int64)
+            return {
+                "tree_moments": np.asarray(
+                    [active, float(leaves.sum()),
+                     float(np.sum(leaves ** 2)), mx, at_max], np.float64),
+                "leaf_hist": hist,
+                "evict_stats": ev,
+                "evict_life_hist": lh,
+            }
 
     def __len__(self) -> int:
         return int(self.learning_steps.sum())

@@ -60,6 +60,14 @@ class ReplaySpec:
     # sampled batches carry stored_frame_height rows; blocks, host replay,
     # and the decoded network input stay at frame_height.
     exact_gather: bool = False
+    # Replay & data-pathology observability (ISSUE 10): True allocates the
+    # in-graph diagnostic state on the replay ring (per-slot sample-count
+    # ring, add-counter birth stamps, eviction accumulators) and routes
+    # the sample/add paths through its accounting. Resolved from
+    # telemetry.enabled AND telemetry.replay_diag_enabled — False (the
+    # kill switch) compiles add/sample programs without any diagnostic
+    # state, and the periodic record schema is byte-identical to PR9.
+    replay_diag: bool = False
 
     @classmethod
     def from_config(cls, cfg: Config) -> "ReplaySpec":
@@ -82,6 +90,8 @@ class ReplaySpec:
                 cfg.replay.pallas_sample_gather, "pallas_sample_gather"),
             exact_gather=resolve_pallas_setting(
                 cfg.replay.pallas_exact_gather, "pallas_exact_gather"),
+            replay_diag=(cfg.telemetry.enabled
+                         and cfg.telemetry.replay_diag_enabled),
         )
 
     @property
@@ -130,10 +140,13 @@ class ReplaySpec:
         hidden = n * s * 2 * self.hidden_dim * 4
         # action/reward/gamma (n,s,l) + 4 per-sequence i32 fields
         seq_meta = n * s * (3 * l + 4) * 4
-        # per-block weight-version stamps (staleness accounting)
-        versions = n * 4
+        # per-block weight-version + lane-provenance stamps
+        versions = 2 * n * 4
         tree = (2 ** self.tree_layers - 1) * 4
-        return obs + last_action + hidden + seq_meta + versions + tree
+        # replay diagnostics (ISSUE 10): sample-count + birth-stamp rings,
+        # the add counter, eviction accumulators, lifetime histogram
+        diag = (2 * n + 1 + 5 + 64) * 4 if self.replay_diag else 0
+        return obs + last_action + hidden + seq_meta + versions + tree + diag
 
     @property
     def seq_window(self) -> int:
@@ -189,6 +202,14 @@ class Block(struct.PyTreeNode):
     # -1 = unknown, reported as such rather than crashing.
     weight_version: jnp.ndarray = struct.field(
         default_factory=lambda: np.full((), -1, np.int32))  # () int32
+    # Lane provenance (ISSUE 10): the GLOBAL ε-ladder lane index that
+    # produced this block. Run loops stamp their lane-relative index and
+    # instrument_block_sink offsets it to the fleet-global ladder position
+    # (the on-device acting path stamps the global index in-graph). Same
+    # trailing-defaulted pattern as the PR5 staleness stamp: PR5-era block
+    # records without the field load as lane -1 = unknown.
+    lane: jnp.ndarray = struct.field(
+        default_factory=lambda: np.full((), -1, np.int32))  # () int32
 
 
 class ReplayState(struct.PyTreeNode):
@@ -208,6 +229,32 @@ class ReplayState(struct.PyTreeNode):
     seq_start: jnp.ndarray     # (N, S) int32
     weight_version: jnp.ndarray  # (N,) int32 — per-block generation stamp
     block_ptr: jnp.ndarray     # () int32 ring pointer
+    # Lane provenance ring (ISSUE 10): the producing ε-lane of each block
+    # row (-1 = unknown / pre-stamp). Trailing + defaulted (a None leaf
+    # drops from the pytree) so directly-constructed states in tests and
+    # external pipelines keep working; replay_init always allocates it.
+    lane: jnp.ndarray = None   # (N,) int32
+    # -- replay-diagnostics state (ISSUE 10; allocated only under
+    # spec.replay_diag — None leaves vanish from the pytree, so the kill
+    # switch compiles the PR9 programs byte-for-byte) --
+    sample_count: jnp.ndarray = None     # (N,) int32 — times any sequence
+                                         # of the block was sampled
+    added_at: jnp.ndarray = None         # (N,) int32 — add-counter value
+                                         # when the block landed
+    add_count: jnp.ndarray = None        # () int32 — monotonic adds
+    # eviction accumulators, updated at overwrite in replay_add_many:
+    # [evicted, never_sampled, lifetime_sum, age_sum,
+    # final_priority_sum] — ages in ring adds (blocks), lifetimes in
+    # times-sampled. SINCE-LAST-SNAPSHOT deltas: the diagnostics
+    # snapshot (telemetry/replaydiag.fused_replay_diag) reads AND
+    # resets them each interval, so the counts stay far below f32's
+    # 2^24 exact-integer ceiling on runs of any length; cumulative
+    # totals integrate host-side in float64 (ReplayDiagAggregator).
+    evict_stats: jnp.ndarray = None      # (5,) float32
+    # histogram (shared 64-bucket log layout) of times-sampled at
+    # eviction, over evicted slots that WERE sampled (the never-sampled
+    # count lives in evict_stats); reset with it
+    evict_life_hist: jnp.ndarray = None  # (64,) int32
 
 
 class SampleBatch(struct.PyTreeNode):
@@ -232,6 +279,10 @@ class SampleBatch(struct.PyTreeNode):
     # stamp keep constructing; a None leaf is dropped from the pytree, so
     # every jitted consumer that ignores it compiles unchanged.
     weight_version: jnp.ndarray = None
+    # (B,) int32 producing ε-lane of each sequence (the containing
+    # block's lane stamp; -1 = unknown) — same trailing-defaulted
+    # contract as weight_version (ISSUE 10).
+    lane: jnp.ndarray = None
 
 
 class RingAccountant:
@@ -297,4 +348,5 @@ def empty_block_np(spec: ReplaySpec) -> dict:
         num_sequences=np.zeros((), np.int32),
         sum_reward=np.full((), np.nan, np.float32),
         weight_version=np.full((), -1, np.int32),
+        lane=np.full((), -1, np.int32),
     )

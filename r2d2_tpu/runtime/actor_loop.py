@@ -73,8 +73,8 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
 
 def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
                           board=None, telemetry=None,
-                          weight_version: Optional[Callable[[], int]] = None
-                          ) -> Callable:
+                          weight_version: Optional[Callable[[], int]] = None,
+                          lane_base: Optional[int] = None) -> Callable:
     """Health + telemetry instrumentation around a block sink — the ONE
     wrapping point shared by every actor spawner (thread, process,
     single-host, multihost), so scalar and vector loops alike publish
@@ -89,8 +89,26 @@ def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
     count the actor is currently acting with) lands in the block's
     weight_version field, the generation half of the learner's sample-age
     accounting (ISSUE 5). ``slot`` is the fleet-local worker index (the
-    HeartbeatBoard row and the fault-spec key)."""
+    HeartbeatBoard row and the fault-spec key).
+
+    ``lane_base`` (ISSUE 10): the worker's first GLOBAL ε-ladder lane
+    index. The run loops stamp each block's lane-RELATIVE index (0 for
+    the scalar loop, the vector lane otherwise); this sink offsets it to
+    the global ladder position — the lane-provenance stamp the learner's
+    replay diagnostics attribute sampled batches to. Unknown stays
+    unknown: a block that reaches the sink UNstamped (-1 — a producer
+    that predates or misses the relative stamp) keeps -1 and lands in
+    the composition's unknown bucket rather than being fabricated into
+    the worker's first lane."""
     wrapped = sink
+    if lane_base is not None:
+        def sink_with_lane(block, _wrapped=wrapped, _base=int(lane_base)):
+            rel = int(np.asarray(block.lane))
+            if rel < 0:
+                return _wrapped(block)
+            return _wrapped(block.replace(lane=np.asarray(
+                _base + rel, np.int32)))
+        wrapped = sink_with_lane
     if weight_version is not None:
         def sink_with_stamp(block, _wrapped=wrapped):
             return _wrapped(block.replace(weight_version=np.asarray(
@@ -171,7 +189,9 @@ def _run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
         total_steps += 1
 
         if done or episode_steps == cfg.actor.max_episode_steps:
-            block = lb.finish(None)
+            # relative lane 0 (the scalar worker IS its only lane);
+            # instrument_block_sink offsets to the global ladder
+            block = lb.finish(None).replace(lane=np.asarray(0, np.int32))
             if policy.epsilon > cfg.actor.near_greedy_eps:
                 # only near-greedy actors report episode returns
                 block = block.replace(sum_reward=np.asarray(np.nan, np.float32))
@@ -181,7 +201,8 @@ def _run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
             lb.reset(obs)
             episode_steps = 0
         elif len(lb) == spec.block_length:
-            block_sink(lb.finish(policy.bootstrap_q()))
+            block_sink(lb.finish(policy.bootstrap_q()).replace(
+                lane=np.asarray(0, np.int32)))
 
         counter += 1
         if counter >= cfg.actor.actor_update_interval:
@@ -262,7 +283,10 @@ def _run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
             # episode accounting lives in the vector env (one source of
             # truth); auto-reset lanes short-circuit on dones[i]
             if dones[i] or venv.episode_steps[i] == cfg.actor.max_episode_steps:
-                block = lb.finish(None)
+                # lane-RELATIVE provenance stamp (ISSUE 10):
+                # instrument_block_sink offsets it to the global ladder
+                block = lb.finish(None).replace(
+                    lane=np.asarray(i, np.int32))
                 if policy.epsilons[i] > cfg.actor.near_greedy_eps:
                     # only near-greedy lanes report episode returns
                     block = block.replace(
@@ -278,7 +302,8 @@ def _run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
             elif len(lb) == spec.block_length:
                 if boot_q is None:
                     boot_q = policy.bootstrap_q()
-                block_sink(lb.finish(boot_q[i]))
+                block_sink(lb.finish(boot_q[i]).replace(
+                    lane=np.asarray(i, np.int32)))
         total_steps += n
 
         counter += n

@@ -105,7 +105,11 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
         board=health_board, telemetry=tele,
         # staleness stamp: the publish count of the params this actor is
         # acting with (the subscriber's last adopted version)
-        weight_version=lambda: sub.publish_count)
+        weight_version=lambda: sub.publish_count,
+        # lane provenance (ISSUE 10): actor_idx is the GLOBAL worker
+        # index (multihost fleets pass theirs), matching the ladder
+        # layout vector_lane_epsilons spreads ε over
+        lane_base=actor_idx * cfg.actor.envs_per_actor)
 
     try:
         run_loop(cfg, env, policy,

@@ -66,6 +66,17 @@ class Learner:
         self._learning_agg = (LearningAggregator(
             player_idx, cfg.runtime.save_dir, cfg.telemetry.nan_policy,
             cfg.optim.lr) if self._diag is not None else None)
+        # replay & data-pathology pillar (ISSUE 10): same spec/aggregator
+        # pattern — a ReplayDiag fuses sum-tree health, sample-lifetime
+        # accounting and lane composition into the step; None (the
+        # telemetry.replay_diag_enabled kill switch, mirrored by
+        # spec.replay_diag for the ring-state allocation) compiles the
+        # pre-pillar program and the record carries no replay_diag block.
+        from r2d2_tpu.telemetry.replaydiag import (ReplayDiag,
+                                                   ReplayDiagAggregator)
+        self._rdiag = ReplayDiag.from_config(cfg)
+        self._replay_agg = (ReplayDiagAggregator(self._rdiag.lanes)
+                            if self._rdiag is not None else None)
         # wired by the orchestrator alongside `publish`: () -> the weight
         # service's current publish count — the learner half of the
         # sample-age clock (None = ages reported as unknown)
@@ -100,12 +111,12 @@ class Learner:
                 self._step_fn, place_state, self._place_batch = (
                     make_tp_external_batch_step(
                         net, self.spec, cfg.optim, cfg.network.use_double,
-                        tp_mesh, diag=self._diag))
+                        tp_mesh, diag=self._diag, rdiag=self._rdiag))
                 self.train_state = place_state(self.train_state)
             else:
                 self._step_fn = make_external_batch_step(
                     net, self.spec, cfg.optim, cfg.network.use_double,
-                    diag=self._diag)
+                    diag=self._diag, rdiag=self._rdiag)
                 self._place_batch = jax.device_put
             self._prefetch_q: queue_mod.Queue = queue_mod.Queue(
                 maxsize=max(1, cfg.runtime.prefetch_batches))
@@ -139,7 +150,8 @@ class Learner:
                 self.replay_state = sharded_replay_init(self.spec, self.mesh)
                 self._step_fn = make_sharded_learner_step(
                     net, self.spec, cfg.optim, cfg.network.use_double,
-                    self.mesh, steps_per_dispatch=self._k, diag=self._diag)
+                    self.mesh, steps_per_dispatch=self._k, diag=self._diag,
+                    rdiag=self._rdiag)
                 self._sharded_add = make_sharded_replay_add(
                     self.spec, self.mesh)
             else:
@@ -147,11 +159,11 @@ class Learner:
                 if self._k > 1:
                     self._step_fn = make_multi_learner_step(
                         net, self.spec, cfg.optim, cfg.network.use_double,
-                        self._k, diag=self._diag)
+                        self._k, diag=self._diag, rdiag=self._rdiag)
                 else:
                     self._step_fn = make_learner_step(
                         net, self.spec, cfg.optim, cfg.network.use_double,
-                        diag=self._diag)
+                        diag=self._diag, rdiag=self._rdiag)
 
         self.metrics = metrics or TrainMetrics(player_idx, cfg.runtime.save_dir,
                                                resume=bool(cfg.runtime.resume))
@@ -748,6 +760,9 @@ class Learner:
             # hold the dispatch's ld/ outputs (device values, no sync);
             # aggregated into the 'learning' record block at flush time
             self._learning_agg.on_dispatch(m)
+        if self._replay_agg is not None:
+            # same contract for the rd/ outputs (replay pillar, ISSUE 10)
+            self._replay_agg.on_dispatch(m)
 
         rt = self.cfg.runtime
         if (self.publish is not None
@@ -804,6 +819,14 @@ class Learner:
             self.metrics.set_learning(self._learning_agg.flush(
                 self._host_step, publish_count=pub,
                 occupancy_versions=self.ring.live_versions()))
+        if self._replay_agg is not None:
+            # host placement: the HostReplay numpy twin supplies the
+            # sum-tree health + eviction snapshot the external-batch step
+            # cannot form in-graph (ISSUE 10)
+            host_stats = (self.host_replay.diag_raw()
+                          if self.host_mode else None)
+            self.metrics.set_replay_diag(
+                self._replay_agg.flush(host_stats=host_stats))
 
     def save(self, index: int) -> str:
         ts = self.train_state

@@ -89,6 +89,13 @@ class TrainMetrics:
         # every non-anakin run (consumers key on its presence)
         self._anakin = None
 
+        # replay & data-pathology block (ISSUE 10): set per flush by the
+        # ReplayDiagAggregator (sum-tree health, eviction lifetimes, lane
+        # composition); emitted once per record then cleared, OMITTED
+        # entirely under the telemetry.replay_diag_enabled kill switch
+        # (schema byte-identical to PR9, stability-tested)
+        self._replay_diag = None
+
         # cost-model block (ISSUE 9): the analytic per-component
         # flops/bytes summary of the configured step, set ONCE by the
         # Learner's first flush and emitted on the next record only (it
@@ -172,6 +179,15 @@ class TrainMetrics:
         ratio — runtime/anakin_loop.py flush_stats); None = nothing this
         interval and the record carries no 'anakin' key."""
         self._anakin = block
+
+    def set_replay_diag(self, block: Optional[dict]) -> None:
+        """Attach the interval's replay-diagnostics block (sum-tree
+        health + collapse indicators, per-slot eviction lifetimes with
+        the never-sampled fraction, ε-lane composition of the sampled
+        batches — telemetry/replaydiag.py); None = nothing this interval
+        (no training, or the pillar disabled) and the record carries no
+        'replay_diag' key."""
+        self._replay_diag = block
 
     def set_costs(self, block: Optional[dict]) -> None:
         """Attach the one-shot cost-model block (ISSUE 9): analytic
@@ -292,6 +308,13 @@ class TrainMetrics:
             # shard_imbalance rule sees its own interval
             record["anakin"] = self._anakin
             self._anakin = None
+        if self._replay_diag is not None:
+            # ONE replay_diag block per interval (ISSUE 10), consumed on
+            # emission; before the sentinel pass so the priority-collapse
+            # / never-sampled / lane-starvation rules see their own
+            # interval
+            record["replay_diag"] = self._replay_diag
+            self._replay_diag = None
         if self._costs is not None:
             # ONE costs block per run (ISSUE 9), consumed on emission —
             # the numbers are pure config constants, so one record

@@ -206,7 +206,11 @@ class PlayerStack:
             board=self.heartbeats, telemetry=self.telemetry,
             # generation stamp: the store version this thread actor last
             # adopted (reader_id = slot index, matching weight_poll below)
-            weight_version=lambda: self.store.reader_version(i))
+            weight_version=lambda: self.store.reader_version(i),
+            # lane provenance (ISSUE 10): worker i owns the contiguous
+            # global-ladder slice [i*k, (i+1)*k) — the same layout
+            # vector_lane_epsilons spreads ε over
+            lane_base=i * cfg.actor.envs_per_actor)
 
         def loop(env=env, policy=policy, run_loop=run_loop, reader_id=i,
                  sink=sink, should_stop=should_stop):

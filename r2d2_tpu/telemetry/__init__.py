@@ -31,6 +31,14 @@ telemetry nested under it, and the declarative alert engine producing
 the ``alerts`` block + ``alerts_player{p}.jsonl`` (tools/sentinel.py is
 the offline/CLI face).
 
+``replaydiag.py`` (ISSUE 10) is the REPLAY pillar: sum-tree / priority
+health (leaf histograms on the shared bucket layout, effective sample
+size, collapse indicators), per-slot sample-lifetime accounting (the
+never-sampled-before-eviction fraction), and ε-lane provenance of
+sampled batches — fused into the jitted sample/update path and
+aggregated into the record's ``replay_diag`` block, with 4 stock alert
+rules riding alerts.py.
+
 ``costmodel.py`` / ``traceparse.py`` (ISSUE 9) are the COMPUTE pillar:
 XLA ``cost_analysis()``/``memory_analysis()`` per-program cost tables
 across every step factory (the ``make regress`` exact-match costs gate
@@ -58,6 +66,7 @@ from r2d2_tpu.telemetry.histogram import (NBUCKETS, LogHistogram,
                                           value_summary)
 from r2d2_tpu.telemetry.learning import LearningAggregator, LearningDiag
 from r2d2_tpu.telemetry.profiler import ProfilerCapture, trace
+from r2d2_tpu.telemetry.replaydiag import ReplayDiag, ReplayDiagAggregator
 from r2d2_tpu.telemetry.resources import (BufferRegistry, ResourceMonitor,
                                           device_memory_stats, host_usage,
                                           pytree_nbytes, register_buffer)
@@ -68,7 +77,8 @@ __all__ = [
     "NBUCKETS", "NULL_TELEMETRY", "STAGES", "STAGE_INDEX",
     "AlertEngine", "AlertRule", "BufferRegistry", "CompileMonitor",
     "LearningAggregator", "LearningDiag", "LogHistogram",
-    "ProfilerCapture", "ResourceMonitor", "SpanTracer", "StageTimers",
+    "ProfilerCapture", "ReplayDiag", "ReplayDiagAggregator",
+    "ResourceMonitor", "SpanTracer", "StageTimers",
     "Telemetry", "TelemetryBoard", "active_monitor",
     "analytic_component_costs", "aot_coverage", "attribute_trace",
     "bucket_bounds",

@@ -132,6 +132,34 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("shard_imbalance", "threshold",
                   ("anakin", "shard_imbalance"),
                   tcfg.alerts_shard_imbalance, "warn"),
+        # replay & data-pathology rules (ISSUE 10; the replay_diag block,
+        # telemetry/replaydiag.py — inactive on records without it):
+        # priority collapse = the sampling distribution's effective
+        # sample size shrank to a sliver of the live leaves (training is
+        # grinding a handful of sequences)
+        AlertRule("priority_collapse", "threshold",
+                  ("replay_diag", "tree", "ess_frac"),
+                  tcfg.alerts_replay_ess_frac, "warn", below=True),
+        # a mass of leaves tied at the tree max: prioritization has
+        # stopped discriminating (constant-stamp seeding never resampled,
+        # or TD errors saturating)
+        AlertRule("priority_saturation", "threshold",
+                  ("replay_diag", "tree", "frac_at_max"),
+                  tcfg.alerts_priority_saturation, "warn"),
+        # replay sized/prioritized wrong: the share of experience evicted
+        # without EVER being sampled is growing past its own history.
+        # Watches the PER-INTERVAL fraction — the cumulative one's
+        # per-window change decays as 1/t and would mask late-onset
+        # pathology behind a long healthy prefix.
+        AlertRule("never_sampled_growth", "growth",
+                  ("replay_diag", "evictions", "interval",
+                   "never_sampled_frac"),
+                  tcfg.alerts_never_sampled_growth, "warn", window=w),
+        # ε-ladder lanes contributing nothing to the learning signal —
+        # Ape-X exploration measured at the point of learning
+        AlertRule("lane_starvation", "threshold",
+                  ("replay_diag", "lanes", "starved_frac"),
+                  tcfg.alerts_lane_starved_frac, "warn"),
     )
 
 

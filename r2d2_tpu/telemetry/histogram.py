@@ -31,11 +31,43 @@ _INV_STEP = 1.0 / _STEP
 _LOG_LO = math.log10(_LO)
 
 # Public aliases for the bucket layout — the device-side bucketize-scatter
-# in telemetry/learning.py reproduces bucket_index() inside jit and MUST
-# use the exact same constants (parity-tested device vs host).
+# below reproduces bucket_index() inside jit and MUST use the exact same
+# constants (parity-tested device vs host).
 BUCKET_LO = _LO
 BUCKET_LOG_LO = _LOG_LO
 BUCKET_INV_STEP = _INV_STEP
+
+
+# ---------------------------------------------------------------------------
+# Device-side twin (jnp; traced into fused steps). ONE implementation of
+# the bucketize-scatter shared by the learning diagnostics
+# (telemetry/learning.py) and the replay diagnostics
+# (telemetry/replaydiag.py) — a third per-pillar copy of the layout math
+# would be a parity bug waiting to happen (ISSUE 10 satellite).
+
+
+def bucketize_values(x):
+    """jit twin of bucket_index over |x|: (same-shape) int32 bucket
+    indices into the shared 64-bucket log layout. Non-finite values clamp
+    into the TOP bucket (the pillars also count them separately) so the
+    scatter index stays in range."""
+    import jax.numpy as jnp
+    ax = jnp.abs(x).astype(jnp.float32)
+    i = jnp.floor((jnp.log10(jnp.maximum(ax, BUCKET_LO)) - BUCKET_LOG_LO)
+                  * BUCKET_INV_STEP).astype(jnp.int32)
+    i = jnp.where(jnp.isfinite(ax), i, NBUCKETS - 1)
+    return jnp.clip(i, 0, NBUCKETS - 1)
+
+
+def value_counts(x, mask=None):
+    """(NBUCKETS,) int32 histogram of |x| via bucketize + scatter-add —
+    the device-side histogram primitive. ``mask`` (same shape, 0/1)
+    excludes padded entries."""
+    import jax.numpy as jnp
+    idx = bucketize_values(x).reshape(-1)
+    ones = (jnp.ones_like(idx) if mask is None
+            else mask.reshape(-1).astype(jnp.int32))
+    return jnp.zeros((NBUCKETS,), jnp.int32).at[idx].add(ones)
 
 
 def bucket_index(seconds: float) -> int:
@@ -45,6 +77,25 @@ def bucket_index(seconds: float) -> int:
         return 0
     i = int((math.log10(seconds) - _LOG_LO) * _INV_STEP)
     return NBUCKETS - 1 if i >= NBUCKETS else i
+
+
+def value_counts_np(x: np.ndarray, mask=None) -> np.ndarray:
+    """Vectorized numpy twin of :func:`value_counts` (same layout, same
+    clamping): one log10 + bincount instead of a per-element Python loop
+    — what host-side consumers over many values use (HostReplay's leaf
+    histogram runs under the replay lock, where a 10^4-iteration Python
+    loop would stall sample()/add() every flush)."""
+    ax = np.abs(np.asarray(x, np.float64)).reshape(-1)
+    # invalid too: floor(NaN).astype(int) warns before the isfinite
+    # fallback below replaces the index
+    with np.errstate(divide="ignore", invalid="ignore"):
+        i = np.floor((np.log10(np.maximum(ax, _LO)) - _LOG_LO)
+                     * _INV_STEP).astype(np.int64)
+    i = np.where(np.isfinite(ax), i, NBUCKETS - 1)
+    i = np.clip(i, 0, NBUCKETS - 1)
+    if mask is not None:
+        i = i[np.asarray(mask, bool).reshape(-1)]
+    return np.bincount(i, minlength=NBUCKETS).astype(np.int64)
 
 
 def bucket_bounds(i: int) -> tuple:

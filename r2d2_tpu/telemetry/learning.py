@@ -54,8 +54,12 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from r2d2_tpu.telemetry.histogram import (
-    BUCKET_INV_STEP, BUCKET_LO, BUCKET_LOG_LO, NBUCKETS, value_summary)
+# bucketize_values / value_counts are re-exported here for back-compat:
+# they moved to telemetry/histogram.py (the ONE home of the bucket
+# layout, host and device sides — ISSUE 10 satellite) so this pillar and
+# replaydiag.py share a single scatter implementation.
+from r2d2_tpu.telemetry.histogram import (  # noqa: F401
+    NBUCKETS, bucketize_values, value_counts, value_summary)
 
 _EPS = 1e-3          # ΔQ normalization floor (a near-zero max-Q state must
                      # not blow the ratio up)
@@ -83,30 +87,6 @@ class LearningDiag:
 
 # ---------------------------------------------------------------------------
 # Device-side pieces (jnp; traced into the fused step)
-
-
-def bucketize_values(x):
-    """jit twin of histogram.bucket_index over |x|: (same-shape) int32
-    bucket indices into the shared 64-bucket log layout. Non-finite values
-    clamp into the TOP bucket (they are also counted separately by the
-    non-finite guard) so the scatter index stays in range."""
-    import jax.numpy as jnp
-    ax = jnp.abs(x).astype(jnp.float32)
-    i = jnp.floor((jnp.log10(jnp.maximum(ax, BUCKET_LO)) - BUCKET_LOG_LO)
-                  * BUCKET_INV_STEP).astype(jnp.int32)
-    i = jnp.where(jnp.isfinite(ax), i, NBUCKETS - 1)
-    return jnp.clip(i, 0, NBUCKETS - 1)
-
-
-def value_counts(x, mask=None):
-    """(NBUCKETS,) int32 histogram of |x| via bucketize + scatter-add —
-    the device-side histogram primitive. ``mask`` (same shape, 0/1)
-    excludes padded entries."""
-    import jax.numpy as jnp
-    idx = bucketize_values(x).reshape(-1)
-    ones = (jnp.ones_like(idx) if mask is None
-            else mask.reshape(-1).astype(jnp.int32))
-    return jnp.zeros((NBUCKETS,), jnp.int32).at[idx].add(ones)
 
 
 def group_grad_norms(grads) -> Dict[str, Any]:

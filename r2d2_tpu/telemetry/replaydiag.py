@@ -1,0 +1,464 @@
+"""Replay & data-pathology observability (ISSUE 10) — the fifth
+telemetry pillar: what the prioritized recurrent replay actually FEEDS
+the learner, fused into the jitted sample/update path.
+
+After four pillars the stack can see how fast data moves (PR 4), how
+stale it is (PR 5), and what it costs (PR 9) — but not what the sum-tree
+prioritizes, which sequences get learned from versus evicted unseen, or
+which ε-ladder lanes produce the learning signal. Three instruments,
+behind ``telemetry.replay_diag_enabled`` (off ⇒ records byte-identical
+to the PR9 schema — the established kill-switch contract):
+
+  * **sum-tree / priority health** — a device-side histogram of the live
+    leaf priorities on the shared 64-bucket log layout
+    (telemetry/histogram.py — the SAME bucketize-scatter the learning
+    diagnostics use), plus collapse indicators derived from one
+    5-element moment vector [active, Σp, Σp², max, count-at-max]:
+    effective sample size of the sampling distribution
+    (ESS = (Σp)²/Σp²), max/mean leaf ratio, and the
+    fraction-at-max-priority. Computed under ``lax.cond`` every
+    ``telemetry.replay_diag_interval`` learner steps inside the existing
+    step factories; ``replay/host_replay.py`` is the numpy twin for host
+    placement (parity-tested).
+  * **per-slot sample-lifetime accounting** — ReplayState carries an
+    in-graph (N,) sample-count ring incremented at the sample gather
+    (``note_sampled``) and read at overwrite in ``replay_add_many``, so
+    each eviction accumulates the retired slot's lifetime (times sampled
+    before overwrite, age at eviction in ring adds, final priority) and
+    the learner reports the **never-sampled-before-eviction fraction** —
+    the single best "is replay sized and prioritized right" number.
+  * **lane provenance** — blocks carry their ε-ladder lane index
+    end-to-end (the PR5 staleness-stamp pattern: LocalBuffer loops stamp
+    the relative lane, ``instrument_block_sink`` offsets to the global
+    ladder, the anakin paths stamp in-graph), and every sampled batch's
+    per-lane composition lands in a (lanes+1,)-bincount — Ape-X's
+    exploration ladder measured at the point of LEARNING, not just at
+    acting.
+
+Under the dp-sharded step the per-shard views are ``all_gather``-ed
+(``rd/shard_*`` keys, leading dp axis) and the host aggregator derives
+both per-shard rows and the merged view from them; the single-chip path
+emits the unprefixed keys directly. :class:`ReplayDiagAggregator` builds
+the periodic record's ``replay_diag`` block; 4 stock alert rules
+(priority_collapse, priority_saturation, never_sampled_growth,
+lane_starvation) watch it in telemetry/alerts.py.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from r2d2_tpu.telemetry.histogram import NBUCKETS, value_counts, value_summary
+
+# near-max tolerance for the count-at-max indicator: f32 tree priorities
+# that round to the max still count as "at max"
+_AT_MAX_RTOL = 1e-6
+
+
+@dataclass(frozen=True)
+class ReplayDiag:
+    """Static (hashable) replay-diagnostics spec closed over by the jitted
+    step factories — the LearningDiag pattern. ``None`` in the factories
+    means the pillar is OFF and (together with ``ReplaySpec.replay_diag``
+    False) the compiled step is byte-identical to the pre-diagnostics
+    program."""
+
+    interval: int = 50        # learner steps between sum-tree snapshots
+    lanes: int = 0            # global ε-ladder width (lane bincount size)
+
+    @classmethod
+    def from_config(cls, cfg) -> Optional["ReplayDiag"]:
+        """The ONE gating rule: replay diagnostics require BOTH the master
+        telemetry switch and the pillar kill switch — the same resolution
+        ReplaySpec.from_config applies to the ring-state allocation."""
+        t = cfg.telemetry
+        if not (t.enabled and t.replay_diag_enabled):
+            return None
+        if cfg.actor.on_device:
+            lanes = cfg.actor.anakin_lanes
+        else:
+            # the GLOBAL ladder width: multihost fleets stamp global lane
+            # indices spanning every process's workers (the same
+            # process_count * num_actors layout vector_lane_epsilons
+            # spreads ε over), so the bincount must cover all of them —
+            # a rank-local width would route every remote rank's stamps
+            # to the unknown bucket
+            procs = (max(cfg.mesh.num_processes, 1)
+                     if cfg.mesh.multihost else 1)
+            lanes = procs * cfg.actor.num_actors * cfg.actor.envs_per_actor
+        return cls(interval=t.replay_diag_interval, lanes=lanes)
+
+
+# ---------------------------------------------------------------------------
+# Device-side pieces (jnp; traced into the fused step)
+
+
+def tree_health_moments(tree, num_layers: int):
+    """(moments, hist) of the tree's LIVE leaves: moments is the (5,) f32
+    vector [active, Σp, Σp², max, count-at-max] every derived collapse
+    indicator comes from (host side, :func:`derive_tree_stats`), hist the
+    (64,) leaf-priority histogram on the shared log layout. Zero-priority
+    leaves (empty/padding slots — unsamplable by construction) are
+    excluded everywhere."""
+    import jax.numpy as jnp
+    leaves = tree[2 ** (num_layers - 1) - 1:]
+    mask = leaves > 0
+    maskf = mask.astype(jnp.float32)
+    active = jnp.sum(maskf)
+    mx = jnp.max(leaves)
+    at_max = jnp.sum(maskf * (leaves >= mx * (1.0 - _AT_MAX_RTOL)))
+    moments = jnp.stack([
+        active, jnp.sum(leaves), jnp.sum(leaves ** 2), mx, at_max])
+    return moments.astype(jnp.float32), value_counts(
+        leaves, mask=mask.astype(jnp.int32))
+
+
+def lane_counts(lane, num_lanes: int):
+    """(num_lanes + 1,) int32 bincount of a batch's producing lanes —
+    the last bucket collects unknown (-1 / out-of-range) stamps."""
+    import jax.numpy as jnp
+    lane = lane.astype(jnp.int32).reshape(-1)
+    idx = jnp.where((lane >= 0) & (lane < num_lanes), lane, num_lanes)
+    return jnp.zeros((num_lanes + 1,), jnp.int32).at[idx].add(1)
+
+
+def fused_replay_diag(spec, rdiag: ReplayDiag, new_step, replay_state,
+                      batch):
+    """The device-side replay-diagnostics block, traced into the fused
+    step: returns ``(replay_state, rd_metrics)``.
+
+    Every step: the (N,) sample-count ring is incremented at the sampled
+    blocks (one scatter-add) and the batch's lane composition bincounted.
+    Every ``rdiag.interval`` steps, under ``lax.cond`` so the
+    steady-state step pays nothing: the sum-tree health snapshot
+    (moments + leaf histogram) and a READ-AND-RESET of the eviction
+    accumulators ``replay_add_many`` maintains — the emitted eviction
+    values are since-last-snapshot DELTAS, which stay far below f32's
+    2^24 exact-integer ceiling no matter how long the run is; the host
+    aggregator integrates the cumulative totals in float64. Off-interval
+    steps return NaN moments / zero histograms, which the aggregator
+    skips."""
+    import jax
+    import jax.numpy as jnp
+
+    rs = replay_state
+    out: Dict[str, Any] = {}
+    if rs.sample_count is not None:
+        with jax.named_scope("replay_diag_count"):
+            block_idx = batch.idxes // spec.seqs_per_block
+            rs = rs.replace(
+                sample_count=rs.sample_count.at[block_idx].add(1))
+    if batch.lane is not None and rdiag.lanes > 0:
+        out["rd/lane_counts"] = lane_counts(batch.lane, rdiag.lanes)
+
+    has_evict = rs.evict_stats is not None
+
+    def on(_):
+        moments, hist = tree_health_moments(rs.tree, spec.tree_layers)
+        if has_evict:
+            ev, lh = rs.evict_stats, rs.evict_life_hist
+            ev_new = jnp.zeros_like(rs.evict_stats)
+            lh_new = jnp.zeros_like(rs.evict_life_hist)
+        else:
+            ev = jnp.full((5,), jnp.nan, jnp.float32)
+            lh = jnp.zeros((NBUCKETS,), jnp.int32)
+            ev_new = lh_new = None
+        return (moments, hist, ev, lh) + \
+            ((ev_new, lh_new) if has_evict else ())
+
+    def off(_):
+        base = (jnp.full((5,), jnp.nan, jnp.float32),
+                jnp.zeros((NBUCKETS,), jnp.int32),
+                jnp.full((5,), jnp.nan, jnp.float32),
+                jnp.zeros((NBUCKETS,), jnp.int32))
+        return base + ((rs.evict_stats, rs.evict_life_hist)
+                       if has_evict else ())
+
+    vals = jax.lax.cond(
+        (new_step % rdiag.interval) == 0, on, off, operand=None)
+    moments, hist, ev, lh = vals[:4]
+    if has_evict:
+        rs = rs.replace(evict_stats=vals[4], evict_life_hist=vals[5])
+    out["rd/tree_moments"] = moments
+    out["rd/leaf_hist"] = hist
+    out["rd/evict_stats"] = ev
+    out["rd/evict_life_hist"] = lh
+    return rs, out
+
+
+def shard_replay_diag(rd: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+    """Reshape a per-shard ``fused_replay_diag`` output for the manual
+    shard_map step's replicated (P()) metric specs: snapshot keys gather
+    to ``rd/shard_*`` arrays with a leading dp axis (the per-shard views
+    the aggregator reports AND merges), lane counts psum to one global
+    composition."""
+    import jax
+    out: Dict[str, Any] = {}
+    if "rd/lane_counts" in rd:
+        out["rd/lane_counts"] = jax.lax.psum(rd["rd/lane_counts"],
+                                             axis_name)
+    for key in ("rd/tree_moments", "rd/leaf_hist", "rd/evict_stats",
+                "rd/evict_life_hist"):
+        out[key.replace("rd/", "rd/shard_")] = jax.lax.all_gather(
+            rd[key], axis_name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side derivation + aggregation
+
+
+def derive_tree_stats(moments, hist=None) -> Optional[dict]:
+    """The record's ``tree`` sub-block from one (5,) moment vector
+    [active, Σp, Σp², max, at_max] (+ its leaf histogram): effective
+    sample size of the sampling distribution, ESS as a fraction of the
+    live leaves, max/mean ratio, fraction-at-max. None when the snapshot
+    is empty/off-interval (NaN or zero active)."""
+    m = np.asarray(moments, np.float64).reshape(-1)
+    if m.size < 5 or not np.isfinite(m[0]) or m[0] <= 0:
+        return None
+    active, s1, s2, mx, at_max = m[:5]
+    ess = (s1 * s1 / s2) if s2 > 0 else 0.0
+    mean = s1 / active
+    out = {
+        "active_leaves": int(active),
+        "ess": round(ess, 2),
+        "ess_frac": round(ess / active, 4),
+        "max_mean_ratio": round(mx / mean, 3) if mean > 0 else None,
+        "frac_at_max": round(at_max / active, 4),
+    }
+    if hist is not None:
+        counts = np.asarray(hist, np.int64).reshape(-1)
+        out["priorities"] = value_summary(counts)
+        out["leaf_hist_counts"] = [int(c) for c in counts]
+    return out
+
+
+def merge_shard_moments(shard_moments) -> np.ndarray:
+    """One merged (5,) moment vector from (dp, 5) per-shard moments:
+    sums for active/Σp/Σp², max of maxes, and at-max counted against the
+    GLOBAL max (shards whose local max falls below it contribute 0)."""
+    sm = np.asarray(shard_moments, np.float64).reshape(-1, 5)
+    gmx = sm[:, 3].max() if sm.size else 0.0
+    at_max = float(np.sum(np.where(
+        sm[:, 3] >= gmx * (1.0 - _AT_MAX_RTOL), sm[:, 4], 0.0)))
+    return np.asarray([sm[:, 0].sum(), sm[:, 1].sum(), sm[:, 2].sum(),
+                       gmx, at_max], np.float64)
+
+
+def derive_evictions(stats, life_hist=None,
+                     interval=None) -> Optional[dict]:
+    """The record's ``evictions`` sub-block from the CUMULATIVE (5,)
+    accumulator [evicted, never_sampled, lifetime_sum, age_sum,
+    final_priority_sum] (float64, integrated host-side from the device
+    path's per-snapshot deltas): the never-sampled-before-eviction
+    fraction plus mean lifetime / age-at-eviction (ring adds) / final
+    priority, the lifetime histogram summary, and — from ``interval``,
+    this flush's delta vector — the interval sub-block whose
+    ``never_sampled_frac`` the never_sampled_growth rule watches (the
+    cumulative fraction's per-window change decays as 1/t, so a
+    pathology starting late in a long run would never move it past the
+    growth bound)."""
+    s = np.asarray(stats, np.float64).reshape(-1)
+    if s.size < 5 or not np.isfinite(s[0]):
+        return None
+    evicted, never, life, age, prio = s[:5]
+    out: Dict[str, Any] = {"evicted": int(evicted),
+                           "never_sampled": int(never)}
+    if evicted > 0:
+        out.update({
+            "never_sampled_frac": round(never / evicted, 4),
+            "mean_lifetime": round(life / evicted, 3),
+            "mean_age_blocks": round(age / evicted, 2),
+            "mean_final_priority": round(prio / evicted, 6),
+        })
+    if life_hist is not None:
+        out["lifetime"] = value_summary(
+            np.asarray(life_hist, np.int64).reshape(-1))
+    if interval is not None:
+        d = np.asarray(interval, np.float64).reshape(-1)
+        out["interval"] = {"evicted": int(d[0]),
+                           "never_sampled": int(d[1])}
+        if d[0] > 0:
+            out["interval"]["never_sampled_frac"] = round(d[1] / d[0], 4)
+    return out
+
+
+def derive_lanes(counts, num_lanes: int) -> Optional[dict]:
+    """The record's ``lanes`` sub-block from the interval's summed
+    (lanes+1,) bincount: how the ε ladder actually composed the sampled
+    batches — active/starved lane fractions, the dominant lane's share,
+    unknown-stamp fraction, and (for ladders that fit) the raw counts."""
+    c = np.asarray(counts, np.int64).reshape(-1)
+    total = int(c.sum())
+    if total == 0 or num_lanes <= 0:
+        return None
+    known = c[:-1]
+    active = int(np.sum(known > 0))
+    out = {
+        "total_lanes": num_lanes,
+        "sampled_sequences": total,
+        "unknown_frac": round(float(c[-1]) / total, 4),
+        "active_lanes": active,
+        "starved_frac": round(1.0 - active / num_lanes, 4),
+        "max_share": round(float(known.max()) / max(int(known.sum()), 1),
+                           4),
+    }
+    if num_lanes <= 64:
+        out["counts"] = [int(x) for x in known]
+    return out
+
+
+class ReplayDiagAggregator:
+    """Host-side accumulator for the fused step's ``rd/`` outputs: holds
+    device values between metric flushes (no sync on the step path), then
+    produces the periodic record's ``replay_diag`` block in the same
+    device_get the learning aggregator batches. Snapshot keys (tree
+    moments / histograms / eviction accumulators) take the NEWEST
+    interval firing — they are state snapshots, not flows — while lane
+    counts SUM across the interval's dispatches. ``host_stats``
+    (HostReplay.diag_raw) substitutes for the device snapshot under host
+    placement."""
+
+    def __init__(self, lanes: int):
+        self.lanes = lanes
+        self._pending: List[Dict[str, Any]] = []
+        # cumulative eviction totals, integrated in float64 from the
+        # device/host paths' per-snapshot deltas (the device
+        # accumulators read-and-reset each snapshot precisely so no f32
+        # counter ever has to hold a run-length total)
+        self._cum_evict = np.zeros(5, np.float64)
+        self._cum_life = np.zeros(NBUCKETS, np.int64)
+        self._evict_seen = False
+
+    def on_dispatch(self, metrics: Dict[str, Any]) -> None:
+        rd = {k: v for k, v in metrics.items() if k.startswith("rd/")}
+        if rd:
+            self._pending.append(rd)
+
+    @staticmethod
+    def _last_snapshot(host, mkey, extras=()):
+        """Newest row (by dispatch + scan order) whose moment vector is a
+        live interval firing (finite leading element), paired with the
+        same row of each extra key. Handles the multi-step scan's (K, 5)
+        stacking — a (5,) single-step value is one row."""
+        for d in reversed(host):
+            if mkey not in d:
+                continue
+            rows = np.asarray(d[mkey], np.float64).reshape(-1, 5)
+            ex = [np.asarray(d[k]).reshape(rows.shape[0], -1)
+                  for k in extras]
+            for i in range(rows.shape[0] - 1, -1, -1):
+                if np.isfinite(rows[i, 0]):
+                    return rows[i], [e[i] for e in ex]
+        return None, []
+
+    @staticmethod
+    def _sum_evict_deltas(host, key, hist_key):
+        """Sum EVERY finite eviction-delta row this flush (each row is a
+        disjoint since-last-snapshot window; off-interval rows are NaN),
+        plus the matching lifetime-histogram rows. Handles the single
+        path's (…, 5), the multi-step scan's (K, 5), and the sharded
+        paths' (…, dp, 5) layouts alike by flattening to rows. Returns
+        (delta5, hist, found)."""
+        delta = np.zeros(5, np.float64)
+        hist = np.zeros(NBUCKETS, np.int64)
+        found = False
+        for d in host:
+            if key not in d:
+                continue
+            rows = np.asarray(d[key], np.float64).reshape(-1, 5)
+            hrows = np.asarray(d[hist_key], np.int64).reshape(
+                rows.shape[0], -1)
+            finite = np.isfinite(rows[:, 0])
+            if finite.any():
+                found = True
+                delta += rows[finite].sum(axis=0)
+                hist += hrows[finite].sum(axis=0)
+        return delta, hist, found
+
+    @staticmethod
+    def _last_shard_snapshot(host, mkey, extras=()):
+        """Per-shard twin of ``_last_snapshot``: newest (dp, 5) moment
+        slab with any finite shard, plus matching (dp, -1) extras."""
+        for d in reversed(host):
+            if mkey not in d:
+                continue
+            m = np.asarray(d[mkey], np.float64)
+            dp = m.shape[-2]
+            slabs = m.reshape(-1, dp, 5)
+            ex = [np.asarray(d[k]) for k in extras]
+            ex = [e.reshape(slabs.shape[0], dp, -1) for e in ex]
+            for i in range(slabs.shape[0] - 1, -1, -1):
+                if np.isfinite(slabs[i, :, 0]).any():
+                    return slabs[i], [e[i] for e in ex]
+        return None, []
+
+    def flush(self, host_stats: Optional[dict] = None) -> Optional[dict]:
+        """Aggregate the interval and return the ``replay_diag`` record
+        block (None when no training dispatches ran)."""
+        import jax
+        if not self._pending:
+            return None
+        pending, self._pending = self._pending, []
+        host = jax.device_get(pending)
+
+        block: Dict[str, Any] = {}
+
+        # -- sum-tree health: merged view + per-shard rows --
+        moments = hist = None
+        sh_m, sh_ex = self._last_shard_snapshot(
+            host, "rd/shard_tree_moments", ("rd/shard_leaf_hist",))
+        if sh_m is not None:
+            block["shards"] = [derive_tree_stats(sh_m[i])
+                               for i in range(sh_m.shape[0])]
+            moments = merge_shard_moments(sh_m)
+            hist = sh_ex[0].reshape(sh_m.shape[0], -1).sum(axis=0)
+            delta, dhist, found = self._sum_evict_deltas(
+                host, "rd/shard_evict_stats", "rd/shard_evict_life_hist")
+        else:
+            m, ex = self._last_snapshot(
+                host, "rd/tree_moments", ("rd/leaf_hist",))
+            if m is not None:
+                moments, hist = m, ex[0]
+            delta, dhist, found = self._sum_evict_deltas(
+                host, "rd/evict_stats", "rd/evict_life_hist")
+
+        if host_stats:
+            # host placement: the numpy twin supplies the snapshot the
+            # external-batch step cannot form (no device-resident ring);
+            # its eviction readings are read-and-reset deltas like the
+            # device path's
+            moments = host_stats["tree_moments"]
+            hist = host_stats["leaf_hist"]
+            delta = np.asarray(host_stats["evict_stats"], np.float64)
+            dhist = np.asarray(host_stats["evict_life_hist"], np.int64)
+            found = True
+
+        tree = derive_tree_stats(moments, hist) if moments is not None \
+            else None
+        if tree is not None:
+            block["tree"] = tree
+        if found:
+            self._evict_seen = True
+            self._cum_evict += delta
+            self._cum_life += dhist.reshape(-1)
+        if self._evict_seen:
+            evictions = derive_evictions(
+                self._cum_evict, self._cum_life,
+                interval=(delta if found else np.zeros(5)))
+            if evictions is not None:
+                block["evictions"] = evictions
+
+        # -- lane composition: SUM over the interval's dispatches --
+        lc = [np.asarray(d["rd/lane_counts"], np.int64)
+              for d in host if "rd/lane_counts" in d]
+        if lc:
+            counts = np.concatenate(
+                [c.reshape(-1, self.lanes + 1) for c in lc]).sum(axis=0)
+            lanes = derive_lanes(counts, self.lanes)
+            if lanes is not None:
+                block["lanes"] = lanes
+
+        return block or None

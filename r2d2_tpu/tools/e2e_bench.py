@@ -216,6 +216,24 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
     # runs
     anakin = next((r["anakin"] for r in reversed(records)
                    if r.get("anakin")), None)
+    # replay-diagnostics evidence (ISSUE 10): field-wise merge, newest
+    # non-null value per sub-block (tree snapshots fire on their own
+    # cadence; evictions only appear once the ring wraps), histogram
+    # count dumps stripped like the learning block's
+    replay_diag = None
+    for r in records:
+        rd = r.get("replay_diag")
+        if not rd:
+            continue
+        clean = {k: ({kk: vv for kk, vv in v.items()
+                      if not kk.endswith("_counts")}
+                     if isinstance(v, dict) else v)
+                 for k, v in rd.items()}
+        if replay_diag is None:
+            replay_diag = clean
+        else:
+            replay_diag.update(
+                {k: v for k, v in clean.items() if v is not None})
     # system-health evidence (ISSUE 7): the newest resources block plus
     # the run's alert tally — proof the pillar actually flowed (or, with
     # the kill switch off, that the records carried neither key)
@@ -249,6 +267,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "records": len(records),
         "stages": stages,
         "learning": learning,
+        "replay_diag": replay_diag,
         "anakin": anakin,
         "resources": resources,
         "alerts_present": alerts_present,
@@ -434,6 +453,89 @@ def run_resources_ab(seconds: float, envs_per_actor: int, num_actors: int,
         c.get("resources") for c in cells["resources_off"])
     out["alerts_block_off"] = any(
         c.get("alerts_present") for c in cells["resources_off"])
+    return out
+
+
+def run_replay_diag_ab(seconds: float, envs_per_actor: int, num_actors: int,
+                       overrides: Optional[dict] = None,
+                       repeats: int = 2, sharded_dp: int = 2) -> dict:
+    """Replay-diagnostics overhead A/B (ISSUE 10 acceptance): the SAME
+    e2e host-actor system with ``telemetry.replay_diag_enabled`` on vs
+    off, in one artifact. Budget under test: the fused pillar — the
+    per-step sample-count scatter + lane bincount, the interval-gated
+    sum-tree snapshot, and eviction accounting inside replay_add_many —
+    costs < 2% on BOTH env-steps/s and learner updates/s. Cells run
+    INTERLEAVED off/on ``repeats`` times with per-arm medians (the
+    learning/resources-AB noise treatment; single cells swing ±10% on
+    the 2-core host).
+
+    A final evidence cell runs the SHARDED (emulated dp=``sharded_dp``)
+    anakin loop with the pillar on — the acceptance's second path — and
+    records its ``replay_diag`` block with per-shard + merged sum-tree
+    views. Requires >= sharded_dp visible devices (main forces the CPU
+    host-device count when it owns the process)."""
+    cells = {"replay_diag_off": [], "replay_diag_on": []}
+    for _ in range(max(repeats, 1)):
+        for label, on in (("replay_diag_off", False),
+                          ("replay_diag_on", True)):
+            ov = dict(overrides or {})
+            ov["telemetry.replay_diag_enabled"] = on
+            # the snapshot must FIRE inside the short window for the
+            # evidence fields; interval=20 is ~4x the production cadence
+            # relative to step rate on this shape, bounding overhead
+            # from above
+            ov.setdefault("telemetry.replay_diag_interval", 20)
+            cells[label].append(run_e2e(seconds, envs_per_actor,
+                                        num_actors, overrides=ov))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"replay_diag_off": cells["replay_diag_off"][-1],
+           "replay_diag_on": cells["replay_diag_on"][-1],
+           "repeats": max(repeats, 1),
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("replay_diag_off", "env_steps_per_sec") > 0:
+        ratio = (med("replay_diag_on", "env_steps_per_sec")
+                 / med("replay_diag_off", "env_steps_per_sec"))
+        out["env_steps_ratio"] = round(ratio, 3)
+        out["overhead_pct"] = round((1.0 - ratio) * 100.0, 2)
+    if med("replay_diag_off", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio"] = round(
+            med("replay_diag_on", "learner_steps_per_sec")
+            / med("replay_diag_off", "learner_steps_per_sec"), 3)
+    # evidence: newest ON cell carrying each sub-block (host-actor path)
+    rd = {}
+    for c in cells["replay_diag_on"]:
+        rd.update({k: v for k, v in (c.get("replay_diag") or {}).items()
+                   if v is not None})
+    out["replay_diag_block_on"] = bool(rd)
+    out["tree_on"] = rd.get("tree")
+    out["evictions_on"] = rd.get("evictions")
+    out["lanes_on"] = rd.get("lanes")
+    out["replay_diag_block_off"] = any(
+        c.get("replay_diag") for c in cells["replay_diag_off"])
+
+    # the sharded-anakin evidence cell: per-shard + merged tree views on
+    # the emulated dp mesh (the acceptance's second path)
+    import jax
+    if len(jax.devices()) >= sharded_dp:
+        ov = dict(ANAKIN_AB_OVERRIDES)
+        ov.update(overrides or {})
+        ov.update({"actor.on_device": True, "actor.anakin_lanes": 64,
+                   "mesh.dp": sharded_dp,
+                   "telemetry.replay_diag_enabled": True,
+                   "telemetry.replay_diag_interval": 5})
+        cell = run_e2e(seconds, overrides=ov)
+        out["sharded_anakin_on"] = cell
+        srd = cell.get("replay_diag") or {}
+        out["sharded_tree_on"] = srd.get("tree")
+        out["sharded_shards_on"] = srd.get("shards")
     return out
 
 
@@ -691,6 +793,15 @@ def main(argv=None) -> int:
                         "budget < 2%% on env-steps/s AND learner "
                         "updates/s; the ON cell carries the 'learning' "
                         "block as end-to-end evidence)")
+    p.add_argument("--replay-diag-ab", type=int, default=0,
+                   help="1: run the e2e phase as a replay-diagnostics "
+                        "on/off A/B instead (telemetry.replay_diag_enabled;"
+                        " budget < 2%% on env-steps/s AND learner "
+                        "updates/s; interleaved repeats with per-arm "
+                        "medians, the ON cells carry the 'replay_diag' "
+                        "block, plus one sharded (emulated dp=2) anakin "
+                        "evidence cell with per-shard + merged sum-tree "
+                        "views)")
     p.add_argument("--resources-ab", type=int, default=0,
                    help="1: run the e2e phase as a resource/compile/alerts "
                         "on/off A/B instead (telemetry.resources_enabled; "
@@ -706,12 +817,13 @@ def main(argv=None) -> int:
                    help="dotted config override key=value (repeatable)")
     args = p.parse_args(argv)
 
-    if args.sharded_anakin_ab:
+    if args.sharded_anakin_ab or args.replay_diag_ab:
         # the emulated-mesh recipe (README "On-device acting"): the CPU
         # platform must present >= dp devices BEFORE the backend
         # initializes — harmless on real accelerators (the flag only
         # shapes the host platform). argparse runs first so this can
-        # land before the jax import below.
+        # land before the jax import below. The replay-diag A/B needs it
+        # for its sharded-anakin evidence cell.
         from r2d2_tpu.utils.platform import force_host_device_count
         force_host_device_count(max(args.sharded_dp, 2))
     from r2d2_tpu.utils import pin_platform
@@ -745,6 +857,11 @@ def main(argv=None) -> int:
                 args.e2e_seconds, args.envs_per_actor,
                 anakin_lanes=args.anakin_lanes, overrides=overrides,
                 repeats=args.ab_repeats)
+        elif args.replay_diag_ab:
+            out["e2e_replay_diag_ab"] = run_replay_diag_ab(
+                args.e2e_seconds, args.envs_per_actor, args.num_actors,
+                overrides=overrides, repeats=args.ab_repeats,
+                sharded_dp=args.sharded_dp)
         elif args.resources_ab:
             out["e2e_resources_ab"] = run_resources_ab(
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,

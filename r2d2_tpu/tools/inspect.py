@@ -99,6 +99,10 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None,
     if lb:
         lines.append("")
         lines.append(render_learning(lb))
+    rd = record.get("replay_diag")
+    if rd:
+        lines.append("")
+        lines.append(render_replay_diag(rd))
     rb = record.get("resources")
     if rb:
         lines.append("")
@@ -160,6 +164,61 @@ def render_anakin(an: dict) -> str:
             bits.append(f"reported={rep[i]}")
         if at(ret, i) is not None:
             bits.append(f"return-sum={ret[i]:.2f}")
+        lines.append(" ".join(bits))
+    return "\n".join(lines)
+
+
+def render_replay_diag(rd: dict) -> str:
+    """The replay-pathology panel (ISSUE 10): sum-tree health + collapse
+    indicators (merged and, on a dp mesh, per shard), eviction lifetimes
+    with the never-sampled fraction, and the ε-lane composition of the
+    interval's sampled batches."""
+    lines = []
+    tree = rd.get("tree") or {}
+    if tree:
+        bits = [f"replay: tree active={tree.get('active_leaves')}"]
+        if tree.get("ess_frac") is not None:
+            bits.append(f"ess={tree.get('ess')} "
+                        f"({100 * tree['ess_frac']:.0f}% of active)")
+        if tree.get("max_mean_ratio") is not None:
+            bits.append(f"max/mean={tree['max_mean_ratio']:.2f}")
+        if tree.get("frac_at_max") is not None:
+            bits.append(f"at-max={100 * tree['frac_at_max']:.0f}%")
+        pr = tree.get("priorities") or {}
+        if pr:
+            bits.append(f"prio p50={pr['p50']:.4g} p95={pr['p95']:.4g}")
+        lines.append(" ".join(bits))
+    else:
+        lines.append("replay: (no tree snapshot this interval)")
+    for i, sh in enumerate(rd.get("shards") or []):
+        if not sh:
+            continue
+        lines.append(f"  shard {i}: active={sh.get('active_leaves')} "
+                     f"ess-frac={sh.get('ess_frac')} "
+                     f"at-max={sh.get('frac_at_max')}")
+    ev = rd.get("evictions") or {}
+    if ev.get("evicted"):
+        bits = [f"  evictions: {ev['evicted']} total"]
+        if ev.get("never_sampled_frac") is not None:
+            bits.append(f"NEVER-SAMPLED {100 * ev['never_sampled_frac']:.1f}%")
+        if ev.get("mean_lifetime") is not None:
+            bits.append(f"mean-lifetime={ev['mean_lifetime']:.2f}x")
+        if ev.get("mean_age_blocks") is not None:
+            bits.append(f"mean-age={ev['mean_age_blocks']:.0f} adds")
+        it = ev.get("interval") or {}
+        if it.get("evicted"):
+            bits.append(f"(+{it['evicted']} this interval)")
+        lines.append(" ".join(bits))
+    ln = rd.get("lanes") or {}
+    if ln:
+        bits = [f"  lanes: {ln.get('active_lanes')}/{ln.get('total_lanes')}"
+                f" active"]
+        if ln.get("starved_frac"):
+            bits.append(f"starved={100 * ln['starved_frac']:.0f}%")
+        if ln.get("max_share") is not None:
+            bits.append(f"top-lane share={100 * ln['max_share']:.0f}%")
+        if ln.get("unknown_frac"):
+            bits.append(f"unknown={100 * ln['unknown_frac']:.0f}%")
         lines.append(" ".join(bits))
     return "\n".join(lines)
 

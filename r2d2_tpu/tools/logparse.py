@@ -83,6 +83,41 @@ def learning_series(records: List[dict]) -> dict:
     return out
 
 
+def replay_diag_series(records: List[dict]) -> dict:
+    """Time series of the ``replay_diag`` block (ISSUE 10) across a
+    metrics JSONL stream, aligned on the records that CARRY one (pre-PR10
+    records and kill-switched runs are skipped, not holes) — the
+    learning_series contract. Keys: t, training_steps, ess_frac,
+    max_mean_ratio, frac_at_max, active_leaves, never_sampled_frac,
+    evicted, mean_lifetime, starved_frac, max_share — everything
+    cli/plot.py --replay-diag draws. Values are None where a record's
+    block lacked that entry (e.g. evictions before the first ring
+    wrap)."""
+    out = {k: [] for k in (
+        "t", "training_steps", "ess_frac", "max_mean_ratio",
+        "frac_at_max", "active_leaves", "never_sampled_frac", "evicted",
+        "mean_lifetime", "starved_frac", "max_share")}
+    for r in records:
+        rd = r.get("replay_diag")
+        if not rd:
+            continue
+        tree = rd.get("tree") or {}
+        ev = rd.get("evictions") or {}
+        ln = rd.get("lanes") or {}
+        out["t"].append(r.get("t"))
+        out["training_steps"].append(r.get("training_steps"))
+        out["ess_frac"].append(tree.get("ess_frac"))
+        out["max_mean_ratio"].append(tree.get("max_mean_ratio"))
+        out["frac_at_max"].append(tree.get("frac_at_max"))
+        out["active_leaves"].append(tree.get("active_leaves"))
+        out["never_sampled_frac"].append(ev.get("never_sampled_frac"))
+        out["evicted"].append(ev.get("evicted"))
+        out["mean_lifetime"].append(ev.get("mean_lifetime"))
+        out["starved_frac"].append(ln.get("starved_frac"))
+        out["max_share"].append(ln.get("max_share"))
+    return out
+
+
 def alerts_series(path: str, limit: Optional[int] = None) -> dict:
     """Time series of an ``alerts_player{p}.jsonl`` stream (ISSUE 7) —
     one entry per FIRED alert, oldest first, with ``parse_jsonl``'s

@@ -130,7 +130,10 @@ def test_sharded_replay_identity_with_per_shard_sequential_adds():
             env, net, spec, num_lanes=lps,
             epsilons=eps[s * lps:(s + 1) * lps], gamma=cfg.optim.gamma,
             priority=cfg.actor.anakin_priority,
-            near_greedy_eps=cfg.actor.near_greedy_eps)
+            near_greedy_eps=cfg.actor.near_greedy_eps,
+            # the shard's slice of the GLOBAL ladder carries its global
+            # lane-provenance stamps (ISSUE 10)
+            lane_base=s * lps)
         c1 = init_act_carry(env, spec, lps, jax.random.fold_in(key, s))
         ref = replay_init(spec)
         for seg in range(n_segments):

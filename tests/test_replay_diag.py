@@ -1,0 +1,760 @@
+"""Replay & data-pathology observability tests (ISSUE 10): device-vs-host
+sum-tree leaf-histogram parity, the per-slot sample-count ring across
+wrap and batched overwrite, eviction lifetimes against a sequential
+reference, lane-provenance stamps end-to-end (queue transports, ring
+wrap, the anakin paths, PR5-era blocks), the aggregator + new alert
+rules, kill-switch record-schema stability for PR4–PR9 readers, and a
+slow e2e slice proving the ``replay_diag`` block lands with a nonzero
+never-sampled-before-eviction fraction.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.replay.device_replay import (replay_add, replay_add_many,
+                                           replay_init, replay_sample)
+from r2d2_tpu.replay.structs import Block, ReplaySpec, empty_block_np
+from r2d2_tpu.replay.synthetic import make_synthetic_block
+from r2d2_tpu.telemetry.histogram import bucket_index, bucket_mid
+from r2d2_tpu.telemetry.replaydiag import (ReplayDiag, ReplayDiagAggregator,
+                                           derive_evictions, derive_lanes,
+                                           derive_tree_stats, lane_counts,
+                                           merge_shard_moments,
+                                           tree_health_moments)
+
+ACTIONS = 4
+
+
+def tiny_cfg(**overrides) -> Config:
+    cfg = Config().replace(**{
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 400, "replay.block_length": 20,
+        "replay.batch_size": 8,
+        "replay.pallas_sample_gather": "off",
+        "replay.pallas_exact_gather": "off",
+    })
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def tiny_net(cfg: Config):
+    from r2d2_tpu.models.network import NetworkApply
+    return NetworkApply(ACTIONS, cfg.network, cfg.env.frame_stack,
+                        cfg.env.frame_height, cfg.env.frame_width)
+
+
+def lane_block(spec, rng, lane: int, priority=None):
+    blk = make_synthetic_block(spec, rng)
+    fields = dict(
+        action=np.asarray(blk.action) % ACTIONS,
+        last_action_row=np.asarray(blk.last_action_row) % ACTIONS,
+        lane=np.asarray(lane, np.int32))
+    if priority is not None:
+        fields["priority"] = np.full(
+            (spec.seqs_per_block,), priority, np.float32)
+    return blk.replace(**fields)
+
+
+# ---------------------------------------------------------------------------
+# sum-tree health: device-vs-host parity + derived indicators
+
+
+def test_tree_health_device_matches_host_twin(rng):
+    """Fill the jitted device replay and the HostReplay numpy twin with
+    the SAME blocks (bucket-midpoint priorities, alpha=1 so leaves equal
+    the stamps) — leaf histogram and moments must agree."""
+    from r2d2_tpu.replay.host_replay import HostReplay
+    cfg = tiny_cfg(**{"replay.prio_exponent": 1.0})
+    spec = ReplaySpec.from_config(cfg)
+    assert spec.replay_diag
+    rs = replay_init(spec)
+    hr = HostReplay(spec, seed=0, use_native=False)
+    for i in range(6):
+        blk = lane_block(spec, rng, i,
+                         priority=bucket_mid(int(rng.integers(20, 60))))
+        rs = replay_add(spec, rs, blk)
+        hr.add(blk)
+    moments, hist = jax.jit(
+        lambda t: tree_health_moments(t, spec.tree_layers))(rs.tree)
+    host = hr.diag_raw()
+    np.testing.assert_array_equal(np.asarray(hist), host["leaf_hist"])
+    np.testing.assert_allclose(np.asarray(moments),
+                               host["tree_moments"], rtol=1e-5)
+    # derived indicators agree too (the numbers the alert rules watch)
+    dev = derive_tree_stats(np.asarray(moments), np.asarray(hist))
+    hst = derive_tree_stats(host["tree_moments"], host["leaf_hist"])
+    assert dev["active_leaves"] == hst["active_leaves"] == \
+        6 * spec.seqs_per_block
+    assert dev["ess_frac"] == pytest.approx(hst["ess_frac"], rel=1e-4)
+    assert dev["frac_at_max"] == pytest.approx(hst["frac_at_max"],
+                                               rel=1e-4)
+
+
+def test_tree_health_collapse_indicators():
+    """A hand-built leaf layout: 3 live leaves [1, 1, 8] → ESS, max/mean
+    and at-max computed against the closed forms."""
+    import jax.numpy as jnp
+    from r2d2_tpu.ops.sum_tree import tree_init, tree_update
+    layers, tree = tree_init(4)
+    tree = tree_update(layers, tree, 1.0,
+                       jnp.asarray([1.0, 1.0, 8.0]),
+                       jnp.asarray([0, 1, 2]))
+    moments, hist = tree_health_moments(tree, layers)
+    stats = derive_tree_stats(np.asarray(moments), np.asarray(hist))
+    assert stats["active_leaves"] == 3
+    # ESS = (10)^2 / 66 (rounded to the block's 2-decimal precision)
+    assert stats["ess"] == pytest.approx(100 / 66.0, abs=5e-3)
+    assert stats["max_mean_ratio"] == pytest.approx(8 / (10 / 3), rel=1e-3)
+    assert stats["frac_at_max"] == pytest.approx(1 / 3, rel=1e-4)
+    assert sum(stats["leaf_hist_counts"]) == 3
+    # empty / off-interval snapshots derive to None
+    assert derive_tree_stats(np.full(5, np.nan)) is None
+    assert derive_tree_stats(np.zeros(5)) is None
+
+
+def test_value_counts_np_matches_device_and_scalar(rng):
+    """The vectorized host bucketize (histogram.value_counts_np) agrees
+    with BOTH the scalar bucket_index loop and the device scatter over
+    bucket-midpoint-safe values."""
+    from r2d2_tpu.telemetry.histogram import value_counts, value_counts_np
+    buckets = rng.integers(1, 63, size=300)
+    values = np.asarray([bucket_mid(int(b)) for b in buckets], np.float64)
+    fast = value_counts_np(values)
+    ref = np.zeros(64, np.int64)
+    for v in values:
+        ref[bucket_index(float(v))] += 1
+    np.testing.assert_array_equal(fast, ref)
+    np.testing.assert_array_equal(
+        fast, np.asarray(value_counts(values.astype(np.float32))))
+    # mask + clamp semantics match the device helper
+    vals = np.asarray([0.0, 0.5, 1e12, np.nan])
+    np.testing.assert_array_equal(
+        value_counts_np(vals, mask=[1, 1, 1, 1]),
+        np.asarray(value_counts(np.asarray(vals, np.float32))))
+    assert value_counts_np(vals, mask=[0, 1, 0, 0]).sum() == 1
+
+
+def test_merge_shard_moments_counts_at_global_max():
+    # shard 0 max 2.0 (3 at max), shard 1 max 8.0 (2 at max): merged
+    # at-max counts only shard 1's
+    merged = merge_shard_moments(np.asarray(
+        [[10, 12.0, 20.0, 2.0, 3], [10, 20.0, 70.0, 8.0, 2]]))
+    assert merged[0] == 20 and merged[3] == 8.0 and merged[4] == 2
+    stats = derive_tree_stats(merged)
+    assert stats["frac_at_max"] == pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# sample-lifetime accounting
+
+
+def test_sample_count_ring_and_eviction_lifetimes(rng):
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)          # 20 ring rows
+    rs = replay_init(spec)
+    for i in range(spec.num_blocks):
+        rs = replay_add(spec, rs, lane_block(spec, rng, i))
+    # sample a few batches: counts accumulate at the sampled blocks
+    from r2d2_tpu.telemetry.replaydiag import fused_replay_diag
+    rdiag = ReplayDiag(interval=1, lanes=spec.num_blocks)
+    for s in range(3):
+        batch = replay_sample(spec, rs, jax.random.PRNGKey(s))
+        rs, _ = jax.jit(
+            lambda r, b: fused_replay_diag(spec, rdiag, 1, r, b))(rs, batch)
+    counts = np.asarray(rs.sample_count)
+    assert counts.sum() == 3 * spec.batch_size
+    # wrap: overwrite the first 4 rows → their lifetimes accumulate and
+    # their counts reset
+    expected_life = counts[:4].sum()
+    expected_never = int(np.sum(counts[:4] == 0))
+    for i in range(4):
+        rs = replay_add(spec, rs, lane_block(spec, rng, 50 + i))
+    ev = np.asarray(rs.evict_stats)
+    assert ev[0] == 4                            # evicted slots
+    assert ev[1] == expected_never               # never sampled
+    assert ev[2] == expected_life                # lifetime sum
+    assert ev[3] == 4 * spec.num_blocks          # age = one full lap each
+    assert np.all(np.asarray(rs.sample_count)[:4] == 0)
+    assert int(np.asarray(rs.add_count)) == spec.num_blocks + 4
+    # the snapshot READS AND RESETS the accumulators (per-interval
+    # deltas — no f32 counter ever holds a run-length total)
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(9))
+    rs, rd = jax.jit(
+        lambda r, b: fused_replay_diag(spec, rdiag, 1, r, b))(rs, batch)
+    assert np.asarray(rd["rd/evict_stats"])[0] == 4     # emitted delta
+    assert np.all(np.asarray(rs.evict_stats) == 0)      # state reset
+
+
+def test_add_many_eviction_parity_with_sequential(rng):
+    """replay_add_many(K) must leave the SAME diagnostic state as K
+    sequential replay_add calls — the eviction read-before-update order
+    and birth stamps included."""
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    blocks = [lane_block(spec, rng, i) for i in range(spec.num_blocks + 5)]
+
+    rs_a = replay_init(spec)
+    for blk in blocks[:spec.num_blocks]:
+        rs_a = replay_add(spec, rs_a, blk)
+    # mark a few LIVE rows sampled so the wrap evicts nonzero lifetimes
+    rs_a = rs_a.replace(sample_count=rs_a.sample_count.at[:3].add(2))
+    rs_b = jax.tree_util.tree_map(lambda x: x.copy(), rs_a)
+    for blk in blocks[spec.num_blocks:]:
+        rs_a = replay_add(spec, rs_a, blk)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]),
+        *blocks[spec.num_blocks:])
+    rs_b = replay_add_many(spec, rs_b, stacked)
+    for name in ("sample_count", "added_at", "add_count", "evict_stats",
+                 "evict_life_hist", "lane"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(rs_a, name)),
+            np.asarray(getattr(rs_b, name)), err_msg=name)
+    ev = np.asarray(rs_a.evict_stats)
+    assert ev[0] == 5 and ev[2] > 0              # lifetimes recorded
+
+
+def test_host_replay_eviction_twin(rng):
+    from r2d2_tpu.replay.host_replay import HostReplay
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    hr = HostReplay(spec, seed=0, use_native=False)
+    for i in range(spec.num_blocks):
+        hr.add(lane_block(spec, rng, i))
+    for _ in range(3):
+        hr.sample()
+    sampled_counts = hr.sample_count.copy()
+    for i in range(4):
+        hr.add(lane_block(spec, rng, 90 + i))
+    raw = hr.diag_raw()
+    ev = raw["evict_stats"]
+    assert ev[0] == 4
+    assert ev[1] == float(np.sum(sampled_counts[:4] == 0))
+    assert ev[2] == float(sampled_counts[:4].sum())
+    block = derive_evictions(ev, raw["evict_life_hist"])
+    assert block["evicted"] == 4
+    assert 0.0 <= block["never_sampled_frac"] <= 1.0
+    # read-and-reset, like the device snapshot: the next reading is a
+    # fresh delta window
+    assert hr.diag_raw()["evict_stats"][0] == 0
+
+
+# ---------------------------------------------------------------------------
+# lane provenance end-to-end
+
+
+def test_lane_stamp_survives_ring_wrap_and_sampling(rng):
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    rs = replay_init(spec)
+    n = spec.num_blocks
+    for i in range(n + 3):
+        rs = replay_add(spec, rs, lane_block(spec, rng, i % 7))
+    ring = np.asarray(rs.lane)
+    assert list(ring[:3]) == [(n + i) % 7 for i in range(3)]
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(0))
+    assert set(int(v) for v in np.asarray(batch.lane)) <= set(range(7))
+
+
+def test_lane_stamp_survives_queue_transports(rng):
+    from r2d2_tpu.runtime.feeder import BlockQueue
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    blk = lane_block(spec, rng, 11)
+    for q in (BlockQueue(maxsize=4, use_mp=True, shm_spec=spec),
+              BlockQueue(maxsize=4, use_mp=True),
+              BlockQueue(maxsize=4, use_mp=False)):
+        try:
+            q.put(blk, timeout=5.0)
+            got = q.get(timeout=5.0)
+            assert int(np.asarray(got.lane)) == 11
+            q.put(blk, timeout=5.0)
+            q.put(lane_block(spec, rng, 13), timeout=5.0)
+            import time
+            deadline = time.time() + 10.0
+            lanes = []
+            while len(lanes) < 2 and time.time() < deadline:
+                stacked, k = q.drain_stacked(4)
+                if k:
+                    lanes += [int(v) for v in np.asarray(stacked.lane)]
+                else:
+                    time.sleep(0.01)
+            assert lanes == [11, 13]
+        finally:
+            q.close()
+
+
+def test_instrument_sink_offsets_lane_base(rng):
+    from r2d2_tpu.runtime.actor_loop import instrument_block_sink
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    seen = []
+    sink = instrument_block_sink(cfg, 0, seen.append, lane_base=32)
+    # run loops stamp the RELATIVE lane; the sink offsets it
+    sink(lane_block(spec, rng, 3))
+    # an UNstamped block (-1) stays unknown — never fabricated into the
+    # worker's first lane
+    sink(make_synthetic_block(spec, rng))
+    assert int(np.asarray(seen[0].lane)) == 35
+    assert int(np.asarray(seen[1].lane)) == -1
+
+
+def test_pr5_era_block_defaults_to_unknown_lane(rng):
+    """A PR5-era record — no lane field — must construct, flow through
+    replay, and report lane unknown (the small-fix satellite)."""
+    cfg = tiny_cfg()
+    spec = ReplaySpec.from_config(cfg)
+    legacy = {k: v for k, v in empty_block_np(spec).items() if k != "lane"}
+    blk = Block(**legacy)
+    assert int(np.asarray(blk.lane)) == -1
+    rs = replay_init(spec)
+    rs = replay_add(spec, rs, blk.replace(
+        priority=np.ones((spec.seqs_per_block,), np.float32),
+        learning_steps=np.full((spec.seqs_per_block,), spec.learning,
+                               np.int32)))
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(0))
+    assert np.all(np.asarray(batch.lane) == -1)
+    counts = np.asarray(lane_counts(batch.lane, 4))
+    assert counts[-1] == spec.batch_size         # all unknown
+    lanes = derive_lanes(counts, 4)
+    assert lanes["unknown_frac"] == 1.0
+
+
+def test_anakin_blocks_carry_global_lanes():
+    from r2d2_tpu.actor.anakin import init_act_carry, make_anakin_act
+    from r2d2_tpu.envs.factory import create_jax_env
+    from r2d2_tpu.models.network import NetworkApply
+    cfg = tiny_cfg(**{
+        "env.game_name": "Fake", "env.frame_height": 8, "env.frame_width": 8,
+        "env.episode_len": 20,
+        "network.conv_layers": ((4, 4, 4),), "network.cnn_out_dim": 16,
+    })
+    spec = ReplaySpec.from_config(cfg)
+    env = create_jax_env(cfg.env)
+    net = NetworkApply(env.action_dim, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    params = net.init(jax.random.PRNGKey(0))
+    act = make_anakin_act(env, net, spec, num_lanes=4, epsilons=[0.4] * 4,
+                          gamma=0.997, priority=1.0, near_greedy_eps=0.02)
+    carry = init_act_carry(env, spec, 4, jax.random.PRNGKey(1))
+    _, blocks, _ = act(params, carry, np.int32(1))
+    assert list(np.asarray(blocks.lane)) == [0, 1, 2, 3]
+
+
+def test_sharded_anakin_lane_stamps_span_global_ladder():
+    from r2d2_tpu.config import MeshConfig
+    from r2d2_tpu.envs.factory import create_jax_env
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.parallel import (init_sharded_act_carry, make_mesh,
+                                   make_sharded_anakin_act,
+                                   sharded_replay_init)
+    cfg = tiny_cfg(**{
+        "env.game_name": "Fake", "env.frame_height": 8, "env.frame_width": 8,
+        "env.episode_len": 20,
+        "network.conv_layers": ((4, 4, 4),), "network.cnn_out_dim": 16,
+    })
+    spec = ReplaySpec.from_config(cfg)
+    env = create_jax_env(cfg.env)
+    net = NetworkApply(env.action_dim, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    params = net.init(jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshConfig(dp=2))
+    act = make_sharded_anakin_act(env, net, spec, mesh=mesh, num_lanes=4,
+                                  epsilons=[0.4] * 4, gamma=0.997,
+                                  priority=1.0, near_greedy_eps=0.02)
+    carry = init_sharded_act_carry(env, spec, 4, mesh, jax.random.PRNGKey(2))
+    rs = sharded_replay_init(spec, mesh)
+    carry, rs, _ = act(params, carry, rs, np.int32(1))
+    ring = np.asarray(rs.lane)                  # (dp, N)
+    assert list(ring[0][:2]) == [0, 1]          # shard 0: ladder slice 0-1
+    assert list(ring[1][:2]) == [2, 3]          # shard 1: ladder slice 2-3
+    # per-shard sample-count rings exist and start clean
+    assert np.asarray(rs.sample_count).shape == (2, spec.num_blocks)
+
+
+# ---------------------------------------------------------------------------
+# fused-step integration + sharded views
+
+
+def _fused_setup(rng, rdiag, **cfg_over):
+    from r2d2_tpu.learner.train_step import (create_train_state,
+                                             make_learner_step)
+    cfg = tiny_cfg(**cfg_over)
+    spec = ReplaySpec.from_config(cfg)
+    net = tiny_net(cfg)
+    ts = create_train_state(jax.random.PRNGKey(0), net, cfg.optim)
+    rs = replay_init(spec)
+    for i in range(4):
+        rs = replay_add(spec, rs, lane_block(spec, rng, i))
+    step = make_learner_step(net, spec, cfg.optim, cfg.network.use_double,
+                             rdiag=rdiag)
+    return cfg, spec, ts, rs, step
+
+
+def test_fused_step_emits_replay_metrics(rng):
+    cfg, spec, ts, rs, step = _fused_setup(
+        rng, ReplayDiag(interval=1, lanes=8))
+    ts, rs, m = step(ts, rs)
+    assert np.asarray(m["rd/lane_counts"]).shape == (9,)
+    assert int(np.asarray(m["rd/lane_counts"]).sum()) == spec.batch_size
+    moments = np.asarray(m["rd/tree_moments"])
+    assert moments[0] == 4 * spec.seqs_per_block        # active leaves
+    assert int(np.asarray(m["rd/leaf_hist"]).sum()) == int(moments[0])
+    assert np.all(np.isfinite(np.asarray(m["rd/evict_stats"])))
+    # the sample-count ring advanced at the sampled blocks
+    assert int(np.asarray(rs.sample_count).sum()) == spec.batch_size
+
+
+def test_fused_step_interval_gates_snapshot(rng):
+    cfg, spec, ts, rs, step = _fused_setup(
+        rng, ReplayDiag(interval=2, lanes=8))
+    ts, rs, m1 = step(ts, rs)
+    ts, rs, m2 = step(ts, rs)
+    assert np.isnan(np.asarray(m1["rd/tree_moments"])).all()
+    assert np.isfinite(np.asarray(m2["rd/tree_moments"])).all()
+    # lane counts + sample counting flow EVERY step
+    assert int(np.asarray(m1["rd/lane_counts"]).sum()) == spec.batch_size
+    assert int(np.asarray(rs.sample_count).sum()) == 2 * spec.batch_size
+
+
+def test_fused_step_without_rdiag_has_no_rd_keys(rng):
+    cfg, spec, ts, rs, step = _fused_setup(rng, None)
+    ts, rs, m = step(ts, rs)
+    assert not any(k.startswith("rd/") for k in m)
+
+
+def test_kill_switch_compiles_without_diag_state(rng):
+    """spec.replay_diag=False: replay_init allocates no diagnostic
+    state, the sampled batch still carries the always-on lane stamp, and
+    the config resolution follows the kill switches."""
+    cfg = tiny_cfg(**{"telemetry.replay_diag_enabled": False})
+    spec = ReplaySpec.from_config(cfg)
+    assert not spec.replay_diag
+    rs = replay_init(spec)
+    assert rs.sample_count is None and rs.evict_stats is None
+    assert rs.lane is not None
+    rs = replay_add(spec, rs, lane_block(rng=rng, spec=spec, lane=2))
+    batch = replay_sample(spec, rs, jax.random.PRNGKey(0))
+    assert int(np.asarray(batch.lane)[0]) in (-1, 2)
+    assert ReplayDiag.from_config(cfg) is None
+    assert ReplayDiag.from_config(
+        tiny_cfg(**{"telemetry.enabled": False})) is None
+    d = ReplayDiag.from_config(tiny_cfg(**{"actor.num_actors": 3,
+                                           "actor.envs_per_actor": 4}))
+    assert d == ReplayDiag(interval=50, lanes=12)
+    # multihost fleets stamp GLOBAL lane indices across every process's
+    # workers — the bincount must span process_count * local lanes
+    assert ReplayDiag.from_config(
+        tiny_cfg(**{"actor.num_actors": 3, "actor.envs_per_actor": 4,
+                    "mesh.multihost": True,
+                    "mesh.num_processes": 2})).lanes == 24
+    assert ReplayDiag.from_config(
+        tiny_cfg(**{"env.episode_len": 20, "actor.on_device": True,
+                    "actor.anakin_lanes": 20})).lanes == 20
+
+
+def test_sharded_step_emits_per_shard_and_merged_views(rng):
+    from r2d2_tpu.learner.train_step import create_train_state
+    from r2d2_tpu.parallel import (make_mesh, make_sharded_learner_step,
+                                   make_sharded_replay_add,
+                                   sharded_replay_init)
+    cfg = tiny_cfg(**{"mesh.dp": 2})
+    spec = ReplaySpec.from_config(cfg)
+    net = tiny_net(cfg)
+    ts = create_train_state(jax.random.PRNGKey(0), net, cfg.optim)
+    mesh = make_mesh(cfg.mesh)
+    rs = sharded_replay_init(spec, mesh)
+    add = make_sharded_replay_add(spec, mesh)
+    for i in range(4):
+        rs = add(rs, lane_block(spec, rng, i), i % 2)
+    step = make_sharded_learner_step(
+        net, spec, cfg.optim, cfg.network.use_double, mesh,
+        rdiag=ReplayDiag(interval=1, lanes=8))
+    ts, rs, m = step(ts, rs)
+    sm = np.asarray(m["rd/shard_tree_moments"])
+    assert sm.shape == (2, 5)
+    assert np.all(sm[:, 0] == 2 * spec.seqs_per_block)   # 2 blocks/shard
+    assert np.asarray(m["rd/shard_leaf_hist"]).shape == (2, 64)
+    # global lane composition psums over shards: dp * batch sequences
+    assert int(np.asarray(m["rd/lane_counts"]).sum()) == 2 * spec.batch_size
+    # the aggregator builds per-shard rows + a merged tree view from it
+    agg = ReplayDiagAggregator(lanes=8)
+    agg.on_dispatch(m)
+    block = agg.flush()
+    assert len(block["shards"]) == 2
+    assert block["tree"]["active_leaves"] == 4 * spec.seqs_per_block
+    assert block["lanes"]["sampled_sequences"] == 2 * spec.batch_size
+
+
+# ---------------------------------------------------------------------------
+# aggregation + derived blocks
+
+
+def _fake_dispatch(interval_fired=True, lanes=4):
+    moments = (np.asarray([10.0, 5.0, 3.0, 1.5, 2.0], np.float32)
+               if interval_fired else np.full(5, np.nan, np.float32))
+    hist = np.zeros(64, np.int32)
+    if interval_fired:
+        hist[30] = 10
+    ev = (np.asarray([6.0, 3.0, 9.0, 60.0, 1.2], np.float32)
+          if interval_fired else np.full(5, np.nan, np.float32))
+    lc = np.zeros(lanes + 1, np.int32)
+    lc[0] = 5
+    lc[1] = 2
+    lc[lanes] = 1
+    return {"rd/tree_moments": moments, "rd/leaf_hist": hist,
+            "rd/evict_stats": ev, "rd/evict_life_hist": hist.copy(),
+            "rd/lane_counts": lc}
+
+
+def test_aggregator_builds_replay_diag_block():
+    agg = ReplayDiagAggregator(lanes=4)
+    agg.on_dispatch(_fake_dispatch(interval_fired=True))
+    agg.on_dispatch(_fake_dispatch(interval_fired=False))
+    block = agg.flush()
+    # snapshot keys take the newest FIRING (the NaN dispatch is skipped)
+    assert block["tree"]["active_leaves"] == 10
+    assert block["tree"]["ess_frac"] == pytest.approx(25 / 30.0, rel=1e-3)
+    ev = block["evictions"]
+    assert ev["evicted"] == 6 and ev["never_sampled"] == 3
+    assert ev["never_sampled_frac"] == 0.5
+    assert ev["mean_age_blocks"] == 10.0
+    # lane counts SUM across the interval's dispatches
+    lanes = block["lanes"]
+    assert lanes["sampled_sequences"] == 16
+    assert lanes["active_lanes"] == 2
+    assert lanes["starved_frac"] == 0.5
+    assert lanes["unknown_frac"] == pytest.approx(2 / 16)
+    assert lanes["counts"] == [10, 4, 0, 0]
+    # flush consumed the interval; eviction totals INTEGRATE across
+    # flushes (the device accumulators are read-and-reset deltas, so no
+    # f32 counter ever holds a run-length total)
+    assert agg.flush() is None
+    agg.on_dispatch(_fake_dispatch(interval_fired=True))
+    block2 = agg.flush()
+    assert block2["evictions"]["evicted"] == 12
+    assert block2["evictions"]["never_sampled"] == 6
+    assert block2["evictions"]["interval"] == {
+        "evicted": 6, "never_sampled": 3, "never_sampled_frac": 0.5}
+
+
+def test_aggregator_handles_multi_step_stacked_rows():
+    agg = ReplayDiagAggregator(lanes=4)
+    d1 = _fake_dispatch(True)
+    d2 = _fake_dispatch(False)
+    stacked = {k: np.stack([d1[k], d2[k]]) for k in d1}
+    agg.on_dispatch(stacked)
+    block = agg.flush()
+    assert block["tree"]["active_leaves"] == 10    # row 0 is the firing
+    assert block["lanes"]["sampled_sequences"] == 16
+
+
+def test_aggregator_host_stats_substitute():
+    agg = ReplayDiagAggregator(lanes=4)
+    d = _fake_dispatch(False)
+    d.pop("rd/tree_moments"), d.pop("rd/leaf_hist")
+    d.pop("rd/evict_stats"), d.pop("rd/evict_life_hist")
+    agg.on_dispatch(d)                   # host placement: lane counts only
+    host = {"tree_moments": np.asarray([4.0, 2.0, 1.0, 0.5, 1.0]),
+            "leaf_hist": np.zeros(64, np.int64),
+            "evict_stats": np.asarray([2.0, 1.0, 3.0, 10.0, 0.5]),
+            "evict_life_hist": np.zeros(64, np.int64)}
+    block = agg.flush(host_stats=host)
+    assert block["tree"]["active_leaves"] == 4
+    assert block["evictions"]["never_sampled_frac"] == 0.5
+    assert block["lanes"]["sampled_sequences"] == 8
+
+
+# ---------------------------------------------------------------------------
+# alert rules + sentinel
+
+
+def _rd_record(ess_frac=0.5, frac_at_max=0.1, never_frac=None,
+               starved=0.0):
+    rd = {"tree": {"ess_frac": ess_frac, "frac_at_max": frac_at_max},
+          "lanes": {"starved_frac": starved}}
+    if never_frac is not None:
+        # the growth rule watches THIS interval's fraction (the
+        # cumulative one's change decays as 1/t)
+        rd["evictions"] = {"never_sampled_frac": never_frac,
+                           "interval": {"evicted": 10,
+                                        "never_sampled_frac": never_frac}}
+    return {"replay_diag": rd}
+
+
+def test_alert_rules_fire_on_replay_pathologies():
+    from r2d2_tpu.telemetry import AlertEngine, default_rules
+    cfg = tiny_cfg()
+    engine = AlertEngine(default_rules(cfg.telemetry))
+    names = {r.name for r in engine.rules}
+    assert {"priority_collapse", "priority_saturation",
+            "never_sampled_growth", "lane_starvation"} <= names
+    # healthy record: nothing fires
+    block = engine.evaluate(_rd_record())
+    assert not block["fired"]
+    # ESS collapse + saturation + starvation fire on their edges
+    block = engine.evaluate(_rd_record(ess_frac=0.01, frac_at_max=0.9,
+                                       starved=0.8))
+    fired = {a["rule"] for a in block["fired"]}
+    assert {"priority_collapse", "priority_saturation",
+            "lane_starvation"} <= fired
+    # growth rule: healthy window, then a 4x jump
+    engine2 = AlertEngine(default_rules(cfg.telemetry))
+    for _ in range(cfg.telemetry.alerts_window):
+        assert not engine2.evaluate(_rd_record(never_frac=0.1))["fired"]
+    block = engine2.evaluate(_rd_record(never_frac=0.4))
+    assert [a["rule"] for a in block["fired"]] == ["never_sampled_growth"]
+
+
+def test_sentinel_rules_listing_includes_replay_rules(capsys):
+    from r2d2_tpu.tools.sentinel import main
+    assert main(["--rules"]) == 0
+    out = capsys.readouterr().out
+    for name in ("priority_collapse", "priority_saturation",
+                 "never_sampled_growth", "lane_starvation"):
+        assert name in out, name
+    assert "replay_diag.tree.ess_frac" in out
+
+
+# ---------------------------------------------------------------------------
+# config round-trip + record schema stability
+
+
+def test_config_roundtrips_replay_diag_fields():
+    cfg = tiny_cfg(**{"telemetry.replay_diag_enabled": False,
+                      "telemetry.replay_diag_interval": 77,
+                      "telemetry.alerts_replay_ess_frac": 0.1,
+                      "telemetry.alerts_lane_starved_frac": 0.9})
+    back = Config.from_json(cfg.to_json())
+    assert back.telemetry.replay_diag_enabled is False
+    assert back.telemetry.replay_diag_interval == 77
+    assert back.telemetry.alerts_replay_ess_frac == 0.1
+    assert back.telemetry.alerts_lane_starved_frac == 0.9
+
+
+def test_pre_pr10_config_dict_loads_with_defaults():
+    d = Config().to_dict()
+    # a PR9-era checkpoint config: telemetry section without the new keys
+    for k in ("replay_diag_enabled", "replay_diag_interval",
+              "alerts_replay_ess_frac", "alerts_priority_saturation",
+              "alerts_never_sampled_growth", "alerts_lane_starved_frac"):
+        del d["telemetry"][k]
+    cfg = Config.from_dict(d)
+    assert cfg.telemetry.replay_diag_enabled is True
+    assert cfg.telemetry.replay_diag_interval == 50
+    assert ReplayDiag.from_config(cfg) is not None
+
+
+def test_config_validates_replay_diag_fields():
+    with pytest.raises(ValueError, match="replay_diag_interval"):
+        tiny_cfg(**{"telemetry.replay_diag_interval": 0})
+    with pytest.raises(ValueError, match="alerts_replay_ess_frac"):
+        tiny_cfg(**{"telemetry.alerts_replay_ess_frac": 1.5})
+    with pytest.raises(ValueError, match="alerts_never_sampled_growth"):
+        tiny_cfg(**{"telemetry.alerts_never_sampled_growth": 1.0})
+
+
+def test_record_schema_replay_diag_block(tmp_path):
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+    m = TrainMetrics(0, str(tmp_path))
+    m.set_replay_diag({"tree": {"ess_frac": 0.4}})
+    record = m.log(1.0)
+    assert record["replay_diag"]["tree"]["ess_frac"] == 0.4
+    # PR2..PR9 reader keys unaffected (schema stability)
+    for key in ("buffer_size", "env_steps", "training_steps", "loss",
+                "ingest_blocks_total", "ingest_drains", "actor_restarts",
+                "actor_parked_slots", "heartbeat_age_max_s",
+                "dropped_priority_updates"):
+        assert key in record, key
+    # consumed on emission; absent when nothing was set (the kill-switch
+    # schema: records byte-identical to PR9)
+    record2 = m.log(1.0)
+    assert "replay_diag" not in record2
+    # and the block round-trips the JSONL stream into the plot series
+    from r2d2_tpu.tools.logparse import parse_jsonl, replay_diag_series
+    records = parse_jsonl(str(tmp_path / "metrics_player0.jsonl"))
+    series = replay_diag_series(records)
+    assert series["ess_frac"] == [0.4]
+
+
+def test_render_replay_diag_panel():
+    from r2d2_tpu.tools.inspect import render_record
+    frame = render_record({
+        "t": 10.0, "env_steps": 100, "training_steps": 5, "buffer_size": 50,
+        "replay_diag": {
+            "tree": {"active_leaves": 64, "ess": 20.0, "ess_frac": 0.31,
+                     "max_mean_ratio": 4.2, "frac_at_max": 0.05,
+                     "priorities": {"count": 64, "p50": 0.5, "p95": 1.2,
+                                    "p99": 2.0}},
+            "shards": [{"active_leaves": 32, "ess_frac": 0.3,
+                        "frac_at_max": 0.04},
+                       {"active_leaves": 32, "ess_frac": 0.32,
+                        "frac_at_max": 0.06}],
+            "evictions": {"evicted": 40, "never_sampled": 10,
+                          "never_sampled_frac": 0.25,
+                          "mean_lifetime": 2.5, "mean_age_blocks": 20,
+                          "interval": {"evicted": 8, "never_sampled": 2}},
+            "lanes": {"total_lanes": 16, "active_lanes": 12,
+                      "starved_frac": 0.25, "max_share": 0.2,
+                      "unknown_frac": 0.0, "sampled_sequences": 64},
+        }})
+    assert "replay: tree active=64" in frame
+    assert "NEVER-SAMPLED 25.0%" in frame
+    assert "shard 1" in frame
+    assert "12/16 active" in frame
+
+
+# ---------------------------------------------------------------------------
+# slow e2e slice: the replay_diag block lands end-to-end
+
+
+@pytest.mark.slow
+def test_e2e_replay_diag_block_and_kill_switch(tmp_path):
+    from r2d2_tpu.runtime.orchestrator import train
+    from tests.test_runtime import tiny_config
+
+    # a SMALL ring (10 rows) so evictions happen inside the slice and
+    # the never-sampled fraction is meaningfully nonzero
+    cfg = tiny_config(tmp_path, **{
+        "replay.capacity": 200, "replay.learning_starts": 60,
+        "runtime.save_interval": 0,
+        "runtime.log_interval": 1.0,
+        "telemetry.replay_diag_interval": 5,
+    })
+    records = []
+    stacks = train(cfg, max_training_steps=40, max_seconds=180,
+                   actor_mode="thread", log_fn=records.append)
+    assert stacks[0].learner.training_steps >= 40
+    blocks = [r["replay_diag"] for r in records if r.get("replay_diag")]
+    assert blocks, "no replay_diag block in any record"
+    trees = [b["tree"] for b in blocks if b.get("tree")]
+    assert trees and all(t["active_leaves"] > 0 for t in trees)
+    assert all(0 < t["ess_frac"] <= 1.0 for t in trees)
+    # the ring wrapped: evictions accumulated with a NONZERO
+    # never-sampled fraction (10-row ring, 2 actors outrunning sampling)
+    evs = [b["evictions"] for b in blocks if b.get("evictions")]
+    assert evs and evs[-1]["evicted"] > 0
+    assert evs[-1].get("never_sampled_frac", 0) > 0
+    # lane composition spans the 2-worker ladder with global stamps
+    lanes = [b["lanes"] for b in blocks if b.get("lanes")]
+    assert lanes and lanes[-1]["total_lanes"] == 2
+    assert lanes[-1]["unknown_frac"] == 0.0
+    assert lanes[-1]["active_lanes"] >= 1
+
+    # kill switch: same system, replay_diag_enabled=false → no block at
+    # all (records byte-identical to the PR9 schema)
+    cfg_off = tiny_config(tmp_path / "off", **{
+        "replay.capacity": 200, "replay.learning_starts": 60,
+        "runtime.save_interval": 0, "runtime.log_interval": 1.0,
+        "telemetry.replay_diag_enabled": False,
+    })
+    records_off = []
+    train(cfg_off, max_training_steps=10, max_seconds=120,
+          actor_mode="thread", log_fn=records_off.append)
+    assert records_off
+    assert all("replay_diag" not in r for r in records_off)
