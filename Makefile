@@ -49,6 +49,17 @@
 #                record-schema stability); the slow e2e slice (populated
 #                replay_diag block, nonzero never-sampled fraction) runs
 #                with the full tier.
+#   make fleet — the fast-tier fleet-observability suite
+#                (tests/test_fleet.py: lockstep psum-row gauge math on
+#                the emulated mesh (argmax/skew, kill-switch shape
+#                identity), FleetAggregator merge parity vs per-rank
+#                references, the four fleet alert rules incl.
+#                once-per-breach edge semantics, host-row rotation,
+#                trace merge + clock alignment on the checked-in
+#                two-rank fixture, sentinel host-row/alert streams,
+#                record-schema stability); the slow single-controller
+#                lockstep e2e + two-process loopback straggler A/B run
+#                with the full tier.
 #   make costmodel — the fast-tier cost-model/roofline suite
 #                (tests/test_costmodel.py: XLA cost-table extraction
 #                across step factories incl. a sharded emulated-mesh
@@ -71,7 +82,7 @@
 #                shape on TPU).
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
-	replaydiag costmodel regress costs roofline check-fast-markers
+	replaydiag fleet costmodel regress costs roofline check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -104,6 +115,10 @@ replaydiag: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_replay_diag.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+fleet: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
 costmodel: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q \
 	    -m 'not slow' -p no:cacheprovider
@@ -133,6 +148,7 @@ FAST_MARKER_CHECKS := \
 	tests/test_anakin_sharded.py:not_slow:8:anakin-sharded \
 	tests/test_sentinel.py:not_slow:20:sentinel \
 	tests/test_replay_diag.py:not_slow:10:replay-diag \
+	tests/test_fleet.py:not_slow:12:fleet \
 	tests/test_costmodel.py:not_slow:10:cost-model
 
 check-fast-markers:

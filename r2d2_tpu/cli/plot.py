@@ -16,7 +16,8 @@ import re
 
 import numpy as np
 
-from r2d2_tpu.tools.logparse import (learning_series, parse_jsonl, parse_log,
+from r2d2_tpu.tools.logparse import (fleet_series, learning_series,
+                                     parse_jsonl, parse_log,
                                      replay_diag_series)
 
 
@@ -124,6 +125,69 @@ def plot_replay_diag(file_path: str, out: str, show: bool) -> None:
         plt.show()
 
 
+def plot_fleet(file_path: str, out: str, show: bool) -> None:
+    """--fleet mode: render the fleet-observability series (per-rank
+    step time, lockstep-wait fraction, skew / env-step divergence —
+    ISSUE 12) from the rank-0 ``metrics_player{i}.jsonl`` streams."""
+    import matplotlib
+    if not show:
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    paths = sorted(glob.glob(os.path.join(file_path,
+                                          "metrics_player*.jsonl")))
+    series = []
+    for path in paths:
+        s = fleet_series(parse_jsonl(path))
+        if s["t"]:
+            player = re.search(r"metrics_player(\d+)\.jsonl", path).group(1)
+            series.append((player, s))
+    if not series:
+        raise SystemExit(
+            f"no metrics_player*.jsonl with a 'fleet' block under "
+            f"{file_path!r} — multihost runs with "
+            "telemetry.fleet_enabled=true produce one")
+
+    fig, axes = plt.subplots(3, len(series), squeeze=False,
+                             figsize=(7 * len(series), 9))
+    for col, (player, s) in enumerate(series):
+        t = np.asarray([x or 0.0 for x in s["t"]]) / 60.0
+
+        # per-rank step-time lines: ragged per_rank_ms lists padded with
+        # NaN (a record before the first gauge table carries None)
+        tables = s["per_rank_ms"]
+        nranks = max((len(p) for p in tables if p), default=0)
+        ax = axes[0][col]
+        for r in range(nranks):
+            ys = np.asarray(
+                [p[r] if p and len(p) > r else np.nan for p in tables],
+                float)
+            if np.isfinite(ys).any():
+                ax.plot(t, ys, ".-", label=f"rank {r}")
+        ax.set_ylabel("per-rank step time (ms)")
+        ax.set_title(f"player {player}")
+        ax.legend(loc="upper right", fontsize=8)
+
+        def draw(ax, keys, ylabel):
+            for key in keys:
+                ys = np.asarray([np.nan if v is None else v for v in s[key]],
+                                float)
+                if np.isfinite(ys).any():
+                    ax.plot(t, ys, ".-", label=key)
+            ax.set_ylabel(ylabel)
+            ax.legend(loc="upper right", fontsize=8)
+
+        draw(axes[1][col], ["wait_frac"], "lockstep wait fraction")
+        draw(axes[2][col], ["skew", "divergence"],
+             "step-time skew / env divergence")
+        axes[2][col].set_xlabel("training time (minutes)")
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out}")
+    if show:
+        plt.show()
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--file_path", default=".",
@@ -147,6 +211,11 @@ def main(argv=None) -> None:
                         "health, never-sampled fraction, lane "
                         "composition) from metrics_player*.jsonl instead "
                         "of the reward curves")
+    p.add_argument("--fleet", action="store_true",
+                   help="plot the fleet-observability series (per-rank "
+                        "step time, lockstep-wait fraction, skew / "
+                        "env-step divergence) from metrics_player*.jsonl "
+                        "instead of the reward curves")
     args = p.parse_args(argv)
 
     if args.learning:
@@ -158,6 +227,11 @@ def main(argv=None) -> None:
         out = args.out if args.out != "training_curves.png" \
             else "replay_diag_curves.png"
         plot_replay_diag(args.file_path, out, args.show)
+        return
+    if args.fleet:
+        out = args.out if args.out != "training_curves.png" \
+            else "fleet_curves.png"
+        plot_fleet(args.file_path, out, args.show)
         return
 
     import matplotlib

@@ -480,6 +480,39 @@ class TelemetryConfig:
     # to the interval's sampled batches at/above which lane_starvation
     # fires.
     alerts_lane_starved_frac: float = 0.5
+    # -- fleet observability (ISSUE 12; telemetry/fleet.py) --
+    # Pillar kill switch for the multihost fleet plane: the lockstep
+    # psum row widened with per-rank step-time gauges (sum/max/min +
+    # one-hot straggler argmax + the all-gathered per-row tables),
+    # per-iteration compute-vs-blocked lockstep timing, the rank-0
+    # FleetAggregator's 'fleet' block on the periodic record, per-rank
+    # AlertEngines on ranks > 0 (firings -> alerts_host{r}.jsonl), and
+    # the clock-anchored host rows the cross-host trace merge aligns
+    # on. False (or the master `enabled` off) compiles the exact PR-10
+    # lockstep programs and leaves records and host rows byte-identical
+    # to the PR-10 schema (stability-tested). Single-controller
+    # (non-multihost) runs are unaffected either way.
+    fleet_enabled: bool = True
+    # Size cap (bytes) on each telemetry_host{r}.jsonl before it rotates
+    # to telemetry_host{r}.jsonl.1 (one generation kept — a pod run
+    # holds at most ~2x this per rank). 0 = unbounded (pre-PR12).
+    fleet_host_row_max_bytes: int = 16 * 2**20
+    # Max/min per-rank mean step time (the fleet block's
+    # step_time.skew — the shard_imbalance convention) at/above which
+    # rank_straggler fires; 1.0 = perfectly balanced.
+    alerts_rank_straggler: float = 2.0
+    # Fraction of loop time this rank spent blocked in the lockstep
+    # collective (fleet.lockstep.wait_frac) at/above which
+    # lockstep_wait_frac fires — the DCN barrier is eating step time.
+    alerts_lockstep_wait_frac: float = 0.75
+    # Max/min per-rank ingested env-steps over the interval
+    # (fleet.env_steps.divergence; a zero-rank reads against a floor of
+    # 1) at/above which fleet_desync fires.
+    alerts_fleet_desync: float = 4.0
+    # Stalest other-rank host-row age (seconds, fleet.host_rows.max_age_s
+    # on rank 0) at/above which missing_rank fires — a rank stopped
+    # writing its row (wedged or dead past the heartbeat horizon).
+    alerts_missing_rank_age_s: float = 120.0
 
 
 @dataclass(frozen=True)
@@ -820,6 +853,31 @@ class Config:
                 f"telemetry.alerts_lane_starved_frac "
                 f"({self.telemetry.alerts_lane_starved_frac}) must be in "
                 "(0, 1]")
+        if self.telemetry.fleet_host_row_max_bytes < 0:
+            raise ValueError(
+                f"telemetry.fleet_host_row_max_bytes "
+                f"({self.telemetry.fleet_host_row_max_bytes}) must be >= 0 "
+                "(0 = unbounded)")
+        if self.telemetry.alerts_rank_straggler <= 1:
+            raise ValueError(
+                f"telemetry.alerts_rank_straggler "
+                f"({self.telemetry.alerts_rank_straggler}) must be > 1 "
+                "(a max/min per-rank step-time ratio; 1.0 = "
+                "perfectly balanced)")
+        if not 0 < self.telemetry.alerts_lockstep_wait_frac <= 1:
+            raise ValueError(
+                f"telemetry.alerts_lockstep_wait_frac "
+                f"({self.telemetry.alerts_lockstep_wait_frac}) must be in "
+                "(0, 1]")
+        if self.telemetry.alerts_fleet_desync <= 1:
+            raise ValueError(
+                f"telemetry.alerts_fleet_desync "
+                f"({self.telemetry.alerts_fleet_desync}) must be > 1 "
+                "(a max/min per-rank env-steps ratio)")
+        if self.telemetry.alerts_missing_rank_age_s <= 0:
+            raise ValueError(
+                f"telemetry.alerts_missing_rank_age_s "
+                f"({self.telemetry.alerts_missing_rank_age_s}) must be > 0")
         if self.multiplayer.enabled and self.actor.envs_per_actor > 1:
             raise ValueError(
                 "actor.envs_per_actor > 1 is not supported with multiplayer "

@@ -31,6 +31,13 @@ Design:
   * **Rank 0 de-duplicates side effects**: checkpoints and metrics logs
     (params are replicated bit-identically everywhere, so this loses
     nothing).
+  * **Fleet observability** (ISSUE 12, ``telemetry.fleet_enabled``):
+    the lockstep row carries per-rank step-time gauges (straggler
+    argmax in-graph, zero extra DCN dispatches), every rank measures
+    compute vs blocked-in-collective time and runs a local AlertEngine
+    (ranks > 0: firings -> alerts_host{r}.jsonl), and rank 0's
+    FleetAggregator merges host rows into the record's ``fleet`` block
+    — see telemetry/fleet.py and README "Fleet observability".
 
 Scope: thread- OR process-mode actors (process mode gives each host a
 spawned CPU-pinned actor fleet fed through the native shm ring, exactly
@@ -148,7 +155,7 @@ class LocalActorFleet:
                 t.terminate()
 
 
-def make_lockstep_ingest(spec: ReplaySpec, mesh):
+def make_lockstep_ingest(spec: ReplaySpec, mesh, fleet: bool = False):
     """One jitted program per loop iteration: conditional per-shard block
     writes, global counters, and stop consensus.
 
@@ -160,6 +167,15 @@ def make_lockstep_ingest(spec: ReplaySpec, mesh):
     buffer_steps (live steps in the ring), filled_shards (shards holding
     data — the dp ready-gate), env_steps (cumulative), stop (>0 = any
     host requested stop).
+
+    ``fleet=True`` (ISSUE 12) appends one (dp,) f32 operand — each host
+    fills its owned rows with its previous iteration's wall step time —
+    and widens the replicated info dict with the skew gauges: the
+    all-gathered per-row step-time and cumulative-env-step tables,
+    sum/max/min reductions, and a one-hot argmax so every rank learns
+    the straggler's dp-row identity in-graph. Same single dispatch —
+    zero extra collectives on the DCN critical path. ``fleet=False``
+    compiles the exact PR-10 program (the kill-switch contract).
 
     mp > 1 routes to the GSPMD formulation (vmap over the dp-leading
     state, scalar sums lowering to the allreduces) for the same reason as
@@ -175,14 +191,14 @@ def make_lockstep_ingest(spec: ReplaySpec, mesh):
     from r2d2_tpu.replay.device_replay import replay_add
 
     if mesh.shape.get("mp", 1) > 1:
-        return _make_gspmd_lockstep_ingest(spec, mesh)
+        return _make_gspmd_lockstep_ingest(spec, mesh, fleet)
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
+        in_specs=(P("dp"),) * (6 if fleet else 5),
         out_specs=(P("dp"), P("dp"), P()),
         check_vma=False)
-    def ingest(state, cum_env, blocks, valid, stop):
+    def ingest(state, cum_env, blocks, valid, stop, *times):
         local = _shard0(state)
         blk = jax.tree_util.tree_map(lambda x: x[0], blocks)
         local = jax.lax.cond(
@@ -198,14 +214,31 @@ def make_lockstep_ingest(spec: ReplaySpec, mesh):
             "env_steps": jax.lax.psum(cum, "dp"),
             "stop": jax.lax.psum(stop[0], "dp"),
         }
+        if fleet:
+            t = times[0][0]
+            tmax = jax.lax.pmax(t, "dp")
+            onehot = (t >= tmax).astype(jnp.int32)   # 1 on the straggler
+            idx = jax.lax.axis_index("dp")
+            info.update({
+                "step_times": jax.lax.all_gather(t, "dp"),
+                "step_time_sum": jax.lax.psum(t, "dp"),
+                "step_time_max": tmax,
+                "step_time_min": jax.lax.pmin(t, "dp"),
+                # one-hot argmax: pmax picks the highest tied row
+                "straggler_shard": jax.lax.pmax(
+                    jnp.where(onehot > 0, idx, -1), "dp"),
+                "env_steps_shards": jax.lax.all_gather(cum, "dp"),
+            })
         return _unshard0(local), cum[None], info
 
     return jax.jit(ingest, donate_argnums=(0, 1))
 
 
-def _make_gspmd_lockstep_ingest(spec: ReplaySpec, mesh):
-    """The dp x mp lockstep ingest: same contract as make_lockstep_ingest,
-    expressed without manual collectives (the replay stays dp-sharded /
+def _make_gspmd_lockstep_ingest(spec: ReplaySpec, mesh, fleet: bool = False):
+    """The dp x mp lockstep ingest: same contract as make_lockstep_ingest
+    (incl. the fleet gauge widening — the reductions/argmax lower to
+    GSPMD allreduces, the tables to replicating constraints), expressed
+    without manual collectives (the replay stays dp-sharded /
     mp-replicated; the scalar reductions become GSPMD allreduces).
 
     Known trade-off: the vmapped ``lax.cond`` lowers through select, so an
@@ -223,9 +256,10 @@ def _make_gspmd_lockstep_ingest(spec: ReplaySpec, mesh):
     from r2d2_tpu.replay.device_replay import replay_add
 
     sharding = NamedSharding(mesh, P("dp"))
+    replicated = NamedSharding(mesh, P())
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def ingest(state, cum_env, blocks, valid, stop):
+    def ingest(state, cum_env, blocks, valid, stop, *times):
         def add_row(s, blk, v):
             return jax.lax.cond(v > 0, lambda ss: replay_add(spec, ss, blk),
                                 lambda ss: ss, s)
@@ -244,28 +278,68 @@ def _make_gspmd_lockstep_ingest(spec: ReplaySpec, mesh):
             "env_steps": cum_env.sum(),
             "stop": stop.sum(),
         }
+        if fleet:
+            t = times[0]
+            # tables replicate (hosts cannot device_get non-addressable
+            # dp shards of a multi-controller array — the PR5 lesson)
+            info.update({
+                "step_times": jax.lax.with_sharding_constraint(
+                    t, replicated),
+                "step_time_sum": t.sum(),
+                "step_time_max": t.max(),
+                "step_time_min": t.min(),
+                "straggler_shard": jnp.argmax(t).astype(jnp.int32),
+                "env_steps_shards": jax.lax.with_sharding_constraint(
+                    cum_env, replicated),
+            })
         return state, cum_env, info
 
     return ingest
 
 
-def _write_host_telemetry_row(path: str, rank: int, tele,
-                              t_start: float, resources=None) -> None:
+def _write_host_telemetry_row(writer, rank: int, tele,
+                              t_start: float, resources=None,
+                              stages=None, fleet_block=None,
+                              stage_counts=None, clock_anchor=None,
+                              actors_per_rank=None, engine=None) -> None:
     """One per-host aggregated telemetry row per log interval. Rank 0's
     stage summary rides the main TrainMetrics record (it owns the
     player's metrics files); every other rank appends compact rows here so
     a pod-wide view exists without breaking the rank-0-deduplicates-side-
     effects rule — tools/inspect.py reads both. With the resource pillar
     on (ISSUE 7) the row also carries this host's ``resources`` block
-    (its own devices + RSS/CPU — resource state is host-local)."""
-    import json
+    (its own devices + RSS/CPU — resource state is host-local).
+
+    Under the fleet plane (ISSUE 12) the row widens: a ``wall`` clock
+    stamp (rank 0 ages other ranks' rows off it — the missing_rank
+    signal), this rank's ``fleet`` timing block, its CUMULATIVE
+    ``stage_counts`` (mergeable by elementwise add into the rank-0 fleet
+    view), the lockstep-iteration-1 ``clock_anchor`` the trace merge
+    aligns ranks on, and ``actors_per_rank`` (maps actor span files to
+    ranks). ``engine`` runs this rank's local AlertEngine over the row
+    itself, so its ``alerts`` block sees the same interval it describes
+    and firings land in alerts_host{r}.jsonl. ``stages`` overrides the
+    default interval summary (rank 0's interval is consumed by the main
+    record, so its own fleet-mode row carries the cumulative summary).
+    ``writer`` is a RotatingJsonlWriter — host rows are size-capped."""
     row = {"t": round(time.time() - t_start, 3), "rank": rank,
-           "stages": tele.interval_summary(),
+           "stages": (tele.interval_summary() if stages is None
+                      else stages),
            "telemetry_dropped_spans": tele.spans.dropped}
     if resources is not None:
         row["resources"] = resources.block()
-    with open(path, "a") as f:
-        f.write(json.dumps(row) + "\n")
+    if fleet_block is not None:
+        row["wall"] = round(time.time(), 3)
+        row["fleet"] = fleet_block
+        if stage_counts is not None:
+            row["stage_counts"] = stage_counts
+        if clock_anchor is not None:
+            row["clock_anchor"] = clock_anchor
+        if actors_per_rank is not None:
+            row["actors_per_rank"] = actors_per_rank
+    if engine is not None:
+        row["alerts"] = engine.evaluate(row)
+    writer.write(row)
 
 
 def owned_dp_rows(mesh) -> List[int]:
@@ -301,33 +375,80 @@ def _local_dp_values(arr) -> np.ndarray:
     return np.concatenate([shards[k] for k in sorted(shards)])
 
 
-def make_lockstep_consensus(mesh):
+def make_lockstep_consensus(mesh, fleet: bool = False):
     """The host-replay twin of lockstep_ingest's counter/stop outputs: a
     tiny psum program every iteration. Each process contributes
     [buffer_steps, env_steps, ready, stop] ONCE (on its first owned dp
     row; zero rows elsewhere); the psum over dp returns the same sums on
     every host, so every control-flow decision downstream is replicated —
-    the lockstep invariant with no device replay involved."""
+    the lockstep invariant with no device replay involved.
+
+    ``fleet=True`` (ISSUE 12) widens the row to 5 columns — col 4 is
+    this host's previous-iteration step time in µs — and the program
+    additionally all-gathers the raw (dp, 5) row table, so every rank
+    reads the full per-rank step-time/env-step picture off the SAME
+    dispatch; the sum/max/min/argmax gauges derive from the table over
+    each rank's first owned row (the only row a host fills). fleet=False
+    compiles the exact PR-10 (dp, 4) psum."""
     import jax
     from r2d2_tpu.parallel.compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from r2d2_tpu.telemetry.fleet import mesh_row_ranks, rank_first_rows
+
     sharding = NamedSharding(mesh, P("dp"))
     local_rows = owned_dp_rows(mesh)
+    ncols = 5 if fleet else 4
+    if fleet:
+        row_ranks = mesh_row_ranks(mesh)
+        first_rows = rank_first_rows(row_ranks, len(set(row_ranks)))
 
-    @jax.jit
-    def psum_rows(x):                                       # (dp, 4) int32
-        return shard_map(lambda v: jax.lax.psum(v, "dp"),
-                         mesh=mesh, in_specs=P("dp"), out_specs=P())(x)
+        @jax.jit
+        def psum_rows(x):                                   # (dp, 5) int32
+            def body(v):
+                return (jax.lax.psum(v, "dp"),
+                        jax.lax.all_gather(v, "dp", axis=0, tiled=True))
+            # check_vma off: the all-gathered table IS replicated, the
+            # static check just cannot infer it (same waiver as the
+            # lockstep ingest program)
+            return shard_map(body, mesh=mesh, in_specs=P("dp"),
+                             out_specs=(P(), P()), check_vma=False)(x)
+    else:
+        @jax.jit
+        def psum_rows(x):                                   # (dp, 4) int32
+            return shard_map(lambda v: jax.lax.psum(v, "dp"),
+                             mesh=mesh, in_specs=P("dp"), out_specs=P())(x)
 
     def consense(buffer_steps: int, env_steps: int, ready: bool,
-                 stop_flag: int) -> dict:
-        rows = np.zeros((len(local_rows), 4), np.int32)
-        rows[0] = (buffer_steps, env_steps, int(bool(ready)), int(stop_flag))
+                 stop_flag: int, step_time_s: float = 0.0) -> dict:
+        rows = np.zeros((len(local_rows), ncols), np.int32)
+        vals = [buffer_steps, env_steps, int(bool(ready)), int(stop_flag)]
+        if fleet:
+            # µs in int32: cap at 2000 s so the cast can never overflow
+            vals.append(int(min(max(step_time_s, 0.0), 2000.0) * 1e6))
+        rows[0] = vals
         x = jax.make_array_from_process_local_data(sharding, rows)
-        out = np.asarray(psum_rows(x)).reshape(-1, 4)[0]
-        return {"buffer_steps": int(out[0]), "env_steps": int(out[1]),
+        if fleet:
+            summed, table = psum_rows(x)
+            out = np.asarray(summed).reshape(-1, ncols)[0]
+        else:
+            out = np.asarray(psum_rows(x)).reshape(-1, ncols)[0]
+        info = {"buffer_steps": int(out[0]), "env_steps": int(out[1]),
                 "ready_procs": int(out[2]), "stop": int(out[3])}
+        if fleet:
+            table = np.asarray(table).reshape(-1, ncols)
+            times = table[:, 4].astype(np.float64) / 1e6        # (dp,) s
+            per_rank = times[first_rows]
+            info.update({
+                "step_times": times,
+                "step_time_sum": float(per_rank.sum()),
+                "step_time_max": float(per_rank.max()),
+                "step_time_min": float(per_rank.min()),
+                "straggler_shard": int(
+                    first_rows[int(np.argmax(per_rank))]),
+                "env_steps_shards": table[:, 1].astype(np.int64),
+            })
+        return info
 
     return consense
 
@@ -378,6 +499,15 @@ class HostFeed:
             return self._noop
         return self._build(block, stop_flag)
 
+    def times(self, step_time_s: float):
+        """The fleet-widened ingest's (dp,) f32 timing operand: every
+        owned row carries this host's previous-iteration step time
+        (seconds). Built fresh per iteration — it changes every time, so
+        there is nothing to reuse (and it is 4 bytes per dp row)."""
+        import jax
+        arr = np.full((self.local_dp,), step_time_s, np.float32)
+        return jax.make_array_from_process_local_data(self.sharding, arr)
+
     def _build(self, block: Optional[Block], stop_flag: int):
         import jax
 
@@ -426,6 +556,10 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         raise ValueError(
             f"unknown replay.placement {cfg.replay.placement!r}")
     host_mode = cfg.replay.placement == "host"
+    # fleet observability plane (ISSUE 12): widened lockstep gauges,
+    # per-iteration compute-vs-wait timing, the rank-0 fleet block,
+    # per-rank alert engines, clock-anchored host rows
+    fleet_on = cfg.telemetry.enabled and cfg.telemetry.fleet_enabled
     from r2d2_tpu.telemetry.learning import LearningAggregator, LearningDiag
     # learning diagnostics (ISSUE 5): fused into the lockstep step like
     # the single-host path; only rank 0 aggregates (it owns TrainMetrics)
@@ -499,7 +633,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         local_batch = spec.batch_size * local_rows_n // dp
         # per-rank seed: each host's replay samples ITS OWN distribution
         host_replay = HostReplay(spec, seed=cfg.runtime.seed + 7919 * rank)
-        consense = make_lockstep_consensus(mesh)
+        consense = make_lockstep_consensus(mesh, fleet=fleet_on)
         ext_step = make_external_batch_step(net, spec, cfg.optim,
                                             cfg.network.use_double,
                                             diag=learn_diag)
@@ -528,7 +662,7 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         step_fn = make_sharded_learner_step(
             net, spec, cfg.optim, cfg.network.use_double, mesh,
             steps_per_dispatch=k, diag=learn_diag)
-        ingest_fn = make_lockstep_ingest(spec, mesh)
+        ingest_fn = make_lockstep_ingest(spec, mesh, fleet=fleet_on)
         feed = HostFeed(spec, mesh)
 
     # -- local actors (this host's share of the global fleet) --
@@ -786,14 +920,63 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         pub_count = ((lambda: publisher.publish_count)
                      if publisher is not None
                      else (lambda: store.publish_count))
-        host_rows_path = os.path.join(
-            cfg.runtime.save_dir or ".", f"telemetry_host{rank}.jsonl")
-        if rank != 0 and tele.enabled:
-            os.makedirs(cfg.runtime.save_dir or ".", exist_ok=True)
-            if not cfg.runtime.resume:
-                # same append-on-resume contract as TrainMetrics: a
-                # preemption resume keeps the pod-wide telemetry history
-                open(host_rows_path, "w").close()
+        # -- fleet observability plane (ISSUE 12) --
+        # Host rows move to the size-capped rotating writer (rotation
+        # applies with or without the fleet switch — the unbounded-growth
+        # fix stands on its own); rank 0 writes a row too UNDER THE FLEET
+        # PLANE ONLY (uniform per-rank inspector panels + the clock
+        # anchor), keeping the pre-PR12 file set when it is off. Every
+        # rank tracks its lockstep timing in a FleetAggregator; ranks > 0
+        # additionally run a local AlertEngine over their own rows
+        # (firings -> alerts_host{r}.jsonl) — until now they evaluated no
+        # rules at all. Same append-on-resume contract as TrainMetrics.
+        from r2d2_tpu.telemetry.fleet import (
+            FLEET_INFO_KEYS, FleetAggregator, RotatingJsonlWriter,
+            cumulative_stage_matrix, host_alerts_path, host_row_path,
+            mesh_row_ranks, stage_counts_dict, summarize_stage_counts)
+        host_writer = None
+        if tele.enabled and (rank != 0 or fleet_on):
+            host_writer = RotatingJsonlWriter(
+                host_row_path(cfg.runtime.save_dir or ".", rank),
+                max_bytes=cfg.telemetry.fleet_host_row_max_bytes,
+                resume=bool(cfg.runtime.resume))
+        elif rank == 0 and not cfg.runtime.resume:
+            # fleet (or telemetry) off on a FRESH run: a previous
+            # fleet-on run's rank-0 host row must not leak into this
+            # run's inspector view / trace merge — the pre-PR12
+            # file-set contract the kill switch promises
+            for suffix in ("", ".1"):
+                try:
+                    os.remove(host_row_path(
+                        cfg.runtime.save_dir or ".", rank) + suffix)
+                except OSError:
+                    pass
+        fleet_mon = None
+        host_engine = None
+        if fleet_on:
+            fleet_mon = FleetAggregator(
+                rank, nprocs, mesh_row_ranks(mesh),
+                save_dir=cfg.runtime.save_dir or ".",
+                missing_age_s=cfg.telemetry.alerts_missing_rank_age_s)
+            if (rank != 0 and cfg.telemetry.resources_enabled
+                    and cfg.telemetry.alerts_enabled):
+                from r2d2_tpu.telemetry import AlertEngine, default_rules
+                host_engine = AlertEngine(
+                    default_rules(cfg.telemetry),
+                    jsonl_path=host_alerts_path(
+                        cfg.runtime.save_dir or ".", rank),
+                    resume=bool(cfg.runtime.resume))
+        # chaos straggler hook (tests only, R2D2_MH_CHAOS_STRAGGLER=
+        # "rank:slowxF"): the named rank stretches every iteration's
+        # compute phase by ~F (sleep proportional to its own last step
+        # time) — the injected straggler the fleet gauges must name
+        straggler_factor = 0.0
+        chaos_straggler = os.environ.get("R2D2_MH_CHAOS_STRAGGLER", "")
+        if chaos_straggler:
+            r_s, _, kind = chaos_straggler.partition(":")
+            if int(r_s) == rank:
+                from r2d2_tpu.tools.chaos import parse_fault_spec
+                straggler_factor = parse_fault_spec(f"0:{kind}")[0].factor
         t_run_start = time.time()
         max_steps = max_training_steps or cfg.optim.training_steps
         deadline = time.time() + max_seconds if max_seconds else None
@@ -851,6 +1034,11 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         it = 0
         while step_count < max_steps:
             it += 1
+            if straggler_factor > 1.0 and fleet_mon is not None:
+                # injected compute slowdown (chaos straggler hook):
+                # genuinely stretches this rank's iteration by ~factor
+                time.sleep(min((straggler_factor - 1.0)
+                               * fleet_mon.last_step_s, 0.25))
             local_stop = int(stop.is_set()
                              or (deadline is not None
                                  and time.time() > deadline))
@@ -866,18 +1054,36 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                     # same accounting as lockstep_ingest's device path
                     env_local += int(np.sum(np.asarray(
                         block.learning_steps)))
+                t0 = time.perf_counter()
                 info = consense(len(host_replay), env_local,
-                                len(host_replay) > 0, local_stop)
+                                len(host_replay) > 0, local_stop,
+                                step_time_s=(fleet_mon.last_step_s
+                                             if fleet_mon else 0.0))
+                if fleet_mon is not None:
+                    t_coll = time.perf_counter() - t0
+                    fleet_mon.on_collective(info, t_coll)
+                    tele.observe("lockstep/dispatch", t_coll)
+                    info = {kk: v for kk, v in info.items()
+                            if kk not in FLEET_INFO_KEYS}
             else:
                 t0 = time.perf_counter()
-                rs, cum_env, dev_info = ingest_fn(
-                    rs, cum_env, *feed.build(block, local_stop))
-                info = {kk: int(v)
-                        for kk, v in jax.device_get(dev_info).items()}
+                args = feed.build(block, local_stop)
+                if fleet_mon is not None:
+                    args = args + (feed.times(fleet_mon.last_step_s),)
+                rs, cum_env, dev_info = ingest_fn(rs, cum_env, *args)
+                fetched = jax.device_get(dev_info)
+                t_coll = time.perf_counter() - t0
+                info = {kk: int(v) for kk, v in fetched.items()
+                        if kk not in FLEET_INFO_KEYS}
+                if fleet_mon is not None:
+                    # the dispatch+readback is the pod's synchronization
+                    # point: blocked time here IS the price of skew
+                    fleet_mon.on_collective(fetched, t_coll)
+                    tele.observe("lockstep/dispatch", t_coll)
                 if block is not None:
                     # only real ingests count — the pre-ready no-op spin
                     # iterations would otherwise dominate the histogram
-                    tele.observe("ingest/commit", time.perf_counter() - t0)
+                    tele.observe("ingest/commit", t_coll)
             if debug:
                 print(f"[mh rank={rank} it={it}] step={step_count} "
                       f"block={block is not None} {info}", flush=True)
@@ -1012,18 +1218,62 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                     metrics.env_steps = resumed_env + info["env_steps"]
                     metrics.set_buffer_size(info["buffer_steps"])
                     metrics.set_actor_health(health.snapshot())
+                    if fleet_mon is not None:
+                        # the rank-0 fleet block: local lockstep timing +
+                        # the gauge tables + the cross-host merge (other
+                        # ranks' host-row ages and stage histograms)
+                        metrics.set_fleet(fleet_mon.flush(
+                            now=now,
+                            local_stage_counts=stage_counts_dict(
+                                cumulative_stage_matrix(tele))))
                     record = metrics.log(now - last_log)
+                    if fleet_mon is not None and host_writer is not None:
+                        # rank 0's own host row (fleet plane only): the
+                        # clock anchor + cumulative stage counts for the
+                        # per-rank panels — its INTERVAL summary was just
+                        # consumed by the record, so the row carries the
+                        # cumulative one
+                        cum = cumulative_stage_matrix(tele)
+                        _write_host_telemetry_row(
+                            host_writer, rank, tele, t_run_start,
+                            stages=summarize_stage_counts(
+                                stage_counts_dict(cum)),
+                            fleet_block=record.get("fleet"),
+                            stage_counts=stage_counts_dict(cum),
+                            clock_anchor=fleet_mon.clock_anchor,
+                            actors_per_rank=n_local)
                     if log_fn:
                         log_fn({"rank": rank, **record})
                 elif tele.enabled:
                     # ranks > 0 have no TrainMetrics (rank 0 de-duplicates
                     # side effects) but their pipeline still needs
                     # observability: one aggregated per-host row per
-                    # interval
-                    _write_host_telemetry_row(host_rows_path, rank, tele,
-                                              t_run_start,
-                                              resources=resources)
+                    # interval (plus, under the fleet plane, this rank's
+                    # timing block, mergeable stage counts, clock anchor,
+                    # and its local alert engine's verdict)
+                    fb = sc = None
+                    if fleet_mon is not None:
+                        fb = fleet_mon.flush(now=now)
+                        sc = stage_counts_dict(
+                            cumulative_stage_matrix(tele))
+                    _write_host_telemetry_row(
+                        host_writer, rank, tele, t_run_start,
+                        resources=resources, fleet_block=fb,
+                        stage_counts=sc,
+                        clock_anchor=(fleet_mon.clock_anchor
+                                      if fleet_mon else None),
+                        actors_per_rank=(n_local if fleet_mon else None),
+                        engine=host_engine)
                 last_log = now
+            if fleet_mon is not None:
+                # close the iteration: its duration feeds the NEXT
+                # iteration's psum row (a one-iteration lag — irrelevant
+                # at alerting cadence) and the lockstep/step histogram.
+                # The first call only arms the clock (returns 0.0) and
+                # must not count as a sub-µs sample.
+                step_s = fleet_mon.on_step()
+                if step_s > 0:
+                    tele.observe("lockstep/step", step_s)
         flush_losses()
         # preemption-safe final checkpoint (same contract as the
         # single-host Learner.save_final): a clean stop — signal fed

@@ -89,6 +89,15 @@ class TrainMetrics:
         # every non-anakin run (consumers key on its presence)
         self._anakin = None
 
+        # fleet observability block (ISSUE 12): set per flush by the
+        # rank-0 FleetAggregator (per-rank step-time table, straggler
+        # rank, lockstep-wait fraction, env-step divergence, host-row
+        # ages, merged fleet stage histograms); emitted once per record
+        # then cleared, OMITTED on every non-multihost run and under the
+        # telemetry.fleet_enabled kill switch (schema byte-identical to
+        # PR10, stability-tested)
+        self._fleet = None
+
         # replay & data-pathology block (ISSUE 10): set per flush by the
         # ReplayDiagAggregator (sum-tree health, eviction lifetimes, lane
         # composition); emitted once per record then cleared, OMITTED
@@ -179,6 +188,13 @@ class TrainMetrics:
         ratio — runtime/anakin_loop.py flush_stats); None = nothing this
         interval and the record carries no 'anakin' key."""
         self._anakin = block
+
+    def set_fleet(self, block: Optional[dict]) -> None:
+        """Attach the interval's fleet-observability block (per-rank
+        step-time skew, straggler identity, lockstep-wait fraction,
+        env-step divergence, host-row ages — telemetry/fleet.py); None =
+        nothing this interval and the record carries no 'fleet' key."""
+        self._fleet = block
 
     def set_replay_diag(self, block: Optional[dict]) -> None:
         """Attach the interval's replay-diagnostics block (sum-tree
@@ -308,6 +324,13 @@ class TrainMetrics:
             # shard_imbalance rule sees its own interval
             record["anakin"] = self._anakin
             self._anakin = None
+        if self._fleet is not None:
+            # ONE fleet block per interval (ISSUE 12), consumed on
+            # emission; before the sentinel pass so the rank_straggler /
+            # lockstep_wait_frac / fleet_desync / missing_rank rules see
+            # their own interval
+            record["fleet"] = self._fleet
+            self._fleet = None
         if self._replay_diag is not None:
             # ONE replay_diag block per interval (ISSUE 10), consumed on
             # emission; before the sentinel pass so the priority-collapse

@@ -39,6 +39,16 @@ sampled batches — fused into the jitted sample/update path and
 aggregated into the record's ``replay_diag`` block, with 4 stock alert
 rules riding alerts.py.
 
+``fleet.py`` (ISSUE 12) is the FLEET plane: per-rank lockstep/collective
+timing gauges widened into the multihost psum row (sum/max/min step
+time + one-hot straggler argmax + all-gathered per-row tables), the
+rank-0 ``FleetAggregator`` merging host rows (stage histograms by
+elementwise add, resource blocks, row ages) into the record's ``fleet``
+block, per-rank AlertEngines on ranks > 0, clock-anchored host rows the
+cross-host trace merge aligns on, and size-capped host-row rotation —
+with 4 stock rules (rank_straggler, lockstep_wait_frac, fleet_desync,
+missing_rank) riding alerts.py.
+
 ``costmodel.py`` / ``traceparse.py`` (ISSUE 9) are the COMPUTE pillar:
 XLA ``cost_analysis()``/``memory_analysis()`` per-program cost tables
 across every step factory (the ``make regress`` exact-match costs gate
@@ -60,6 +70,12 @@ from r2d2_tpu.telemetry.costmodel import (analytic_component_costs,
 from r2d2_tpu.telemetry.core import (NULL_TELEMETRY, STAGE_INDEX, STAGES,
                                      StageTimers, Telemetry,
                                      summarize_matrix)
+from r2d2_tpu.telemetry.fleet import (FLEET_INFO_KEYS, FleetAggregator,
+                                      RotatingJsonlWriter,
+                                      cumulative_stage_matrix,
+                                      merge_stage_counts, mesh_row_ranks,
+                                      read_last_jsonl_row, stage_counts_dict,
+                                      summarize_stage_counts)
 from r2d2_tpu.telemetry.histogram import (NBUCKETS, LogHistogram,
                                           bucket_bounds, bucket_index,
                                           bucket_mid, percentile, summarize,
@@ -74,18 +90,24 @@ from r2d2_tpu.telemetry.spans import SpanTracer, chrome_trace_events
 from r2d2_tpu.telemetry.traceparse import attribute_trace, component_of
 
 __all__ = [
-    "NBUCKETS", "NULL_TELEMETRY", "STAGES", "STAGE_INDEX",
+    "FLEET_INFO_KEYS", "NBUCKETS", "NULL_TELEMETRY", "STAGES",
+    "STAGE_INDEX",
     "AlertEngine", "AlertRule", "BufferRegistry", "CompileMonitor",
-    "LearningAggregator", "LearningDiag", "LogHistogram",
+    "FleetAggregator", "LearningAggregator", "LearningDiag",
+    "LogHistogram",
     "ProfilerCapture", "ReplayDiag", "ReplayDiagAggregator",
-    "ResourceMonitor", "SpanTracer", "StageTimers",
+    "ResourceMonitor", "RotatingJsonlWriter", "SpanTracer", "StageTimers",
     "Telemetry", "TelemetryBoard", "active_monitor",
     "analytic_component_costs", "aot_coverage", "attribute_trace",
     "bucket_bounds",
     "bucket_index", "bucket_mid", "chrome_trace_events",
     "collect_cost_table", "compare_cost_tables", "component_of",
-    "default_rules", "device_memory_stats", "host_usage", "peak_spec",
+    "cumulative_stage_matrix",
+    "default_rules", "device_memory_stats", "host_usage",
+    "merge_stage_counts", "mesh_row_ranks", "peak_spec",
     "percentile", "program_cost",
-    "pytree_nbytes", "record_value", "register_buffer", "summarize",
-    "summarize_matrix", "trace", "value_summary",
+    "pytree_nbytes", "read_last_jsonl_row", "record_value",
+    "register_buffer", "stage_counts_dict", "summarize",
+    "summarize_matrix", "summarize_stage_counts", "trace",
+    "value_summary",
 ]

@@ -160,6 +160,28 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("lane_starvation", "threshold",
                   ("replay_diag", "lanes", "starved_frac"),
                   tcfg.alerts_lane_starved_frac, "warn"),
+        # fleet rules (ISSUE 12; the fleet block, telemetry/fleet.py —
+        # inactive on records without it, i.e. every non-multihost run):
+        # one rank's mean step time running a multiple of the fastest
+        # rank's — under lockstep the WHOLE pod runs at its pace
+        AlertRule("rank_straggler", "threshold",
+                  ("fleet", "step_time", "skew"),
+                  tcfg.alerts_rank_straggler, "warn"),
+        # this rank's loop time is mostly spent blocked in the per-
+        # iteration psum — the DCN barrier (or a peer) owns the step
+        AlertRule("lockstep_wait_frac", "threshold",
+                  ("fleet", "lockstep", "wait_frac"),
+                  tcfg.alerts_lockstep_wait_frac, "warn"),
+        # per-rank ingested env-steps diverging: one host's actors are
+        # starving its replay shards relative to the fleet
+        AlertRule("fleet_desync", "threshold",
+                  ("fleet", "env_steps", "divergence"),
+                  tcfg.alerts_fleet_desync, "warn"),
+        # a rank stopped writing its host row (rank-0 view): wedged or
+        # dead past the heartbeat horizon
+        AlertRule("missing_rank", "threshold",
+                  ("fleet", "host_rows", "max_age_s"),
+                  tcfg.alerts_missing_rank_age_s, "crit"),
     )
 
 

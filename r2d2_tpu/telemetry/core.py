@@ -40,6 +40,8 @@ STAGES = (
     "learner/device_sync",        # flush_metrics device readback
     "learner/priority_writeback", # host-placement async priority update
     "weights/publish",            # learner -> weight service publish
+    "lockstep/dispatch",          # multihost: blocked in the psum collective
+    "lockstep/step",              # multihost: one whole lockstep iteration
 )
 STAGE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(STAGES)}
 

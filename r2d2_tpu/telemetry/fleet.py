@@ -1,0 +1,451 @@
+"""Fleet observability plane (ISSUE 12): cross-host aggregation,
+lockstep/collective timing, and straggler attribution.
+
+The multihost trainer (parallel/multihost.py) is lockstep by
+construction: every controller dispatches the same psum program every
+iteration, so the WHOLE POD runs at the slowest rank's pace — yet until
+this module nothing measured which rank that was or how much step time
+the DCN barrier ate. Three instruments close the gap, all behind
+``telemetry.fleet_enabled``:
+
+  * **In-band skew gauges** — the per-iteration lockstep psum row is
+    widened (``make_lockstep_ingest`` / ``make_lockstep_consensus``,
+    ``fleet=True``) with each rank's previous-iteration step time:
+    sum/max/min reductions, a one-hot argmax so every rank learns the
+    straggler's identity in-graph, and the all-gathered per-row
+    step-time and env-step tables — replicated outputs on the SAME
+    dispatch, zero extra DCN collectives.
+  * **:class:`FleetAggregator`** — every rank accumulates its local
+    lockstep timing (compute vs blocked-in-collective) and the gauge
+    tables into a per-interval ``fleet`` block; rank 0 additionally
+    merges the other ranks' host rows (stage histograms — mergeable by
+    elementwise add by design, PR 4 — resource blocks, row ages) read
+    from the shared filesystem, and the block rides the periodic record
+    where the ``rank_straggler`` / ``lockstep_wait_frac`` /
+    ``fleet_desync`` / ``missing_rank`` alert rules watch it.
+  * **Clock anchors** — each rank's host row carries a
+    monotonic/wall-clock anchor pair stamped when lockstep iteration 1's
+    collective completed (a genuinely pod-synchronized instant), so
+    ``tools/inspect.py --export-trace`` can align every rank's span
+    files onto rank 0's clock and merge them into one Perfetto timeline
+    with per-rank tracks.
+
+:class:`RotatingJsonlWriter` gives the per-host streams size-capped
+rotation (``telemetry.fleet_host_row_max_bytes``) consistent with
+``logparse.parse_jsonl``'s partial-line tolerance — a pod run's
+``telemetry_host{r}.jsonl`` no longer grows unboundedly.
+
+Designed so ISSUE 1's multihost sharded-Anakin loop adopts the same
+block unchanged: the gauges are per-dp-row (``row_ranks`` maps rows to
+controllers), not tied to the host-actor ingestion path.
+"""
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# Keys the fleet-widened lockstep programs add to the replicated info
+# dict — the training loop strips these (they are tables/gauges for the
+# aggregator, not control-flow scalars).
+FLEET_INFO_KEYS = ("step_times", "step_time_sum", "step_time_max",
+                   "step_time_min", "straggler_shard", "env_steps_shards")
+
+
+def host_row_path(save_dir: str, rank: int) -> str:
+    return os.path.join(save_dir or ".", f"telemetry_host{rank}.jsonl")
+
+
+def host_alerts_path(save_dir: str, rank: int) -> str:
+    return os.path.join(save_dir or ".", f"alerts_host{rank}.jsonl")
+
+
+class RotatingJsonlWriter:
+    """Size-capped JSONL appender for the per-host telemetry streams.
+
+    When the live file exceeds ``max_bytes`` it is renamed to
+    ``{path}.1`` (replacing the previous rotated generation) and writing
+    continues on a fresh file — so a long pod run holds at most
+    ~2 x max_bytes per rank. Readers keep working mid-rotation:
+    ``parse_jsonl`` tolerates partial trailing lines, and a reader that
+    opened the old inode simply finishes it. ``max_bytes=0`` disables
+    rotation (unbounded, the pre-PR12 behavior)."""
+
+    def __init__(self, path: str, max_bytes: int = 0, resume: bool = False):
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self.rotations = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if resume:
+            try:
+                self._size = os.path.getsize(path)
+            except OSError:
+                self._size = 0
+        else:
+            # fresh run truncates the live file AND drops the previous
+            # run's rotated generation (the TrainMetrics truncate-on-fresh
+            # contract; a stale .1 would splice another run's history
+            # into this run's reads)
+            open(path, "w").close()
+            try:
+                os.remove(path + ".1")
+            except OSError:
+                pass
+            self._size = 0
+
+    def write(self, row: dict) -> None:
+        line = json.dumps(row) + "\n"
+        if (self.max_bytes and self._size
+                and self._size + len(line) > self.max_bytes):
+            # rotate BEFORE the write that would exceed the cap, so the
+            # live file always holds the newest row — a reader (rank 0's
+            # flush, the trace merge) must never find the stream empty
+            # for a whole interval just because it rotated
+            try:
+                os.replace(self.path, self.path + ".1")
+                self.rotations += 1
+                self._size = 0
+            except OSError:
+                pass
+        with open(self.path, "a") as f:
+            f.write(line)
+        self._size += len(line)
+
+
+def read_last_jsonl_row(path: str,
+                        max_scan_bytes: int = 65536) -> Optional[dict]:
+    """The newest complete record of a JSONL stream without reading the
+    whole file — rank 0 polls every other rank's host row once per log
+    interval, so this must stay O(tail), not O(file). Partial trailing
+    lines (a writer mid-append) are skipped, like ``parse_jsonl``. Falls
+    back to the ``.1`` rotated generation when the live file is missing
+    or empty (the instant between a rotation's rename and its write)."""
+    for p in (path, path + ".1"):
+        row = _read_tail_row(p, max_scan_bytes)
+        if row is not None:
+            return row
+    return None
+
+
+def _read_tail_row(path: str, max_scan_bytes: int) -> Optional[dict]:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - max_scan_bytes))
+            tail = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Stage-histogram merge: the PR-4 histograms are mergeable by elementwise
+# add by design; host rows carry each rank's CUMULATIVE counts (keyed by
+# stage name, robust to stage-list growth) and rank 0 sums them into one
+# fleet-wide view.
+
+
+def stage_counts_dict(matrix: np.ndarray) -> Dict[str, List[int]]:
+    """Serialize a (stages, buckets) counts matrix to {stage: [counts]},
+    keeping only stages with data (host rows stay lean)."""
+    from r2d2_tpu.telemetry.core import STAGES
+    out = {}
+    for i, name in enumerate(STAGES):
+        if i < matrix.shape[0] and int(matrix[i].sum()):
+            out[name] = [int(c) for c in matrix[i]]
+    return out
+
+
+def merge_stage_counts(dicts: Sequence[Dict[str, Sequence[int]]]
+                       ) -> Dict[str, np.ndarray]:
+    """Elementwise-add merge of per-rank stage-count dicts."""
+    merged: Dict[str, np.ndarray] = {}
+    for d in dicts:
+        for name, counts in (d or {}).items():
+            arr = np.asarray(counts, np.int64)
+            if name in merged:
+                merged[name] = merged[name] + arr
+            else:
+                merged[name] = arr.copy()
+    return merged
+
+
+def summarize_stage_counts(counts: Dict[str, Sequence[int]]
+                           ) -> Dict[str, Dict[str, float]]:
+    """{stage: {count, p50_ms, p95_ms, p99_ms}} from a (merged) counts
+    dict — the same summary shape as the record's ``stages`` block."""
+    from r2d2_tpu.telemetry.histogram import summarize
+    out = {}
+    for name in sorted(counts):
+        s = summarize(np.asarray(counts[name], np.int64))
+        if s is not None:
+            out[name] = s
+    return out
+
+
+def cumulative_stage_matrix(tele) -> np.ndarray:
+    """This process's cumulative (stages, buckets) counts: the local
+    timers plus, when an actor TelemetryBoard is attached, the fleet
+    slots' cumulative rows — both non-consuming reads, so this never
+    races the interval_summary() consumption the record path owns."""
+    m = tele.timers.cumulative()
+    board = getattr(tele, "_agg_board", None)
+    if board is not None:
+        try:
+            m = m + board.read().sum(axis=0)
+        except (ValueError, OSError):
+            pass    # board torn down mid-shutdown: local counts only
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Mesh topology helpers: the gauge tables are per dp-ROW; these map rows
+# to the controller (process/rank) that owns them.
+
+
+def mesh_row_ranks(mesh) -> List[int]:
+    """Owning process index per dp row (a multi-device host owns several
+    consecutive rows; all its rows carry the same host timing)."""
+    rows = mesh.devices.reshape(mesh.shape["dp"], -1)
+    return [int(rows[r].flat[0].process_index) for r in range(rows.shape[0])]
+
+
+def rank_first_rows(row_ranks: Sequence[int], nprocs: int) -> List[int]:
+    """First dp row owned by each rank, rank order — the row whose gauge
+    entry represents that rank (hosts fill all their rows identically on
+    the device path and only the first on the host-replay path)."""
+    first: Dict[int, int] = {}
+    for row, rank in enumerate(row_ranks):
+        first.setdefault(int(rank), row)
+    missing = [r for r in range(nprocs) if r not in first]
+    if missing:
+        raise ValueError(
+            f"ranks {missing} own no dp rows (row_ranks={list(row_ranks)})")
+    return [first[r] for r in range(nprocs)]
+
+
+class FleetAggregator:
+    """Per-rank lockstep-timing accumulator + (on rank 0) the cross-host
+    merge behind the periodic record's ``fleet`` block.
+
+    The training loop feeds it twice per iteration:
+
+      * :meth:`on_collective` with the lockstep program's fetched info
+        dict (the widened gauge tables) and the seconds this rank spent
+        blocked in the dispatch+readback — the collective is the pod's
+        synchronization point, so blocked time IS the price of skew;
+      * :meth:`on_step` at iteration end (measures the whole iteration
+        against its own internal clock; the result feeds the NEXT
+        iteration's psum row via :attr:`last_step_s` — a one-iteration
+        lag, irrelevant at alerting cadence).
+
+    :meth:`flush` (once per log interval) returns the ``fleet`` block
+    and resets the interval accumulators. On rank 0 it additionally
+    reads every other rank's newest host row (shared filesystem) for
+    row ages (the ``missing_rank`` signal) and the fleet-wide stage
+    merge."""
+
+    def __init__(self, rank: int, nprocs: int, row_ranks: Sequence[int],
+                 save_dir: Optional[str] = None,
+                 missing_age_s: float = 120.0):
+        self.rank = int(rank)
+        self.nprocs = int(nprocs)
+        self.row_ranks = [int(r) for r in row_ranks]
+        self.first_rows = rank_first_rows(self.row_ranks, self.nprocs)
+        self.save_dir = save_dir
+        self.missing_age_s = missing_age_s
+        self.clock_anchor: Optional[dict] = None
+        self.last_step_s = 0.0
+        self._iter_t0: Optional[float] = None
+        self._prev_env: Optional[np.ndarray] = None   # per-rank cumulative
+        self._collectives_total = 0
+        self._reset_interval()
+
+    def _reset_interval(self) -> None:
+        self._wait_s = 0.0
+        self._step_sum_s = 0.0
+        self._iters = 0
+        self._collectives = 0
+        self._time_rows: Optional[np.ndarray] = None   # per-row sums (s)
+        self._env_rows: Optional[np.ndarray] = None    # last cumulative
+        self._last_straggler_shard: Optional[int] = None
+        self._in_band: Dict[str, float] = {}   # last psum/pmax/pmin gauges
+
+    # -- per-iteration feed points --
+
+    def on_collective(self, info: Dict[str, Any], wait_s: float) -> None:
+        self._wait_s += float(wait_s)
+        self._collectives += 1
+        self._collectives_total += 1
+        if self.clock_anchor is None:
+            # iteration 1's collective completion: every rank exits the
+            # psum at (nearly) the same true instant — the cross-host
+            # alignment event the trace merge shifts clocks by
+            self.clock_anchor = {"it": self._collectives_total,
+                                 "wall": time.time(),
+                                 "mono": time.monotonic()}
+        st = info.get("step_times")
+        if st is not None:
+            st = np.asarray(st, np.float64).reshape(-1)
+            self._time_rows = (st.copy() if self._time_rows is None
+                               else self._time_rows + st)
+        for key in ("step_time_sum", "step_time_max", "step_time_min"):
+            if info.get(key) is not None:
+                self._in_band[key] = float(info[key])
+        env = info.get("env_steps_shards")
+        if env is not None:
+            self._env_rows = np.asarray(env, np.int64).reshape(-1)
+        ss = info.get("straggler_shard")
+        if ss is not None:
+            self._last_straggler_shard = int(ss)
+
+    def on_step(self, step_s: Optional[float] = None) -> float:
+        """Close this iteration: returns its duration (seconds) and arms
+        :attr:`last_step_s` for the next iteration's psum row.
+        ``step_s`` overrides the internal clock (deterministic tests and
+        fixture replay)."""
+        now = time.perf_counter()
+        if step_s is None:
+            if self._iter_t0 is None:
+                self._iter_t0 = now
+                return 0.0
+            step_s = now - self._iter_t0
+        self._iter_t0 = now
+        self.last_step_s = step_s
+        self._step_sum_s += step_s
+        self._iters += 1
+        return step_s
+
+    # -- per-rank collapse --
+
+    def _per_rank(self, rows: Optional[np.ndarray]) -> Optional[np.ndarray]:
+        if rows is None:
+            return None
+        rows = np.asarray(rows)
+        if rows.shape[0] < len(self.row_ranks):
+            return None
+        return rows[self.first_rows]
+
+    def _per_rank_env(self) -> Optional[np.ndarray]:
+        """Cumulative env steps per RANK: rows are per-shard counters, a
+        multi-row host's total is the sum over its rows (the host-replay
+        path only fills the first owned row, summing stays correct)."""
+        if self._env_rows is None:
+            return None
+        out = np.zeros((self.nprocs,), np.int64)
+        for row, rank in enumerate(self.row_ranks):
+            if row < len(self._env_rows):
+                out[rank] += int(self._env_rows[row])
+        return out
+
+    # -- the record block --
+
+    def flush(self, now: Optional[float] = None,
+              local_stage_counts: Optional[dict] = None) -> dict:
+        now = time.time() if now is None else now
+        block: Dict[str, Any] = {"ranks": self.nprocs,
+                                 "rank": self.rank,
+                                 "row_ranks": self.row_ranks,
+                                 "iters": self._iters}
+        tot = self._step_sum_s
+        block["lockstep"] = {
+            "dispatches": self._collectives,
+            "wait_s": round(self._wait_s, 4),
+            "wait_frac": (round(min(self._wait_s / tot, 1.0), 4)
+                          if tot > 0 else None),
+            "wait_ms_mean": (round(1e3 * self._wait_s / self._collectives, 3)
+                             if self._collectives else None),
+            "step_ms_mean": (round(1e3 * tot / self._iters, 3)
+                             if self._iters else None),
+        }
+        per_rank_t = self._per_rank(self._time_rows)
+        if per_rank_t is not None and self._collectives:
+            mean_rows = per_rank_t / self._collectives
+            per_ms = [round(1e3 * float(v), 3) for v in mean_rows]
+            mean = float(np.mean(mean_rows))
+            block["step_time"] = {
+                "per_rank_ms": per_ms,
+                "mean_ms": round(1e3 * mean, 3),
+                "max_ms": round(max(per_ms), 3),
+                "min_ms": round(min(per_ms), 3),
+                # max/min mean step time (the shard_imbalance
+                # convention): 1.0 = perfectly balanced; the
+                # rank_straggler rule's metric. NOT max-over-mean — that
+                # is bounded by the rank count, so a 2-host pod could
+                # never reach a 2x threshold however slow one rank got.
+                "skew": (round(max(per_ms) / min(per_ms), 3)
+                         if min(per_ms) > 0 else None),
+                "straggler_rank": int(np.argmax(mean_rows)),
+                # the in-graph one-hot argmax from the LAST collective (a
+                # dp-row id; row_ranks maps it to a rank) — every rank
+                # saw this without any host-side merge
+                "straggler_shard": self._last_straggler_shard,
+            }
+            if self._in_band:
+                # the LAST collective's psum/pmax/pmin gauges — the
+                # in-band values every rank read without host math (the
+                # interval means above are the alerting metric; these
+                # pin the instantaneous picture)
+                block["step_time"]["in_band_ms"] = {
+                    k.split("step_time_")[-1]: round(1e3 * v, 3)
+                    for k, v in self._in_band.items()}
+        env = self._per_rank_env()
+        if env is not None:
+            interval = (env - self._prev_env if self._prev_env is not None
+                        else env.copy())
+            self._prev_env = env
+            lo, hi = int(interval.min()), int(interval.max())
+            block["env_steps"] = {
+                "per_rank": [int(v) for v in env],
+                "interval": [int(v) for v in interval],
+                # max/min per-rank ingested env-steps this interval; a
+                # rank at zero reads against a floor of 1 (the
+                # fleet_desync rule's metric); None before any ingestion
+                "divergence": (round(hi / max(lo, 1), 3) if hi > 0
+                               else None),
+            }
+        if self.rank == 0:
+            self._merge_host_rows(block, now, local_stage_counts)
+        self._reset_interval()
+        return block
+
+    def _merge_host_rows(self, block: dict, now: float,
+                         local_stage_counts: Optional[dict]) -> None:
+        ages: List[Optional[float]] = [0.0]      # rank 0 is, well, here
+        absent: List[int] = []
+        counts = [local_stage_counts] if local_stage_counts else []
+        if self.save_dir is not None:
+            for r in range(1, self.nprocs):
+                row = read_last_jsonl_row(host_row_path(self.save_dir, r))
+                if row is None:
+                    # never wrote a row yet: bring-up grace, not staleness
+                    # (a rank that dies before its first row is caught by
+                    # jax.distributed's heartbeat, not this signal)
+                    ages.append(None)
+                    absent.append(r)
+                    continue
+                wall = row.get("wall")
+                ages.append(round(now - wall, 3) if wall else None)
+                if row.get("stage_counts"):
+                    counts.append(row["stage_counts"])
+        known = [a for a in ages if a is not None]
+        block["host_rows"] = {
+            "ages_s": ages,
+            "absent_ranks": absent,
+            # the missing_rank rule's metric: the stalest row age seen
+            "max_age_s": round(max(known), 3) if known else None,
+        }
+        if counts:
+            block["stages"] = summarize_stage_counts(
+                merge_stage_counts(counts))

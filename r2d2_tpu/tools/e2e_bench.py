@@ -41,6 +41,11 @@ Phases:
      (histograms, staleness, ΔQ cadence), and the PR-7 machine-side
      pillar (memory sampling, RSS/CPU gauges, compile/retrace capture,
      the per-record alert pass).
+  6. **Fleet A/B** (``--fleet-ab``): the lockstep multihost trainer (one
+     controller over an emulated dp mesh) with ``telemetry.fleet_enabled``
+     on vs off — the widened psum gauges, per-iteration lockstep timing,
+     and the rank-0 FleetAggregator under the same < 2% budget
+     (``E2E_r14.json``).
 
 Output: ONE JSON line (the driver artifact), also written to ``--out``.
 Hermetic on any backend — the fake env and (for the e2e phase) a
@@ -539,6 +544,130 @@ def run_replay_diag_ab(seconds: float, envs_per_actor: int, num_actors: int,
     return out
 
 
+def run_fleet_mh(seconds: float, envs_per_actor: int = 8,
+                 dp: int = 2, fleet_on: bool = True,
+                 overrides: Optional[dict] = None) -> dict:
+    """One lockstep-trainer cell for the fleet A/B: the rank-aware
+    ``train_multihost`` loop run as a SINGLE controller over an emulated
+    dp-wide mesh (this container's CPU backend has no multiprocess
+    collectives — known since PR 3 — so the in-artifact A/B measures the
+    fleet plane's per-iteration cost where it lives: the widened psum
+    row, the per-iteration timers, the gauge readback, and the rank-0
+    aggregator; the loopback two-process twin is the slow-marked test).
+    Thread actors feed the real lockstep ingest + dp-sharded learner
+    step; speeds come from the rank-0 TrainMetrics records exactly like
+    ``run_e2e``."""
+    from r2d2_tpu.parallel.multihost import train_multihost
+
+    ov = dict(E2E_CPU_OVERRIDES)
+    ov.update({"actor.num_actors": 1,
+               "actor.envs_per_actor": envs_per_actor,
+               "mesh.dp": dp,
+               "telemetry.fleet_enabled": bool(fleet_on)})
+    ov.update(overrides or {})
+    scratch = None
+    if "runtime.save_dir" not in ov:
+        import tempfile
+        scratch = tempfile.mkdtemp(prefix="r2d2_fleet_")
+        ov["runtime.save_dir"] = scratch
+    cfg = _bench_config(ov)
+    records = []
+    t0 = time.time()
+    try:
+        out = train_multihost(cfg, max_training_steps=10**9,
+                              max_seconds=seconds, actor_mode="thread",
+                              log_fn=records.append)
+    finally:
+        if scratch is not None:
+            import shutil
+            shutil.rmtree(scratch, ignore_errors=True)
+    elapsed = time.time() - t0
+    steady = [r for r in records[1:] if r.get("training_speed")]
+    env_speed = (float(np.mean([r["buffer_speed"] for r in steady]))
+                 if steady else 0.0)
+    train_speed = (float(np.mean([r["training_speed"] for r in steady]))
+                   if steady else 0.0)
+    fleet = next((r["fleet"] for r in reversed(records)
+                  if r.get("fleet")), None)
+    return {
+        "seconds": round(elapsed, 1),
+        "dp": dp,
+        "fleet_enabled": bool(fleet_on),
+        "total_env_steps": int(out["env_steps"]),
+        "total_train_steps": int(out["step"]),
+        "env_steps_per_sec": round(env_speed, 1),
+        "learner_steps_per_sec": round(train_speed, 2),
+        "env_steps_per_sec_overall": round(out["env_steps"] / elapsed, 1),
+        "learner_steps_per_sec_overall": round(out["step"] / elapsed, 2),
+        "records": len(records),
+        "fleet": fleet,
+        "config": {k: ov[k] for k in sorted(ov)},
+    }
+
+
+def run_fleet_ab(seconds: float, envs_per_actor: int = 8, dp: int = 2,
+                 overrides: Optional[dict] = None,
+                 repeats: int = 2) -> dict:
+    """Fleet-observability overhead A/B (ISSUE 12 acceptance): the SAME
+    lockstep trainer with ``telemetry.fleet_enabled`` on vs off, in one
+    artifact. Budget under test: the fleet plane — the widened psum row
+    (one f32 per dp row + the gauge reductions/all-gathers riding the
+    existing dispatch), per-iteration perf_counter pairs, the gauge-table
+    readback, the rank-0 FleetAggregator flush, and the rank-0 host row
+    — costs < 2% on BOTH env-steps/s and learner updates/s (the
+    established pillar budget). Cells run INTERLEAVED off/on ``repeats``
+    times with per-arm medians (the learning/resources-AB noise
+    treatment). The ON cells carry the ``fleet`` block (per-rank
+    step-time table, wait fraction, straggler rank) as end-to-end
+    evidence; the OFF cells prove the records carried no ``fleet`` key
+    (the kill-switch schema contract)."""
+    cells = {"fleet_off": [], "fleet_on": []}
+    for rep in range(max(repeats, 1)):
+        order = (("fleet_off", False), ("fleet_on", True))
+        if rep % 2:
+            # ABBA order: repeated in-process runs on a small shared
+            # host drift slower over time (cache/alloc pressure), and a
+            # fixed A,B order would hand the whole drift to one arm —
+            # alternating cancels the linear component in the medians
+            order = order[::-1]
+        for label, on in order:
+            cells[label].append(run_fleet_mh(
+                seconds, envs_per_actor, dp=dp, fleet_on=on,
+                overrides=overrides))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"fleet_off": cells["fleet_off"][-1],
+           "fleet_on": cells["fleet_on"][-1],
+           "repeats": max(repeats, 1),
+           "dp": dp,
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("fleet_off", "env_steps_per_sec") > 0:
+        ratio = (med("fleet_on", "env_steps_per_sec")
+                 / med("fleet_off", "env_steps_per_sec"))
+        out["env_steps_ratio"] = round(ratio, 3)
+        out["overhead_pct"] = round((1.0 - ratio) * 100.0, 2)
+    if med("fleet_off", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio"] = round(
+            med("fleet_on", "learner_steps_per_sec")
+            / med("fleet_off", "learner_steps_per_sec"), 3)
+    fb = next((c["fleet"] for c in reversed(cells["fleet_on"])
+               if c.get("fleet")), None)
+    out["fleet_block_on"] = bool(fb)
+    if fb:
+        out["wait_frac_on"] = (fb.get("lockstep") or {}).get("wait_frac")
+        out["step_time_on"] = fb.get("step_time")
+    out["fleet_block_off"] = any(c.get("fleet")
+                                 for c in cells["fleet_off"])
+    return out
+
+
 # Anakin A/B shape: the acting-path STRUCTURAL overhead measurement. The
 # policy/env compute is shrunk until it is nearly free on this host (8px
 # frames, hidden 16, one conv), because the quantity under test is the
@@ -802,6 +931,14 @@ def main(argv=None) -> int:
                         "block, plus one sharded (emulated dp=2) anakin "
                         "evidence cell with per-shard + merged sum-tree "
                         "views)")
+    p.add_argument("--fleet-ab", type=int, default=0,
+                   help="1: run the e2e phase as the fleet-observability "
+                        "on/off A/B instead (telemetry.fleet_enabled; the "
+                        "lockstep multihost trainer as one controller "
+                        "over an emulated --sharded-dp mesh; budget < 2%% "
+                        "on env-steps/s AND learner updates/s; "
+                        "interleaved repeats with per-arm medians, the "
+                        "ON cells carry the 'fleet' block as evidence)")
     p.add_argument("--resources-ab", type=int, default=0,
                    help="1: run the e2e phase as a resource/compile/alerts "
                         "on/off A/B instead (telemetry.resources_enabled; "
@@ -817,13 +954,14 @@ def main(argv=None) -> int:
                    help="dotted config override key=value (repeatable)")
     args = p.parse_args(argv)
 
-    if args.sharded_anakin_ab or args.replay_diag_ab:
+    if args.sharded_anakin_ab or args.replay_diag_ab or args.fleet_ab:
         # the emulated-mesh recipe (README "On-device acting"): the CPU
         # platform must present >= dp devices BEFORE the backend
         # initializes — harmless on real accelerators (the flag only
         # shapes the host platform). argparse runs first so this can
         # land before the jax import below. The replay-diag A/B needs it
-        # for its sharded-anakin evidence cell.
+        # for its sharded-anakin evidence cell; the fleet A/B for its
+        # emulated dp-wide lockstep mesh.
         from r2d2_tpu.utils.platform import force_host_device_count
         force_host_device_count(max(args.sharded_dp, 2))
     from r2d2_tpu.utils import pin_platform
@@ -856,6 +994,11 @@ def main(argv=None) -> int:
             out["e2e_anakin_ab"] = run_anakin_ab(
                 args.e2e_seconds, args.envs_per_actor,
                 anakin_lanes=args.anakin_lanes, overrides=overrides,
+                repeats=args.ab_repeats)
+        elif args.fleet_ab:
+            out["e2e_fleet_ab"] = run_fleet_ab(
+                args.e2e_seconds, args.envs_per_actor,
+                dp=args.sharded_dp, overrides=overrides,
                 repeats=args.ab_repeats)
         elif args.replay_diag_ab:
             out["e2e_replay_diag_ab"] = run_replay_diag_ab(

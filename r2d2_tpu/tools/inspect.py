@@ -6,7 +6,10 @@ Reads what a training run leaves in ``runtime.save_dir``:
   * ``metrics_player{p}.jsonl``  — the per-interval aggregated records
     (throughput counters, health counters, and the telemetry 'stages'
     block with fleet-wide P50/P95/P99 per pipeline stage);
-  * ``telemetry_host{r}.jsonl``  — per-host stage rows under multihost;
+  * ``telemetry_host{r}.jsonl``  — per-host stage rows under multihost
+    (fleet mode widens them: lockstep timing, mergeable stage counts,
+    clock anchors, per-rank alert state — rendered as the per-rank
+    panel, and the anchors align the cross-host trace merge);
   * ``spans_*.jsonl``            — drained span events per process;
   * ``alerts_player{p}.jsonl``   — the sentinel's fired alerts (the
     record's ``alerts`` panel is the live view, this file the history).
@@ -36,6 +39,7 @@ import sys
 import time
 from typing import List, Optional
 
+from r2d2_tpu.telemetry.fleet import read_last_jsonl_row
 from r2d2_tpu.tools.logparse import parse_jsonl
 
 # stages in display order; anything else in the record appends after
@@ -46,6 +50,7 @@ _STAGE_ORDER = [
     "ingest/ring_get", "ingest/stage", "ingest/commit",
     "learner/sample", "learner/train_dispatch", "learner/device_sync",
     "learner/priority_writeback", "weights/publish",
+    "lockstep/dispatch", "lockstep/step",
 ]
 
 
@@ -95,6 +100,10 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None,
     an = record.get("anakin")
     if an:
         lines.append(render_anakin(an))
+    fb = record.get("fleet")
+    if fb:
+        lines.append("")
+        lines.append(render_fleet(fb))
     lb = record.get("learning")
     if lb:
         lines.append("")
@@ -131,11 +140,86 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None,
     else:
         lines.append("(no 'stages' block — telemetry.enabled=false, or a "
                      "pre-telemetry run)")
-    for row in host_rows or []:
-        n = len(row.get("stages") or {})
-        lines.append(f"host rank {row.get('rank')}: {n} stages at "
-                     f"t={row.get('t', 0):.1f}s "
-                     f"(telemetry_host{row.get('rank')}.jsonl)")
+    if host_rows:
+        lines.append("")
+        lines.append(render_host_rows(host_rows))
+    return "\n".join(lines)
+
+
+def render_fleet(fb: dict) -> str:
+    """The fleet panel (ISSUE 12): per-rank step-time table with the
+    straggler called out, lockstep-wait fraction, env-step divergence,
+    and host-row health — the record's ``fleet`` block."""
+    lines = [f"fleet: {fb.get('ranks')} rank(s), "
+             f"{fb.get('iters')} lockstep iters"]
+    ls = fb.get("lockstep") or {}
+    if ls.get("wait_frac") is not None:
+        lines[0] += (f"  wait={100 * ls['wait_frac']:.0f}% of step "
+                     f"(dispatch p~{_fmt(ls.get('wait_ms_mean'), 1).strip()}"
+                     f"ms, step {_fmt(ls.get('step_ms_mean'), 1).strip()}ms)")
+    st = fb.get("step_time") or {}
+    per = st.get("per_rank_ms") or []
+    if per:
+        straggler = st.get("straggler_rank")
+        cells = [f"r{i}={v:.1f}{'*' if i == straggler else ''}"
+                 for i, v in enumerate(per)]
+        line = "  step-time ms: " + " ".join(cells)
+        if st.get("skew") is not None:
+            line += f"   skew={st['skew']:.2f}"
+        if straggler is not None:
+            line += f"  straggler=rank {straggler}"
+        lines.append(line)
+    env = fb.get("env_steps") or {}
+    if env.get("interval"):
+        line = ("  env-steps this interval: "
+                + " ".join(f"r{i}={v}"
+                           for i, v in enumerate(env["interval"])))
+        if env.get("divergence") is not None:
+            line += f"   divergence={env['divergence']:.2f}"
+        lines.append(line)
+    hr = fb.get("host_rows") or {}
+    if hr:
+        bits = []
+        if hr.get("max_age_s") is not None:
+            bits.append(f"stalest row {hr['max_age_s']:.1f}s")
+        if hr.get("absent_ranks"):
+            bits.append(f"ABSENT ranks {hr['absent_ranks']}")
+        if bits:
+            lines.append("  host rows: " + " ".join(bits))
+    return "\n".join(lines)
+
+
+def render_host_rows(host_rows: List[dict]) -> str:
+    """The per-rank panel (ISSUE 12): one line per host row — stage P99
+    peaks, HBM headroom, step-time/wait view, and alert state — instead
+    of the old one-line 'N stages' summary."""
+    lines = ["per-rank (telemetry_host*.jsonl):"]
+    for row in host_rows:
+        stages = row.get("stages") or {}
+        bits = [f"  rank {row.get('rank')}: t={row.get('t', 0):.1f}s"]
+        # the three slowest stages by P99 — where this rank's time goes
+        top = sorted(((s.get("p99_ms") or 0.0, name)
+                      for name, s in stages.items()), reverse=True)[:3]
+        if top:
+            bits.append("p99 " + " ".join(
+                f"{name.split('/')[-1]}={p99:.1f}ms"
+                for p99, name in top))
+        rb = row.get("resources") or {}
+        if rb.get("hbm_headroom_frac_min") is not None:
+            bits.append(f"hbm-free={100 * rb['hbm_headroom_frac_min']:.0f}%")
+        fb = (row.get("fleet") or {})
+        ls = fb.get("lockstep") or {}
+        if ls.get("wait_frac") is not None:
+            bits.append(f"wait={100 * ls['wait_frac']:.0f}%")
+        st = fb.get("step_time") or {}
+        if st.get("skew") is not None:
+            bits.append(f"skew={st['skew']:.2f}")
+        ab = row.get("alerts")
+        if ab is not None:
+            active = ab.get("active") or []
+            bits.append("alerts: " + (" ".join(active) if active
+                                      else "none"))
+        lines.append(" ".join(bits))
     return "\n".join(lines)
 
 
@@ -435,27 +519,87 @@ def costs_record(records: List[dict]) -> Optional[dict]:
 
 
 def newest_host_rows(run_dir: str) -> List[dict]:
+    # O(tail) + rotation-aware: a near-cap host row file must not cost
+    # a full parse per dashboard frame, and the instant between a
+    # rotation's rename and its next write must not drop the rank
     rows = []
     for path in sorted(glob.glob(os.path.join(run_dir,
                                               "telemetry_host*.jsonl"))):
-        recs = parse_jsonl(path, limit=1)
-        if recs:
-            rows.append(recs[-1])
+        row = read_last_jsonl_row(path)
+        if row is not None:
+            rows.append(row)
     return rows
+
+
+def fleet_clock_offsets(run_dir: str):
+    """Cross-host clock alignment from the fleet host rows (ISSUE 12):
+    each rank's row carries a wall/monotonic anchor pair stamped when
+    lockstep iteration 1's collective completed — a genuinely
+    pod-synchronized instant — so ``offset[r] = anchor_r.wall -
+    anchor_0.wall`` estimates rank r's wall-clock skew against rank 0.
+    Returns ``({rank: offset_seconds}, actors_per_rank)``; empty when no
+    anchored rows exist (pre-PR12 runs, fleet off, single-host)."""
+    import re
+    offsets = {}
+    anchors = {}
+    actors_per_rank = None
+    for path in glob.glob(os.path.join(run_dir, "telemetry_host*.jsonl")):
+        m = re.search(r"telemetry_host(\d+)\.jsonl$", path)
+        if not m:
+            continue
+        row = read_last_jsonl_row(path)
+        if row is None:
+            continue
+        a = row.get("clock_anchor")
+        if a and a.get("wall") is not None:
+            anchors[int(m.group(1))] = a
+        if row.get("actors_per_rank"):
+            actors_per_rank = int(row["actors_per_rank"])
+    base = anchors.get(0)
+    if base is not None:
+        for r, a in anchors.items():
+            offsets[r] = a["wall"] - base["wall"]
+    return offsets, actors_per_rank
+
+
+def _span_file_rank(path: str, actors_per_rank) -> Optional[int]:
+    """Which rank produced a spans file: host files carry it in the
+    name; actor files carry the GLOBAL worker index, which maps back via
+    the fleet rows' actors_per_rank (None = unknown, left unshifted)."""
+    import re
+    name = os.path.basename(path)
+    m = re.match(r"spans_host(\d+)\.jsonl$", name)
+    if m:
+        return int(m.group(1))
+    m = re.match(r"spans_p\d+_a(\d+)\.jsonl$", name)
+    if m and actors_per_rank:
+        return int(m.group(1)) // actors_per_rank
+    return None
 
 
 def export_chrome_trace(run_dir: str, out_path: str) -> int:
     """Merge every spans_*.jsonl under ``run_dir`` into one Chrome-trace
-    JSON; returns the number of span events exported."""
+    JSON; returns the number of span events exported. When the run's
+    fleet host rows carry clock anchors (ISSUE 12), every rank's spans
+    are shifted onto rank 0's wall clock before the merge — one aligned
+    Perfetto timeline with per-rank tracks instead of one skewed track
+    per process."""
     from r2d2_tpu.telemetry import chrome_trace_events
+    offsets, actors_per_rank = fleet_clock_offsets(run_dir)
     events = []
     n = 0
     for pid_index, path in enumerate(
             sorted(glob.glob(os.path.join(run_dir, "spans_*.jsonl")))):
         spans = parse_jsonl(path)
         n += len(spans)
+        rank = _span_file_rank(path, actors_per_rank)
+        shift = offsets.get(rank, 0.0) if rank is not None else 0.0
+        if shift:
+            spans = [{**ev, "ts": ev["ts"] - shift} for ev in spans]
         pid = (spans[0].get("pid") if spans else None) or \
             os.path.basename(path)[len("spans_"):-len(".jsonl")]
+        if rank is not None:
+            pid = f"rank{rank}/{pid}"
         events.extend(chrome_trace_events(spans, pid, pid_index))
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events,

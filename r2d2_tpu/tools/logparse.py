@@ -118,6 +118,41 @@ def replay_diag_series(records: List[dict]) -> dict:
     return out
 
 
+def fleet_series(records: List[dict]) -> dict:
+    """Time series of the ``fleet`` block (ISSUE 12) across a metrics (or
+    host-row) JSONL stream, aligned on the records that CARRY one
+    (single-host records and kill-switched runs are skipped, not holes)
+    — the learning_series contract. Keys: t, training_steps, wait_frac,
+    skew, straggler_rank, divergence, step_time_mean_ms,
+    step_time_max_ms, per_rank_ms (one list per record), max_age_s —
+    everything cli/plot.py --fleet draws. Values are None where a
+    record's block lacked that entry (e.g. host-row ages on a rank > 0
+    row)."""
+    out = {k: [] for k in (
+        "t", "training_steps", "wait_frac", "skew", "straggler_rank",
+        "divergence", "step_time_mean_ms", "step_time_max_ms",
+        "per_rank_ms", "max_age_s")}
+    for r in records:
+        fb = r.get("fleet")
+        if not fb:
+            continue
+        ls = fb.get("lockstep") or {}
+        st = fb.get("step_time") or {}
+        env = fb.get("env_steps") or {}
+        hr = fb.get("host_rows") or {}
+        out["t"].append(r.get("t"))
+        out["training_steps"].append(r.get("training_steps"))
+        out["wait_frac"].append(ls.get("wait_frac"))
+        out["skew"].append(st.get("skew"))
+        out["straggler_rank"].append(st.get("straggler_rank"))
+        out["divergence"].append(env.get("divergence"))
+        out["step_time_mean_ms"].append(st.get("mean_ms"))
+        out["step_time_max_ms"].append(st.get("max_ms"))
+        out["per_rank_ms"].append(st.get("per_rank_ms"))
+        out["max_age_s"].append(hr.get("max_age_s"))
+    return out
+
+
 def alerts_series(path: str, limit: Optional[int] = None) -> dict:
     """Time series of an ``alerts_player{p}.jsonl`` stream (ISSUE 7) —
     one entry per FIRED alert, oldest first, with ``parse_jsonl``'s

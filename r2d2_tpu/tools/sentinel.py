@@ -20,8 +20,20 @@ overridable per flag-less dotted ``--override key=value`` pairs (e.g.
 ``telemetry.alerts_retrace_storm=5``). ``--rules`` prints the effective
 rule table and exits.
 
+Fleet streams (ISSUE 12): ``--host-rank R`` points the SAME engine
+(replay or --follow) at a rank's ``telemetry_host{R}.jsonl`` — host rows
+share the record line format, so the resource/compile/fleet rules
+evaluate unchanged (throughput rules stay inactive; those metrics only
+exist on rank 0's record). ``--alerts-stream PATH`` instead
+replays/tails an existing alerts JSONL (``alerts_player{p}.jsonl`` or a
+rank's ``alerts_host{r}.jsonl``) — no re-evaluation, just the firing
+log with the same crit exit code, for triaging a rank whose metrics
+stream rotated away.
+
     python -m r2d2_tpu.tools.sentinel --dir models                # replay
     python -m r2d2_tpu.tools.sentinel --dir models --follow       # live
+    python -m r2d2_tpu.tools.sentinel --dir models --host-rank 1
+    python -m r2d2_tpu.tools.sentinel --alerts-stream models/alerts_host1.jsonl
     python -m r2d2_tpu.tools.sentinel --rules
 """
 
@@ -71,6 +83,70 @@ def replay_stream(records, engine, emit=print) -> dict:
             "by_rule": by_rule}
 
 
+def resume_after_shrink(path: str, seen: int):
+    """A followed stream SHRANK: distinguish size-cap rotation (the
+    fleet plane's RotatingJsonlWriter moved the live file to ``.1`` —
+    the SAME run continuing, so rule state must survive and the rotated
+    generation's unread tail must still be evaluated) from a fresh-run
+    truncation (new run: reset the engine). Returns ``(is_rotation,
+    backlog_rows)`` — on rotation the backlog is the old generation's
+    rows past ``seen``; on truncation it is empty and the caller
+    rebuilds the engine."""
+    from r2d2_tpu.tools.logparse import parse_jsonl
+    try:
+        rotated = parse_jsonl(path + ".1")
+    except FileNotFoundError:
+        rotated = []
+    if len(rotated) >= seen > 0:
+        return True, rotated[seen:]
+    return False, []
+
+
+def replay_alerts_stream(path: str, follow: bool = False,
+                         interval: float = 2.0, emit=print) -> int:
+    """Replay (or tail) an existing alerts JSONL — the machine-readable
+    side a run's engine already wrote (alerts_player{p}.jsonl, or a
+    rank's alerts_host{r}.jsonl under the fleet plane). No rules are
+    re-evaluated; exit 1 when the stream carries any crit firing."""
+    from r2d2_tpu.tools.logparse import parse_jsonl
+
+    def show(rows):
+        crit = 0
+        for row in rows:
+            if row.get("severity") == "crit":
+                crit += 1
+            emit(f"t={row.get('t') or 0:8.1f}s step="
+                 f"{row.get('training_steps') or 0:>8} "
+                 f"{row.get('severity', '?'):>4} {row.get('rule')}"
+                 + (f" value={row['value']:.4g}"
+                    if row.get("value") is not None else ""))
+        return crit
+
+    if not follow:
+        try:
+            rows = parse_jsonl(path)
+        except FileNotFoundError:
+            print(f"no alerts stream at {path}", file=sys.stderr)
+            return 2
+        crit = show(rows)
+        print(f"-- {len(rows)} firing(s), {crit} crit")
+        return 1 if crit else 0
+
+    seen = 0
+    while True:
+        try:
+            rows = parse_jsonl(path)
+        except FileNotFoundError:
+            rows = []
+            print(f"waiting for {path} ...")
+        if len(rows) < seen:      # truncation: fresh run, restart the tail
+            seen = 0
+        if len(rows) > seen:
+            show(rows[seen:])
+            seen = len(rows)
+        time.sleep(interval)
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -89,6 +165,15 @@ def main(argv=None) -> int:
                         "(existing history is kept, never truncated)")
     p.add_argument("--rules", action="store_true",
                    help="print the effective rule table and exit")
+    p.add_argument("--host-rank", type=int, default=None,
+                   help="evaluate a rank's telemetry_host{R}.jsonl host-row "
+                        "stream instead of the player metrics stream "
+                        "(replay and --follow both work)")
+    p.add_argument("--alerts-stream", default="",
+                   help="replay/tail an existing alerts JSONL "
+                        "(alerts_player{p}.jsonl or alerts_host{r}.jsonl) "
+                        "instead of evaluating a metrics stream; exit 1 "
+                        "when it contains a crit firing")
     p.add_argument("--override", action="append", default=[],
                    help="dotted config override key=value (repeatable), "
                         "e.g. telemetry.alerts_retrace_storm=5")
@@ -111,7 +196,15 @@ def main(argv=None) -> int:
                   + (" (below)" if r.below else ""))
         return 0
 
-    path = os.path.join(args.dir, f"metrics_player{args.player}.jsonl")
+    if args.alerts_stream:
+        return replay_alerts_stream(args.alerts_stream, args.follow,
+                                    args.interval)
+
+    if args.host_rank is not None:
+        path = os.path.join(args.dir,
+                            f"telemetry_host{args.host_rank}.jsonl")
+    else:
+        path = os.path.join(args.dir, f"metrics_player{args.player}.jsonl")
     if not args.follow:
         try:
             records = parse_jsonl(path)
@@ -134,13 +227,23 @@ def main(argv=None) -> int:
             records = []
             print(f"waiting for {path} ...")
         if len(records) < seen:
-            # the stream SHRANK: a fresh (non-resume) run truncated the
-            # metrics file — evaluate the new run from its first record
-            # with a fresh engine, so the old run's counter baselines and
-            # median windows don't poison the new one
-            print(f"stream restarted ({seen} -> {len(records)} records), "
-                  "resetting rule state")
-            engine = build_engine(overrides, jsonl_path=args.out or None)
+            # the stream SHRANK: either the fleet plane's size-cap
+            # rotation (same run — evaluate the rotated generation's
+            # unread tail, keep rule state) or a fresh (non-resume) run
+            # truncating the file (reset the engine, so the old run's
+            # counter baselines and median windows don't poison the new
+            # one)
+            rotation, backlog = resume_after_shrink(path, seen)
+            if rotation:
+                print(f"stream rotated ({seen} -> {len(records)} "
+                      f"records), evaluating {len(backlog)} rotated "
+                      "row(s)")
+                replay_stream(backlog, engine)
+            else:
+                print(f"stream restarted ({seen} -> {len(records)} "
+                      "records), resetting rule state")
+                engine = build_engine(overrides,
+                                      jsonl_path=args.out or None)
             seen = 0
         if len(records) > seen:
             replay_stream(records[seen:], engine)

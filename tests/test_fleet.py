@@ -1,0 +1,654 @@
+"""Fleet observability tests (ISSUE 12): lockstep psum-row gauges on the
+emulated mesh, FleetAggregator merge/skew math vs per-rank references,
+the four fleet alert rules (incl. once-per-breach edge semantics),
+host-row rotation, the clock-aligned cross-host trace merge on the
+checked-in two-rank fixture, sentinel host streams, and record-schema /
+psum-shape stability under the ``telemetry.fleet_enabled`` kill switch.
+
+Single-process emulated meshes throughout (this container's CPU backend
+lacks multiprocess collectives — known since PR 3); the loopback
+two-process straggler A/B is the slow-marked test at the bottom.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config, MeshConfig
+from r2d2_tpu.parallel.mesh import make_mesh
+from r2d2_tpu.telemetry import AlertEngine, default_rules
+from r2d2_tpu.telemetry.fleet import (FLEET_INFO_KEYS, FleetAggregator,
+                                      RotatingJsonlWriter,
+                                      host_row_path, merge_stage_counts,
+                                      mesh_row_ranks, rank_first_rows,
+                                      read_last_jsonl_row,
+                                      stage_counts_dict,
+                                      summarize_stage_counts)
+from r2d2_tpu.tools.logparse import fleet_series, parse_jsonl
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "fleet_two_rank")
+
+BASE_CFG = {
+    "env.game_name": "Fake",
+    "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+    "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+    "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+    "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+    "sequence.forward_steps": 3,
+    "replay.capacity": 800, "replay.block_length": 20,
+    "replay.batch_size": 4, "replay.learning_starts": 60,
+    "actor.num_actors": 1,
+    "runtime.save_interval": 0, "runtime.log_interval": 1.0,
+    "runtime.weight_publish_interval": 2,
+    "runtime.steps_per_dispatch": 1,
+}
+
+
+# ---------------------------------------------------------------------------
+# Widened lockstep programs (emulated mesh, single controller)
+
+
+def _spec():
+    from r2d2_tpu.replay.structs import ReplaySpec
+    return ReplaySpec.from_config(Config().replace(**BASE_CFG))
+
+
+def _times(mesh, values):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.device_put(np.asarray(values, np.float32),
+                          NamedSharding(mesh, P("dp")))
+
+
+def test_lockstep_ingest_fleet_gauges():
+    """The widened ingest returns the all-gathered step-time/env tables,
+    the sum/max/min reductions, and the one-hot argmax straggler row —
+    replicated, off the same dispatch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from r2d2_tpu.parallel.multihost import HostFeed, make_lockstep_ingest
+    from r2d2_tpu.parallel.sharded import sharded_replay_init
+
+    spec = _spec()
+    mesh = make_mesh(MeshConfig(dp=4))
+    rs = sharded_replay_init(spec, mesh)
+    cum = jax.device_put(np.zeros((4,), np.int32),
+                         NamedSharding(mesh, P("dp")))
+    feed = HostFeed(spec, mesh)
+    ing = make_lockstep_ingest(spec, mesh, fleet=True)
+    rs, cum, info = ing(rs, cum, *feed.build(None, 0),
+                        _times(mesh, [0.1, 0.4, 0.2, 0.3]))
+    got = jax.device_get(info)
+    np.testing.assert_allclose(got["step_times"], [0.1, 0.4, 0.2, 0.3],
+                               rtol=1e-6)
+    assert abs(float(got["step_time_sum"]) - 1.0) < 1e-6
+    assert abs(float(got["step_time_max"]) - 0.4) < 1e-6
+    assert abs(float(got["step_time_min"]) - 0.1) < 1e-6
+    assert int(got["straggler_shard"]) == 1
+    np.testing.assert_array_equal(got["env_steps_shards"], [0, 0, 0, 0])
+    # every widened key is declared (the loop strips them by this list)
+    assert set(FLEET_INFO_KEYS) <= set(got)
+
+
+def test_lockstep_ingest_kill_switch_shape_identity():
+    """fleet=False compiles the exact PR-10 program: 5 operands, the
+    4-key info dict, no gauge outputs."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from r2d2_tpu.parallel.multihost import HostFeed, make_lockstep_ingest
+    from r2d2_tpu.parallel.sharded import sharded_replay_init
+
+    spec = _spec()
+    mesh = make_mesh(MeshConfig(dp=2))
+    rs = sharded_replay_init(spec, mesh)
+    cum = jax.device_put(np.zeros((2,), np.int32),
+                         NamedSharding(mesh, P("dp")))
+    feed = HostFeed(spec, mesh)
+    ing = make_lockstep_ingest(spec, mesh, fleet=False)
+    _, _, info = ing(rs, cum, *feed.build(None, 0))
+    assert sorted(jax.device_get(info).keys()) == [
+        "buffer_steps", "env_steps", "filled_shards", "stop"]
+
+
+def test_lockstep_consensus_fleet_rows():
+    """The widened consensus gathers the raw (dp, 5) row table alongside
+    the psum — per-rank step times and env steps readable on every rank;
+    fleet=False keeps the PR-10 4-column psum."""
+    from r2d2_tpu.parallel.multihost import make_lockstep_consensus
+
+    mesh = make_mesh(MeshConfig(dp=2))
+    con = make_lockstep_consensus(mesh, fleet=True)
+    info = con(10, 20, True, 0, step_time_s=0.25)
+    assert info["buffer_steps"] == 10 and info["env_steps"] == 20
+    assert info["ready_procs"] == 1 and info["stop"] == 0
+    # single process owns both rows; only the first carries data
+    np.testing.assert_allclose(info["step_times"], [0.25, 0.0], atol=1e-6)
+    assert abs(info["step_time_max"] - 0.25) < 1e-6
+    assert info["straggler_shard"] == 0
+    np.testing.assert_array_equal(info["env_steps_shards"], [20, 0])
+
+    con0 = make_lockstep_consensus(mesh, fleet=False)
+    assert sorted(con0(10, 20, True, 0).keys()) == [
+        "buffer_steps", "env_steps", "ready_procs", "stop"]
+
+
+def test_gspmd_lockstep_ingest_fleet_gauges():
+    """The mp>1 (GSPMD) formulation returns the same widened contract."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from r2d2_tpu.parallel.multihost import (HostFeed,
+                                             make_lockstep_ingest)
+    from r2d2_tpu.parallel.sharded import sharded_replay_init
+
+    spec = _spec()
+    mesh = make_mesh(MeshConfig(dp=2, mp=2))
+    rs = sharded_replay_init(spec, mesh)
+    cum = jax.device_put(np.zeros((2,), np.int32),
+                         NamedSharding(mesh, P("dp")))
+    feed = HostFeed(spec, mesh)
+    ing = make_lockstep_ingest(spec, mesh, fleet=True)
+    _, _, info = ing(rs, cum, *feed.build(None, 0),
+                     _times(mesh, [0.3, 0.1]))
+    got = jax.device_get(info)
+    np.testing.assert_allclose(got["step_times"], [0.3, 0.1], rtol=1e-6)
+    assert int(got["straggler_shard"]) == 0
+    assert abs(float(got["step_time_max"]) - 0.3) < 1e-6
+
+
+def test_mesh_row_ranks_and_first_rows():
+    mesh = make_mesh(MeshConfig(dp=4))
+    ranks = mesh_row_ranks(mesh)
+    assert ranks == [0, 0, 0, 0]          # single controller owns all rows
+    assert rank_first_rows(ranks, 1) == [0]
+    assert rank_first_rows([0, 0, 1, 1], 2) == [0, 2]
+    with pytest.raises(ValueError, match="own no dp rows"):
+        rank_first_rows([0, 0], 2)
+
+
+# ---------------------------------------------------------------------------
+# Stage-histogram merge parity
+
+
+def test_stage_counts_merge_parity():
+    """Rank 0's merge must equal the elementwise sum of the per-rank
+    references — the PR-4 mergeability contract, through the JSON row
+    round-trip."""
+    from r2d2_tpu.telemetry import STAGES
+    from r2d2_tpu.telemetry.core import summarize_matrix
+    from r2d2_tpu.telemetry.histogram import NBUCKETS
+
+    rng = np.random.default_rng(7)
+    mats = [rng.integers(0, 20, size=(len(STAGES), NBUCKETS)).astype(
+        np.int64) for _ in range(3)]
+    # rows travel as JSON (host rows on the shared filesystem)
+    dicts = [json.loads(json.dumps(stage_counts_dict(m))) for m in mats]
+    merged = merge_stage_counts(dicts)
+    ref = summarize_matrix(sum(mats))
+    assert summarize_stage_counts(merged) == ref
+    # sparse rows merge too: a rank missing a stage contributes nothing
+    partial = merge_stage_counts([dicts[0], {}])
+    assert summarize_stage_counts(partial) == summarize_matrix(mats[0])
+
+
+# ---------------------------------------------------------------------------
+# FleetAggregator skew/argmax math + the straggler acceptance fixture
+
+
+def _feed_two_rank(agg, factor, iters=10, base=0.01, env_fast=100,
+                   env_slow=100):
+    """Synthetic two-rank lockstep: rank 1's step time is ``factor`` x
+    rank 0's (the chaos slowxF shape); env counters advance per rank."""
+    for i in range(1, iters + 1):
+        times = np.array([base, base * factor], np.float64)
+        agg.on_collective({
+            "step_times": times,
+            "step_time_sum": times.sum(),
+            "step_time_max": times.max(),
+            "step_time_min": times.min(),
+            "env_steps_shards": np.array([env_fast * i, env_slow * i]),
+            "straggler_shard": int(np.argmax(times)),
+        }, wait_s=base * (factor - 1.0))
+        agg.on_step(step_s=base * factor)   # lockstep: all run at F x base
+
+
+def test_fleet_aggregator_names_injected_straggler():
+    """The acceptance shape, fixture-replayed: chaos ``slowx4`` on rank 1
+    -> the fleet block names rank 1 as the straggler with skew ~ F, and
+    the lockstep wait fraction shows the fast rank blocked."""
+    from r2d2_tpu.tools.chaos import parse_fault_spec
+
+    factor = parse_fault_spec("1:slowx4")[1].factor
+    agg = FleetAggregator(rank=0, nprocs=2, row_ranks=[0, 1],
+                          save_dir=None)
+    _feed_two_rank(agg, factor)
+    block = agg.flush(now=1000.0)
+    st = block["step_time"]
+    assert st["straggler_rank"] == 1
+    assert st["straggler_shard"] == 1              # the in-graph one-hot
+    np.testing.assert_allclose(st["per_rank_ms"], [10.0, 40.0], rtol=1e-3)
+    assert abs(st["skew"] - factor) < 0.05
+    # the LAST collective's in-band psum/pmax/pmin gauges surface too
+    ib = st["in_band_ms"]
+    assert abs(ib["max"] - 40.0) < 1e-6 and abs(ib["min"] - 10.0) < 1e-6
+    assert abs(ib["sum"] - 50.0) < 1e-6
+    ls = block["lockstep"]
+    # this rank stepped at F x base but spent (F-1) x base in the psum
+    assert abs(ls["wait_frac"] - (factor - 1.0) / factor) < 0.01
+    assert block["env_steps"]["divergence"] == 1.0
+    # flush resets the interval; a fresh healthy interval reads balanced
+    _feed_two_rank(agg, 1.0)
+    block2 = agg.flush(now=1001.0)
+    assert abs(block2["step_time"]["skew"] - 1.0) < 1e-6
+    assert block2["step_time"]["per_rank_ms"][1] < 11.0
+
+
+def test_fleet_aggregator_env_divergence_and_multirow_collapse():
+    """Per-rank env accounting: a rank owning several dp rows sums them;
+    interval deltas (not cumulative totals) drive the divergence ratio."""
+    agg = FleetAggregator(rank=0, nprocs=2, row_ranks=[0, 0, 1, 1],
+                          save_dir=None)
+    for i, env in enumerate(([100, 100, 50, 50], [200, 200, 60, 60])):
+        agg.on_collective({
+            "step_times": np.full((4,), 0.01),
+            "env_steps_shards": np.asarray(env),
+        }, wait_s=0.001)
+        agg.on_step(step_s=0.01)
+        block = agg.flush(now=float(i))
+    assert block["env_steps"]["per_rank"] == [400, 120]
+    # interval deltas: rank0 +200, rank1 +20 -> 10x divergence
+    assert block["env_steps"]["interval"] == [200, 20]
+    assert abs(block["env_steps"]["divergence"] - 10.0) < 1e-6
+
+
+def test_fleet_aggregator_host_row_fixture_replay():
+    """Rank-0 flush over the checked-in two-rank fixture: rank 1's row
+    ages off its wall stamp, its stage counts merge into the fleet
+    stages view, and an absent rank is reported (not false-aged)."""
+    agg = FleetAggregator(rank=0, nprocs=2, row_ranks=[0, 1],
+                          save_dir=FIXTURE)
+    _feed_two_rank(agg, 2.0, iters=3)
+    local = {"learner/train_dispatch": [0] * 64}
+    local["learner/train_dispatch"][40] = 5
+    block = agg.flush(now=1012.6 + 100.0, local_stage_counts=local)
+    hr = block["host_rows"]
+    assert hr["absent_ranks"] == []
+    assert abs(hr["ages_s"][1] - 100.0) < 1e-6      # now - rank1 wall
+    assert hr["max_age_s"] == hr["ages_s"][1]
+    # fixture rank 1 counts merged with the local matrix
+    assert block["stages"]["actor/env_step"]["count"] == 400
+    assert block["stages"]["learner/train_dispatch"]["count"] == 5
+    assert block["stages"]["lockstep/dispatch"]["count"] == 40
+
+    # a rank that never wrote a row: absent, never a fake age
+    agg3 = FleetAggregator(rank=0, nprocs=3, row_ranks=[0, 1, 2],
+                           save_dir=FIXTURE)
+    _feed_two_rank(agg3, 1.0, iters=1)   # tables too short for 3 ranks: ok
+    block3 = agg3.flush(now=2000.0)
+    assert block3["host_rows"]["absent_ranks"] == [2]
+    assert block3["host_rows"]["ages_s"][2] is None
+
+
+# ---------------------------------------------------------------------------
+# The four fleet alert rules
+
+
+def _engine():
+    return AlertEngine(default_rules(Config().telemetry))
+
+
+def test_fleet_rules_present_and_parameterized():
+    t = Config().replace(**{
+        "telemetry.alerts_rank_straggler": 3.0,
+        "telemetry.alerts_missing_rank_age_s": 60.0}).telemetry
+    by_name = {r.name: r for r in default_rules(t)}
+    assert by_name["rank_straggler"].path == ("fleet", "step_time", "skew")
+    assert by_name["rank_straggler"].bound == 3.0
+    assert by_name["lockstep_wait_frac"].path == (
+        "fleet", "lockstep", "wait_frac")
+    assert by_name["fleet_desync"].path == (
+        "fleet", "env_steps", "divergence")
+    assert by_name["missing_rank"].path == (
+        "fleet", "host_rows", "max_age_s")
+    assert by_name["missing_rank"].bound == 60.0
+    assert by_name["missing_rank"].severity == "crit"
+
+
+def _fleet_record(skew=1.0, wait=0.1, div=1.0, age=1.0):
+    return {"fleet": {"step_time": {"skew": skew},
+                      "lockstep": {"wait_frac": wait},
+                      "env_steps": {"divergence": div},
+                      "host_rows": {"max_age_s": age}}}
+
+
+def test_rank_straggler_fires_exactly_once_per_breach():
+    """The acceptance's edge contract: a sustained breach fires ONE
+    alert, recovery re-arms, the next breach fires again."""
+    eng = _engine()
+    fired = []
+    for rec in (_fleet_record(), _fleet_record(skew=4.0),
+                _fleet_record(skew=4.2), _fleet_record(skew=1.1),
+                _fleet_record(skew=5.0)):
+        fired += [a["rule"] for a in eng.evaluate(rec)["fired"]]
+    assert fired.count("rank_straggler") == 2
+    # records with no fleet block (single-host runs) never activate it
+    eng2 = _engine()
+    out = eng2.evaluate({"buffer_speed": 10.0})
+    assert "rank_straggler" not in out["active"]
+
+
+def test_other_fleet_rules_fire_on_their_metrics():
+    eng = _engine()
+    out = eng.evaluate(_fleet_record(wait=0.9, div=10.0, age=500.0))
+    names = {a["rule"] for a in out["fired"]}
+    assert {"lockstep_wait_frac", "fleet_desync", "missing_rank"} <= names
+    sev = {a["rule"]: a["severity"] for a in out["fired"]}
+    assert sev["missing_rank"] == "crit"
+
+
+# ---------------------------------------------------------------------------
+# Host-row rotation
+
+
+def test_rotating_writer_wraps_and_stays_parseable(tmp_path):
+    path = str(tmp_path / "telemetry_host1.jsonl")
+    w = RotatingJsonlWriter(path, max_bytes=600)
+    for i in range(50):
+        w.write({"rank": 1, "i": i, "pad": "x" * 40})
+    assert w.rotations >= 1
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= 600 + 80     # at most one row over
+    live = parse_jsonl(path)
+    prev = parse_jsonl(path + ".1")
+    # no gaps across the rotation boundary, newest row in the live file
+    seen = [r["i"] for r in prev + live]
+    assert seen == sorted(seen) and seen[-1] == 49
+    # partial trailing line (writer mid-append) stays tolerated
+    with open(path, "a") as f:
+        f.write('{"rank": 1, "i": 99')
+    assert parse_jsonl(path)[-1]["i"] == seen[-1]
+    assert read_last_jsonl_row(path)["i"] == seen[-1]
+    # readers racing the rotation instant fall back to the .1 generation
+    # (rotation also happens BEFORE the exceeding write, so the live
+    # file normally always holds the newest row)
+    os.remove(path)
+    assert read_last_jsonl_row(path)["i"] == prev[-1]["i"]
+
+    # fresh (non-resume) construction truncates live AND rotated files
+    RotatingJsonlWriter(path, max_bytes=600)
+    assert os.path.getsize(path) == 0 and not os.path.exists(path + ".1")
+
+
+def test_rotating_writer_resume_appends(tmp_path):
+    path = str(tmp_path / "telemetry_host1.jsonl")
+    RotatingJsonlWriter(path).write({"i": 0})
+    w = RotatingJsonlWriter(path, resume=True)
+    w.write({"i": 1})
+    assert [r["i"] for r in parse_jsonl(path)] == [0, 1]
+
+
+def test_rotation_default_on_and_validated():
+    cfg = Config()
+    assert cfg.telemetry.fleet_host_row_max_bytes == 16 * 2**20
+    with pytest.raises(ValueError, match="fleet_host_row_max_bytes"):
+        Config().replace(**{"telemetry.fleet_host_row_max_bytes": -1})
+
+
+# ---------------------------------------------------------------------------
+# Cross-host trace merge on the checked-in fixture
+
+
+def test_trace_merge_aligns_two_rank_fixture(tmp_path):
+    """The fixture's rank-1 clock runs 2.5 s ahead (its anchor says so);
+    after the merge both ranks' 'lockstep/it5' spans — the same true
+    instant — land at the same trace timestamp, on per-rank tracks."""
+    from r2d2_tpu.tools.inspect import (export_chrome_trace,
+                                        fleet_clock_offsets)
+
+    offsets, actors_per_rank = fleet_clock_offsets(FIXTURE)
+    assert abs(offsets[1] - 2.5) < 1e-6 and offsets[0] == 0.0
+    assert actors_per_rank == 1
+
+    out = str(tmp_path / "trace.json")
+    n = export_chrome_trace(FIXTURE, out)
+    assert n == 4
+    trace = json.load(open(out))["traceEvents"]
+    pids = {e["args"]["name"]: e["pid"] for e in trace
+            if e.get("name") == "process_name"}
+    assert any(name.startswith("rank0/") for name in pids)
+    assert any(name.startswith("rank1/") for name in pids)
+    its = [e for e in trace if e.get("name") == "lockstep/it5"]
+    assert len(its) == 2
+    assert abs(its[0]["ts"] - its[1]["ts"]) < 1.0    # µs, aligned
+    assert its[0]["pid"] != its[1]["pid"]            # separate tracks
+
+
+def test_span_file_rank_mapping():
+    from r2d2_tpu.tools.inspect import _span_file_rank
+    assert _span_file_rank("spans_host3.jsonl", None) == 3
+    assert _span_file_rank("spans_p0_a5.jsonl", 2) == 2
+    assert _span_file_rank("spans_p0_a5.jsonl", None) is None
+    assert _span_file_rank("spans_learner.jsonl", 2) is None
+
+
+# ---------------------------------------------------------------------------
+# Sentinel host-row / host-alert streams + logparse/plot series
+
+
+def test_sentinel_host_rank_stream(tmp_path, capsys):
+    """--host-rank replays a rank's host rows through the same engine:
+    the fleet rules see the row's own fleet block."""
+    from r2d2_tpu.tools import sentinel
+
+    d = tmp_path / "run"
+    d.mkdir()
+    rows = [_fleet_record(), _fleet_record(wait=0.95)]
+    for i, r in enumerate(rows):
+        r.update({"t": float(i), "rank": 1})
+    with open(d / "telemetry_host1.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    rc = sentinel.main(["--dir", str(d), "--host-rank", "1"])
+    out = capsys.readouterr().out
+    assert "lockstep_wait_frac" in out
+    assert rc == 0                                   # warn, not crit
+
+
+def test_sentinel_resume_after_shrink_rotation_vs_truncation(tmp_path):
+    """A followed stream that shrank because of size-cap rotation must
+    keep the engine (same run!) and surface the rotated generation's
+    unread tail; a genuine truncation resets."""
+    from r2d2_tpu.tools.sentinel import resume_after_shrink
+
+    path = str(tmp_path / "telemetry_host1.jsonl")
+    # rotation: 5 rows moved to .1, live file restarted with 1 row
+    with open(path + ".1", "w") as f:
+        for i in range(5):
+            f.write(json.dumps({"i": i}) + "\n")
+    with open(path, "w") as f:
+        f.write(json.dumps({"i": 5}) + "\n")
+    rotation, backlog = resume_after_shrink(path, seen=3)
+    assert rotation and [r["i"] for r in backlog] == [3, 4]
+    # all rotated rows already seen: rotation, empty backlog
+    rotation, backlog = resume_after_shrink(path, seen=5)
+    assert rotation and backlog == []
+    # truncation: no rotated generation (or one shorter than seen)
+    os.remove(path + ".1")
+    rotation, backlog = resume_after_shrink(path, seen=3)
+    assert not rotation and backlog == []
+
+
+def test_sentinel_alerts_stream(tmp_path, capsys):
+    from r2d2_tpu.tools import sentinel
+
+    path = tmp_path / "alerts_host1.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"t": 1.0, "rule": "missing_rank",
+                            "severity": "crit", "value": 300.0}) + "\n")
+        f.write(json.dumps({"t": 2.0, "rule": "rank_straggler",
+                            "severity": "warn", "value": 4.0}) + "\n")
+    rc = sentinel.main(["--alerts-stream", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "missing_rank" in out and "rank_straggler" in out
+    assert sentinel.main(["--alerts-stream",
+                          str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_fleet_series_extraction():
+    records = [
+        {"t": 1.0, "training_steps": 5},              # no block: skipped
+        {"t": 2.0, "training_steps": 10,
+         "fleet": {"lockstep": {"wait_frac": 0.4},
+                   "step_time": {"skew": 3.96, "straggler_rank": 1,
+                                 "mean_ms": 620.0, "max_ms": 990.0,
+                                 "per_rank_ms": [250.0, 990.0]},
+                   "env_steps": {"divergence": 1.5},
+                   "host_rows": {"max_age_s": 2.0}}},
+    ]
+    s = fleet_series(records)
+    assert s["t"] == [2.0]
+    assert s["wait_frac"] == [0.4]
+    assert s["skew"] == [3.96] and s["straggler_rank"] == [1]
+    assert s["per_rank_ms"] == [[250.0, 990.0]]
+    assert s["divergence"] == [1.5] and s["max_age_s"] == [2.0]
+
+
+def test_inspect_fleet_panels_render():
+    from r2d2_tpu.tools.inspect import (render_fleet, render_host_rows,
+                                        render_record)
+
+    rows = parse_jsonl(os.path.join(FIXTURE, "telemetry_host0.jsonl")) \
+        + parse_jsonl(os.path.join(FIXTURE, "telemetry_host1.jsonl"))
+    panel = render_fleet(rows[0]["fleet"])
+    assert "straggler=rank 1" in panel and "skew=3.96" in panel
+    per_rank = render_host_rows(rows)
+    assert "rank 0" in per_rank and "rank 1" in per_rank
+    assert "wait=40%" in per_rank        # rank 0's row view
+    # the full record path renders the fleet panel + per-rank lines
+    frame = render_record({"t": 1.0, "fleet": rows[0]["fleet"]},
+                          host_rows=rows)
+    assert "fleet: 2 rank(s)" in frame and "per-rank" in frame
+
+
+# ---------------------------------------------------------------------------
+# Config round-trip + schema stability
+
+
+def test_pre_pr12_config_dicts_round_trip():
+    d = Config().to_dict()
+    for key in list(d["telemetry"]):
+        if key.startswith("fleet_") or key in (
+                "alerts_rank_straggler", "alerts_lockstep_wait_frac",
+                "alerts_fleet_desync", "alerts_missing_rank_age_s"):
+            del d["telemetry"][key]
+    cfg = Config.from_dict(d)
+    assert cfg.telemetry.fleet_enabled is True
+    assert cfg.telemetry.alerts_rank_straggler == 2.0
+    for bad, val in (("alerts_rank_straggler", 1.0),
+                     ("alerts_lockstep_wait_frac", 0.0),
+                     ("alerts_fleet_desync", 1.0),
+                     ("alerts_missing_rank_age_s", 0.0)):
+        with pytest.raises(ValueError, match=bad):
+            Config().replace(**{f"telemetry.{bad}": val})
+
+
+def test_record_schema_stable_without_fleet(tmp_path):
+    """TrainMetrics: no set_fleet call (single-host runs, or the kill
+    switch) -> no 'fleet' key; one call -> exactly one record carries
+    it, then it is consumed."""
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+
+    m = TrainMetrics(0, str(tmp_path))
+    rec = m.log(1.0)
+    assert "fleet" not in rec
+    m.set_fleet({"ranks": 2})
+    assert m.log(1.0)["fleet"] == {"ranks": 2}
+    assert "fleet" not in m.log(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Slow e2e slices: the real lockstep loop (single controller), and the
+# two-process loopback straggler A/B (needs multiprocess collectives).
+
+
+@pytest.mark.slow
+def test_fleet_e2e_single_controller(tmp_path):
+    """The full lockstep trainer as one controller over an emulated dp=2
+    mesh: records carry a live fleet block (wait fraction, gauge tables,
+    host-row section), rank 0 writes its anchored host row, and the
+    trace export aligns without error."""
+    from r2d2_tpu.parallel.multihost import train_multihost
+    from r2d2_tpu.tools.inspect import export_chrome_trace
+
+    d = str(tmp_path / "mh")
+    cfg = Config().replace(**dict(
+        BASE_CFG, **{"mesh.dp": 2, "runtime.save_dir": d}))
+    records = []
+    out = train_multihost(cfg, max_training_steps=6, max_seconds=180,
+                          actor_mode="thread", log_fn=records.append)
+    assert out["step"] >= 6
+    fleet = [r["fleet"] for r in records if r.get("fleet")]
+    assert fleet, "no fleet block reached the records"
+    fb = fleet[-1]
+    assert fb["ranks"] == 1 and fb["lockstep"]["dispatches"] > 0
+    assert fb["lockstep"]["wait_frac"] is not None
+    assert fb["step_time"]["per_rank_ms"]
+    rows = parse_jsonl(os.path.join(d, "telemetry_host0.jsonl"))
+    assert rows and rows[-1]["clock_anchor"]["it"] == 1
+    assert rows[-1]["stage_counts"]
+    n = export_chrome_trace(d, str(tmp_path / "trace.json"))
+    assert n > 0
+
+
+@pytest.mark.slow
+def test_fleet_e2e_kill_switch_schema(tmp_path):
+    """fleet_enabled=false through the real loop: records byte-free of
+    the fleet key, no rank-0 host row, the PR-10 file set."""
+    from r2d2_tpu.parallel.multihost import train_multihost
+
+    d = str(tmp_path / "mh_off")
+    os.makedirs(d)
+    # a previous fleet-on run's stale rank-0 host row must be cleaned
+    # up, not rendered as if it belonged to this run
+    stale = os.path.join(d, "telemetry_host0.jsonl")
+    with open(stale, "w") as f:
+        f.write(json.dumps({"rank": 0, "clock_anchor": {"wall": 1.0}})
+                + "\n")
+    cfg = Config().replace(**dict(
+        BASE_CFG, **{"mesh.dp": 2, "runtime.save_dir": d,
+                     "telemetry.fleet_enabled": False}))
+    records = []
+    train_multihost(cfg, max_training_steps=4, max_seconds=180,
+                    actor_mode="thread", log_fn=records.append)
+    assert records and not any("fleet" in r for r in records)
+    assert not os.path.exists(stale)
+
+
+@pytest.mark.slow
+def test_fleet_loopback_two_rank_straggler(tmp_path, monkeypatch):
+    """The loopback two-process A/B (the acceptance's first path where
+    the backend allows): chaos slowx3 injected on rank 1's loop — rank
+    0's fleet block must name rank 1 as the straggler, and the
+    rank_straggler firing must land in alerts_player0.jsonl. Requires
+    multiprocess collectives (fails on backends without them — the
+    known PR-3 limitation; the fixture-replay tests above are the
+    container-portable acceptance)."""
+    from r2d2_tpu.parallel.multihost import launch_demo
+
+    monkeypatch.setenv("R2D2_MH_CHAOS_STRAGGLER", "1:slowx3")
+    save_dir = str(tmp_path / "mh_straggler")
+    launch_demo(num_processes=2, devices_per_process=2, save_dir=save_dir,
+                max_steps=8, timeout=280.0)
+    records = parse_jsonl(os.path.join(save_dir, "metrics_player0.jsonl"))
+    fleet = [r["fleet"] for r in records if r.get("fleet")]
+    assert fleet, "rank 0 logged no fleet block"
+    skews = [f["step_time"]["skew"] for f in fleet
+             if f.get("step_time", {}).get("skew")]
+    assert skews and max(skews) > 1.5
+    stragglers = {f["step_time"].get("straggler_rank") for f in fleet
+                  if f.get("step_time")}
+    assert 1 in stragglers
+    # rank 1 wrote its own anchored, alert-bearing host row
+    rows = parse_jsonl(os.path.join(save_dir, "telemetry_host1.jsonl"))
+    assert rows and rows[-1].get("clock_anchor")

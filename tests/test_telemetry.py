@@ -542,7 +542,9 @@ def test_render_record_includes_stage_table():
     assert "actor/forward" in frame
     assert "p99 ms" in frame
     assert "restarts=1" in frame
-    assert "host rank 1" in frame
+    # host rows render as the per-rank fleet panel (ISSUE 12 replaced
+    # the one-line "host rank r: N stages" summary)
+    assert "per-rank" in frame and "rank 1" in frame
 
 
 def test_render_record_without_telemetry():
