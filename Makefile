@@ -60,6 +60,14 @@
 #                record-schema stability); the slow single-controller
 #                lockstep e2e + two-process loopback straggler A/B run
 #                with the full tier.
+#   make serve — the fast-tier policy-serving suite (tests/test_serve.py:
+#                micro-batcher deadline/fill semantics, state-cache
+#                lease/evict/reconnect, local-vs-server action parity,
+#                transport round-trips (in-proc + shm + socket), serving
+#                record schema + the serve_* alert rules, kill-switch
+#                schema stability); the slow e2e slice (real actors
+#                through the server into the learner) and the
+#                server-kill/restart chaos drill run with the full tier.
 #   make costmodel — the fast-tier cost-model/roofline suite
 #                (tests/test_costmodel.py: XLA cost-table extraction
 #                across step factories incl. a sharded emulated-mesh
@@ -82,7 +90,8 @@
 #                shape on TPU).
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
-	replaydiag fleet costmodel regress costs roofline check-fast-markers
+	replaydiag fleet serve costmodel regress costs roofline \
+	check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -119,6 +128,10 @@ fleet: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+serve: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
 costmodel: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q \
 	    -m 'not slow' -p no:cacheprovider
@@ -149,6 +162,7 @@ FAST_MARKER_CHECKS := \
 	tests/test_sentinel.py:not_slow:20:sentinel \
 	tests/test_replay_diag.py:not_slow:10:replay-diag \
 	tests/test_fleet.py:not_slow:12:fleet \
+	tests/test_serve.py:not_slow:14:serve \
 	tests/test_costmodel.py:not_slow:10:cost-model
 
 check-fast-markers:

@@ -35,6 +35,29 @@ def _pin_params(params, cpu, copy: bool):
     return jax.device_put(params, cpu)
 
 
+def make_forward_fn(net: NetworkApply):
+    """The ONE jitted acting forward (ISSUE 13 satellite): a (N, 1)
+    single-step recurrent forward shared by ``ActorPolicy`` (N=1),
+    ``BatchedActorPolicy``, and the central policy server
+    (serve/server.py) — one definition of the acting forward across
+    local and served inference, so parity between them is the identity
+    of a single program, not a numerics argument.
+
+    Signature: ``fn(params, stacked_obs, last_action, hidden)`` with
+    ``stacked_obs`` (N, H, W, stack) f32 in [0,1], ``last_action`` (N,)
+    int32, ``hidden`` (N, 2, hidden) packed — returns (greedy_actions
+    (N,), q (N, A), hidden' (N, 2, hidden))."""
+
+    def step_fn(params, stacked_obs, last_action, hidden):
+        obs = stacked_obs[:, None]                         # (N, 1, ...)
+        la = jax.nn.one_hot(last_action, net.action_dim,
+                            dtype=jnp.float32)[:, None]
+        q, h = net.module.apply(params, obs, la, hidden)
+        return jnp.argmax(q[:, 0], axis=-1), q[:, 0], h
+
+    return jax.jit(step_fn)
+
+
 def _force_f32(net: NetworkApply) -> NetworkApply:
     """Actors infer on host CPUs, where bf16 is emulated and slower —
     force the f32 compute policy regardless of the learner's (params are
@@ -67,17 +90,17 @@ class ActorPolicy:
         # defensive copy in _pin would be a second full-tree copy per refresh
         self._copy_updates = copy_updates
         self.params = self._pin(params, copy=True)  # initial params: unknown owner
-
-        def step_fn(params, stacked_obs, last_action, hidden):
-            # stacked_obs: (H, W, stack) f32 in [0,1]; last_action: () int32
-            obs = stacked_obs[None, None]
-            la = jax.nn.one_hot(last_action, net.action_dim,
-                                dtype=jnp.float32)[None, None]
-            q, h = net.module.apply(params, obs, la, hidden)
-            return jnp.argmax(q[0, 0]), q[0, 0], h
-
-        self._step = jax.jit(step_fn)
+        # the shared (N, 1) acting forward at N=1 — the exact program the
+        # batched policy and the policy server run (inputs expand to the
+        # same (1, 1, ...) shapes the old scalar closure built, so the
+        # compiled computation is unchanged)
+        self._fwd = make_forward_fn(net)
         self.reset_state()
+
+    def _step(self, params, stacked, last_action, hidden):
+        action, q, h = self._fwd(params, stacked[None],
+                                 np.asarray(last_action)[None], hidden)
+        return action[0], q[0], h
 
     def reset_state(self) -> None:
         """Per-episode state reset (ref model.py:86-87, worker.py:584-591)."""
@@ -162,16 +185,9 @@ class BatchedActorPolicy:
         self._cpu = jax.local_devices(backend="cpu")[0]
         self._copy_updates = copy_updates
         self.params = self._pin(params, copy=True)
-
-        def step_fn(params, stacked_obs, last_action, hidden):
-            # stacked_obs: (N, H, W, stack) f32 in [0,1]; last_action: (N,)
-            obs = stacked_obs[:, None]                         # (N, 1, ...)
-            la = jax.nn.one_hot(last_action, net.action_dim,
-                                dtype=jnp.float32)[:, None]
-            q, h = net.module.apply(params, obs, la, hidden)
-            return jnp.argmax(q[:, 0], axis=-1), q[:, 0], h
-
-        self._step = jax.jit(step_fn)
+        # the shared acting forward (make_forward_fn) — identical closure
+        # to the one this class used to define inline
+        self._step = make_forward_fn(net)
         self.reset_state()
 
     def reset_state(self) -> None:

@@ -40,11 +40,19 @@ def rollout_episode(env, policy, max_steps: int = 100_000) -> float:
 def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
                         testing: bool = False, is_host: bool = False,
                         port: int = 5060, seed: int = 0,
-                        env_sink: Optional[callable] = None
+                        env_sink: Optional[callable] = None,
+                        serve: bool = False, serve_clients: int = 4
                         ) -> Tuple[float, int, int]:
     """Returns (mean_return, training_steps, env_steps). ``env_sink``
     receives the created env handle so a supervising caller can close it if
-    this evaluator is abandoned mid-rollout (--play straggler handling)."""
+    this evaluator is abandoned mid-rollout (--play straggler handling).
+
+    ``serve=True`` (ISSUE 13): evaluation-as-a-service — the checkpoint's
+    params load into ONE in-proc PolicyServer and ``serve_clients``
+    concurrent evaluator threads (each with its own env + thin
+    RemotePolicy at the same test ε) split the rounds, so every policy
+    forward of the evaluation rides the micro-batcher. Greedy-ish math
+    is identical (shared forward factory, client-side ε draws)."""
     import jax
 
     from r2d2_tpu.actor.policy import ActorPolicy
@@ -63,22 +71,91 @@ def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
         import dataclasses
         cfg = dataclasses.replace(cfg, env=stored.env, network=stored.network,
                                   sequence=stored.sequence)
-    env = create_env(cfg.env, clip_rewards=False, testing=testing,
-                     is_host=is_host, port=port, seed=seed)
+    probe_env = create_env(cfg.env, clip_rewards=False, testing=testing,
+                           is_host=is_host, port=port, seed=seed)
     if env_sink is not None:
-        env_sink(env)
-    net = NetworkApply(env.action_space.n, cfg.network, cfg.env.frame_stack,
-                       cfg.env.frame_height, cfg.env.frame_width)
+        env_sink(probe_env)
+    net = NetworkApply(probe_env.action_space.n, cfg.network,
+                       cfg.env.frame_stack, cfg.env.frame_height,
+                       cfg.env.frame_width)
     template = net.init(jax.random.PRNGKey(0))
     restored = restore_checkpoint(ckpt_path)
     params = jax.tree_util.tree_map(
         lambda t, p: np.asarray(p, np.asarray(t).dtype),
         template, restored["params"])
-    policy = ActorPolicy(net, params, cfg.runtime.test_epsilon, seed=seed)
-    returns = [rollout_episode(env, policy) for _ in range(rounds)]
-    env.close()
+    if serve:
+        returns = _serve_rollouts(cfg, net, params, probe_env, rounds,
+                                  max(serve_clients, 1), testing, seed,
+                                  env_sink)
+    else:
+        policy = ActorPolicy(net, params, cfg.runtime.test_epsilon,
+                             seed=seed)
+        returns = [rollout_episode(probe_env, policy)
+                   for _ in range(rounds)]
+    probe_env.close()
     return (float(np.mean(returns)), int(restored.get("step", 0)),
             int(restored.get("env_steps", 0)))
+
+
+def _serve_rollouts(cfg, net, params, first_env, rounds: int, clients: int,
+                    testing: bool, seed: int, env_sink) -> list:
+    """Evaluation-as-a-service rollouts: one in-proc policy server, N
+    concurrent thin clients splitting the rounds (client i reuses the
+    caller's env for i=0, fresh seeded envs otherwise)."""
+    import threading
+
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.serve import InprocEndpoint, PolicyServer, RemotePolicy
+
+    endpoint = InprocEndpoint()
+    server = PolicyServer(cfg, net, params, endpoint=endpoint).start()
+    clients = min(clients, max(rounds, 1))
+    shares = [rounds // clients + (1 if i < rounds % clients else 0)
+              for i in range(clients)]
+    returns: list = []
+    errors: list = []
+    lock = threading.Lock()
+
+    def run(i: int, share: int) -> None:
+        env = policy = None
+        try:
+            env = first_env if i == 0 else create_env(
+                cfg.env, clip_rewards=False, testing=testing, seed=seed + i)
+            if i > 0 and env_sink is not None:
+                env_sink(env)
+            policy = RemotePolicy(endpoint.connect(), net.action_dim,
+                                  cfg.runtime.test_epsilon, seed=seed + i,
+                                  client_id=i,
+                                  timeout_s=cfg.serve.request_timeout_s,
+                                  max_retry_s=cfg.serve.max_retry_s)
+            got = [rollout_episode(env, policy) for _ in range(share)]
+            with lock:
+                returns.extend(got)
+        except BaseException as e:     # surfaced below
+            errors.append(e)
+        finally:
+            # a mid-rollout failure must not leak the engine handle
+            # (run_actor's finally exists for the same reason)
+            if policy is not None:
+                policy.close()
+            if env is not None and i > 0:
+                try:
+                    env.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=run, args=(i, share), daemon=True)
+               for i, share in enumerate(shares) if share > 0]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        server.stop()
+    if errors:
+        raise errors[0]
+    return returns
 
 
 def _sweep_worker(cfg_dict: dict, ckpt: str, rounds: int, seed: int):
@@ -110,6 +187,16 @@ def main(argv=None) -> None:
     p.add_argument("--workers", type=int, default=5,
                    help="concurrent checkpoint evaluations (the reference "
                         "uses a 5-way multiprocessing pool, test.py:23)")
+    p.add_argument("--serve", action="store_true",
+                   help="evaluation-as-a-service (ISSUE 13): load each "
+                        "checkpoint into ONE in-proc policy server and "
+                        "split its rounds across --serve-clients "
+                        "concurrent thin clients — every forward rides "
+                        "the micro-batcher (forces the in-process sweep "
+                        "path; per-checkpoint servers, identical math)")
+    p.add_argument("--serve-clients", type=int, default=4,
+                   help="--serve: concurrent evaluator clients per "
+                        "checkpoint")
     p.add_argument("--straggler-window", type=float, default=60.0,
                    help="--play: seconds a peer evaluator may keep running "
                         "after the first one finishes before being "
@@ -245,8 +332,10 @@ def main(argv=None) -> None:
     # GIL-bound (round-3 review) — while separate processes parallelize
     # the whole rollout like the reference does. --workers 1 runs
     # in-process (no spawn/jax-import cost for small sweeps).
-    if args.workers <= 1 or len(ckpts) == 1:
-        results = [evaluate_checkpoint(cfg, c, args.rounds, seed=i)
+    if args.serve or args.workers <= 1 or len(ckpts) == 1:
+        results = [evaluate_checkpoint(cfg, c, args.rounds, seed=i,
+                                       serve=args.serve,
+                                       serve_clients=args.serve_clients)
                    for i, c in ckpts]
     else:
         import multiprocessing as mp

@@ -1,0 +1,154 @@
+"""Standalone policy inference server (ISSUE 13): serve a checkpoint's
+policy over TCP (and optionally the shm ring for same-host clients).
+
+    python -m r2d2_tpu.cli.serve --ckpt models/Fake3_player0 --port 5999
+    python -m r2d2_tpu.cli.serve --seconds 30            # random-init smoke
+
+The server loop owns the device-resident params and the per-client
+state cache; clients are ``serve.RemotePolicy``/``RemoteBatchedPolicy``
+over a ``SocketChannel`` (or ``ShmServeChannel`` with ``--shm``). A
+periodic record with the ``serving`` block (request latency, batch fill,
+client churn) appends to ``serve_metrics.jsonl`` in --save-dir, with the
+stock alert rules (``serve_latency_slo``, ``serve_batch_starvation``,
+``serve_client_churn``) evaluated per record into
+``serve_alerts.jsonl`` — the same SLO plumbing the in-training server
+rides. SIGTERM/SIGINT stop cleanly.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--ckpt", default="",
+                   help="checkpoint to serve (empty: random init — smoke "
+                        "tests and transport bring-up)")
+    p.add_argument("--shm", action="store_true",
+                   help="also open the same-host shm ring transport; its "
+                        "request-ring name is printed for clients")
+    p.add_argument("--seconds", type=float, default=0.0,
+                   help="stop after this long (0 = run until signaled)")
+    p.add_argument("--save-dir", default=".",
+                   help="where serve_metrics.jsonl / serve_alerts.jsonl go")
+    args, config_overrides = p.parse_known_args(argv)
+
+    import jax
+    import numpy as np
+
+    from r2d2_tpu.config import Config, parse_overrides
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.serve import (InprocEndpoint, PolicyServer, ServingStats,
+                                ShmServeTransport, SocketServerTransport)
+    from r2d2_tpu.telemetry import Telemetry
+    from r2d2_tpu.telemetry.alerts import AlertEngine, default_rules
+
+    cfg = parse_overrides(Config(), config_overrides)
+    if args.ckpt:
+        from r2d2_tpu.runtime.checkpoint import (load_checkpoint_config,
+                                                 restore_checkpoint)
+        stored = load_checkpoint_config(args.ckpt)
+        if stored is not None:
+            import dataclasses
+            cfg = dataclasses.replace(cfg, env=stored.env,
+                                      network=stored.network,
+                                      sequence=stored.sequence)
+    probe = create_env(cfg.env, seed=cfg.runtime.seed)
+    action_dim = probe.action_space.n
+    probe.close()
+    net = NetworkApply(action_dim, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    params = net.init(jax.random.PRNGKey(cfg.runtime.seed))
+    if args.ckpt:
+        restored = restore_checkpoint(args.ckpt)
+        params = jax.tree_util.tree_map(
+            lambda t, p_: np.asarray(p_, np.asarray(t).dtype),
+            params, restored["params"])
+
+    stats = ServingStats()
+    telemetry = Telemetry.from_config(cfg, name="serve")
+    endpoint = InprocEndpoint()
+    transports = [SocketServerTransport(endpoint.submit, cfg.serve.host,
+                                        cfg.serve.port)]
+    print(f"serving on {transports[0].host}:{transports[0].port} "
+          f"(action_dim={action_dim})", flush=True)
+    if args.shm:
+        shm_t = ShmServeTransport(
+            endpoint.submit, (cfg.env.frame_height, cfg.env.frame_width),
+            action_dim, cfg.network.hidden_dim,
+            request_slots=cfg.serve.request_ring_slots)
+        transports.append(shm_t)
+        print(f"shm request ring: {shm_t.request_ring.name}", flush=True)
+
+    os.makedirs(args.save_dir or ".", exist_ok=True)
+    metrics_path = os.path.join(args.save_dir or ".", "serve_metrics.jsonl")
+    open(metrics_path, "w").close()
+    engine = AlertEngine(
+        default_rules(cfg.telemetry),
+        jsonl_path=os.path.join(args.save_dir or ".", "serve_alerts.jsonl"))
+
+    server = PolicyServer(cfg, net, params, endpoint=endpoint,
+                          stats=stats, telemetry=telemetry).start()
+
+    stop = {"flag": False}
+
+    def _on_signal(signum, frame):
+        stop["flag"] = True
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except (ValueError, OSError):
+            pass
+
+    t0 = time.time()
+    last_log = t0
+    try:
+        while not stop["flag"]:
+            if args.seconds and time.time() - t0 >= args.seconds:
+                break
+            time.sleep(0.2)
+            now = time.time()
+            if now - last_log >= cfg.runtime.log_interval:
+                last_log = now
+                block = stats.interval_block(
+                    deadline_ms=cfg.serve.deadline_ms,
+                    max_batch=cfg.serve.max_batch)
+                record = {"t": round(now - t0, 1),
+                          "batches": server.batches_dispatched}
+                if block is not None:   # the TrainMetrics omission contract
+                    record["serving"] = block
+                record["alerts"] = engine.evaluate(record)
+                with open(metrics_path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+    finally:
+        server.stop()
+        for t in transports:
+            t.close()
+        telemetry.close()
+        # final record so short runs still leave evidence
+        block = stats.interval_block(deadline_ms=cfg.serve.deadline_ms,
+                                     max_batch=cfg.serve.max_batch)
+        record = {"t": round(time.time() - t0, 1),
+                  "batches": server.batches_dispatched, "final": True}
+        if block is not None:
+            record["serving"] = block
+        record["alerts"] = engine.evaluate(record)
+        with open(metrics_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        print(f"served {server.batches_dispatched} batches in "
+              f"{time.time() - t0:.1f}s; records in {metrics_path}",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

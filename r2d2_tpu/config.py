@@ -283,7 +283,80 @@ class ActorConfig:
     # faults. Exists so every health behavior — watchdog kill, backoff,
     # breaker, ring reclamation — is exercised by real misbehaving workers
     # in tests and in the soak's chaos phase, not just hoped for.
+    # With inference="server" two CLIENT-side kinds join (ISSUE 13):
+    # ``disconnect@req=N`` drops the worker's serve connection every N-th
+    # request (exercising lease release + reconnect-with-state), and
+    # ``slow``/``slowxF`` moves from the block sink to the request path
+    # (stretching the worker's request cadence — a laggy client against
+    # the micro-batcher). crash/hang stay at the block sink either way.
     fault_spec: str = ""
+    # Where the acting forward runs (ISSUE 13): "local" (default) = the
+    # policy + its recurrent state live in the actor worker (pre-PR13
+    # behavior, byte-identical); "server" = the worker holds a thin
+    # RemotePolicy and the central policy server (r2d2_tpu/serve/) owns
+    # params + per-client state, micro-batching all workers' requests
+    # into one device forward — the SEED placement (arXiv 1910.03552).
+    # Action parity at equal seeds/ε is test-asserted.
+    inference: str = "local"
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Central policy inference service (ISSUE 13; r2d2_tpu/serve/):
+    a SEED-style batched policy server — thin clients submit raw
+    observation frames, one server loop owns the device-resident params
+    and a sharded per-client LSTM-state + frame-stack cache, and
+    micro-batches pending requests into one jitted forward under a
+    latency deadline. ``actor.inference="server"`` routes the existing
+    actor loops through it; ``cli/serve.py`` runs it standalone;
+    ``cli/evaluate.py --serve`` is evaluation-as-a-service."""
+
+    # Micro-batch dispatch bound: a batch dispatches when it holds this
+    # many requests OR when the oldest pending request is deadline_ms
+    # old, whichever first. Dispatch widths pad to power-of-two buckets
+    # (all pre-compiled at server start) so fill jitter never retraces.
+    max_batch: int = 32
+    deadline_ms: float = 5.0
+    # State cache geometry: total per-client slots (each holds one packed
+    # LSTM hidden + rolling frame stack + last action) partitioned into
+    # ``state_shards`` independently-leased shard groups (client ids hash
+    # onto shards; the layout a multi-device server pins per device).
+    state_slots: int = 1024
+    state_shards: int = 4
+    # A DISCONNECTED client's state survives this long before eviction —
+    # the reconnect window (a bouncing client resumes mid-episode); an
+    # evicted slot resets to the episode-initial zero state.
+    lease_timeout_s: float = 120.0
+    # Client-side request timeout: past it the client backs off on the
+    # PR-3 WorkerHealth ladder, reconnects, and resends; after
+    # ``max_retry_s`` of failures it raises (worker supervision takes
+    # over: respawn with backoff).
+    request_timeout_s: float = 5.0
+    max_retry_s: float = 60.0
+    # Server-side request TTL: requests older than this at dispatch are
+    # dropped unapplied (a restarted server must not replay its dead
+    # predecessor's backlog — the client already timed out and will
+    # resend its current state). 0 disables.
+    request_ttl_s: float = 10.0
+    # Transport rung for PROCESS-mode actors: "shm" (the shm_feeder ring
+    # discipline — native MPMC request ring + per-client reply rings),
+    # "socket" (TCP, the cross-host rung), or "auto" (shm when the
+    # native toolchain is available, else socket). Thread-mode actors
+    # always ride the in-proc queue; cli/serve.py listens on socket
+    # (and shm with --shm).
+    transport: str = "auto"
+    host: str = "127.0.0.1"
+    port: int = 0                   # 0 = ephemeral (socket transport)
+    # Ring geometries (shm transport).
+    request_ring_slots: int = 256
+    reply_ring_slots: int = 16
+    # Seconds between the server's weight-service polls (the reader side
+    # of runtime/weights.py; every reply stamps the adopted publish
+    # count so block staleness accounting stays live in served mode).
+    weight_poll_interval_s: float = 1.0
+    # Pre-compile every pow2 dispatch bucket at server start (the ingest
+    # stager's AOT recipe — a lazy mid-run compile parks every client).
+    warmup: bool = True
 
 
 @dataclass(frozen=True)
@@ -513,6 +586,22 @@ class TelemetryConfig:
     # on rank 0) at/above which missing_rank fires — a rank stopped
     # writing its row (wedged or dead past the heartbeat horizon).
     alerts_missing_rank_age_s: float = 120.0
+    # -- serving plane (ISSUE 13; the record's 'serving' block) --
+    # Client-visible request-latency P99 (serving.latency.p99_ms —
+    # includes queueing, retries, and timed-out attempts) at/above which
+    # serve_latency_slo fires: the SLO ceiling. Inactive on records
+    # without a serving block (every non-served run).
+    alerts_serve_p99_ms: float = 1000.0
+    # Fraction of the interval's dispatched batches that went out with
+    # fill == 1 while >1 clients were connected (serving.batch.
+    # starved_frac) at/above which serve_batch_starvation fires — the
+    # micro-batcher is not coalescing despite load (deadline too tight,
+    # or clients serialized behind something).
+    alerts_serve_starved_frac: float = 0.95
+    # Cumulative client disconnects (serving.clients.disconnects)
+    # growing by at least this much within one interval fires
+    # serve_client_churn (counter semantics — one burst, one alert).
+    alerts_serve_churn: float = 3.0
 
 
 @dataclass(frozen=True)
@@ -610,6 +699,7 @@ class Config:
     replay: ReplayConfig = field(default_factory=ReplayConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
     actor: ActorConfig = field(default_factory=ActorConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
     multiplayer: MultiplayerConfig = field(default_factory=MultiplayerConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
@@ -759,6 +849,87 @@ class Config:
                 raise ValueError(
                     f"actor.fault_spec targets slot(s) {bad} outside the "
                     f"fleet of {self.actor.num_actors} workers")
+            if self.actor.inference != "server":
+                disc = [s for s, f in faults.items()
+                        if f.kind == "disconnect"]
+                if disc:
+                    raise ValueError(
+                        f"actor.fault_spec slot(s) {disc} use the "
+                        "'disconnect' kind, which injects at the serve "
+                        "client — it requires actor.inference='server' "
+                        "(with local inference there is no connection to "
+                        "drop, so the run would report vacuously healthy)")
+        if self.actor.inference not in ("local", "server"):
+            raise ValueError(
+                f"actor.inference ({self.actor.inference!r}) must be "
+                "'local' or 'server'")
+        if self.actor.inference == "server":
+            if self.actor.on_device:
+                raise ValueError(
+                    "actor.inference='server' requires the host actor "
+                    "fleet: the fused on-device loop (actor.on_device) "
+                    "has no per-step policy client — its acting forward "
+                    "is already device-resident")
+            if self.mesh.multihost:
+                raise ValueError(
+                    "actor.inference='server' is single-host for now: the "
+                    "multihost lockstep fleet wires its own weight "
+                    "distribution — route its actors through a serve "
+                    "transport in the elastic-fleet arc (ROADMAP item 4)")
+            lanes = self.actor.num_actors * self.actor.envs_per_actor
+            if lanes > self.serve.state_slots:
+                raise ValueError(
+                    f"actor fleet has {lanes} lanes but serve.state_slots "
+                    f"is {self.serve.state_slots}: every lane leases a "
+                    "server-side state slot, so an undersized cache would "
+                    "thrash (evict live episodes) — raise "
+                    "serve.state_slots")
+        if self.serve.max_batch < 1:
+            raise ValueError(
+                f"serve.max_batch ({self.serve.max_batch}) must be >= 1")
+        if self.serve.deadline_ms < 0:
+            raise ValueError(
+                f"serve.deadline_ms ({self.serve.deadline_ms}) must be "
+                ">= 0")
+        if self.serve.state_slots < 1 or self.serve.state_shards < 1:
+            raise ValueError(
+                "serve.state_slots and serve.state_shards must be >= 1")
+        if self.serve.state_slots % self.serve.state_shards != 0:
+            raise ValueError(
+                f"serve.state_slots ({self.serve.state_slots}) must be "
+                f"divisible by serve.state_shards "
+                f"({self.serve.state_shards}): shards are equal slot "
+                "groups")
+        for fname in ("lease_timeout_s", "request_timeout_s",
+                      "max_retry_s", "weight_poll_interval_s"):
+            if getattr(self.serve, fname) <= 0:
+                raise ValueError(f"serve.{fname} must be > 0")
+        if self.serve.request_ttl_s < 0:
+            raise ValueError(
+                f"serve.request_ttl_s ({self.serve.request_ttl_s}) must "
+                "be >= 0 (0 disables expiry)")
+        if self.serve.transport not in ("auto", "shm", "socket"):
+            raise ValueError(
+                f"serve.transport ({self.serve.transport!r}) must be "
+                "'auto', 'shm', or 'socket'")
+        if self.serve.request_ring_slots < 2 or \
+                self.serve.reply_ring_slots < 2:
+            raise ValueError(
+                "serve.request_ring_slots and serve.reply_ring_slots "
+                "must be >= 2")
+        if self.telemetry.alerts_serve_p99_ms <= 0:
+            raise ValueError(
+                f"telemetry.alerts_serve_p99_ms "
+                f"({self.telemetry.alerts_serve_p99_ms}) must be > 0")
+        if not 0 < self.telemetry.alerts_serve_starved_frac <= 1:
+            raise ValueError(
+                f"telemetry.alerts_serve_starved_frac "
+                f"({self.telemetry.alerts_serve_starved_frac}) must be in "
+                "(0, 1]")
+        if self.telemetry.alerts_serve_churn < 1:
+            raise ValueError(
+                f"telemetry.alerts_serve_churn "
+                f"({self.telemetry.alerts_serve_churn}) must be >= 1")
         for fname, lo in (("supervise_interval_s", 0.0),
                           ("restart_window_s", 0.0)):
             if getattr(self.runtime, fname) <= lo:
@@ -955,8 +1126,9 @@ class Config:
 _SECTION_TYPES = {
     "env": EnvConfig, "network": NetworkConfig, "sequence": SequenceConfig,
     "replay": ReplayConfig, "optim": OptimConfig, "actor": ActorConfig,
-    "multiplayer": MultiplayerConfig, "mesh": MeshConfig,
-    "runtime": RuntimeConfig, "telemetry": TelemetryConfig,
+    "serve": ServeConfig, "multiplayer": MultiplayerConfig,
+    "mesh": MeshConfig, "runtime": RuntimeConfig,
+    "telemetry": TelemetryConfig,
 }
 
 # Field annotations are strings (PEP 563 via `from __future__ import

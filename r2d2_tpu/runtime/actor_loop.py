@@ -46,28 +46,67 @@ def make_actor_env(cfg: Config, player_idx: int, actor_idx: int, seed: int,
 def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
                       epsilon: Optional[float] = None,
                       copy_updates: bool = True,
-                      total_actors: Optional[int] = None):
+                      total_actors: Optional[int] = None,
+                      serve_channel=None, serve_stats=None,
+                      should_stop: Optional[Callable[[], bool]] = None):
     """Build the policy matching the env shape ``make_actor_env`` produced;
     returns ``(policy, run_loop)`` where ``run_loop`` is run_actor or
     run_vector_actor. ``epsilon`` overrides the scalar path's Ape-X ladder
     value (process actors receive it from the parent); vector lanes always
     take the ladder spread (config.vector_lane_epsilons). Multihost fleets
     pass the GLOBAL ``actor_idx`` and their global worker count as
-    ``total_actors`` so the ladder spans the whole fleet."""
+    ``total_actors`` so the ladder spans the whole fleet.
+
+    ``actor.inference="server"`` (ISSUE 13): the same ladder/seed scheme
+    builds a thin Remote(Batched)Policy over ``serve_channel`` instead —
+    the ε draws and client ids reproduce the local policies' exactly, so
+    a served fleet is action-for-action the local fleet (parity-tested).
+    Client-side chaos faults for this slot (disconnect/slow) wrap the
+    channel here — the serve twin of instrument_block_sink's injection
+    point."""
     from r2d2_tpu.config import apex_epsilon, vector_lane_epsilons
+    serve = cfg.actor.inference == "server"
+    if serve:
+        if serve_channel is None:
+            raise ValueError(
+                "actor.inference='server' needs a serve_channel (the "
+                "spawner connects it to the policy server's transport)")
+        if cfg.actor.fault_spec:
+            from r2d2_tpu.tools.chaos import parse_fault_spec, wrap_channel
+            fault = parse_fault_spec(cfg.actor.fault_spec).get(actor_idx)
+            if fault is not None:
+                serve_channel = wrap_channel(serve_channel, fault)
+        kw = dict(stats=serve_stats,
+                  timeout_s=cfg.serve.request_timeout_s,
+                  max_retry_s=cfg.serve.max_retry_s,
+                  should_stop=should_stop,
+                  backoff_base_s=cfg.runtime.restart_backoff_base_s,
+                  backoff_max_s=cfg.runtime.restart_backoff_max_s)
     if cfg.actor.envs_per_actor > 1:
-        policy = BatchedActorPolicy(
-            net, params,
-            vector_lane_epsilons(actor_idx, cfg.actor, total_actors),
-            seeds=[seed + lane for lane in range(cfg.actor.envs_per_actor)],
-            copy_updates=copy_updates)
+        epsilons = vector_lane_epsilons(actor_idx, cfg.actor, total_actors)
+        seeds = [seed + lane for lane in range(cfg.actor.envs_per_actor)]
+        if serve:
+            from r2d2_tpu.serve import RemoteBatchedPolicy
+            policy = RemoteBatchedPolicy(
+                serve_channel, net.action_dim, epsilons, seeds,
+                client_base=actor_idx * cfg.actor.envs_per_actor, **kw)
+        else:
+            policy = BatchedActorPolicy(net, params, epsilons, seeds=seeds,
+                                        copy_updates=copy_updates)
         return policy, run_vector_actor
     if epsilon is None:
         epsilon = apex_epsilon(actor_idx,
                                total_actors or cfg.actor.num_actors,
                                cfg.actor.base_eps, cfg.actor.eps_alpha)
-    policy = ActorPolicy(net, params, epsilon, seed=seed,
-                         copy_updates=copy_updates)
+    if serve:
+        from r2d2_tpu.serve import RemotePolicy
+        policy = RemotePolicy(serve_channel, net.action_dim, epsilon,
+                              seed=seed,
+                              client_id=actor_idx * cfg.actor.envs_per_actor,
+                              **kw)
+    else:
+        policy = ActorPolicy(net, params, epsilon, seed=seed,
+                             copy_updates=copy_updates)
     return policy, run_actor
 
 
@@ -115,9 +154,16 @@ def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
                 int(weight_version()), np.int32)))
         wrapped = sink_with_stamp
     if cfg.actor.fault_spec:
-        from r2d2_tpu.tools.chaos import apply_fault, parse_fault_spec
+        from r2d2_tpu.tools.chaos import (SINK_KINDS_LOCAL,
+                                          SINK_KINDS_SERVER, apply_fault,
+                                          parse_fault_spec)
         fault = parse_fault_spec(cfg.actor.fault_spec).get(slot)
-        if fault is not None:
+        # served inference moves slow/disconnect to the REQUEST path
+        # (make_actor_policy wraps the serve channel); only the worker-
+        # process kinds stay at the sink there
+        sink_kinds = (SINK_KINDS_SERVER if cfg.actor.inference == "server"
+                      else SINK_KINDS_LOCAL)
+        if fault is not None and fault.kind in sink_kinds:
             wrapped = apply_fault(wrapped, fault)
     if board is not None:
         def sink_with_heartbeat(block, _wrapped=wrapped):

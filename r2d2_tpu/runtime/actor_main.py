@@ -16,7 +16,7 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                        is_host: bool, port: int,
                        total_actors: int = None,
                        health_board=None, health_slot: int = None,
-                       telemetry_board=None) -> None:
+                       telemetry_board=None, serve_spec: dict = None) -> None:
     # total_actors: the GLOBAL worker-fleet size for the vector ε ladder —
     # multihost spawners pass process_count * num_actors with a global
     # actor_idx; None = single-host (cfg.actor.num_actors)
@@ -50,23 +50,44 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                          num_players=cfg.multiplayer.num_players)
     net = NetworkApply(env.action_space.n, cfg.network, cfg.env.frame_stack,
                        cfg.env.frame_height, cfg.env.frame_width)
-    params = net.init(jax.random.PRNGKey(cfg.runtime.seed))
-    try:
-        sub = WeightSubscriber(shm_name, params)
-    except FileNotFoundError:
-        if stop_event.is_set():
-            env.close()   # parent tore the segments down mid-boot: shutdown
-            return
-        raise
-    fresh = sub.poll()
-    if fresh is not None:
-        params = fresh
+    sub = None
+    serve_channel = None
+    if cfg.actor.inference == "server" and serve_spec is not None:
+        # served inference (ISSUE 13): this worker is a THIN client — no
+        # local params, no weight subscriber. The channel rides the rung
+        # the parent picked: the shm request ring handle crossed the
+        # spawn boundary by name; socket just dials.
+        params = None
+        if serve_spec["transport"] == "shm":
+            from r2d2_tpu.serve import ShmServeChannel
+            serve_channel = ShmServeChannel(
+                serve_spec["request_ring"], serve_spec["action_dim"],
+                serve_spec["hidden_dim"],
+                reply_slots=serve_spec["reply_slots"])
+        else:
+            from r2d2_tpu.serve import SocketChannel
+            serve_channel = SocketChannel(serve_spec["host"],
+                                          serve_spec["port"])
+    else:
+        params = net.init(jax.random.PRNGKey(cfg.runtime.seed))
+        try:
+            sub = WeightSubscriber(shm_name, params)
+        except FileNotFoundError:
+            if stop_event.is_set():
+                env.close()  # parent tore the segments down mid-boot
+                return
+            raise
+        fresh = sub.poll()
+        if fresh is not None:
+            params = fresh
     # copy_updates=False: WeightSubscriber.poll materializes a fresh copy
     # per poll already — the policy may own those buffers directly
     policy, run_loop = make_actor_policy(cfg, net, params, actor_idx, seed,
                                          epsilon=epsilon,
                                          copy_updates=False,
-                                         total_actors=total_actors)
+                                         total_actors=total_actors,
+                                         serve_channel=serve_channel,
+                                         should_stop=stop_event.is_set)
 
     from r2d2_tpu.runtime.actor_loop import instrument_block_sink
     from r2d2_tpu.runtime.feeder import put_patient
@@ -104,8 +125,10 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                               telemetry=tele),
         board=health_board, telemetry=tele,
         # staleness stamp: the publish count of the params this actor is
-        # acting with (the subscriber's last adopted version)
-        weight_version=lambda: sub.publish_count,
+        # acting with — the subscriber's last adopted version locally,
+        # or (served) the server's adopted count riding each reply
+        weight_version=((lambda: policy.weight_version)
+                        if sub is None else (lambda: sub.publish_count)),
         # lane provenance (ISSUE 10): actor_idx is the GLOBAL worker
         # index (multihost fleets pass theirs), matching the ladder
         # layout vector_lane_epsilons spreads ε over
@@ -114,9 +137,17 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
     try:
         run_loop(cfg, env, policy,
                  block_sink=sink,
-                 weight_poll=sub.poll,
+                 weight_poll=(sub.poll if sub is not None
+                              else (lambda: None)),
                  should_stop=stop_event.is_set,
                  telemetry=tele)
+    except Exception:
+        if not stop_event.is_set():
+            raise      # a served policy raising at shutdown is clean-stop
     finally:
         tele.close()
-        sub.close()   # env is closed by the run loop (its finally owns it)
+        if sub is not None:
+            sub.close()
+        if serve_channel is not None:
+            policy.close()
+        # env is closed by the run loop (its finally owns it)

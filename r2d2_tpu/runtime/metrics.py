@@ -114,6 +114,15 @@ class TrainMetrics:
         # to pre-PR9, stability-tested)
         self._costs = None
 
+        # serving plane (ISSUE 13): a serving-block provider
+        # (ServingStats.interval_block, attached by the orchestrating
+        # loop when actor.inference="server" or a standalone server
+        # shares this metrics stream) — called once per log(); a None
+        # return (no serving traffic this interval) omits the key, and
+        # an unattached provider (every local-inference run) leaves the
+        # record byte-identical to the pre-PR13 schema.
+        self._serving_fn = None
+
         # system-health pillar (ISSUE 7): a resources-block provider
         # (ResourceMonitor.block) and the alert engine, both attached by
         # the orchestrating loop. None = the blocks are OMITTED and the
@@ -211,6 +220,14 @@ class TrainMetrics:
         configured step (telemetry/costmodel.analytic_component_costs).
         Emitted on exactly one record then cleared; None = no block."""
         self._costs = block
+
+    def set_serving(self, provider) -> None:
+        """Attach the serving-block provider (ISSUE 13): a callable
+        returning ``ServingStats.interval_block()`` — request/reply
+        counts, latency percentiles, batch-fill histogram summary,
+        client lease churn. Called once per log(); None returns omit
+        the block (consumers key on its presence)."""
+        self._serving_fn = provider
 
     def set_resources(self, provider) -> None:
         """Attach the resources-block provider (ISSUE 7): a callable
@@ -352,6 +369,14 @@ class TrainMetrics:
             # above are unaffected either way (schema-stability-tested).
             record["stages"] = self.telemetry.interval_summary()
             record["telemetry_dropped_spans"] = self.telemetry.spans.dropped
+        if self._serving_fn is not None:
+            # serving block (ISSUE 13): request latency / batch fill /
+            # client churn for the interval. Before the sentinel pass so
+            # the serve_* rules see their own interval; a no-traffic
+            # interval returns None and the key is omitted.
+            serving = self._serving_fn()
+            if serving is not None:
+                record["serving"] = serving
         if self._resources_fn is not None:
             # machine-side block (ISSUE 7): devices/host/buffer footprints
             # + the compile sub-block. Before the sentinel, which reads it.

@@ -79,6 +79,30 @@ class PlayerStack:
         self.resources = None
         self.compile_monitor = None
         self.sentinel = None
+        # central policy inference service (ISSUE 13): in server mode the
+        # stack owns ONE PolicyServer + its endpoint/stats; the endpoint
+        # and transports OUTLIVE server restarts (the chaos drill swaps
+        # only the server object via restart_serve_server). The stats
+        # aggregator is shared with in-proc clients so the periodic
+        # record's 'serving' block carries CLIENT-visible latencies.
+        self.serve_stats = None
+        self.serve_endpoint = None
+        self.serve_server = None
+        self._serve_transport = None
+        self._serve_weight_sub = None
+        self._serve_weight_poll = None
+        self._serve_weight_version = None
+        self._serve_copy_updates = True
+        self._serve_client_timed = True
+        self._serve_spec = None
+        if cfg.actor.inference == "server":
+            from r2d2_tpu.serve import InprocEndpoint, ServingStats
+            self.serve_stats = ServingStats()
+            self.serve_endpoint = InprocEndpoint()
+            self.metrics.set_serving(
+                lambda: self.serve_stats.interval_block(
+                    deadline_ms=cfg.serve.deadline_ms,
+                    max_batch=cfg.serve.max_batch))
         # LAST: telemetry board shm + the span-drain's file I/O. Anything
         # raising after an shm allocation would leak the segment (train()
         # only closes stacks that made it into its list), so the file I/O
@@ -159,6 +183,31 @@ class PlayerStack:
         the per-player-job multihost path via MultiplayerConfig.env_args)."""
         return self.cfg.multiplayer.env_args(self.player_idx, actor_idx)
 
+    def _start_serve_server(self) -> None:
+        """(Re)build the policy server against the persistent endpoint —
+        the ONE construction path for cold start and the chaos drill's
+        restart (the replacement adopts the learner's CURRENT params and
+        the same weight-service reader)."""
+        from r2d2_tpu.serve import PolicyServer
+        self.serve_server = PolicyServer(
+            self.cfg, self.net, self.learner.train_state.params,
+            endpoint=self.serve_endpoint,
+            weight_poll=self._serve_weight_poll,
+            weight_version=self._serve_weight_version,
+            copy_updates=self._serve_copy_updates,
+            stats=self.serve_stats, telemetry=self.telemetry,
+            client_timed=self._serve_client_timed).start()
+
+    def restart_serve_server(self) -> None:
+        """Replace a (possibly dead) server with a fresh one on the same
+        endpoint; connected clients reconnect transparently (their
+        retries drain into the replacement; the lost state cache resets
+        served episodes to the episode-initial state, the same grace as
+        an eviction)."""
+        if self.serve_server is not None:
+            self.serve_server.stop()
+        self._start_serve_server()
+
     def start_actors_threads(self, stop: threading.Event) -> None:
         cfg = self.cfg
         self.store = InProcWeightStore(self.learner.train_state.params)
@@ -168,6 +217,17 @@ class PlayerStack:
         self.learner.weight_version_fn = lambda: self.store.publish_count
         self.queue = BlockQueue(use_mp=False)
         self._stop = stop
+        if self.serve_endpoint is not None:
+            # thread-mode serving: the server polls the in-proc store
+            # under its own reader id; clients share the stats object so
+            # the serving block's latency is the CLIENT-visible round
+            # trip (the SLO the chaos drill fires on)
+            self._serve_weight_poll = lambda: self.store.poll("serve")
+            self._serve_weight_version = \
+                lambda: self.store.reader_version("serve")
+            self._serve_copy_updates = True
+            self._serve_client_timed = True
+            self._start_serve_server()
         for i in range(cfg.actor.num_actors):
             self._spawn_thread_actor(i)
 
@@ -183,8 +243,6 @@ class PlayerStack:
                              env_factory=create_env,
                              num_players=cfg.multiplayer.num_players,
                              **self.actor_env_args(i))
-        policy, run_loop = make_actor_policy(
-            cfg, self.net, self.learner.train_state.params, i, seed)
 
         # per-spawn cancel event: the hang watchdog cannot kill a thread,
         # so it sets this and abandons the incarnation — a thread that
@@ -195,8 +253,27 @@ class PlayerStack:
         def should_stop(cancel=cancel):
             return self._stop.is_set() or cancel.is_set()
 
+        serve_channel = (self.serve_endpoint.connect()
+                         if self.serve_endpoint is not None else None)
+        policy, run_loop = make_actor_policy(
+            cfg, self.net, self.learner.train_state.params, i, seed,
+            serve_channel=serve_channel, serve_stats=self.serve_stats,
+            should_stop=should_stop)
+
         from r2d2_tpu.runtime.actor_loop import instrument_block_sink
         self.heartbeats.reset_slot(i)
+        if serve_channel is not None:
+            # served inference: the SERVER owns weight sync; the block's
+            # staleness stamp is the publish count riding each reply
+            weight_version = lambda: policy.weight_version  # noqa: E731
+            weight_poll = lambda: None                      # noqa: E731
+        else:
+            # generation stamp: the store version this thread actor last
+            # adopted (reader_id = slot index, matching weight_poll)
+            weight_version = (
+                lambda reader_id=i: self.store.reader_version(reader_id))
+            weight_poll = (
+                lambda reader_id=i: self.store.poll(reader_id))
         sink = instrument_block_sink(
             cfg, i,
             lambda b: self.queue.put_patient(
@@ -204,22 +281,27 @@ class PlayerStack:
                 beat=lambda: self.heartbeats.touch(i),
                 telemetry=self.telemetry),
             board=self.heartbeats, telemetry=self.telemetry,
-            # generation stamp: the store version this thread actor last
-            # adopted (reader_id = slot index, matching weight_poll below)
-            weight_version=lambda: self.store.reader_version(i),
+            weight_version=weight_version,
             # lane provenance (ISSUE 10): worker i owns the contiguous
             # global-ladder slice [i*k, (i+1)*k) — the same layout
             # vector_lane_epsilons spreads ε over
             lane_base=i * cfg.actor.envs_per_actor)
 
-        def loop(env=env, policy=policy, run_loop=run_loop, reader_id=i,
-                 sink=sink, should_stop=should_stop):
+        def loop(env=env, policy=policy, run_loop=run_loop,
+                 weight_poll=weight_poll, sink=sink,
+                 should_stop=should_stop):
             # the run loop owns env and closes it on every exit
-            run_loop(cfg, env, policy,
-                     block_sink=sink,
-                     weight_poll=lambda: self.store.poll(reader_id),
-                     should_stop=should_stop,
-                     telemetry=self.telemetry)
+            try:
+                run_loop(cfg, env, policy,
+                         block_sink=sink,
+                         weight_poll=weight_poll,
+                         should_stop=should_stop,
+                         telemetry=self.telemetry)
+            except Exception:
+                # a served policy raising ServeUnavailable DURING
+                # shutdown is the clean-stop path, not a failure
+                if not should_stop():
+                    raise
 
         t = threading.Thread(target=loop, daemon=True,
                              name=f"actor-p{self.player_idx}-{i}")
@@ -242,8 +324,67 @@ class PlayerStack:
             use_mp=True, ctx=self._ctx,
             shm_spec=self.learner.spec if cfg.runtime.shm_transport else None)
         self._stop = stop_event
+        if self.serve_endpoint is not None:
+            self._start_serve_transport()
         for i in range(cfg.actor.num_actors):
             self._spawn_process_actor(i)
+
+    def _start_serve_transport(self) -> None:
+        """Process-mode serving: the server lives in THIS (learner)
+        process and actor processes reach it over the transport ladder —
+        the shm request/reply rings by default (the shm_feeder
+        discipline), TCP loopback when forced or when the native
+        toolchain is unavailable. The server reads weights through a
+        WeightSubscriber on the existing publisher segment (one more
+        reader, zero new mechanisms)."""
+        cfg = self.cfg
+        from r2d2_tpu.runtime.weights import WeightSubscriber
+        sub = WeightSubscriber(self.publisher.name,
+                               self.learner.train_state.params)
+        self._serve_weight_sub = sub
+        self._serve_weight_poll = sub.poll
+        self._serve_weight_version = lambda: sub.publish_count
+        # WeightSubscriber.poll materializes a fresh copy per poll — the
+        # server may own those buffers directly (actor_main's reasoning)
+        self._serve_copy_updates = False
+        # clients are in other processes: the server times request
+        # latency itself (receive→reply; client timeouts still reach the
+        # histogram through the chaos drill's in-proc path)
+        self._serve_client_timed = False
+        reply_slots = max(cfg.serve.reply_ring_slots,
+                          cfg.actor.envs_per_actor)
+        if cfg.serve.transport in ("auto", "shm"):
+            try:
+                from r2d2_tpu.serve import ShmServeTransport
+                self._serve_transport = ShmServeTransport(
+                    self.serve_endpoint.submit,
+                    (cfg.env.frame_height, cfg.env.frame_width),
+                    self.net.action_dim, cfg.network.hidden_dim,
+                    request_slots=cfg.serve.request_ring_slots)
+                self._serve_spec = {
+                    "transport": "shm",
+                    "request_ring": self._serve_transport.request_ring,
+                    "action_dim": self.net.action_dim,
+                    "hidden_dim": cfg.network.hidden_dim,
+                    "reply_slots": reply_slots,
+                }
+            except Exception as e:
+                if cfg.serve.transport == "shm":
+                    raise
+                import logging
+                logging.getLogger(__name__).warning(
+                    "native shm serve transport unavailable (%s); "
+                    "falling back to TCP loopback", e)
+        if self._serve_spec is None:
+            from r2d2_tpu.serve import SocketServerTransport
+            self._serve_transport = SocketServerTransport(
+                self.serve_endpoint.submit, cfg.serve.host, cfg.serve.port)
+            self._serve_spec = {
+                "transport": "socket",
+                "host": self._serve_transport.host,
+                "port": self._serve_transport.port,
+            }
+        self._start_serve_server()
 
     def _spawn_process_actor(self, i: int) -> mp.Process:
         cfg = self.cfg
@@ -260,7 +401,8 @@ class PlayerStack:
                   self.publisher.name, self.queue._q, self._stop),
             kwargs={**self.actor_env_args(i),
                     "health_board": self.heartbeats, "health_slot": i,
-                    "telemetry_board": self.tele_board},
+                    "telemetry_board": self.tele_board,
+                    "serve_spec": self._serve_spec},
             daemon=True, name=f"actor-p{self.player_idx}-{i}")
         p.start()
         if i < len(self.processes):
@@ -345,6 +487,12 @@ class PlayerStack:
 
     def close(self) -> None:
         self.learner.stop_background()
+        if self.serve_server is not None:
+            self.serve_server.stop()
+        if self._serve_transport is not None:
+            self._serve_transport.close()
+        if self._serve_weight_sub is not None:
+            self._serve_weight_sub.close()
         if self.publisher is not None:
             self.publisher.close()
         for p in self.processes:

@@ -1,0 +1,32 @@
+"""Central policy inference service (ISSUE 13): micro-batched policy
+server with server-side recurrent state — the SEED-style serving plane.
+
+    transport.py   — the rung ladder: in-proc queue, shm record rings
+                     (the shm_feeder discipline), TCP sockets
+    state_cache.py — sharded per-client LSTM/frame-stack cache with
+                     lease/evict/reconnect semantics
+    server.py      — the micro-batcher + jitted forward loop, ServingStats
+    client.py      — RemotePolicy / RemoteBatchedPolicy (the local
+                     policies' surface, served)
+"""
+
+from r2d2_tpu.serve.client import RemoteBatchedPolicy, RemotePolicy
+from r2d2_tpu.serve.server import (PolicyServer, ServingStats, collect_batch,
+                                   serve_buckets)
+from r2d2_tpu.serve.state_cache import StateCache
+from r2d2_tpu.serve.transport import (InprocChannel, InprocEndpoint,
+                                      KIND_BOOTSTRAP, KIND_DISCONNECT,
+                                      KIND_STEP, Reply, Request,
+                                      ServeTimeout, ServeUnavailable,
+                                      ShmRecordRing, ShmServeChannel,
+                                      ShmServeTransport, SocketChannel,
+                                      SocketServerTransport)
+
+__all__ = [
+    "RemoteBatchedPolicy", "RemotePolicy", "PolicyServer", "ServingStats",
+    "collect_batch", "serve_buckets", "StateCache", "InprocChannel",
+    "InprocEndpoint", "KIND_BOOTSTRAP", "KIND_DISCONNECT", "KIND_STEP",
+    "Reply", "Request", "ServeTimeout", "ServeUnavailable", "ShmRecordRing",
+    "ShmServeChannel", "ShmServeTransport", "SocketChannel",
+    "SocketServerTransport",
+]

@@ -1,0 +1,310 @@
+"""Thin serving clients: the local acting policies' surface over a
+request/reply channel.
+
+``RemotePolicy`` mirrors ``ActorPolicy`` and ``RemoteBatchedPolicy``
+mirrors ``BatchedActorPolicy`` (actor/policy.py) method-for-method, so
+the existing run loops (runtime/actor_loop.py) drive served inference
+UNCHANGED — ``actor.inference="server"`` swaps the policy object and
+nothing else. The division of labor:
+
+  * server-side: frame stack, LSTM hidden, last action (the state
+    cache), the batched forward, weight sync;
+  * client-side: the ε-greedy draw. The RNG stream and draw order are
+    EXACTLY the local policy's (one uniform per step, one integer draw
+    only when exploring), which is half of the action-parity guarantee —
+    the other half is the shared forward factory the server runs.
+
+State mutations (observe/observe_reset) are buffered and piggybacked
+onto the next forward request, so they cost no extra round trip.
+
+Failure handling: a timed-out request backs off on the PR-3
+``WorkerHealth`` ladder (breaker disabled — a serving client retries
+until ``max_retry_s``, then raises ``ServeUnavailable`` so worker
+supervision takes over), reconnects its channel, and RESENDS the
+buffered state with the retry. The reply carries the server's adopted
+weight publish count, which the client exposes as ``weight_version`` —
+the staleness stamp instrument_block_sink records on every block, kept
+live in served mode.
+"""
+
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from r2d2_tpu.serve.transport import (KIND_BOOTSTRAP, KIND_STEP, Reply,
+                                      Request, STATUS_OK, ServeTimeout,
+                                      ServeUnavailable)
+
+
+class _Lane:
+    """One client identity's pending-mutation buffer + op/req counters.
+    ``op_seq`` advances once per LOGICAL operation (``begin_op``) and is
+    stable across retries, which is what lets the server dedup a retried
+    op whose first copy was applied but whose reply was lost; ``req_seq``
+    advances per ATTEMPT so every wire request has a fresh id."""
+
+    __slots__ = ("client_id", "req_seq", "op_seq", "pending_reset",
+                 "pending_obs", "pending_action")
+
+    def __init__(self, client_id: int):
+        self.client_id = int(client_id)
+        self.req_seq = 0
+        self.op_seq = 0
+        self.pending_reset: Optional[np.ndarray] = None
+        self.pending_obs: Optional[np.ndarray] = None
+        self.pending_action: int = -1
+
+    def begin_op(self) -> None:
+        self.op_seq += 1
+
+    def build(self, kind: int) -> Request:
+        self.req_seq += 1
+        # req_id is globally unique per channel exchange: lane id in the
+        # high bits so pipelined lanes on one channel never collide
+        req = Request(client_id=self.client_id,
+                      req_id=(self.client_id << 32) | self.req_seq,
+                      kind=kind, op_seq=self.op_seq,
+                      t_submit=time.monotonic())
+        if self.pending_reset is not None:
+            req.reset_obs = self.pending_reset
+        elif self.pending_obs is not None:
+            req.obs = self.pending_obs
+            req.action = self.pending_action
+        return req
+
+    def clear(self) -> None:
+        self.pending_reset = None
+        self.pending_obs = None
+        self.pending_action = -1
+
+    def observe_reset(self, obs: np.ndarray) -> None:
+        self.pending_reset = np.ascontiguousarray(obs, np.uint8)
+        self.pending_obs = None
+
+    def observe(self, obs: np.ndarray, action: int) -> None:
+        # an unsent reset wins (reset clears the stack server-side; an
+        # observe cannot follow it before the next forward in the local
+        # protocol, but be defensive about ordering)
+        if self.pending_reset is None:
+            self.pending_obs = np.ascontiguousarray(obs, np.uint8)
+            self.pending_action = int(action)
+
+
+class _RetryPolicy:
+    """Reconnect backoff on the PR-3 WorkerHealth ladder (one slot, no
+    breaker): first retry immediate, then exponential up to the cap."""
+
+    def __init__(self, backoff_base_s: float = 0.25,
+                 backoff_max_s: float = 5.0):
+        from r2d2_tpu.runtime.feeder import WorkerHealth
+        self.health = WorkerHealth(
+            1, None, backoff_base_s=backoff_base_s,
+            backoff_max_s=backoff_max_s, max_restarts_per_window=0)
+
+    def on_failure(self) -> None:
+        self.health.on_failure(0, time.time())
+
+    def wait(self, should_stop: Optional[Callable[[], bool]] = None) -> None:
+        while not self.health.respawn_due(0, time.time()):
+            if should_stop is not None and should_stop():
+                return
+            time.sleep(0.05)
+
+
+class _RemoteBase:
+    def __init__(self, channel, action_dim: int, *, stats=None,
+                 timeout_s: float = 5.0, max_retry_s: float = 60.0,
+                 backoff_base_s: float = 0.25, backoff_max_s: float = 5.0,
+                 should_stop: Optional[Callable[[], bool]] = None):
+        self.channel = channel
+        self.action_dim = int(action_dim)
+        self.stats = stats
+        self.timeout_s = timeout_s
+        self.max_retry_s = max_retry_s
+        self._retry = _RetryPolicy(backoff_base_s, backoff_max_s)
+        self._should_stop = should_stop
+        self.weight_version = 0
+        self.timeouts = 0
+        self.reconnects = 0
+
+    def update_params(self, params) -> None:
+        """No-op: the server owns (and syncs) the weights."""
+
+    def _exchange_many(self, lanes: List[_Lane],
+                       kind: int) -> List[Reply]:
+        """Pipelined request/reply for every lane, with per-lane retries
+        on the backoff ladder. Mutation buffers are rebuilt into each
+        attempt and cleared only on an OK reply — a request the server
+        expired (never applied) keeps its mutation for the resend."""
+        t0 = time.monotonic()
+        for lane in lanes:
+            lane.begin_op()        # one logical op per lane per exchange
+        reqs = {lane.client_id: lane.build(kind) for lane in lanes}
+        out: dict = {}
+        while True:
+            pending_lanes = [lane for lane in lanes
+                             if lane.client_id not in out]
+            if not pending_lanes:
+                break
+            got = self.channel.request_many(
+                [reqs[lane.client_id] for lane in pending_lanes],
+                timeout=self.timeout_s)
+            now = time.monotonic()
+            missing, expired = [], []
+            for lane in pending_lanes:
+                reply = got.get(reqs[lane.client_id].req_id)
+                if reply is None:
+                    missing.append(lane)
+                elif reply.status == STATUS_OK:
+                    out[lane.client_id] = reply
+                else:
+                    expired.append(lane)
+            if now - t0 > self.max_retry_s and (missing or expired):
+                raise ServeUnavailable(
+                    f"policy server unreachable for {now - t0:.1f}s")
+            if self._should_stop is not None and self._should_stop() \
+                    and (missing or expired):
+                raise ServeUnavailable("stopped while retrying")
+            # EXPIRED: the server is alive but judged the request stale
+            # (its TTL guards against replaying a dead server's backlog)
+            # and did NOT apply the op — rebuild with a fresh id and
+            # resend, paced on the backoff ladder (no reconnect: the
+            # channel is fine) so a persistently-expiring condition
+            # cannot busy-spin the core at full request rate
+            for lane in expired:
+                reqs[lane.client_id] = lane.build(kind)
+            if expired and not missing:
+                self._retry.on_failure()
+                self._retry.wait(self._should_stop)
+            if missing:
+                self.timeouts += len(missing)
+                if self.stats is not None:
+                    for _ in missing:
+                        self.stats.on_timeout(self.timeout_s)
+                self._retry.on_failure()
+                self._retry.wait(self._should_stop)
+                self.channel.reconnect()
+                self.reconnects += 1
+                # fresh req ids for the retries: the old copies may still
+                # be processed late; TTL expiry discards them server-side
+                for lane in missing:
+                    reqs[lane.client_id] = lane.build(kind)
+        elapsed = time.monotonic() - t0
+        if self.stats is not None:
+            for _ in lanes:
+                self.stats.on_request_latency(elapsed)
+        replies = []
+        for lane in lanes:
+            reply = out[lane.client_id]
+            lane.clear()
+            self.weight_version = reply.weight_version
+            replies.append(reply)
+        return replies
+
+    def close(self) -> None:
+        try:
+            for lane in self._lanes():
+                self.channel.disconnect(lane.client_id)
+            self.channel.close()
+        except Exception:
+            pass
+
+    def _lanes(self) -> List[_Lane]:
+        raise NotImplementedError
+
+
+class RemotePolicy(_RemoteBase):
+    """``ActorPolicy`` over a serve channel — drop-in for ``run_actor``."""
+
+    def __init__(self, channel, action_dim: int, epsilon: float,
+                 seed: int = 0, client_id: int = 0, **kw):
+        super().__init__(channel, action_dim, **kw)
+        self.epsilon = float(epsilon)
+        self.rng = np.random.default_rng(seed)
+        self._lane = _Lane(client_id)
+
+    def _lanes(self) -> List[_Lane]:
+        return [self._lane]
+
+    def reset_state(self) -> None:
+        self._lane.clear()
+
+    def observe_reset(self, obs: np.ndarray) -> None:
+        self._lane.observe_reset(obs)
+
+    def observe(self, obs: np.ndarray, action: int) -> None:
+        self._lane.observe(obs, action)
+
+    def step(self) -> Tuple[int, np.ndarray, np.ndarray]:
+        (reply,) = self._exchange_many([self._lane], KIND_STEP)
+        return int(reply.action), np.asarray(reply.q), \
+            np.asarray(reply.hidden)
+
+    def act(self) -> Tuple[int, np.ndarray, np.ndarray]:
+        action, q, hidden = self.step()
+        if self.rng.random() < self.epsilon:
+            action = int(self.rng.integers(self.action_dim))
+        return action, q, hidden
+
+    def bootstrap_q(self) -> np.ndarray:
+        (reply,) = self._exchange_many([self._lane], KIND_BOOTSTRAP)
+        return np.asarray(reply.q)
+
+
+class RemoteBatchedPolicy(_RemoteBase):
+    """``BatchedActorPolicy`` over a serve channel — drop-in for
+    ``run_vector_actor``. Each lane is its own server-side client
+    (``client_base + lane``, the global ε-ladder position), and every
+    tick pipelines all N requests before collecting any reply — N lanes
+    arriving together are exactly what fills the server's micro-batch."""
+
+    def __init__(self, channel, action_dim: int,
+                 epsilons: Sequence[float], seeds: Sequence[int],
+                 client_base: int = 0, **kw):
+        super().__init__(channel, action_dim, **kw)
+        if len(epsilons) != len(seeds):
+            raise ValueError(
+                f"epsilons ({len(epsilons)}) and seeds ({len(seeds)}) must "
+                "have one entry per lane")
+        self.num_lanes = len(epsilons)
+        self.epsilons = np.asarray(epsilons, np.float64)
+        self.rngs = [np.random.default_rng(s) for s in seeds]
+        self._lane_list = [_Lane(client_base + i)
+                           for i in range(self.num_lanes)]
+
+    def _lanes(self) -> List[_Lane]:
+        return self._lane_list
+
+    def reset_state(self) -> None:
+        for lane in self._lane_list:
+            lane.clear()
+
+    def reset_lane(self, lane: int) -> None:
+        self._lane_list[lane].clear()
+
+    def observe_reset_lane(self, lane: int, obs: np.ndarray) -> None:
+        self._lane_list[lane].observe_reset(obs)
+
+    def observe(self, obs: np.ndarray, actions: np.ndarray) -> None:
+        for i, lane in enumerate(self._lane_list):
+            lane.observe(obs[i], int(actions[i]))
+
+    def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        replies = self._exchange_many(self._lane_list, KIND_STEP)
+        actions = np.asarray([r.action for r in replies], np.int64)
+        q = np.stack([np.asarray(r.q) for r in replies])
+        hidden = np.stack([np.asarray(r.hidden) for r in replies])
+        return actions, q, hidden
+
+    def act(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        actions, q, hidden = self.step()
+        actions = np.array(actions)
+        for i, rng in enumerate(self.rngs):
+            if rng.random() < self.epsilons[i]:
+                actions[i] = int(rng.integers(self.action_dim))
+        return actions, q, hidden
+
+    def bootstrap_q(self) -> np.ndarray:
+        replies = self._exchange_many(self._lane_list, KIND_BOOTSTRAP)
+        return np.stack([np.asarray(r.q) for r in replies])

@@ -1,0 +1,702 @@
+"""Request/reply transports for the central policy inference service.
+
+The serving plane moves tiny fixed-shape records — one observation frame
+up, one (action, Q, hidden) down — at env-step cadence, so the transport
+ladder mirrors the experience path's (ISSUE 2) but for request/response:
+
+  * ``InprocEndpoint``   — thread-mode clients in the server's process:
+    a plain queue of (Request, reply_fn) pairs. The endpoint OUTLIVES
+    server restarts (the chaos drill kills and restarts the server loop
+    against the same endpoint), which is what makes in-proc reconnect
+    trivial: clients keep submitting, the replacement server drains.
+  * ``ShmServeTransport`` / ``ShmServeChannel`` — process-mode clients on
+    the same host: the shm_feeder ring discipline (native Vyukov MPMC
+    ring, one memcpy per side) applied to fixed-layout request records;
+    each client owns a small private REPLY ring whose name rides in every
+    request, so the server routes replies without a connection registry.
+  * ``SocketServerTransport`` / ``SocketChannel`` — cross-host clients:
+    length-prefixed pickle over TCP, one connection per client process,
+    replies matched by ``req_id`` so pipelined lanes may complete out of
+    order.
+
+All three deliver into ONE server inbox; the micro-batcher
+(serve/server.py) neither knows nor cares which rung a request climbed.
+"""
+
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Request kinds. STEP advances the client's server-held recurrent state
+# (the local policy's ``step``); BOOTSTRAP runs the forward WITHOUT
+# advancing it (the block-boundary ``bootstrap_q``); DISCONNECT releases
+# the client's state-slot lease (state retained until the lease times
+# out, so a reconnect resumes mid-episode).
+KIND_STEP, KIND_BOOTSTRAP, KIND_DISCONNECT = 0, 1, 2
+STATUS_OK, STATUS_EXPIRED = 0, 1
+
+# shm layout: reply-ring names are materialized into a fixed char field
+_REPLY_NAME_BYTES = 48
+
+
+class ServeTimeout(Exception):
+    """A request saw no reply inside the client timeout (server busy,
+    dead, or mid-restart) — the client backs off and retries."""
+
+
+class ServeUnavailable(Exception):
+    """Retries exhausted (``max_retry_s``): the server stayed unreachable
+    long enough that the caller should fail loudly and let worker
+    supervision take over (respawn with backoff, breaker)."""
+
+
+@dataclass
+class Request:
+    """One client→server message. ``reset_obs``/``obs`` piggyback the
+    local policy's state mutations (observe_reset / observe) onto the
+    next forward request, so pure state updates never cost a round
+    trip."""
+
+    client_id: int
+    req_id: int
+    kind: int = KIND_STEP
+    t_submit: float = 0.0          # client time.monotonic (informational)
+    # Logical operation number, incremented ONCE per client step()/
+    # bootstrap() — STABLE across retries of the same op (req_id is
+    # fresh per attempt). The server dedups on it: a retried op whose
+    # first copy was already applied replays the CACHED reply instead
+    # of re-advancing state (idempotent RPC). -1 = no dedup.
+    op_seq: int = -1
+    reset_obs: Optional[np.ndarray] = None   # (H, W) uint8 episode start
+    obs: Optional[np.ndarray] = None         # (H, W) uint8 pending frame
+    action: int = -1                          # pending observe action
+    reply_to: str = ""             # shm: the client's reply-ring name
+    t_recv: float = 0.0            # server-side arrival stamp (monotonic —
+    #                                the TTL clock: comparable across
+    #                                processes AND hosts, unlike t_submit)
+
+
+@dataclass
+class Reply:
+    req_id: int
+    status: int = STATUS_OK
+    action: int = -1
+    q: Optional[np.ndarray] = None           # (A,) f32
+    hidden: Optional[np.ndarray] = None      # (2, hidden) f32 post-step
+    weight_version: int = 0        # server's adopted publish count
+
+
+# ---------------------------------------------------------------------------
+# In-proc rung.
+
+
+class _ReplyBox:
+    __slots__ = ("event", "reply")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply: Optional[Reply] = None
+
+    def set(self, reply: Reply) -> None:
+        self.reply = reply
+        self.event.set()
+
+
+class InprocEndpoint:
+    """The server's inbox + the thread-mode client rendezvous. Created
+    ONCE by the orchestrating process and shared by every client channel
+    and every server incarnation — a server restart attaches to the same
+    endpoint, so in-flight requests survive the gap (bounded by the
+    request TTL, which the replacement server enforces)."""
+
+    def __init__(self, maxsize: int = 0):
+        self.inbox: "queue.Queue[Tuple[Request, Callable]]" = \
+            queue.Queue(maxsize)
+
+    def submit(self, req: Request, reply_cb: Callable[[Reply], None]) -> None:
+        req.t_recv = time.monotonic()
+        self.inbox.put((req, reply_cb))
+
+    def submit_many(self, items) -> None:
+        """Bulk submit under ONE lock acquisition: a batched client's N
+        pipelined lanes land in the inbox atomically, so the server's
+        fill loop sees the whole tick at once instead of N arrivals
+        interleaved with its own wakeups (measured as several ms of
+        arrival spread per tick on a contended host)."""
+        now = time.monotonic()
+        for req, _cb in items:
+            req.t_recv = now
+        with self.inbox.mutex:
+            self.inbox.queue.extend(items)
+            self.inbox.not_empty.notify()
+
+    def connect(self) -> "InprocChannel":
+        return InprocChannel(self)
+
+
+class InprocChannel:
+    """Thread-mode client channel: submit into the endpoint queue, block
+    on a per-request reply box. Pipelining (request_many) submits every
+    lane before collecting any reply — the shape that fills the server's
+    micro-batch."""
+
+    def __init__(self, endpoint: InprocEndpoint):
+        self._ep = endpoint
+
+    def submit(self, req: Request) -> _ReplyBox:
+        box = _ReplyBox()
+        self._ep.submit(req, box.set)
+        return box
+
+    def collect(self, box: _ReplyBox, timeout: float) -> Reply:
+        if not box.event.wait(timeout):
+            raise ServeTimeout("no reply within timeout")
+        return box.reply
+
+    def request(self, req: Request, timeout: float = 5.0) -> Reply:
+        return self.collect(self.submit(req), timeout)
+
+    def request_many(self, reqs: List[Request],
+                     timeout: float = 5.0) -> Dict[int, Reply]:
+        boxes = [_ReplyBox() for _ in reqs]
+        self._ep.submit_many(list(zip(reqs, [b.set for b in boxes])))
+        deadline = time.monotonic() + timeout
+        out: Dict[int, Reply] = {}
+        for r, box in zip(reqs, boxes):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not box.event.wait(remaining):
+                continue            # missing replies: the caller retries
+            out[r.req_id] = box.reply
+        return out
+
+    def reconnect(self) -> None:
+        """Nothing to re-dial in-process; the endpoint persists."""
+
+    def disconnect(self, client_id: int) -> None:
+        """Best-effort lease release (fire and forget)."""
+        self._ep.submit(Request(client_id=client_id, req_id=-1,
+                                kind=KIND_DISCONNECT,
+                                t_submit=time.monotonic()),
+                        lambda _reply: None)
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Socket rung (cross-host): length-prefixed pickle frames.
+
+
+def _send_frame(sock: socket.socket, obj, lock: threading.Lock) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    with lock:
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket):
+    (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class SocketServerTransport:
+    """TCP listener feeding the server inbox: one reader thread per
+    connection; replies go back over the same connection under a per-
+    connection send lock (batched replies from the server thread may
+    interleave with nothing else, but the lock keeps frames atomic)."""
+
+    def __init__(self, submit: Callable[[Request, Callable], None],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._submit = submit
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.25)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._conns: List[socket.socket] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="serve-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(None)
+            self._conns.append(conn)
+            threading.Thread(target=self._reader_loop, args=(conn,),
+                             daemon=True, name="serve-conn").start()
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        lock = threading.Lock()
+
+        def reply_cb(reply: Reply, _conn=conn, _lock=lock):
+            try:
+                _send_frame(_conn, reply, _lock)
+            except OSError:
+                pass               # client went away; lease expiry cleans up
+
+        try:
+            while not self._stop.is_set():
+                req = _recv_frame(conn)
+                self._submit(req, reply_cb)
+        except (ConnectionError, OSError, EOFError, pickle.PickleError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._accept_thread.join(timeout=2.0)
+
+
+class SocketChannel:
+    """Client channel over TCP. Lazily (re)dials; replies are matched by
+    ``req_id`` (a stash absorbs out-of-order completions when lanes are
+    pipelined). Every socket failure surfaces as ``ServeTimeout`` so the
+    caller's one retry/backoff path covers dead server, mid-restart, and
+    plain slowness alike."""
+
+    def __init__(self, host: str, port: int, dial_timeout: float = 2.0):
+        self._addr = (host, port)
+        self._dial_timeout = dial_timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._stash: Dict[int, Reply] = {}
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self._addr,
+                                         timeout=self._dial_timeout)
+            s.settimeout(self._dial_timeout)
+            self._sock = s
+            self._stash.clear()
+        return self._sock
+
+    def _recv_until(self, req_id: int, deadline: float) -> Reply:
+        while True:
+            if req_id in self._stash:
+                return self._stash.pop(req_id)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ServeTimeout("no reply within timeout")
+            sock = self._ensure()
+            sock.settimeout(remaining)
+            reply = _recv_frame(sock)
+            if reply.req_id == req_id:
+                return reply
+            self._stash[reply.req_id] = reply
+
+    def request(self, req: Request, timeout: float = 5.0) -> Reply:
+        deadline = time.monotonic() + timeout
+        try:
+            _send_frame(self._ensure(), req, self._lock)
+            return self._recv_until(req.req_id, deadline)
+        except (ConnectionError, OSError, EOFError, socket.timeout) as e:
+            self.reconnect()
+            raise ServeTimeout(str(e)) from None
+
+    def request_many(self, reqs: List[Request],
+                     timeout: float = 5.0) -> Dict[int, Reply]:
+        deadline = time.monotonic() + timeout
+        out: Dict[int, Reply] = {}
+        try:
+            sock = self._ensure()
+            for r in reqs:
+                _send_frame(sock, r, self._lock)
+            for r in reqs:
+                out[r.req_id] = self._recv_until(r.req_id, deadline)
+        except (ConnectionError, OSError, EOFError, socket.timeout,
+                ServeTimeout):
+            # partial results are fine — the caller retries the missing
+            self.reconnect()
+        return out
+
+    def reconnect(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def disconnect(self, client_id: int) -> None:
+        try:
+            _send_frame(self._ensure(),
+                        Request(client_id=client_id, req_id=-1,
+                                kind=KIND_DISCONNECT,
+                                t_submit=time.monotonic()), self._lock)
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        self.reconnect()
+
+
+# ---------------------------------------------------------------------------
+# Shm rung (same host, cross process): the shm_feeder ring discipline over
+# fixed-layout request/reply records.
+
+
+def request_layout(h: int, w: int) -> List[Tuple[str, tuple, np.dtype]]:
+    """(field, shape, dtype) of one request slot — the serve twin of
+    shm_feeder.block_layout, derived once so client and server views of
+    the ring cannot drift (both sides build it from the same config)."""
+    return [("client_id", (), np.dtype(np.int64)),
+            ("req_id", (), np.dtype(np.int64)),
+            ("kind", (), np.dtype(np.int64)),
+            ("op_seq", (), np.dtype(np.int64)),
+            ("action", (), np.dtype(np.int64)),
+            ("flags", (), np.dtype(np.int64)),     # bit0 reset, bit1 observe
+            ("t_submit", (), np.dtype(np.float64)),
+            ("reply_to", (_REPLY_NAME_BYTES,), np.dtype(np.uint8)),
+            ("reset_obs", (h, w), np.dtype(np.uint8)),
+            ("obs", (h, w), np.dtype(np.uint8))]
+
+
+def reply_layout(action_dim: int,
+                 hidden_dim: int) -> List[Tuple[str, tuple, np.dtype]]:
+    return [("req_id", (), np.dtype(np.int64)),
+            ("status", (), np.dtype(np.int64)),
+            ("action", (), np.dtype(np.int64)),
+            ("weight_version", (), np.dtype(np.int64)),
+            ("q", (action_dim,), np.dtype(np.float32)),
+            ("hidden", (2, hidden_dim), np.dtype(np.float32))]
+
+
+@dataclass
+class _Field:
+    name: str
+    shape: tuple
+    dtype: np.dtype
+    offset: int
+    nbytes: int
+
+
+class ShmRecordRing:
+    """Generic fixed-record MPMC ring over the native shm ring
+    (native/shm_ring.cc) — ``ShmBlockRing`` with the layout injected
+    instead of derived from the Block schema, so the serving plane's
+    request and reply records ride the same reserve/commit discipline.
+    Picklable by name like the block ring: the creating side owns (and
+    unlinks) the region; an unpickled handle attaches lazily."""
+
+    def __init__(self, layout: List[Tuple[str, tuple, np.dtype]],
+                 maxsize: int = 64, _attach_name: Optional[str] = None):
+        from multiprocessing import shared_memory
+        self.layout = [(n, tuple(s), np.dtype(d)) for n, s, d in layout]
+        self.capacity = maxsize
+        self._fields: List[_Field] = []
+        off = 0
+        for name, shape, dtype in self.layout:
+            nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            self._fields.append(_Field(name, shape, dtype, off, nbytes))
+            off += nbytes
+        self.slot_bytes = off
+        self._owner = _attach_name is None
+        self._shm = None
+        self._base = 0
+        if self._owner:
+            from r2d2_tpu.native import ring_lib
+            lib = ring_lib()
+            size = int(lib.ring_required_bytes(self.capacity,
+                                               self.slot_bytes))
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            self._bind()
+            lib.ring_init(self._base, self.capacity, self.slot_bytes)
+        else:
+            self._name = _attach_name
+
+    def __getstate__(self):
+        return {"layout": self.layout, "capacity": self.capacity,
+                "name": self.name}
+
+    def __setstate__(self, state):
+        self.__init__(state["layout"], state["capacity"],
+                      _attach_name=state["name"])
+
+    @property
+    def name(self) -> str:
+        return self._shm.name if self._shm is not None else self._name
+
+    def _bind(self) -> None:
+        import ctypes
+        self._cbuf = ctypes.c_char.from_buffer(self._shm.buf)
+        self._base = ctypes.addressof(self._cbuf)
+
+    def _ensure(self):
+        if self._shm is None:
+            from multiprocessing import shared_memory
+
+            from r2d2_tpu.runtime.weights import untrack_attached_shm
+            self._shm = shared_memory.SharedMemory(name=self._name)
+            untrack_attached_shm(self._shm)
+            self._bind()
+        from r2d2_tpu.native import ring_lib
+        return ring_lib()
+
+    def _slot_view(self, lib, pos: int) -> np.ndarray:
+        off = int(lib.ring_payload_offset(self._base, pos))
+        return np.ndarray((self.slot_bytes,), np.uint8, self._shm.buf, off)
+
+    def put(self, record: Dict[str, np.ndarray],
+            timeout: Optional[float] = None) -> None:
+        lib = self._ensure()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            pos = int(lib.ring_reserve_push(self._base))
+            if pos >= 0:
+                break
+            if deadline is None or time.monotonic() >= deadline:
+                raise queue.Full
+            time.sleep(0.0005)
+        slot = self._slot_view(lib, pos)
+        for f in self._fields:
+            src = np.ascontiguousarray(record[f.name], f.dtype)
+            slot[f.offset:f.offset + f.nbytes] = \
+                src.view(np.uint8).reshape(-1)
+        lib.ring_commit_push(self._base, pos)
+
+    def get_nowait(self) -> Optional[Dict[str, np.ndarray]]:
+        lib = self._ensure()
+        pos = int(lib.ring_reserve_pop(self._base))
+        if pos < 0:
+            return None
+        slot = self._slot_view(lib, pos)
+        out = {}
+        for f in self._fields:
+            raw = slot[f.offset:f.offset + f.nbytes]
+            out[f.name] = raw.view(f.dtype).reshape(f.shape).copy()
+        lib.ring_commit_pop(self._base, pos)
+        return out
+
+    def qsize(self) -> int:
+        lib = self._ensure()
+        return int(lib.ring_size(self._base))
+
+    def close(self) -> None:
+        if self._shm is None:
+            return
+        self._base = 0
+        self._cbuf = None
+        self._shm.close()
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+        self._shm = None
+
+
+def _encode_name(name: str) -> np.ndarray:
+    raw = name.encode()[:_REPLY_NAME_BYTES]
+    out = np.zeros(_REPLY_NAME_BYTES, np.uint8)
+    out[:len(raw)] = np.frombuffer(raw, np.uint8)
+    return out
+
+
+def _decode_name(arr: np.ndarray) -> str:
+    raw = bytes(np.asarray(arr, np.uint8))
+    return raw.rstrip(b"\x00").decode(errors="replace")
+
+
+class ShmServeTransport:
+    """Server side of the shm rung: owns the shared REQUEST ring, drains
+    it into the inbox off-thread, and routes replies into each client's
+    private reply ring (attached lazily by the name riding in the
+    request)."""
+
+    def __init__(self, submit: Callable[[Request, Callable], None],
+                 frame_hw: Tuple[int, int], action_dim: int,
+                 hidden_dim: int, request_slots: int = 256):
+        h, w = frame_hw
+        self.request_ring = ShmRecordRing(request_layout(h, w),
+                                          maxsize=request_slots)
+        self._reply_layout = reply_layout(action_dim, hidden_dim)
+        self._submit = submit
+        self._reply_rings: Dict[str, ShmRecordRing] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._drain_loop,
+                                        daemon=True, name="serve-shm-drain")
+        self._thread.start()
+
+    def _reply_cb_for(self, name: str) -> Callable[[Reply], None]:
+        def cb(reply: Reply, _name=name):
+            ring = self._reply_rings.get(_name)
+            if ring is None:
+                try:
+                    ring = ShmRecordRing(self._reply_layout,
+                                         _attach_name=_name, maxsize=0)
+                    self._reply_rings[_name] = ring
+                except (OSError, FileNotFoundError):
+                    return          # client's ring is gone — drop
+            try:
+                ring.put({
+                    "req_id": np.int64(reply.req_id),
+                    "status": np.int64(reply.status),
+                    "action": np.int64(reply.action),
+                    "weight_version": np.int64(reply.weight_version),
+                    "q": (reply.q if reply.q is not None
+                          else np.zeros(self._reply_layout[4][1],
+                                        np.float32)),
+                    "hidden": (reply.hidden if reply.hidden is not None
+                               else np.zeros(self._reply_layout[5][1],
+                                             np.float32)),
+                }, timeout=1.0)
+            except (queue.Full, OSError):
+                # a wedged/dead client's ring must not block the server:
+                # drop the reply; the client times out and retries
+                self._reply_rings.pop(_name, None)
+        return cb
+
+    def _drain_loop(self) -> None:
+        while not self._stop.is_set():
+            rec = None
+            try:
+                rec = self.request_ring.get_nowait()
+            except OSError:
+                return
+            if rec is None:
+                time.sleep(0.0005)
+                continue
+            flags = int(rec["flags"])
+            req = Request(
+                client_id=int(rec["client_id"]), req_id=int(rec["req_id"]),
+                kind=int(rec["kind"]), op_seq=int(rec["op_seq"]),
+                action=int(rec["action"]),
+                t_submit=float(rec["t_submit"]),
+                reset_obs=rec["reset_obs"] if flags & 1 else None,
+                obs=rec["obs"] if flags & 2 else None,
+                reply_to=_decode_name(rec["reply_to"]))
+            self._submit(req, self._reply_cb_for(req.reply_to))
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.request_ring.close()
+        for ring in self._reply_rings.values():
+            ring.close()
+        self._reply_rings.clear()
+
+
+class ShmServeChannel:
+    """Client side of the shm rung: pushes requests into the server's
+    shared ring (the handle crossed the spawn boundary by name) and polls
+    its own private reply ring. Built IN the client process so the reply
+    ring is owned (and unlinked) by the process that reads it."""
+
+    def __init__(self, request_ring: ShmRecordRing, action_dim: int,
+                 hidden_dim: int, reply_slots: int = 8):
+        self._req_ring = request_ring
+        self._reply_ring = ShmRecordRing(reply_layout(action_dim, hidden_dim),
+                                         maxsize=reply_slots)
+        self._name_field = _encode_name(self._reply_ring.name)
+        self._stash: Dict[int, Reply] = {}
+
+    def _push(self, req: Request) -> None:
+        h, w = self._req_ring.layout[-1][1]
+        zeros = None
+        flags = (1 if req.reset_obs is not None else 0) | \
+                (2 if req.obs is not None else 0)
+        if req.reset_obs is None or req.obs is None:
+            zeros = np.zeros((h, w), np.uint8)
+        try:
+            self._req_ring.put({
+                "client_id": np.int64(req.client_id),
+                "req_id": np.int64(req.req_id),
+                "kind": np.int64(req.kind),
+                "op_seq": np.int64(req.op_seq),
+                "action": np.int64(req.action),
+                "flags": np.int64(flags),
+                "t_submit": np.float64(req.t_submit),
+                "reply_to": self._name_field,
+                "reset_obs": (req.reset_obs if req.reset_obs is not None
+                              else zeros),
+                "obs": req.obs if req.obs is not None else zeros,
+            }, timeout=1.0)
+        except queue.Full:
+            raise ServeTimeout("request ring full") from None
+
+    def _poll(self, req_id: int, deadline: float) -> Reply:
+        while True:
+            if req_id in self._stash:
+                return self._stash.pop(req_id)
+            rec = self._reply_ring.get_nowait()
+            if rec is None:
+                if time.monotonic() >= deadline:
+                    raise ServeTimeout("no reply within timeout")
+                time.sleep(0.0005)
+                continue
+            reply = Reply(req_id=int(rec["req_id"]),
+                          status=int(rec["status"]),
+                          action=int(rec["action"]),
+                          q=rec["q"], hidden=rec["hidden"],
+                          weight_version=int(rec["weight_version"]))
+            if reply.req_id == req_id:
+                return reply
+            self._stash[reply.req_id] = reply
+
+    def request(self, req: Request, timeout: float = 5.0) -> Reply:
+        deadline = time.monotonic() + timeout
+        self._push(req)
+        return self._poll(req.req_id, deadline)
+
+    def request_many(self, reqs: List[Request],
+                     timeout: float = 5.0) -> Dict[int, Reply]:
+        deadline = time.monotonic() + timeout
+        out: Dict[int, Reply] = {}
+        try:
+            for r in reqs:
+                self._push(r)
+            for r in reqs:
+                out[r.req_id] = self._poll(r.req_id, deadline)
+        except ServeTimeout:
+            pass                    # partial: the caller retries the rest
+        return out
+
+    def reconnect(self) -> None:
+        """The rings persist across server restarts; nothing to re-dial.
+        Drop any stale stashed replies so a fresh exchange starts clean."""
+        self._stash.clear()
+
+    def disconnect(self, client_id: int) -> None:
+        try:
+            self._push(Request(client_id=client_id, req_id=-1,
+                               kind=KIND_DISCONNECT,
+                               t_submit=time.monotonic()))
+        except ServeTimeout:
+            pass
+
+    def close(self) -> None:
+        self._reply_ring.close()

@@ -182,6 +182,28 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("missing_rank", "threshold",
                   ("fleet", "host_rows", "max_age_s"),
                   tcfg.alerts_missing_rank_age_s, "crit"),
+        # serving-plane rules (ISSUE 13; the serving block,
+        # serve/server.py ServingStats — inactive on records without it,
+        # i.e. every run with actor.inference="local" and no server):
+        # client-visible request latency P99 over the SLO ceiling —
+        # includes queueing, retries, and timed-out attempts, so a dead
+        # or wedged server fires this DURING the outage, and recovery
+        # re-arms it (the chaos drill's acceptance)
+        AlertRule("serve_latency_slo", "threshold",
+                  ("serving", "latency", "p99_ms"),
+                  tcfg.alerts_serve_p99_ms, "crit"),
+        # the micro-batcher dispatching singletons despite >1 connected
+        # clients: batching is not coalescing under load (deadline too
+        # tight for the arrival cadence, or clients serialized)
+        AlertRule("serve_batch_starvation", "threshold",
+                  ("serving", "batch", "starved_frac"),
+                  tcfg.alerts_serve_starved_frac, "warn"),
+        # a burst of client disconnects within one interval (cumulative
+        # counter: one burst, one alert) — flapping clients or a
+        # lease-thrashing cache
+        AlertRule("serve_client_churn", "counter",
+                  ("serving", "clients", "disconnects"),
+                  tcfg.alerts_serve_churn, "warn"),
     )
 
 

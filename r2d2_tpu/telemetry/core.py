@@ -42,6 +42,10 @@ STAGES = (
     "weights/publish",            # learner -> weight service publish
     "lockstep/dispatch",          # multihost: blocked in the psum collective
     "lockstep/step",              # multihost: one whole lockstep iteration
+    "serve/enqueue",              # serving: request arrival -> dispatch
+    "serve/batch_wait",           # serving: oldest request's fill wait
+    "serve/forward",              # serving: jitted micro-batch forward
+    "serve/reply",                # serving: state scatter + reply send
 )
 STAGE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(STAGES)}
 

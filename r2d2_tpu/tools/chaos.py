@@ -31,7 +31,15 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict
 
-_KINDS = ("crash", "hang", "slow")
+_KINDS = ("crash", "hang", "slow", "disconnect")
+# kinds that inject at the worker's BLOCK SINK vs at its SERVE CLIENT
+# (actor.inference="server"): crash/hang are about the worker process
+# and stay at the sink either way; slow moves to the request path in
+# served mode (a laggy client against the micro-batcher); disconnect
+# only exists at the client (there is no connection to drop locally).
+SINK_KINDS_LOCAL = ("crash", "hang", "slow")
+SINK_KINDS_SERVER = ("crash", "hang")
+CLIENT_KINDS = ("disconnect", "slow")
 
 
 class ChaosFault(RuntimeError):
@@ -40,8 +48,9 @@ class ChaosFault(RuntimeError):
 
 @dataclass(frozen=True)
 class FaultSpec:
-    kind: str            # "crash" | "hang" | "slow"
-    block: int = 0       # 1-based emit ordinal triggering crash/hang
+    kind: str            # "crash" | "hang" | "slow" | "disconnect"
+    block: int = 0       # 1-based emit ordinal (crash/hang) or request
+    #                      period (disconnect@req=N: drop every Nth)
     factor: float = 1.0  # slow-down multiplier (slow only)
 
 
@@ -91,6 +100,20 @@ def parse_fault_spec(spec: str) -> Dict[int, FaultSpec]:
                     f"fault_spec entry {entry!r}: block must be >= 1 "
                     "(1-based emit ordinal)")
             faults[slot] = FaultSpec(kind, block=block)
+        elif kind == "disconnect":
+            # client-side serve fault (ISSUE 13): drop the worker's serve
+            # connection every Nth request — lease release + reconnect
+            try:
+                req = int(kv.get("req", ""))
+            except ValueError:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: disconnect needs "
+                    "@req=N (drop the serve connection every Nth "
+                    "request)") from None
+            if req < 1:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: req must be >= 1")
+            faults[slot] = FaultSpec("disconnect", block=req)
         else:
             try:
                 factor = float(kv.get("factor", ""))
@@ -132,6 +155,63 @@ def apply_fault(sink: Callable, fault: FaultSpec) -> Callable:
         return sink(block)
 
     return faulty_sink
+
+
+class ChaosChannel:
+    """Serve-channel fault wrapper (ISSUE 13): the client-side twin of
+    ``apply_fault``. ``disconnect@req=N`` drops the connection (an
+    explicit lease release + channel reconnect) every Nth request —
+    exercising the server's lease/reconnect path with the state-survival
+    guarantee under test; ``slow``/``slowxF`` stretches the request
+    cadence by F, a laggy client against the micro-batcher's deadline.
+    Counts live on the wrapper (``disconnects_injected``) so drills can
+    assert the fault actually fired."""
+
+    def __init__(self, inner, fault: FaultSpec):
+        self._inner = inner
+        self._fault = fault
+        self._n = 0
+        self._last = None
+        self._last_client = None
+        self.disconnects_injected = 0
+
+    def _before(self, client_id) -> None:
+        self._n += 1
+        self._last_client = client_id
+        f = self._fault
+        if f.kind == "disconnect" and self._n % f.block == 0:
+            self._inner.disconnect(client_id)
+            self._inner.reconnect()
+            self.disconnects_injected += 1
+        if f.kind == "slow" and self._last is not None:
+            time.sleep(min((f.factor - 1.0)
+                           * (time.monotonic() - self._last), 5.0))
+        self._last = time.monotonic()
+
+    def request(self, req, timeout: float = 5.0):
+        self._before(req.client_id)
+        return self._inner.request(req, timeout=timeout)
+
+    def request_many(self, reqs, timeout: float = 5.0):
+        if reqs:
+            self._before(reqs[0].client_id)
+        return self._inner.request_many(reqs, timeout=timeout)
+
+    def reconnect(self) -> None:
+        self._inner.reconnect()
+
+    def disconnect(self, client_id) -> None:
+        self._inner.disconnect(client_id)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+def wrap_channel(channel, fault: FaultSpec):
+    """Apply a client-side serve fault; non-client kinds pass through."""
+    if fault is not None and fault.kind in CLIENT_KINDS:
+        return ChaosChannel(channel, fault)
+    return channel
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +295,126 @@ def run_chaos(seconds: float = 60.0, actor_mode: str = "process",
     return report
 
 
+# ---------------------------------------------------------------------------
+# Serving chaos: the server-kill/restart drill (ISSUE 13).
+
+
+def run_serve_chaos(seconds: float = 45.0, outage_s: float = 6.0,
+                    config_overrides: dict = None) -> dict:
+    """Server-kill/restart drill: thread actors act through the central
+    policy server (``actor.inference="server"``); mid-run the server loop
+    is STOPPED for ``outage_s`` and then restarted against the same
+    endpoint. The claims under test: (a) the learner never stalls —
+    replay keeps it stepping straight through the outage; (b) clients
+    time out, back off on the WorkerHealth ladder, reconnect, and resume
+    feeding blocks; (c) ``serve_latency_slo`` fires during the outage
+    window and re-arms after recovery."""
+    import threading
+
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.runtime.orchestrator import PlayerStack
+
+    overrides = {
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "actor.num_actors": 2, "actor.inference": "server",
+        "serve.max_batch": 8, "serve.deadline_ms": 3.0,
+        # timeouts tuned so an OUTAGE-window attempt (~0.5 s) clears the
+        # drill's 200 ms SLO bound while healthy requests (~1-5 ms) sit
+        # far under it — fire during the outage, re-arm after recovery
+        "serve.request_timeout_s": 0.5,
+        "serve.max_retry_s": 600.0,
+        "telemetry.alerts_serve_p99_ms": 200.0,
+        "runtime.save_interval": 0, "runtime.log_interval": 1.5,
+        "runtime.steps_per_dispatch": 1,
+        "runtime.supervise_interval_s": 1.0,
+        "runtime.ingest_stall_timeout_s": 0.0,
+    }
+    overrides.update(config_overrides or {})
+    cfg = Config().replace(**overrides)
+
+    probe = create_env(cfg.env, seed=0)
+    action_dim = probe.action_space.n
+    probe.close()
+
+    stop = threading.Event()
+    stack = PlayerStack(cfg, 0, action_dim)
+    records = []
+    t0 = time.time()
+    outage_at = t0 + max(seconds * 0.35, 8.0)
+    restore_at = outage_at + outage_s
+    state = "healthy"
+    steps_at_kill = steps_at_restore = None
+    last_log = last_supervise = t0
+    try:
+        stack.start_actors_threads(stop)
+        while time.time() - t0 < seconds:
+            stack.learner.drain(stack.queue)
+            if stack.learner.ready:
+                stack.learner.step()
+            now = time.time()
+            if state == "healthy" and now >= outage_at:
+                steps_at_kill = stack.learner.training_steps
+                stack.serve_server.stop()
+                state = "outage"
+            elif state == "outage" and now >= restore_at:
+                steps_at_restore = stack.learner.training_steps
+                stack.restart_serve_server()
+                state = "restored"
+            if now - last_supervise >= cfg.runtime.supervise_interval_s:
+                stack.supervise()
+                last_supervise = now
+            if now - last_log >= cfg.runtime.log_interval:
+                stack.learner.flush_metrics()
+                records.append(
+                    {"phase": state, **stack.metrics.log(now - last_log)})
+                last_log = now
+            if not stack.learner.ready:
+                time.sleep(0.01)
+    finally:
+        stop.set()
+        stack.close()
+
+    fired = [a["rule"] for r in records
+             for a in (r.get("alerts") or {}).get("fired") or []]
+    final_active = ((records[-1].get("alerts") or {}).get("active") or []
+                    if records else [])
+    restored = [r for r in records if r.get("phase") == "restored"]
+    reconnects = max((((r.get("serving") or {}).get("clients") or {})
+                      .get("reconnects") or 0) for r in records) \
+        if records else 0
+    resumed = any(((r.get("serving") or {}).get("replies") or 0) > 0
+                  for r in restored)
+    report = {
+        "metric": "serve_chaos",
+        "duration_s": round(time.time() - t0, 1),
+        "outage_s": outage_s,
+        "training_steps": stack.learner.training_steps,
+        "steps_at_kill": steps_at_kill,
+        "steps_at_restore": steps_at_restore,
+        "alerts_fired": fired,
+        "final_active": final_active,
+        "records": records[-3:],
+    }
+    report["verdict"] = {
+        # the learner kept stepping THROUGH the outage window
+        "no_learner_stall": (steps_at_kill is not None
+                             and steps_at_restore is not None
+                             and steps_at_restore > steps_at_kill),
+        "slo_fired": "serve_latency_slo" in fired,
+        "slo_rearmed": "serve_latency_slo" not in final_active,
+        "clients_resumed": resumed or reconnects > 0,
+    }
+    return report
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -224,6 +424,11 @@ def main(argv=None) -> int:
     p.add_argument("--seconds", type=float, default=60.0)
     p.add_argument("--actor-mode", choices=("thread", "process"),
                    default="process")
+    p.add_argument("--serve", action="store_true",
+                   help="run the ISSUE-13 server-kill/restart drill "
+                        "instead of the worker-fault phase")
+    p.add_argument("--outage-seconds", type=float, default=6.0,
+                   help="--serve: how long the policy server stays down")
     p.add_argument("--override", action="append", default=[],
                    help="dotted config override key=value (repeatable)")
     args = p.parse_args(argv)
@@ -234,7 +439,10 @@ def main(argv=None) -> int:
             overrides[k] = json.loads(v)
         except (json.JSONDecodeError, ValueError):
             overrides[k] = v
-    out = run_chaos(args.seconds, args.actor_mode, overrides)
+    if args.serve:
+        out = run_serve_chaos(args.seconds, args.outage_seconds, overrides)
+    else:
+        out = run_chaos(args.seconds, args.actor_mode, overrides)
     print(json.dumps(out))
     ok = all(out["verdict"].values())
     print(f"chaos: verdict={'PASS' if ok else 'FAIL'} {out['verdict']}",

@@ -56,7 +56,7 @@ import json
 import os
 import sys
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -145,10 +145,13 @@ def run_actor_sweep(sweep: List[int], seconds: float = 5.0,
 
 
 def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
-            num_actors: int = 1, overrides: Optional[dict] = None) -> dict:
-    """Process-mode vector actors feeding the REAL learner; both speeds
-    measured from the same run's TrainMetrics records (steady-state mean:
-    records after the first, when training has started)."""
+            num_actors: int = 1, overrides: Optional[dict] = None,
+            actor_mode: str = "process") -> dict:
+    """Process-mode (default) vector actors feeding the REAL learner;
+    both speeds measured from the same run's TrainMetrics records
+    (steady-state mean: records after the first, when training has
+    started). The serve A/B runs the same system in thread mode so the
+    in-proc serving rung carries client-observed latencies."""
     from r2d2_tpu.runtime.orchestrator import train
 
     ov = dict(E2E_CPU_OVERRIDES)
@@ -167,7 +170,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
     records = []
     t0 = time.time()
     try:
-        stacks = train(cfg, max_seconds=seconds, actor_mode="process",
+        stacks = train(cfg, max_seconds=seconds, actor_mode=actor_mode,
                        log_fn=records.append)
     finally:
         if scratch is not None:
@@ -239,6 +242,19 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         else:
             replay_diag.update(
                 {k: v for k, v in clean.items() if v is not None})
+    # serving evidence (ISSUE 13): field-wise merge of the serving
+    # blocks, newest non-null per sub-field (the latency histogram and
+    # batch stats reset per interval, so one quiet interval must not
+    # blank the evidence); present only on inference="server" runs
+    serving = None
+    for r in records:
+        sb = r.get("serving")
+        if not sb:
+            continue
+        if serving is None:
+            serving = dict(sb)
+        else:
+            serving.update({k: v for k, v in sb.items() if v is not None})
     # system-health evidence (ISSUE 7): the newest resources block plus
     # the run's alert tally — proof the pillar actually flowed (or, with
     # the kill switch off, that the records carried neither key)
@@ -274,6 +290,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "learning": learning,
         "replay_diag": replay_diag,
         "anakin": anakin,
+        "serving": serving,
         "resources": resources,
         "alerts_present": alerts_present,
         "alerts_fired": alerts_fired,
@@ -542,6 +559,162 @@ def run_replay_diag_ab(seconds: float, envs_per_actor: int, num_actors: int,
         out["sharded_tree_on"] = srd.get("tree")
         out["sharded_shards_on"] = srd.get("shards")
     return out
+
+
+def run_serve_ab(seconds: float, lanes: int = 16,
+                 overrides: Optional[dict] = None,
+                 repeats: int = 2, sweep: Tuple[int, ...] = (1, 4, 16)
+                 ) -> dict:
+    """Serving overhead + batching-under-load evidence (ISSUE 13
+    acceptance): the SAME thread-mode e2e system — one vector actor
+    worker whose lanes each hold a serve client — with
+    ``actor.inference`` local vs server at equal lanes, ABBA-interleaved
+    ``repeats`` times with per-arm medians (the fleet-AB noise
+    treatment), PLUS a client-count sweep (server mode at 1/4/16 lanes)
+    recording the batch-fill climb with load.
+
+    The claims under test on this CPU container: server-mode aggregate
+    env-steps/s stays within 0.8x of local at 16 clients (the mechanism
+    is not pathological — the WIN is placement on real accelerators,
+    where the batched forward leaves the actor host entirely), mean
+    batch fill > 1 from 4 clients up, and P99 request latency bounded by
+    the deadline + one forward. Thread mode keeps the in-proc rung under
+    test (client-observed latency in the serving block); the process
+    rungs (shm/socket) are round-trip-tested in tests/test_serve.py."""
+    base = dict(overrides or {})
+    cells = {"local": [], "server": []}
+    for rep in range(max(repeats, 1)):
+        order = (("local", "local"), ("server", "server"))
+        if rep % 2:
+            order = order[::-1]    # ABBA: cancel monotonic host drift
+        for label, mode in order:
+            ov = dict(base)
+            ov["actor.inference"] = mode
+            cells[label].append(run_e2e(
+                seconds, envs_per_actor=lanes, num_actors=1,
+                overrides=ov, actor_mode="thread"))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"local": cells["local"][-1], "server": cells["server"][-1],
+           "lanes": lanes, "repeats": max(repeats, 1),
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("local", "env_steps_per_sec") > 0:
+        out["env_steps_ratio_serve"] = round(
+            med("server", "env_steps_per_sec")
+            / med("local", "env_steps_per_sec"), 3)
+    if med("local", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio_serve"] = round(
+            med("server", "learner_steps_per_sec")
+            / med("local", "learner_steps_per_sec"), 3)
+    sb = next((c["serving"] for c in reversed(cells["server"])
+               if c.get("serving")), None)
+    out["serving_block_on"] = bool(sb)
+    if sb:
+        out["serve_latency_p99_ms"] = (sb.get("latency") or {}).get(
+            "p99_ms")
+        out["serve_fill_mean"] = (sb.get("batch") or {}).get("fill_mean")
+    out["serving_block_local"] = any(c.get("serving")
+                                     for c in cells["local"])
+
+    # client-count sweep: batch fill climbing with load is the
+    # micro-batcher's central claim — each lane is one blocking client,
+    # so fill tracks the number of concurrently-pending requests. The
+    # probe isolates the SERVING plane (no colocated learner): on this
+    # 2-core host the integrated arms' tail latency is GIL/scheduler
+    # contention with the training loop, which would mis-measure the
+    # batcher itself; the SLO leg (p99 <= deadline + one forward) is
+    # checked per cell against the same run's forward percentiles.
+    out["client_sweep"] = [
+        serve_latency_probe(min(seconds, 15.0), n, overrides=base)
+        for n in sweep]
+    fills = [c["fill_mean"] for c in out["client_sweep"]
+             if c["fill_mean"] is not None]
+    if fills:
+        out["serve_fill_mean_sweep_max"] = max(fills)
+    out["serve_slo_ok_sweep"] = all(
+        c.get("slo_ok") for c in out["client_sweep"])
+    return out
+
+
+def serve_latency_probe(seconds: float, clients: int,
+                        overrides: Optional[dict] = None) -> dict:
+    """Pure serving-plane cell: one in-proc PolicyServer, ``clients``
+    pipelined lanes stepping synthetic frames as fast as replies come
+    back. Measures the micro-batcher itself — batch fill, client-visible
+    latency percentiles, forward time — without a training loop
+    competing for the cores. ``slo_ok`` is the acceptance leg: latency
+    p99 <= serve.deadline_ms + the same run's forward p99."""
+    import jax
+
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.serve import (InprocEndpoint, PolicyServer,
+                                RemoteBatchedPolicy, ServingStats)
+    from r2d2_tpu.telemetry import Telemetry
+    ov = dict(E2E_CPU_OVERRIDES)
+    ov.update(overrides or {})
+    ov.pop("actor.inference", None)
+    cfg = _bench_config(ov)
+    net = NetworkApply(6, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    params = net.init(jax.random.PRNGKey(0))
+    stats = ServingStats()
+    telemetry = Telemetry(name="serve-probe")
+    ep = InprocEndpoint()
+    srv = PolicyServer(cfg, net, params, endpoint=ep, stats=stats,
+                       telemetry=telemetry, client_timed=True).start()
+    try:
+        remote = RemoteBatchedPolicy(
+            ep.connect(), net.action_dim, [0.05] * clients,
+            list(range(clients)), stats=stats,
+            timeout_s=cfg.serve.request_timeout_s)
+        rng = np.random.default_rng(0)
+        h, w = cfg.env.frame_height, cfg.env.frame_width
+        frames = rng.integers(0, 255, (64, h, w), np.uint8)
+        for i in range(clients):
+            remote.observe_reset_lane(i, frames[i % 64])
+        for _ in range(3):                       # warm the round trip
+            remote.act()
+        stats.interval_block()                   # drop warm-up samples
+        telemetry.timers.take()
+        ticks = 0
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            actions, _, _ = remote.act()
+            remote.observe(frames[(ticks + np.arange(clients)) % 64],
+                           actions)
+            ticks += 1
+        elapsed = time.time() - t0
+        block = stats.interval_block() or {}
+        from r2d2_tpu.telemetry.core import summarize_matrix
+        stages = summarize_matrix(telemetry.timers.take())
+        fwd = stages.get("serve/forward") or {}
+        lat = block.get("latency") or {}
+        cell = {
+            "clients": clients,
+            "seconds": round(elapsed, 1),
+            "ticks": ticks,
+            "requests_per_sec": round(ticks * clients / elapsed, 1),
+            "fill_mean": (block.get("batch") or {}).get("fill_mean"),
+            "fill_p99": (block.get("batch") or {}).get("fill_p99"),
+            "latency_p50_ms": lat.get("p50_ms"),
+            "latency_p99_ms": lat.get("p99_ms"),
+            "forward_p50_ms": fwd.get("p50_ms"),
+            "forward_p99_ms": fwd.get("p99_ms"),
+            "deadline_ms": cfg.serve.deadline_ms,
+        }
+        if lat.get("p99_ms") is not None and fwd.get("p99_ms") is not None:
+            cell["slo_ok"] = bool(
+                lat["p99_ms"] <= cfg.serve.deadline_ms + fwd["p99_ms"])
+        return cell
+    finally:
+        srv.stop()
 
 
 def run_fleet_mh(seconds: float, envs_per_actor: int = 8,
@@ -939,6 +1112,18 @@ def main(argv=None) -> int:
                         "on env-steps/s AND learner updates/s; "
                         "interleaved repeats with per-arm medians, the "
                         "ON cells carry the 'fleet' block as evidence)")
+    p.add_argument("--serve-ab", type=int, default=0,
+                   help="1: run the e2e phase as the policy-serving A/B "
+                        "instead (ISSUE 13) — thread-mode actors with "
+                        "actor.inference local vs server at equal lanes "
+                        "(ABBA-interleaved, per-arm medians) plus a "
+                        "1/4/16 client-count sweep showing batch fill "
+                        "climbing with load; one artifact with the "
+                        "serving block (latency percentiles, fill) as "
+                        "evidence")
+    p.add_argument("--serve-lanes", type=int, default=16,
+                   help="lanes (= serve clients) for the serve A/B's "
+                        "equal-lane arms")
     p.add_argument("--resources-ab", type=int, default=0,
                    help="1: run the e2e phase as a resource/compile/alerts "
                         "on/off A/B instead (telemetry.resources_enabled; "
@@ -1000,6 +1185,10 @@ def main(argv=None) -> int:
                 args.e2e_seconds, args.envs_per_actor,
                 dp=args.sharded_dp, overrides=overrides,
                 repeats=args.ab_repeats)
+        elif args.serve_ab:
+            out["e2e_serve_ab"] = run_serve_ab(
+                args.e2e_seconds, lanes=args.serve_lanes,
+                overrides=overrides, repeats=args.ab_repeats)
         elif args.replay_diag_ab:
             out["e2e_replay_diag_ab"] = run_replay_diag_ab(
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,

@@ -51,6 +51,7 @@ _STAGE_ORDER = [
     "learner/sample", "learner/train_dispatch", "learner/device_sync",
     "learner/priority_writeback", "weights/publish",
     "lockstep/dispatch", "lockstep/step",
+    "serve/enqueue", "serve/batch_wait", "serve/forward", "serve/reply",
 ]
 
 
@@ -112,6 +113,10 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None,
     if rd:
         lines.append("")
         lines.append(render_replay_diag(rd))
+    sv = record.get("serving")
+    if sv:
+        lines.append("")
+        lines.append(render_serving(sv))
     rb = record.get("resources")
     if rb:
         lines.append("")
@@ -249,6 +254,43 @@ def render_anakin(an: dict) -> str:
         if at(ret, i) is not None:
             bits.append(f"return-sum={ret[i]:.2f}")
         lines.append(" ".join(bits))
+    return "\n".join(lines)
+
+
+def render_serving(sv: dict) -> str:
+    """The serving panel (ISSUE 13): request latency percentiles, batch
+    fill, dispatch causes, and client lease churn — the record's
+    ``serving`` block from the central policy inference server."""
+    lat = sv.get("latency") or {}
+    batch = sv.get("batch") or {}
+    clients = sv.get("clients") or {}
+    lines = [f"serving: {sv.get('requests', 0)} req "
+             f"{sv.get('replies', 0)} ok "
+             f"{sv.get('expired', 0)} expired "
+             f"{sv.get('timeouts', 0)} timeouts(cum)  "
+             f"clients={clients.get('active', 0)}"]
+    if lat:
+        lines.append(
+            f"  latency ms: p50={_fmt(lat.get('p50_ms'), 8).strip()} "
+            f"p95={_fmt(lat.get('p95_ms'), 8).strip()} "
+            f"p99={_fmt(lat.get('p99_ms'), 8).strip()}"
+            + (f"   SLO deadline {sv['deadline_ms']}ms"
+               if sv.get("deadline_ms") is not None else ""))
+    if batch.get("count"):
+        bits = [f"  batches={batch['count']} "
+                f"fill={_fmt(batch.get('fill_mean'), 6).strip()}"
+                f"/{sv.get('max_batch', '-')}"]
+        for key, label in (("full_frac", "full"),
+                           ("deadline_frac", "deadline"),
+                           ("starved_frac", "starved")):
+            if batch.get(key) is not None:
+                bits.append(f"{label}={100 * batch[key]:.0f}%")
+        lines.append(" ".join(bits))
+    churn = [f"{k}={clients[k]}" for k in
+             ("connects", "reconnects", "disconnects", "evictions")
+             if clients.get(k)]
+    if churn:
+        lines.append("  leases: " + " ".join(churn))
     return "\n".join(lines)
 
 

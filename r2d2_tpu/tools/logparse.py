@@ -153,6 +153,47 @@ def fleet_series(records: List[dict]) -> dict:
     return out
 
 
+def serve_series(records: List[dict]) -> dict:
+    """Time series of the ``serving`` block (ISSUE 13) across a metrics
+    JSONL stream (``metrics_player{p}.jsonl`` in served-training runs, or
+    the standalone server's ``serve_metrics.jsonl``), aligned on the
+    records that CARRY one — the learning_series contract. Keys: t,
+    training_steps, requests, latency_p50_ms/p95_ms/p99_ms, fill_mean,
+    full_frac, deadline_frac, starved_frac, clients_active, connects,
+    reconnects, disconnects, evictions, timeouts, expired. Values are
+    None where a record's block lacked that entry."""
+    out = {k: [] for k in (
+        "t", "training_steps", "requests", "latency_p50_ms",
+        "latency_p95_ms", "latency_p99_ms", "fill_mean", "full_frac",
+        "deadline_frac", "starved_frac", "clients_active", "connects",
+        "reconnects", "disconnects", "evictions", "timeouts", "expired")}
+    for r in records:
+        sv = r.get("serving")
+        if not sv:
+            continue
+        lat = sv.get("latency") or {}
+        batch = sv.get("batch") or {}
+        clients = sv.get("clients") or {}
+        out["t"].append(r.get("t"))
+        out["training_steps"].append(r.get("training_steps"))
+        out["requests"].append(sv.get("requests"))
+        out["latency_p50_ms"].append(lat.get("p50_ms"))
+        out["latency_p95_ms"].append(lat.get("p95_ms"))
+        out["latency_p99_ms"].append(lat.get("p99_ms"))
+        out["fill_mean"].append(batch.get("fill_mean"))
+        out["full_frac"].append(batch.get("full_frac"))
+        out["deadline_frac"].append(batch.get("deadline_frac"))
+        out["starved_frac"].append(batch.get("starved_frac"))
+        out["clients_active"].append(clients.get("active"))
+        out["connects"].append(clients.get("connects"))
+        out["reconnects"].append(clients.get("reconnects"))
+        out["disconnects"].append(clients.get("disconnects"))
+        out["evictions"].append(clients.get("evictions"))
+        out["timeouts"].append(sv.get("timeouts"))
+        out["expired"].append(sv.get("expired"))
+    return out
+
+
 def alerts_series(path: str, limit: Optional[int] = None) -> dict:
     """Time series of an ``alerts_player{p}.jsonl`` stream (ISSUE 7) —
     one entry per FIRED alert, oldest first, with ``parse_jsonl``'s

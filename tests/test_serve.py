@@ -1,0 +1,869 @@
+"""Central policy inference service (ISSUE 13): micro-batcher
+deadline/fill semantics, state-cache lease/evict/reconnect, local-vs-
+server action parity, the transport ladder (in-proc + shm + socket),
+serving record schema + serve_* alert rules, kill-switch schema
+stability, chaos client faults, and the e2e/chaos slow slices."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config
+
+pytestmark = []
+
+
+def small_cfg(**over):
+    base = {
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "serve.max_batch": 4, "serve.deadline_ms": 2.0,
+        "runtime.save_interval": 0,
+    }
+    base.update(over)
+    return Config().replace(**base)
+
+
+def tiny_net(cfg, action_dim=4):
+    import jax
+
+    from r2d2_tpu.models.network import NetworkApply
+    net = NetworkApply(action_dim, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    return net, net.init(jax.random.PRNGKey(0))
+
+
+def make_server(cfg=None, **server_kw):
+    from r2d2_tpu.serve import InprocEndpoint, PolicyServer
+    cfg = cfg or small_cfg()
+    net, params = tiny_net(cfg)
+    ep = InprocEndpoint()
+    srv = PolicyServer(cfg, net, params, endpoint=ep, **server_kw).start()
+    return cfg, net, params, ep, srv
+
+
+def rand_obs(rng, cfg):
+    return rng.integers(0, 255, (cfg.env.frame_height,
+                                 cfg.env.frame_width), np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher semantics
+
+
+def _pending(t_recv=None):
+    from r2d2_tpu.serve import Request
+    req = Request(client_id=0, req_id=0)
+    req.t_recv = time.monotonic() if t_recv is None else t_recv
+    return (req, lambda reply: None)
+
+
+def test_collect_batch_dispatches_on_fill():
+    from r2d2_tpu.serve import collect_batch
+    inbox = queue.Queue()
+    for _ in range(5):
+        inbox.put(_pending())
+    first = inbox.get()
+    t0 = time.monotonic()
+    batch = collect_batch(inbox, first, max_batch=4, deadline_s=10.0)
+    # fills to max_batch immediately — never waits out a long deadline
+    assert len(batch) == 4
+    assert time.monotonic() - t0 < 1.0
+    assert inbox.qsize() == 1                      # one left behind
+
+
+def test_collect_batch_dispatches_on_deadline():
+    from r2d2_tpu.serve import collect_batch
+    inbox = queue.Queue()
+    first = _pending()
+    t0 = time.monotonic()
+    batch = collect_batch(inbox, first, max_batch=8, deadline_s=0.08)
+    elapsed = time.monotonic() - t0
+    # a lone request goes out once the OLDEST (itself) ages out
+    assert len(batch) == 1
+    assert 0.04 <= elapsed < 2.0
+
+
+def test_collect_batch_deadline_measured_from_arrival():
+    from r2d2_tpu.serve import collect_batch
+    inbox = queue.Queue()
+    # the first request already waited its deadline out in the queue:
+    # dispatch must be immediate, not deadline-from-now
+    first = _pending(t_recv=time.monotonic() - 1.0)
+    t0 = time.monotonic()
+    batch = collect_batch(inbox, first, max_batch=8, deadline_s=0.5)
+    assert len(batch) == 1
+    assert time.monotonic() - t0 < 0.2
+
+
+def test_collect_batch_early_dispatch_at_expected():
+    """Once every connected client is represented (expected), the
+    batcher stops WAITING — but still drains an immediately-pending
+    burst up to max_batch."""
+    from r2d2_tpu.serve import collect_batch
+    inbox = queue.Queue()
+    inbox.put(_pending())
+    first = inbox.get()
+    t0 = time.monotonic()
+    batch = collect_batch(inbox, first, max_batch=8, deadline_s=5.0,
+                          expected=1)
+    assert len(batch) == 1
+    assert time.monotonic() - t0 < 0.5              # no deadline wait
+    # burst backlog: expected=2 reached, the rest drain without waiting
+    for _ in range(5):
+        inbox.put(_pending())
+    first = inbox.get()
+    t0 = time.monotonic()
+    batch = collect_batch(inbox, first, max_batch=8, deadline_s=5.0,
+                          expected=2)
+    assert len(batch) == 5                          # 1 + all 4 pending
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_serve_buckets():
+    from r2d2_tpu.serve import serve_buckets
+    assert serve_buckets(1) == [1]
+    assert serve_buckets(8) == [1, 2, 4, 8]
+    assert serve_buckets(12) == [1, 2, 4, 8, 12]
+
+
+# ---------------------------------------------------------------------------
+# state cache
+
+
+def test_state_cache_lease_reconnect_evict():
+    from r2d2_tpu.serve import StateCache
+    c = StateCache(slots=4, shards=2, frame_hw=(8, 8), frame_stack=2,
+                   hidden_dim=4, lease_timeout_s=10.0)
+    slot, fresh = c.lease(7, now=0.0)
+    assert fresh and c.connects == 1
+    c.hidden[slot, 0, 0] = 3.5                      # mark the state
+    again, fresh2 = c.lease(7, now=1.0)
+    assert again == slot and not fresh2             # renewal, state kept
+    assert c.release(7, now=2.0)
+    # reconnect inside the lease window: SAME slot, state retained
+    back, fresh3 = c.lease(7, now=5.0)
+    assert back == slot and not fresh3
+    assert c.hidden[slot, 0, 0] == 3.5
+    assert c.reconnects == 1
+    # disconnected past the timeout: swept, slot resets
+    c.release(7, now=6.0)
+    assert c.sweep(now=20.0) == 1
+    assert c.evictions == 1
+    slot2, fresh4 = c.lease(7, now=21.0)
+    assert fresh4 and c.hidden[slot2].sum() == 0.0
+
+
+def test_state_cache_full_shard_evicts_stalest():
+    from r2d2_tpu.serve import StateCache
+    c = StateCache(slots=4, shards=2, frame_hw=(8, 8), frame_stack=2,
+                   hidden_dim=4, lease_timeout_s=1e9)
+    # shard 0 owns even client ids (id % shards); fill its 2 slots
+    c.lease(0, now=0.0)
+    c.lease(2, now=1.0)
+    c.release(0, now=2.0)                           # disconnected, stalest
+    s4, fresh = c.lease(4, now=3.0)                 # full shard: evict
+    assert fresh and c.evictions == 1
+    # the disconnected lease went first; the connected one survived
+    assert c.lease(2, now=4.0)[1] is False
+    assert c.lease(0, now=5.0)[1] is True           # evicted = fresh again
+
+
+def test_state_cache_mutation_parity_with_local_policy():
+    """observe_reset / observe on a cache slot reproduce ActorPolicy's
+    frame-stack math bit-for-bit."""
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.serve import StateCache
+    cfg = small_cfg()
+    net, params = tiny_net(cfg)
+    local = ActorPolicy(net, params, 0.0, seed=0)
+    c = StateCache(slots=2, shards=1, frame_hw=(24, 24), frame_stack=2,
+                   hidden_dim=16)
+    slot, _ = c.lease(0)
+    rng = np.random.default_rng(0)
+    obs = rand_obs(rng, cfg)
+    local.observe_reset(obs)
+    c.reset_slot(slot, obs)
+    np.testing.assert_array_equal(c.stacked[slot], local.stacked)
+    for t in range(3):
+        nxt = rand_obs(rng, cfg)
+        local.observe(nxt, t)
+        c.observe(slot, nxt, t)
+        np.testing.assert_array_equal(c.stacked[slot], local.stacked)
+        assert c.last_action[slot] == local.last_action
+
+
+# ---------------------------------------------------------------------------
+# local-vs-server parity
+
+
+def test_scalar_action_parity_exact():
+    """At equal seeds and ε the served actor's action/Q/hidden stream is
+    BIT-IDENTICAL to the local one's: the server runs the same shared
+    forward program (make_forward_fn) on the same state math."""
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.serve import RemotePolicy
+    cfg, net, params, ep, srv = make_server()
+    try:
+        local = ActorPolicy(net, params, 0.4, seed=7)
+        remote = RemotePolicy(ep.connect(), net.action_dim, 0.4, seed=7)
+        rng = np.random.default_rng(1)
+        obs = rand_obs(rng, cfg)
+        local.observe_reset(obs)
+        remote.observe_reset(obs)
+        for t in range(30):
+            a1, q1, h1 = local.act()
+            a2, q2, h2 = remote.act()
+            assert a1 == a2
+            np.testing.assert_array_equal(q1, q2)
+            np.testing.assert_array_equal(h1, h2)
+            if t == 10:
+                np.testing.assert_array_equal(local.bootstrap_q(),
+                                              remote.bootstrap_q())
+            nxt = rand_obs(rng, cfg)
+            local.observe(nxt, a1)
+            remote.observe(nxt, a2)
+        assert remote.weight_version == 0           # no weight service
+    finally:
+        srv.stop()
+
+
+def test_vector_action_parity_exact():
+    """N=4 lanes: the pipelined lanes fill one bucket-4 micro-batch —
+    the identical (4, 1) program BatchedActorPolicy runs locally."""
+    from r2d2_tpu.actor.policy import BatchedActorPolicy
+    from r2d2_tpu.serve import RemoteBatchedPolicy
+    cfg, net, params, ep, srv = make_server()
+    try:
+        eps = [0.4, 0.2, 0.1, 0.05]
+        seeds = [3, 4, 5, 6]
+        local = BatchedActorPolicy(net, params, eps, seeds)
+        remote = RemoteBatchedPolicy(ep.connect(), net.action_dim, eps,
+                                     seeds, client_base=0)
+        rng = np.random.default_rng(2)
+        for i in range(4):
+            obs = rand_obs(rng, cfg)
+            local.observe_reset_lane(i, obs)
+            remote.observe_reset_lane(i, obs)
+        for t in range(10):
+            a1, q1, h1 = local.act()
+            a2, q2, h2 = remote.act()
+            np.testing.assert_array_equal(a1, a2)
+            np.testing.assert_array_equal(q1, q2)
+            np.testing.assert_array_equal(h1, h2)
+            if t == 4:
+                np.testing.assert_array_equal(local.bootstrap_q(),
+                                              remote.bootstrap_q())
+            nxt = np.stack([rand_obs(rng, cfg) for _ in range(4)])
+            local.observe(nxt, a1)
+            remote.observe(nxt, a2)
+    finally:
+        srv.stop()
+
+
+def test_run_actor_block_stream_parity():
+    """The whole loop: run_actor with a local policy vs a RemotePolicy
+    on identically-seeded envs emits IDENTICAL blocks."""
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.runtime.actor_loop import make_actor_policy, run_actor
+    cfg = small_cfg()
+    cfg_srv = small_cfg(**{"actor.inference": "server"})
+    _, net, params, ep, srv = make_server(cfg_srv)
+    blocks = {"local": [], "server": []}
+    try:
+        for mode, c in (("local", cfg), ("server", cfg_srv)):
+            env = create_env(c.env, seed=11)
+            channel = ep.connect() if mode == "server" else None
+            policy, run_loop = make_actor_policy(
+                c, net, params, 0, seed=5, epsilon=0.3,
+                serve_channel=channel)
+            run_loop(c, env, policy, blocks[mode].append,
+                     lambda: None, lambda: False, max_env_steps=60)
+    finally:
+        srv.stop()
+    assert len(blocks["local"]) == len(blocks["server"]) > 0
+    for lb, sb in zip(blocks["local"], blocks["server"]):
+        for field in ("obs_row", "last_action_row", "hidden", "action",
+                      "reward", "gamma", "priority", "learning_steps"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(lb, field)),
+                np.asarray(getattr(sb, field)), err_msg=field)
+
+
+def test_bootstrap_does_not_advance_state():
+    from r2d2_tpu.serve import RemotePolicy
+    cfg, net, params, ep, srv = make_server()
+    try:
+        remote = RemotePolicy(ep.connect(), net.action_dim, 0.0, seed=0)
+        rng = np.random.default_rng(3)
+        remote.observe_reset(rand_obs(rng, cfg))
+        q1 = remote.bootstrap_q()
+        q2 = remote.bootstrap_q()
+        np.testing.assert_array_equal(q1, q2)       # no hidden advance
+        _, q3, _ = remote.step()
+        np.testing.assert_array_equal(q1, q3)       # first step: same state
+        _, q4, _ = remote.step()                    # now hidden advanced
+        assert not np.array_equal(q3, q4)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# batching under load + weight sync
+
+
+def test_pipelined_lanes_fill_micro_batch():
+    from r2d2_tpu.serve import RemoteBatchedPolicy
+    cfg = small_cfg(**{"serve.max_batch": 8, "serve.deadline_ms": 50.0})
+    _, net, params, ep, srv = make_server(cfg)
+    try:
+        remote = RemoteBatchedPolicy(ep.connect(), net.action_dim,
+                                     [0.1] * 8, list(range(8)))
+        rng = np.random.default_rng(4)
+        for i in range(8):
+            remote.observe_reset_lane(i, rand_obs(rng, cfg))
+        for _ in range(5):
+            remote.act()
+        block = srv.stats.interval_block()
+        assert block["batch"]["fill_mean"] > 4      # 8 lanes coalesce
+        assert block["clients"]["active"] == 8
+    finally:
+        srv.stop()
+
+
+def test_weight_sync_and_version_stamp():
+    from r2d2_tpu.runtime.weights import InProcWeightStore
+    from r2d2_tpu.serve import RemotePolicy
+    cfg = small_cfg(**{"serve.weight_poll_interval_s": 0.01})
+    net, params = tiny_net(cfg)
+    store = InProcWeightStore(params)
+    from r2d2_tpu.serve import InprocEndpoint, PolicyServer
+    ep = InprocEndpoint()
+    srv = PolicyServer(cfg, net, params, endpoint=ep,
+                       weight_poll=lambda: store.poll("serve"),
+                       weight_version=lambda: store.reader_version(
+                           "serve")).start()
+    try:
+        remote = RemotePolicy(ep.connect(), net.action_dim, 0.0, seed=0)
+        rng = np.random.default_rng(5)
+        remote.observe_reset(rand_obs(rng, cfg))
+        _, q_before, _ = remote.step()
+        import jax
+        new_params = jax.tree_util.tree_map(lambda x: x * 2.0, params)
+        store.publish(new_params)
+        deadline = time.monotonic() + 10.0
+        while remote.weight_version < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+            remote.bootstrap_q()                    # no state advance
+        assert remote.weight_version == 2           # stamped from replies
+        q_after = remote.bootstrap_q()
+        assert not np.array_equal(q_before, q_after)
+    finally:
+        srv.stop()
+
+
+def test_expired_request_dropped_without_state_touch():
+    from r2d2_tpu.serve import Reply, Request
+    from r2d2_tpu.serve.transport import STATUS_EXPIRED
+    cfg = small_cfg(**{"serve.request_ttl_s": 0.5})
+    _, net, params, ep, srv = make_server(cfg)
+    try:
+        got = []
+        event = threading.Event()
+        # aged on the SERVER-side arrival stamp (t_recv — comparable
+        # across hosts, unlike the client's t_submit monotonic): push
+        # straight into the inbox with an old arrival time, the shape of
+        # a backlog queued against a dead server
+        req = Request(client_id=9, req_id=1, t_submit=time.monotonic())
+        req.t_recv = time.monotonic() - 10.0
+        ep.inbox.put((req, lambda r: (got.append(r), event.set())))
+        assert event.wait(5.0)
+        assert got[0].status == STATUS_EXPIRED
+        assert srv.cache.leased_slots == 0          # state untouched
+        assert isinstance(got[0], Reply)
+    finally:
+        srv.stop()
+
+
+def test_duplicate_op_replays_cached_reply():
+    """Idempotent RPC: a retried copy of an already-applied op (client
+    timed out, reply lost) must NOT re-roll the frame stack or
+    re-advance the hidden — the server replays the cached result."""
+    from r2d2_tpu.serve import KIND_STEP, Request
+    cfg, net, params, ep, srv = make_server()
+    try:
+        rng = np.random.default_rng(11)
+        obs = rand_obs(rng, cfg)
+        frame = rand_obs(rng, cfg)
+
+        def ask(req):
+            got = []
+            event = threading.Event()
+            ep.submit(req, lambda r: (got.append(r), event.set()))
+            assert event.wait(5.0)
+            return got[0]
+
+        first = Request(client_id=5, req_id=100, kind=KIND_STEP, op_seq=1,
+                        t_submit=time.monotonic(), reset_obs=obs)
+        r1 = ask(first)
+        # the retry: fresh req_id, SAME op_seq, same payload
+        dup = Request(client_id=5, req_id=101, kind=KIND_STEP, op_seq=1,
+                      t_submit=time.monotonic(), reset_obs=obs)
+        r2 = ask(dup)
+        assert r2.action == r1.action
+        np.testing.assert_array_equal(r2.q, r1.q)
+        np.testing.assert_array_equal(r2.hidden, r1.hidden)  # no advance
+        # the NEXT logical op advances normally
+        nxt = Request(client_id=5, req_id=102, kind=KIND_STEP, op_seq=2,
+                      t_submit=time.monotonic(), obs=frame, action=r1.action)
+        r3 = ask(nxt)
+        assert not np.array_equal(r3.hidden, r1.hidden)
+        # a stale copy OLDER than the applied horizon is never re-applied
+        from r2d2_tpu.serve.transport import STATUS_EXPIRED
+        stale = Request(client_id=5, req_id=103, kind=KIND_STEP, op_seq=1,
+                        t_submit=time.monotonic(), reset_obs=obs)
+        r4 = ask(stale)
+        assert r4.status == STATUS_EXPIRED
+        slot = srv.cache._leases[5 % srv.cache.shards][5]
+        np.testing.assert_array_equal(srv.cache.hidden[slot],
+                                      np.asarray(r3.hidden))
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# transports
+
+
+def _native_available():
+    try:
+        from r2d2_tpu.native import ring_lib
+        ring_lib()
+        return True
+    except Exception:
+        return False
+
+
+def test_shm_transport_roundtrip():
+    if not _native_available():
+        pytest.skip("native shm ring toolchain unavailable")
+    from r2d2_tpu.serve import (InprocEndpoint, PolicyServer,
+                                RemotePolicy, ShmServeChannel,
+                                ShmServeTransport)
+    cfg = small_cfg()
+    net, params = tiny_net(cfg)
+    ep = InprocEndpoint()
+    transport = ShmServeTransport(
+        ep.submit, (cfg.env.frame_height, cfg.env.frame_width),
+        net.action_dim, cfg.network.hidden_dim, request_slots=16)
+    srv = PolicyServer(cfg, net, params, endpoint=ep).start()
+    try:
+        channel = ShmServeChannel(transport.request_ring, net.action_dim,
+                                  cfg.network.hidden_dim, reply_slots=4)
+        remote = RemotePolicy(channel, net.action_dim, 0.0, seed=0,
+                              client_id=3)
+        rng = np.random.default_rng(6)
+        remote.observe_reset(rand_obs(rng, cfg))
+        a, q, h = remote.act()
+        assert 0 <= a < net.action_dim
+        assert q.shape == (net.action_dim,)
+        assert h.shape == (2, cfg.network.hidden_dim)
+        remote.close()
+    finally:
+        srv.stop()
+        transport.close()
+
+
+def test_shm_transport_full_stream_parity():
+    if not _native_available():
+        pytest.skip("native shm ring toolchain unavailable")
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.serve import (InprocEndpoint, PolicyServer,
+                                RemotePolicy, ShmServeChannel,
+                                ShmServeTransport)
+    cfg = small_cfg()
+    net, params = tiny_net(cfg)
+    ep = InprocEndpoint()
+    transport = ShmServeTransport(
+        ep.submit, (cfg.env.frame_height, cfg.env.frame_width),
+        net.action_dim, cfg.network.hidden_dim, request_slots=16)
+    srv = PolicyServer(cfg, net, params, endpoint=ep).start()
+    try:
+        channel = ShmServeChannel(transport.request_ring, net.action_dim,
+                                  cfg.network.hidden_dim, reply_slots=4)
+        remote = RemotePolicy(channel, net.action_dim, 0.3, seed=9)
+        local = ActorPolicy(net, params, 0.3, seed=9)
+        rng = np.random.default_rng(7)
+        obs = rand_obs(rng, cfg)
+        local.observe_reset(obs)
+        remote.observe_reset(obs)
+        for _ in range(10):
+            a1, q1, _ = local.act()
+            a2, q2, _ = remote.act()
+            assert a1 == a2
+            np.testing.assert_array_equal(q1, q2)
+            nxt = rand_obs(rng, cfg)
+            local.observe(nxt, a1)
+            remote.observe(nxt, a2)
+        remote.close()
+    finally:
+        srv.stop()
+        transport.close()
+
+
+def test_socket_transport_roundtrip():
+    from r2d2_tpu.serve import (InprocEndpoint, PolicyServer, RemotePolicy,
+                                SocketChannel, SocketServerTransport)
+    cfg = small_cfg()
+    net, params = tiny_net(cfg)
+    ep = InprocEndpoint()
+    transport = SocketServerTransport(ep.submit, "127.0.0.1", 0)
+    srv = PolicyServer(cfg, net, params, endpoint=ep).start()
+    try:
+        channel = SocketChannel(transport.host, transport.port)
+        remote = RemotePolicy(channel, net.action_dim, 0.0, seed=0)
+        rng = np.random.default_rng(8)
+        remote.observe_reset(rand_obs(rng, cfg))
+        a1, q1, _ = remote.act()
+        a2, q2, _ = remote.act()
+        assert q1.shape == q2.shape == (net.action_dim,)
+        assert not np.array_equal(q1, q2)           # hidden advanced
+        remote.close()
+    finally:
+        srv.stop()
+        transport.close()
+
+
+def test_server_restart_reconnect_inproc():
+    """A dead server makes requests time out (backoff ladder, eventually
+    ServeUnavailable); a replacement on the SAME endpoint picks the
+    retried requests up — the chaos drill's mechanism, unit-sized."""
+    from r2d2_tpu.serve import (PolicyServer, RemotePolicy, ServeUnavailable)
+    cfg = small_cfg(**{"serve.request_timeout_s": 0.15,
+                       "serve.request_ttl_s": 0.3})
+    _, net, params, ep, srv = make_server(cfg)
+    remote = RemotePolicy(ep.connect(), net.action_dim, 0.0, seed=0,
+                          timeout_s=0.15, max_retry_s=1.0,
+                          backoff_base_s=0.05, backoff_max_s=0.1)
+    rng = np.random.default_rng(9)
+    remote.observe_reset(rand_obs(rng, cfg))
+    remote.step()
+    srv.stop()
+    with pytest.raises(ServeUnavailable):
+        remote.step()
+    assert remote.timeouts >= 1 and remote.reconnects >= 1
+    srv2 = PolicyServer(cfg, net, params, endpoint=ep).start()
+    try:
+        remote.max_retry_s = 30.0
+        remote.observe_reset(rand_obs(rng, cfg))    # resync state
+        a, q, h = remote.step()
+        assert q.shape == (net.action_dim,)
+    finally:
+        srv2.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving record schema + alert rules
+
+
+def test_serving_stats_interval_block_schema_and_consumption():
+    from r2d2_tpu.serve import ServingStats
+    s = ServingStats()
+    assert s.interval_block() is None               # no traffic: no block
+    s.on_requests(3)
+    s.on_replies(3)
+    s.on_request_latency(0.004)
+    s.on_batch(3, hit_full=False, hit_deadline=True, starved=False)
+    s.on_clients(connects=2, disconnects=1)
+    s.active_clients = 2
+    block = s.interval_block(deadline_ms=5.0, max_batch=32)
+    assert block["requests"] == 3
+    assert block["latency"]["count"] == 1
+    assert block["batch"]["fill_mean"] == 3.0
+    assert block["batch"]["deadline_frac"] == 1.0
+    assert block["clients"] == {"active": 2, "connects": 2,
+                                "reconnects": 0, "disconnects": 1,
+                                "evictions": 0}
+    assert block["deadline_ms"] == 5.0 and block["max_batch"] == 32
+    assert s.interval_block() is None               # consumed
+    s.on_clients(disconnects=1)
+    s.on_requests(1)
+    block2 = s.interval_block()
+    assert block2["clients"]["disconnects"] == 2    # cumulative counter
+
+
+def _record_with_serving(p99_ms=None, starved=None, disconnects=0):
+    serving = {"latency": {"p99_ms": p99_ms},
+               "batch": {"starved_frac": starved},
+               "clients": {"disconnects": disconnects}}
+    return {"t": 1.0, "buffer_speed": 100.0, "training_speed": 1.0,
+            "serving": serving}
+
+
+def test_serve_alert_rules_fire_and_rearm():
+    from r2d2_tpu.telemetry.alerts import AlertEngine, default_rules
+    engine = AlertEngine(default_rules(Config().telemetry))
+    # healthy: nothing
+    out = engine.evaluate(_record_with_serving(p99_ms=5.0))
+    assert not out["fired"]
+    # outage-shaped latency: SLO fires once, stays active, then re-arms
+    out = engine.evaluate(_record_with_serving(p99_ms=5000.0))
+    assert [a["rule"] for a in out["fired"]] == ["serve_latency_slo"]
+    out = engine.evaluate(_record_with_serving(p99_ms=6000.0))
+    assert not out["fired"]                         # level: edge only
+    out = engine.evaluate(_record_with_serving(p99_ms=4.0))
+    assert "serve_latency_slo" not in out["active"]
+    out = engine.evaluate(_record_with_serving(p99_ms=5000.0))
+    assert [a["rule"] for a in out["fired"]] == ["serve_latency_slo"]
+    # starvation threshold (fires, then clears on a healthy interval)
+    out = engine.evaluate(_record_with_serving(p99_ms=5.0, starved=0.99))
+    assert [a["rule"] for a in out["fired"]] == ["serve_batch_starvation"]
+    out = engine.evaluate(_record_with_serving(p99_ms=5.0, starved=0.1))
+    assert "serve_batch_starvation" not in out["active"]
+    # churn counter: cumulative jump >= bound fires once
+    out = engine.evaluate(_record_with_serving(p99_ms=5.0, disconnects=4))
+    assert [a["rule"] for a in out["fired"]] == ["serve_client_churn"]
+    out = engine.evaluate(_record_with_serving(p99_ms=5.0, disconnects=4))
+    assert not out["fired"]
+    # a record WITHOUT the serving block neither fires nor re-activates
+    # any serve rule (record_value -> None leaves level rules holding
+    # their — here inactive — state)
+    out = engine.evaluate({"t": 2.0, "buffer_speed": 100.0})
+    assert not out["fired"]
+    assert not any(r.startswith("serve") for r in out["active"])
+
+
+def test_record_schema_identical_without_serving(tmp_path):
+    """actor.inference='local' (nothing attached): the record must be
+    byte-identical to the PR-11 schema — no 'serving' key, every
+    pre-PR13 key intact (the kill-switch acceptance)."""
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+    from tests.test_telemetry import PR23_RECORD_KEYS
+    m = TrainMetrics(0, str(tmp_path))
+    m.on_block(20, 1.0)
+    m.on_train_step(0.5)
+    record = m.log(2.0)
+    assert "serving" not in record
+    assert PR23_RECORD_KEYS <= set(record)
+    from r2d2_tpu.tools.logparse import parse_jsonl
+    rows = parse_jsonl(str(tmp_path / "metrics_player0.jsonl"))
+    assert set(rows[0]) == set(record)
+
+
+def test_record_serving_block_and_provider_contract(tmp_path):
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+    from r2d2_tpu.serve import ServingStats
+    m = TrainMetrics(0, str(tmp_path))
+    stats = ServingStats()
+    m.set_serving(stats.interval_block)
+    record = m.log(2.0)
+    assert "serving" not in record                  # no traffic: omitted
+    stats.on_requests(2)
+    stats.on_replies(2)
+    stats.on_request_latency(0.002)
+    record = m.log(2.0)
+    assert record["serving"]["requests"] == 2
+    from r2d2_tpu.tools.logparse import serve_series
+    series = serve_series([record])
+    assert series["requests"] == [2]
+    assert series["latency_p99_ms"][0] is not None
+
+
+def test_inspect_serving_panel():
+    from r2d2_tpu.tools.inspect import render_serving
+    block = {"requests": 10, "replies": 10, "expired": 0, "timeouts": 1,
+             "latency": {"count": 10, "p50_ms": 2.0, "p95_ms": 5.0,
+                         "p99_ms": 9.0},
+             "batch": {"count": 5, "fill_mean": 2.0, "full_frac": 0.0,
+                       "deadline_frac": 1.0, "starved_frac": 0.2},
+             "clients": {"active": 2, "connects": 2, "reconnects": 1,
+                         "disconnects": 1, "evictions": 0},
+             "deadline_ms": 5.0, "max_batch": 8}
+    panel = render_serving(block)
+    assert "serving: 10 req" in panel
+    assert "p99=9" in panel.replace(".0000", "").replace(".000", "")
+    assert "reconnects=1" in panel
+
+
+# ---------------------------------------------------------------------------
+# chaos: client faults + config plumbing
+
+
+def test_fault_grammar_disconnect():
+    from r2d2_tpu.tools.chaos import parse_fault_spec
+    faults = parse_fault_spec("0:disconnect@req=5;1:slowx2")
+    assert faults[0].kind == "disconnect" and faults[0].block == 5
+    with pytest.raises(ValueError):
+        parse_fault_spec("0:disconnect")            # needs @req=N
+    with pytest.raises(ValueError):
+        parse_fault_spec("0:disconnect@req=0")
+    # config validation: disconnect requires served inference
+    with pytest.raises(ValueError, match="inference"):
+        small_cfg(**{"actor.fault_spec": "0:disconnect@req=5"})
+    cfg = small_cfg(**{"actor.fault_spec": "0:disconnect@req=5",
+                       "actor.inference": "server"})
+    assert cfg.actor.inference == "server"
+
+
+def test_chaos_channel_disconnect_state_survives():
+    """disconnect@req=N drops the serve connection every Nth request;
+    the lease-retention window means the action stream STILL matches the
+    uninterrupted local policy's exactly."""
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.serve import RemotePolicy
+    from r2d2_tpu.tools.chaos import parse_fault_spec, wrap_channel
+    cfg, net, params, ep, srv = make_server()
+    try:
+        fault = parse_fault_spec("0:disconnect@req=4")[0]
+        channel = wrap_channel(ep.connect(), fault)
+        remote = RemotePolicy(channel, net.action_dim, 0.25, seed=13)
+        local = ActorPolicy(net, params, 0.25, seed=13)
+        rng = np.random.default_rng(10)
+        obs = rand_obs(rng, cfg)
+        local.observe_reset(obs)
+        remote.observe_reset(obs)
+        for _ in range(12):
+            a1, q1, _ = local.act()
+            a2, q2, _ = remote.act()
+            assert a1 == a2
+            np.testing.assert_array_equal(q1, q2)
+            nxt = rand_obs(rng, cfg)
+            local.observe(nxt, a1)
+            remote.observe(nxt, a2)
+        assert channel.disconnects_injected >= 2
+        deadline = time.monotonic() + 5.0
+        while srv.cache.reconnects < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.cache.reconnects >= 2            # lease resumed each time
+    finally:
+        srv.stop()
+
+
+def test_config_roundtrip_and_validation():
+    cfg = small_cfg(**{"actor.inference": "server", "serve.max_batch": 16,
+                       "serve.transport": "socket"})
+    again = Config.from_dict(cfg.to_dict())
+    assert again.serve.max_batch == 16
+    assert again.actor.inference == "server"
+    # pre-PR13 config dicts (no serve section / inference field) load
+    d = cfg.to_dict()
+    del d["serve"]
+    del d["actor"]["inference"]
+    old = Config.from_dict(d)
+    # absent section/field take defaults: serve defaults, local inference
+    assert old.serve.max_batch == 32 and old.actor.inference == "local"
+    with pytest.raises(ValueError, match="inference"):
+        small_cfg(**{"actor.inference": "remote"})
+    with pytest.raises(ValueError, match="divisible"):
+        small_cfg(**{"serve.state_slots": 10, "serve.state_shards": 4})
+    with pytest.raises(ValueError, match="state_slots"):
+        small_cfg(**{"actor.inference": "server", "actor.num_actors": 2,
+                     "actor.envs_per_actor": 16, "serve.state_slots": 8,
+                     "serve.state_shards": 1})
+    with pytest.raises(ValueError, match="on_device"):
+        small_cfg(**{"actor.inference": "server", "actor.on_device": True,
+                     "env.episode_len": 20, "actor.anakin_lanes": 4})
+    with pytest.raises(ValueError, match="transport"):
+        small_cfg(**{"serve.transport": "pigeon"})
+
+
+def test_serve_stages_registered():
+    from r2d2_tpu.telemetry import STAGES
+    for stage in ("serve/enqueue", "serve/batch_wait", "serve/forward",
+                  "serve/reply"):
+        assert stage in STAGES
+
+
+# ---------------------------------------------------------------------------
+# e2e slices
+
+
+def test_serve_e2e_thread_mini(tmp_path):
+    """Fast e2e: thread actors act through the in-proc server into the
+    real learner; the periodic record carries the serving block and
+    training advances."""
+    from r2d2_tpu.runtime.orchestrator import train
+    cfg = small_cfg(**{
+        "actor.num_actors": 2, "actor.inference": "server",
+        "runtime.log_interval": 1.0,
+        "runtime.steps_per_dispatch": 1,
+        "runtime.save_dir": str(tmp_path),
+    })
+    records = []
+    stacks = train(cfg, max_training_steps=2, max_seconds=120,
+                   actor_mode="thread", log_fn=records.append)
+    lr = stacks[0].learner
+    assert lr.training_steps >= 2
+    serving = [r["serving"] for r in records if r.get("serving")]
+    assert serving, "no serving block in any record"
+    sb = serving[-1]
+    assert sb["replies"] > 0
+    assert sb["clients"]["active"] == 2
+    assert sb["latency"]["p99_ms"] is not None
+    # the serve stages flowed through the canonical telemetry
+    stages = {}
+    for r in records:
+        stages.update(r.get("stages") or {})
+    assert "serve/forward" in stages
+
+
+@pytest.mark.slow
+def test_serve_e2e_process_shm(tmp_path):
+    """Slow e2e: PROCESS actors reach the learner-process server over
+    the shm request/reply rings and training advances — the full
+    transport ladder under the real orchestrator."""
+    from r2d2_tpu.runtime.orchestrator import train
+    cfg = small_cfg(**{
+        "actor.num_actors": 1, "actor.envs_per_actor": 4,
+        "actor.inference": "server",
+        "runtime.log_interval": 2.0,
+        "runtime.steps_per_dispatch": 1,
+        "runtime.save_dir": str(tmp_path),
+    })
+    records = []
+    stacks = train(cfg, max_training_steps=3, max_seconds=240,
+                   actor_mode="process", log_fn=records.append)
+    assert stacks[0].learner.training_steps >= 3
+    serving = [r["serving"] for r in records if r.get("serving")]
+    assert serving and serving[-1]["batch"]["fill_mean"] > 1
+
+
+@pytest.mark.slow
+def test_serve_chaos_server_restart_drill():
+    """The acceptance drill: kill the server mid-training — the learner
+    never stalls, serve_latency_slo fires during the outage and re-arms,
+    clients reconnect and resume."""
+    from r2d2_tpu.tools.chaos import run_serve_chaos
+    report = run_serve_chaos(seconds=45.0, outage_s=6.0)
+    assert report["verdict"]["no_learner_stall"], report
+    assert report["verdict"]["slo_fired"], report
+    assert report["verdict"]["slo_rearmed"], report
+    assert report["verdict"]["clients_resumed"], report
+
+
+@pytest.mark.slow
+def test_evaluate_as_a_service(tmp_path):
+    """cli/evaluate --serve: checkpoint rollouts through the in-proc
+    server match the direct path's contract (finite mean return)."""
+    from r2d2_tpu.cli.evaluate import evaluate_checkpoint
+    from r2d2_tpu.runtime.checkpoint import save_checkpoint
+    cfg = small_cfg(**{"runtime.save_dir": str(tmp_path)})
+    net, params = tiny_net(cfg, action_dim=6)
+    ckpt = save_checkpoint(str(tmp_path), "Fake", 1, 0, params,
+                           {"none": np.zeros(1)}, params, step=7,
+                           env_steps=140, config_json=cfg.to_json())
+    mean_direct, step, env_steps = evaluate_checkpoint(
+        cfg, ckpt, rounds=2, seed=0)
+    mean_served, step2, _ = evaluate_checkpoint(
+        cfg, ckpt, rounds=4, seed=0, serve=True, serve_clients=2)
+    assert step == step2 == 7
+    assert np.isfinite(mean_direct) and np.isfinite(mean_served)
