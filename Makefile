@@ -68,6 +68,16 @@
 #                schema stability); the slow e2e slice (real actors
 #                through the server into the learner) and the
 #                server-kill/restart chaos drill run with the full tier.
+#   make quant — the fast-tier quantized-inference suite
+#                (tests/test_quant.py: per-channel int8 round-trip
+#                bounds, greedy-action agreement vs the f32 twin,
+#                publish-time bundle round-trips through both weight
+#                stores with staleness stamps, serve/local/anakin
+#                switching through the one shared forward, the in-graph
+#                probe + quant block + quant_divergence rule,
+#                kill-switch schema stability, pre-PR14 config
+#                round-trip); the slow int8 learnability slice runs
+#                with the full tier.
 #   make costmodel — the fast-tier cost-model/roofline suite
 #                (tests/test_costmodel.py: XLA cost-table extraction
 #                across step factories incl. a sharded emulated-mesh
@@ -90,7 +100,7 @@
 #                shape on TPU).
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
-	replaydiag fleet serve costmodel regress costs roofline \
+	replaydiag fleet serve quant costmodel regress costs roofline \
 	check-fast-markers
 
 t1: check-fast-markers
@@ -132,6 +142,10 @@ serve: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+quant: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
 costmodel: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q \
 	    -m 'not slow' -p no:cacheprovider
@@ -163,6 +177,7 @@ FAST_MARKER_CHECKS := \
 	tests/test_replay_diag.py:not_slow:10:replay-diag \
 	tests/test_fleet.py:not_slow:12:fleet \
 	tests/test_serve.py:not_slow:14:serve \
+	tests/test_quant.py:not_slow:14:quant \
 	tests/test_costmodel.py:not_slow:10:cost-model
 
 check-fast-markers:

@@ -263,7 +263,8 @@ def _emit_blocks_body(spec, gamma, priority, tail_frames, tail_la,
 
 def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
                   num_lanes: int, gamma: float, priority,
-                  priority_eta: float = 0.9, unroll: int = 1) -> Callable:
+                  priority_eta: float = 0.9, unroll: int = 1,
+                  quant_probe: bool = True) -> Callable:
     """The traceable acting segment, parameterized by per-lane arrays:
 
         core(params, carry, weight_version, eps, report, lanes=None)
@@ -289,6 +290,29 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
         raise ValueError(f"priority must be a positive float or 'td', "
                          f"got {priority!r}")
     action_dim = net.action_dim
+    # quantized acting (ISSUE 14): when the config knob is on, ``params``
+    # is the published inference bundle and every policy forward inside
+    # the scan runs the quantized twin (the same apply variant the shared
+    # make_forward_fn uses — flipping the knob switches host actors, the
+    # server, and this scan together). At "f32" the branch below is a
+    # python-level identity and the traced program is byte-identical.
+    quant = net.config.inference_dtype != "f32"
+    # the per-segment accuracy probe honors the same kill switch as the
+    # host actors' lax.cond probe (telemetry.quant_probe_interval = 0):
+    # off, the f32 twin never enters the program at all
+    quant_probe = quant and bool(quant_probe)
+    if quant:
+        from r2d2_tpu.models.network import (f32_reference_module,
+                                             quantized_inference_apply)
+        # the ONE shared definition of the probe's f32 reference twin
+        f32_module = f32_reference_module(net)
+
+        def policy_apply(params, obs, la, hidden):
+            return quantized_inference_apply(net, params["quant"], obs, la,
+                                             hidden)
+    else:
+        def policy_apply(params, obs, la, hidden):
+            return net.module.apply(params, obs, la, hidden)
     if env.action_dim != action_dim:
         raise ValueError(f"env action_dim {env.action_dim} != network "
                          f"action_dim {action_dim}")
@@ -328,8 +352,8 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
                            / np.float32(255.0)).transpose(0, 2, 3, 1)
                 la_1h = jax.nn.one_hot(c.last_action, action_dim,
                                        dtype=jnp.float32)
-                q, hid = net.module.apply(params, stacked[:, None],
-                                          la_1h[:, None], c.hidden)
+                q, hid = policy_apply(params, stacked[:, None],
+                                      la_1h[:, None], c.hidden)
                 greedy = jnp.argmax(q[:, 0], axis=-1).astype(jnp.int32)
                 explore = jax.random.uniform(k_eps, (num_lanes,)) < eps
                 randa = jax.random.randint(k_expl, (num_lanes,), 0,
@@ -375,10 +399,34 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
                          / np.float32(255.0)).transpose(0, 2, 3, 1)
             la_b = jax.nn.one_hot(out_carry.last_action, action_dim,
                                   dtype=jnp.float32)
-            qb, _ = net.module.apply(params, stacked_b[:, None],
-                                     la_b[:, None], out_carry.hidden)
+            qb, _ = policy_apply(params, stacked_b[:, None],
+                                 la_b[:, None], out_carry.hidden)
             q_boot = jnp.where(terminal[:, None], jnp.float32(0.0),
                                qb[:, 0])
+
+        probe_stats = None
+        if quant_probe:
+            # accuracy probe (ISSUE 14): once per segment — already
+            # ~2/block_length of the scan's cost — run the quantized
+            # forward AND the f32 twin on the PRE-reset end-of-segment
+            # state and record max |ΔQ| + greedy agreement across the
+            # lanes; the host loop feeds these into the record's quant
+            # block (the host actors' lax.cond probe, at segment cadence)
+            stacked_p = (out_carry.cur_stack.astype(jnp.float32)
+                         / np.float32(255.0)).transpose(0, 2, 3, 1)
+            la_p = jax.nn.one_hot(out_carry.last_action, action_dim,
+                                  dtype=jnp.float32)
+            qq, _ = policy_apply(params, stacked_p[:, None],
+                                 la_p[:, None], out_carry.hidden)
+            qf, _ = f32_module.apply(params["f32"], stacked_p[:, None],
+                                     la_p[:, None], out_carry.hidden)
+            qq, qf = qq[:, 0], qf[:, 0]
+            probe_stats = {
+                "quant_dq": jnp.max(jnp.abs(qf - qq)).astype(jnp.float32),
+                "quant_agree": jnp.mean(
+                    (jnp.argmax(qf, axis=-1)
+                     == jnp.argmax(qq, axis=-1)).astype(jnp.float32)),
+            }
 
         def sel(a, b):
             d = terminal.reshape(terminal.shape + (1,) * (a.ndim - 1))
@@ -414,6 +462,8 @@ def make_act_core(env, net: NetworkApply, spec: ReplaySpec, *,
             "reported_return_sum": jnp.sum(
                 jnp.where(done_rep, ys["ep_ret"], 0.0)).astype(jnp.float32),
         }
+        if probe_stats is not None:
+            stats.update(probe_stats)
         out_carry = out_carry.replace(tail_frames=tf, tail_la=tl,
                                       tail_hidden=th, burn0=b0)
         return out_carry, blocks, stats
@@ -425,7 +475,7 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
                     num_lanes: int, epsilons, gamma: float,
                     priority, near_greedy_eps: float,
                     priority_eta: float = 0.9, unroll: int = 1,
-                    lane_base: int = 0) -> Callable:
+                    lane_base: int = 0, quant_probe: bool = True) -> Callable:
     """Build the jitted acting segment (1x1-mesh composition):
 
         act(params, carry, weight_version) -> (carry, blocks, stats)
@@ -454,7 +504,7 @@ def make_anakin_act(env, net: NetworkApply, spec: ReplaySpec, *,
     report = np.asarray([e <= near_greedy_eps for e in eps_list])
     core = make_act_core(env, net, spec, num_lanes=num_lanes, gamma=gamma,
                          priority=priority, priority_eta=priority_eta,
-                         unroll=unroll)
+                         unroll=unroll, quant_probe=quant_probe)
 
     def act(params, carry: ActCarry, weight_version):
         # the static ladder constant-folds into the program — the dp=1

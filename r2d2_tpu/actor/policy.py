@@ -19,7 +19,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from r2d2_tpu.models.network import NetworkApply, initial_hidden
+from r2d2_tpu.models.network import (NetworkApply, initial_hidden,
+                                     is_quant_bundle, make_inference_bundle,
+                                     quantized_inference_apply)
 
 
 def _pin_params(params, cpu, copy: bool):
@@ -35,7 +37,8 @@ def _pin_params(params, cpu, copy: bool):
     return jax.device_put(params, cpu)
 
 
-def make_forward_fn(net: NetworkApply):
+def make_forward_fn(net: NetworkApply, inference_dtype: Optional[str] = None,
+                    probe_interval: int = 0):
     """The ONE jitted acting forward (ISSUE 13 satellite): a (N, 1)
     single-step recurrent forward shared by ``ActorPolicy`` (N=1),
     ``BatchedActorPolicy``, and the central policy server
@@ -43,19 +46,86 @@ def make_forward_fn(net: NetworkApply):
     local and served inference, so parity between them is the identity
     of a single program, not a numerics argument.
 
-    Signature: ``fn(params, stacked_obs, last_action, hidden)`` with
-    ``stacked_obs`` (N, H, W, stack) f32 in [0,1], ``last_action`` (N,)
-    int32, ``hidden`` (N, 2, hidden) packed — returns (greedy_actions
-    (N,), q (N, A), hidden' (N, 2, hidden))."""
+    ``inference_dtype`` (default: ``net.config.inference_dtype``) is the
+    quantized-inference knob (ISSUE 14) — because every consumer builds
+    its forward HERE, flipping the config knob switches local actors,
+    the policy server, and (through the same apply variant) the anakin
+    scan together.
 
-    def step_fn(params, stacked_obs, last_action, hidden):
+    At ``"f32"`` (the default) the program is byte-identical to pre-PR14:
+    ``fn(params, stacked_obs, last_action, hidden)`` with ``stacked_obs``
+    (N, H, W, stack) f32 in [0,1], ``last_action`` (N,) int32, ``hidden``
+    (N, 2, hidden) packed — returns (greedy_actions (N,), q (N, A),
+    hidden' (N, 2, hidden)).
+
+    At ``"bf16"``/``"int8"`` the forward takes the PUBLISHED bundle
+    ({"f32", "quant", "stamp"} — make_inference_bundle) plus a tick
+    counter and the LIVE row count, and returns a 4th element, the
+    accuracy probe: ``fn(bundle, stacked_obs, last_action, hidden,
+    tick, live) -> (actions, q, hidden', (dq_max, agree_frac,
+    probed))``. Every ``probe_interval``-th tick a ``lax.cond`` branch
+    ALSO runs the f32 twin on the same live batch and emits
+    max |Q_f32 − Q_quant| and the greedy-action agreement fraction over
+    the first ``live`` rows (probed = 1.0) — the server pads
+    under-filled dispatches to pow2 buckets, and degenerate pad rows
+    must neither fire nor dilute quant_divergence; local policies pass
+    live = N. Other ticks the branch is skipped and probed = 0.0.
+    ``probe_interval=0`` compiles the probe OUT entirely — the
+    program's weight arguments are then the quantized twin alone (what
+    the costmodel's weight-bytes rows measure)."""
+    mode = (inference_dtype if inference_dtype is not None
+            else net.config.inference_dtype)
+
+    if mode == "f32":
+        def step_fn(params, stacked_obs, last_action, hidden):
+            obs = stacked_obs[:, None]                     # (N, 1, ...)
+            la = jax.nn.one_hot(last_action, net.action_dim,
+                                dtype=jnp.float32)[:, None]
+            q, h = net.module.apply(params, obs, la, hidden)
+            return jnp.argmax(q[:, 0], axis=-1), q[:, 0], h
+
+        return jax.jit(step_fn)
+
+    from r2d2_tpu.models.network import f32_reference_module
+    f32_module = f32_reference_module(net)
+    interval = int(probe_interval)
+
+    def quant_step_fn(bundle, stacked_obs, last_action, hidden, tick,
+                      live):
         obs = stacked_obs[:, None]                         # (N, 1, ...)
         la = jax.nn.one_hot(last_action, net.action_dim,
                             dtype=jnp.float32)[:, None]
-        q, h = net.module.apply(params, obs, la, hidden)
-        return jnp.argmax(q[:, 0], axis=-1), q[:, 0], h
+        q, h = quantized_inference_apply(net, bundle["quant"], obs, la,
+                                         hidden)
+        q = q[:, 0]
+        actions = jnp.argmax(q, axis=-1)
+        if interval > 0:
+            def probe(_):
+                q32, _h = f32_module.apply(bundle["f32"], obs, la, hidden)
+                q32 = q32[:, 0]
+                # first `live` rows only: the server's pow2 padding rows
+                # are a fixed degenerate input, not policy behavior
+                mask = (jnp.arange(q.shape[0]) <
+                        jnp.asarray(live, jnp.int32))
+                n = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+                dq = jnp.max(jnp.where(
+                    mask[:, None], jnp.abs(q32 - q), 0.0))
+                agree = jnp.sum(
+                    ((jnp.argmax(q32, axis=-1) == actions) & mask
+                     ).astype(jnp.float32)) / n
+                return dq, agree, jnp.float32(1.0)
 
-    return jax.jit(step_fn)
+            probe_out = jax.lax.cond(
+                jnp.asarray(tick, jnp.int32) % interval == 0, probe,
+                lambda _: (jnp.float32(0.0), jnp.float32(0.0),
+                           jnp.float32(0.0)),
+                operand=None)
+        else:
+            probe_out = (jnp.float32(0.0), jnp.float32(0.0),
+                         jnp.float32(0.0))
+        return actions, q, h, probe_out
+
+    return jax.jit(quant_step_fn)
 
 
 def _force_f32(net: NetworkApply) -> NetworkApply:
@@ -73,9 +143,58 @@ def _force_f32(net: NetworkApply) -> NetworkApply:
     return net
 
 
-class ActorPolicy:
+def feed_quant_probe(stats, probe_interval: int, probe, lanes: int,
+                     tick: Optional[int] = None) -> None:
+    """Route one forward's probe tuple (dq_max, agree_frac, probed) into
+    a QuantStats — the ONE implementation shared by the local policies
+    and the policy server's dispatch loop. No sink, a disabled probe,
+    or an off-interval ``tick`` (the caller holds it host-side, so
+    ``tick % interval`` is known BEFORE any device fetch) skips the
+    three scalar fetches entirely."""
+    if stats is None or probe_interval <= 0:
+        return
+    if tick is not None and tick % probe_interval != 0:
+        return
+    dq, agree, probed = (float(np.asarray(x)) for x in probe)
+    if probed > 0.5:
+        stats.on_probe(dq, agree, lanes=lanes)
+
+
+class _QuantPolicyMixin:
+    """The quantized-inference plumbing both local policies share
+    (ISSUE 14): accept EITHER the published {"f32", "quant", "stamp"}
+    bundle or raw params (a direct construction — eval, tests — gets a
+    locally-built twin, stamp 0), drive the tick counter the in-graph
+    probe keys on, and feed probe results / adopted publish stamps into
+    the attached QuantStats. All no-ops at inference_dtype="f32"."""
+
+    def _init_quant(self, net, quant_stats, probe_interval: int):
+        self._quant = net.config.inference_dtype != "f32"
+        self._quant_stats = quant_stats
+        self._probe_interval = int(probe_interval) if self._quant else 0
+        self._tick = 0
+
+    def _prepare(self, params):
+        """Bundle raw params for the quant forward (identity for a tree
+        that already IS the published bundle, and at f32)."""
+        if not self._quant or is_quant_bundle(params):
+            return params
+        return jax.device_get(make_inference_bundle(self.net, params))
+
+    def _note_update(self, params) -> None:
+        if self._quant and self._quant_stats is not None \
+                and is_quant_bundle(params):
+            self._quant_stats.on_stamp(int(np.asarray(params["stamp"])))
+
+    def _feed_probe(self, probe, lanes: int) -> None:
+        feed_quant_probe(self._quant_stats, self._probe_interval, probe,
+                         lanes, tick=self._tick)
+
+
+class ActorPolicy(_QuantPolicyMixin):
     def __init__(self, net: NetworkApply, params, epsilon: float, seed: int = 0,
-                 copy_updates: bool = True):
+                 copy_updates: bool = True, quant_stats=None,
+                 quant_probe_interval: int = 0):
         net = _force_f32(net)
         self.net = net
         self.epsilon = float(epsilon)
@@ -89,17 +208,26 @@ class ActorPolicy:
         # (WeightSubscriber.poll materializes a new copy per poll), so the
         # defensive copy in _pin would be a second full-tree copy per refresh
         self._copy_updates = copy_updates
-        self.params = self._pin(params, copy=True)  # initial params: unknown owner
+        self._init_quant(net, quant_stats, quant_probe_interval)
+        self.params = self._pin(self._prepare(params), copy=True)
         # the shared (N, 1) acting forward at N=1 — the exact program the
         # batched policy and the policy server run (inputs expand to the
         # same (1, 1, ...) shapes the old scalar closure built, so the
         # compiled computation is unchanged)
-        self._fwd = make_forward_fn(net)
+        self._fwd = make_forward_fn(net,
+                                    probe_interval=self._probe_interval)
         self.reset_state()
 
-    def _step(self, params, stacked, last_action, hidden):
-        action, q, h = self._fwd(params, stacked[None],
-                                 np.asarray(last_action)[None], hidden)
+    def _step(self, params, stacked, last_action, hidden, feed=True):
+        if self._quant:
+            action, q, h, probe = self._fwd(
+                params, stacked[None], np.asarray(last_action)[None],
+                hidden, np.int32(self._tick), np.int32(1))
+            if feed:
+                self._feed_probe(probe, lanes=1)
+        else:
+            action, q, h = self._fwd(params, stacked[None],
+                                     np.asarray(last_action)[None], hidden)
         return action[0], q[0], h
 
     def reset_state(self) -> None:
@@ -125,13 +253,16 @@ class ActorPolicy:
         return _pin_params(params, self._cpu, copy)
 
     def update_params(self, params) -> None:
-        self.params = self._pin(params, copy=self._copy_updates)
+        self._note_update(params)
+        self.params = self._pin(self._prepare(params),
+                                copy=self._copy_updates)
 
     def step(self) -> Tuple[int, np.ndarray, np.ndarray]:
         """Greedy action + Q-values + packed hidden *after* this step; the
         ε-greedy override happens in ``act`` (ref worker.py:535-538)."""
         action, q, self.hidden = self._step(
             self.params, self.stacked, self.last_action, self.hidden)
+        self._tick += 1
         return int(action), np.asarray(q), np.asarray(self.hidden[0])
 
     def act(self) -> Tuple[int, np.ndarray, np.ndarray]:
@@ -142,12 +273,15 @@ class ActorPolicy:
 
     def bootstrap_q(self) -> np.ndarray:
         """Q at the current state without advancing the recurrent state —
-        the block-boundary bootstrap (ref worker.py:560-563)."""
-        _, q, _ = self._step(self.params, self.stacked, self.last_action, self.hidden)
+        the block-boundary bootstrap (ref worker.py:560-563). feed=False:
+        the tick doesn't advance here, so an on-interval bootstrap would
+        otherwise feed the SAME tick's probe twice."""
+        _, q, _ = self._step(self.params, self.stacked, self.last_action,
+                             self.hidden, feed=False)
         return np.asarray(q)
 
 
-class BatchedActorPolicy:
+class BatchedActorPolicy(_QuantPolicyMixin):
     """N env lanes through ONE jitted (N, 1) forward pass per tick.
 
     The scalar ActorPolicy pays a full jit dispatch + interpreter round-trip
@@ -170,7 +304,8 @@ class BatchedActorPolicy:
 
     def __init__(self, net: NetworkApply, params,
                  epsilons: Sequence[float], seeds: Sequence[int],
-                 copy_updates: bool = True):
+                 copy_updates: bool = True, quant_stats=None,
+                 quant_probe_interval: int = 0):
         if len(epsilons) != len(seeds):
             raise ValueError(
                 f"epsilons ({len(epsilons)}) and seeds ({len(seeds)}) must "
@@ -184,11 +319,23 @@ class BatchedActorPolicy:
         self.rngs = [np.random.default_rng(s) for s in seeds]
         self._cpu = jax.local_devices(backend="cpu")[0]
         self._copy_updates = copy_updates
-        self.params = self._pin(params, copy=True)
+        self._init_quant(net, quant_stats, quant_probe_interval)
+        self.params = self._pin(self._prepare(params), copy=True)
         # the shared acting forward (make_forward_fn) — identical closure
         # to the one this class used to define inline
-        self._step = make_forward_fn(net)
+        self._fwd = make_forward_fn(net,
+                                    probe_interval=self._probe_interval)
         self.reset_state()
+
+    def _step(self, params, stacked, last_action, hidden, feed=True):
+        if self._quant:
+            actions, q, h, probe = self._fwd(
+                params, stacked, last_action, hidden,
+                np.int32(self._tick), np.int32(self.num_lanes))
+            if feed:
+                self._feed_probe(probe, lanes=self.num_lanes)
+            return actions, q, h
+        return self._fwd(params, stacked, last_action, hidden)
 
     def reset_state(self) -> None:
         """Reset every lane's per-episode state."""
@@ -221,7 +368,9 @@ class BatchedActorPolicy:
         return _pin_params(params, self._cpu, copy)
 
     def update_params(self, params) -> None:
-        self.params = self._pin(params, copy=self._copy_updates)
+        self._note_update(params)
+        self.params = self._pin(self._prepare(params),
+                                copy=self._copy_updates)
 
     def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Greedy actions (N,), Q-values (N, A), and packed hiddens
@@ -229,6 +378,7 @@ class BatchedActorPolicy:
         ``act``."""
         actions, q, hidden = self._step(
             self.params, self.stacked, self.last_action, self.hidden)
+        self._tick += 1
         # np.array, not asarray: device output views are read-only, and
         # reset_lane mutates rows of this buffer in place
         self.hidden = np.array(hidden)
@@ -245,7 +395,9 @@ class BatchedActorPolicy:
     def bootstrap_q(self) -> np.ndarray:
         """(N, A) Q at every lane's current state without advancing any
         recurrent state — the block-boundary bootstrap, one jitted call
-        for all lanes (rows of reset lanes are unused by the caller)."""
+        for all lanes (rows of reset lanes are unused by the caller).
+        feed=False: the tick doesn't advance here (see ActorPolicy)."""
         _, q, _ = self._step(
-            self.params, self.stacked, self.last_action, self.hidden)
+            self.params, self.stacked, self.last_action, self.hidden,
+            feed=False)
         return np.asarray(q)

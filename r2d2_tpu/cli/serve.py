@@ -95,8 +95,18 @@ def main(argv=None) -> int:
         default_rules(cfg.telemetry),
         jsonl_path=os.path.join(args.save_dir or ".", "serve_alerts.jsonl"))
 
+    quant_stats = None
+    if cfg.network.inference_dtype != "f32":
+        # quantized serving (ISSUE 14): the server builds the twin at
+        # construction and probes per dispatch interval; the quant block
+        # (dtype, agreement, |ΔQ|) rides every serve_metrics record so
+        # the quant_divergence rule evaluates here too
+        from r2d2_tpu.telemetry import QuantStats
+        quant_stats = QuantStats(cfg.network.inference_dtype,
+                                 cfg.telemetry.quant_probe_interval)
     server = PolicyServer(cfg, net, params, endpoint=endpoint,
-                          stats=stats, telemetry=telemetry).start()
+                          stats=stats, telemetry=telemetry,
+                          quant_stats=quant_stats).start()
 
     stop = {"flag": False}
 
@@ -126,6 +136,8 @@ def main(argv=None) -> int:
                           "batches": server.batches_dispatched}
                 if block is not None:   # the TrainMetrics omission contract
                     record["serving"] = block
+                if quant_stats is not None:
+                    record["quant"] = quant_stats.interval_block()
                 record["alerts"] = engine.evaluate(record)
                 with open(metrics_path, "a") as f:
                     f.write(json.dumps(record) + "\n")
@@ -141,6 +153,8 @@ def main(argv=None) -> int:
                   "batches": server.batches_dispatched, "final": True}
         if block is not None:
             record["serving"] = block
+        if quant_stats is not None:
+            record["quant"] = quant_stats.interval_block()
         record["alerts"] = engine.evaluate(record)
         with open(metrics_path, "a") as f:
             f.write(json.dumps(record) + "\n")

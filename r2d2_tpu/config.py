@@ -112,6 +112,25 @@ class NetworkConfig:
     # mode (works on any backend, slow) — how the driver's multichip
     # dryrun executes the kernel's exact semantics without a TPU.
     pallas_lstm_interpret: bool = False
+    # -- quantized inference plane (ISSUE 14) --
+    # Dtype of the ACTING/SERVING forward only (local scalar/vector
+    # actors, the policy server's micro-batched dispatch, and the anakin
+    # acting scan — all through the ONE shared forward); the learner's
+    # training math is untouched. "f32" (default) = every existing
+    # program byte-identical. "bf16" publishes a bf16 weight twin (2x
+    # weight-bytes cut); "int8" publishes a per-channel symmetric int8
+    # twin of every matmul kernel (~4x kernel-bytes cut), dequantized
+    # per-channel into the compute-dtype matmul at apply time — the
+    # acting forward is weight-streaming-bound at acting batch sizes
+    # (costmodel tables; Podracer, arXiv 2104.06272), so cutting weight
+    # bytes is the direct multiplier on env-steps/s and requests/s.
+    # Quantization happens ONCE at weight publish (a quantized twin
+    # rides the existing publish plumbing — no hot-path requantization);
+    # the LSTM carry stays f32 so recurrent state never accumulates
+    # quantization drift. Quality is guarded in-graph: a per-interval
+    # probe runs the f32 twin on the live batch and feeds the record's
+    # 'quant' block + the quant_divergence alert rule.
+    inference_dtype: str = "f32"
 
 
 @dataclass(frozen=True)
@@ -602,6 +621,22 @@ class TelemetryConfig:
     # growing by at least this much within one interval fires
     # serve_client_churn (counter semantics — one burst, one alert).
     alerts_serve_churn: float = 3.0
+    # -- quantized inference plane (ISSUE 14; the record's 'quant' block) --
+    # Forward calls between accuracy probes when network.inference_dtype
+    # != "f32": every probe_interval-th acting forward also runs the f32
+    # twin on the SAME live batch (a lax.cond inside the jitted forward —
+    # steady-state cost amortizes to ~nothing) and feeds max |Q_f32 −
+    # Q_quant| + the greedy-action agreement fraction into the periodic
+    # record's 'quant' block. 0 disables probing (the block still carries
+    # the active dtype). The anakin path probes once per acting segment
+    # (already ~1/block_length of the scan's cost).
+    quant_probe_interval: int = 256
+    # Interval greedy-action agreement fraction (quant.agree_frac, the
+    # lane-weighted mean over the interval's probes) at/below which
+    # quant_divergence fires — the quantized policy is no longer acting
+    # like its f32 twin. Inactive on records without a quant block
+    # (every inference_dtype="f32" run).
+    alerts_quant_agreement: float = 0.95
 
 
 @dataclass(frozen=True)
@@ -930,6 +965,23 @@ class Config:
             raise ValueError(
                 f"telemetry.alerts_serve_churn "
                 f"({self.telemetry.alerts_serve_churn}) must be >= 1")
+        if self.network.inference_dtype not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"network.inference_dtype "
+                f"({self.network.inference_dtype!r}) must be 'f32', "
+                "'bf16', or 'int8' — the acting/serving forward's weight "
+                "dtype (the learner always trains in the network.bf16 "
+                "policy regardless)")
+        if self.telemetry.quant_probe_interval < 0:
+            raise ValueError(
+                f"telemetry.quant_probe_interval "
+                f"({self.telemetry.quant_probe_interval}) must be >= 0 "
+                "(0 disables the in-graph accuracy probe)")
+        if not 0 < self.telemetry.alerts_quant_agreement <= 1:
+            raise ValueError(
+                f"telemetry.alerts_quant_agreement "
+                f"({self.telemetry.alerts_quant_agreement}) must be in "
+                "(0, 1]")
         for fname, lo in (("supervise_interval_s", 0.0),
                           ("restart_window_s", 0.0)):
             if getattr(self.runtime, fname) <= lo:

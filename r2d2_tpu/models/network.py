@@ -399,6 +399,208 @@ def dual_sequence_q(net: "NetworkApply", params_a, params_b,
         return head_q(params_a, out_a), head_q(params_b, out_b)
 
 
+# ---------------------------------------------------------------------------
+# Quantized inference plane (ISSUE 14): per-channel symmetric int8 / bf16
+# weight twins for the ACTING forward. The acting forward is
+# weight-streaming-bound at acting batch sizes (tiny per-request FLOPs
+# against full param-bytes HBM traffic — the costmodel tables; Podracer,
+# arXiv 2104.06272), so shrinking weight bytes is the direct multiplier
+# on env-steps/s and serving requests/s. Quantization happens ONCE at
+# weight publish (runtime/weights.py ships the twin; no hot-path
+# requantization); the forward dequantizes per-channel into the compute
+# matmul. The learner never sees any of this — training stays f32/bf16.
+# ---------------------------------------------------------------------------
+
+INFERENCE_DTYPES = ("f32", "bf16", "int8")
+
+
+def quant_compute_dtype():
+    """Compute dtype of the quantized forward's matmuls: bf16 on TPU
+    (the MXU-native acting dtype — the int8 weights dequantize into it),
+    f32 elsewhere (bf16 is emulated and slower on CPU hosts, the
+    _force_f32 reasoning; int8 storage still cuts publish bytes there).
+    Resolved per-process at trace time, like the sibling tri-states."""
+    import jax
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
+def quantize_leaf_int8(w: jnp.ndarray) -> dict:
+    """Per-channel symmetric int8 quantization of one kernel: the scale
+    is max|w| over all axes but the LAST (the output-channel axis of
+    conv/dense/LSTM kernels) / 127, so each output channel keeps its own
+    dynamic range — the standard per-channel weight-only scheme. The
+    round-trip error is bounded by scale/2 per element (tested)."""
+    w = jnp.asarray(w, jnp.float32)
+    axes = tuple(range(w.ndim - 1))
+    scale = jnp.max(jnp.abs(w), axis=axes, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, jnp.float32(1e-12))   # all-zero channels
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale.astype(jnp.float32)}
+
+
+def _is_quant_leaf(leaf) -> bool:
+    return isinstance(leaf, dict) and "q" in leaf and "scale" in leaf
+
+
+def dequantize_leaf(leaf, dtype):
+    """Inverse of quantize_leaf_int8 (or a plain cast for bf16-twin /
+    unquantized leaves): int8 -> f32 per-channel rescale -> compute
+    dtype. Inside a jitted forward XLA fuses this into the consumer
+    matmul's operand read, so HBM weight traffic stays int8."""
+    if _is_quant_leaf(leaf):
+        return (leaf["q"].astype(jnp.float32) * leaf["scale"]).astype(dtype)
+    return jnp.asarray(leaf).astype(dtype)
+
+
+def dequantize_tree(tree, dtype):
+    return jax.tree_util.tree_map(lambda l: dequantize_leaf(l, dtype),
+                                  tree, is_leaf=_is_quant_leaf)
+
+
+def quantize_params(params, inference_dtype: str):
+    """The publish-time weight twin for one inference dtype:
+
+      * ``"f32"``  — ``params`` unchanged (identity; the kill switch);
+      * ``"bf16"`` — every float leaf cast to bf16 (2x weight bytes);
+      * ``"int8"`` — every kernel (float ndim >= 2: conv kernels, dense
+        kernels, the LSTM input projection and recurrent kernel) becomes
+        a per-channel {"q": int8, "scale": f32} pair (~4x kernel bytes);
+         1-D leaves (biases) stay f32 — they are noise against the
+        kernels and the LSTM cell math wants them full-precision.
+    """
+    if inference_dtype == "f32":
+        return params
+    if inference_dtype == "bf16":
+        return jax.tree_util.tree_map(
+            lambda w: (jnp.asarray(w).astype(jnp.bfloat16)
+                       if jnp.issubdtype(jnp.asarray(w).dtype, jnp.floating)
+                       else jnp.asarray(w)), params)
+    if inference_dtype != "int8":
+        raise ValueError(
+            f"inference_dtype must be one of {INFERENCE_DTYPES}, got "
+            f"{inference_dtype!r}")
+
+    def one(w):
+        w = jnp.asarray(w)
+        if w.ndim >= 2 and jnp.issubdtype(w.dtype, jnp.floating):
+            return quantize_leaf_int8(w)
+        return w.astype(jnp.float32)
+
+    return jax.tree_util.tree_map(one, params)
+
+
+def is_quant_bundle(tree) -> bool:
+    """True for the published {"f32", "quant", "stamp"} bundle (vs a raw
+    param tree, whose top level is flax's {"params": ...})."""
+    return isinstance(tree, dict) and "quant" in tree and "f32" in tree
+
+
+def make_inference_bundle(net: "NetworkApply", params, stamp: int = 0):
+    """The tree the weight service publishes when
+    ``net.config.inference_dtype != "f32"``: the f32 params (the probe's
+    reference twin), the quantized twin (the hot path), and the
+    publication stamp the twin was built at — so staleness between the
+    two halves is impossible by construction and testable (the
+    publish-time-twin stamp rides every adoption). For "f32" the raw
+    params ARE the published tree (byte-identical plumbing)."""
+    mode = net.config.inference_dtype
+    if mode == "f32":
+        return params
+    return {"f32": params,
+            "quant": quantize_params(params, mode),
+            "stamp": jnp.asarray(stamp, jnp.int32)}
+
+
+def f32_reference_module(net: "NetworkApply") -> "R2D2Network":
+    """The accuracy probe's reference twin: TRUE f32 whatever the
+    learner's compute policy — the guard measures quantization against
+    the unquantized policy, not against bf16's own rounding. ONE
+    definition shared by the host/server forward (make_forward_fn) and
+    the anakin segment probe, so the two probes can never measure
+    against different references."""
+    import dataclasses
+    return R2D2Network(action_dim=net.action_dim,
+                       config=dataclasses.replace(net.config, bf16=False))
+
+
+def quantized_inference_apply(net: "NetworkApply", qparams,
+                              obs_seq: jnp.ndarray,
+                              last_action_seq: jnp.ndarray,
+                              hidden: jnp.ndarray,
+                              compute_dtype=None
+                              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The quantized twin of ``R2D2Network.__call__``: same signature,
+    same module components (ConvTorso / DuelingHead via raw .apply, the
+    shared ``lstm_cell_step`` — the dual_sequence_q pattern), but the
+    weights come dequantized per-channel from the published twin and the
+    LSTM CARRY STAYS f32: the recurrent state crosses acting steps
+    thousands of times, so carrying it (and the cell math) in f32 keeps
+    quantization error per-step instead of compounding — the recurrent
+    matmul at acting batch is latency-bound anyway (PERF.md), so the
+    f32 promotion costs nothing where this forward runs. Torso, the
+    hoisted input projection, and the head run in ``compute_dtype``
+    (bf16 on TPU, quant_compute_dtype); Q returns f32 like every other
+    forward."""
+    cfg = net.config
+    dtype = compute_dtype if compute_dtype is not None \
+        else quant_compute_dtype()
+    qp = qparams["params"]
+    batch, seq = obs_seq.shape[0], obs_seq.shape[1]
+
+    flat = obs_seq.astype(dtype).reshape(batch * seq, *obs_seq.shape[2:])
+    torso = ConvTorso(cfg.cnn_out_dim, cfg.conv_layers, dtype,
+                      space_to_depth=cfg.space_to_depth)
+    # explicit component scopes, like dual_sequence_q: raw .apply calls
+    # carry no flax module names, and the trace→component mapping
+    # (telemetry/traceparse.py) keys on these exact tokens
+    with jax.named_scope("torso"):
+        latent = torso.apply({"params": dequantize_tree(qp["torso"], dtype)},
+                             flat)
+    rnn_in = jnp.concatenate(
+        [latent.reshape(batch, seq, cfg.cnn_out_dim),
+         last_action_seq.astype(dtype)], axis=-1)
+
+    lp = qp["lstm"]
+    wi = dequantize_leaf(lp["input_proj"]["kernel"], dtype)
+    w_rec = dequantize_leaf(lp["recurrent_kernel"], jnp.float32)
+    bias = dequantize_leaf(lp["bias"], jnp.float32)
+    with jax.named_scope("lstm"):
+        # hoisted input projection in the compute dtype; the serial cell
+        # chain in f32 (carry + gates — see docstring)
+        xp = (rnn_in @ wi).astype(jnp.float32).swapaxes(0, 1)  # (T, B, 4H)
+        carry = unpack_hidden(hidden.astype(jnp.float32))
+
+        def step(c, xpt):
+            new_c, new_h = lstm_cell_step(xpt, c[0], c[1], w_rec, bias)
+            return (new_c, new_h), new_h
+
+        carry, outputs = jax.lax.scan(step, carry, xp,
+                                      unroll=cfg.scan_unroll)
+
+    head = DuelingHead(net.action_dim, cfg.hidden_dim, cfg.use_dueling,
+                       dtype)
+    with jax.named_scope("head"):
+        q = head.apply(
+            {"params": dequantize_tree(qp["head"], dtype)},
+            outputs.swapaxes(0, 1).reshape(batch * seq,
+                                           cfg.hidden_dim).astype(dtype))
+    return (q.reshape(batch, seq, net.action_dim),
+            pack_hidden(carry).astype(jnp.float32))
+
+
+def param_tree_bytes(tree) -> int:
+    """Total bytes of a (possibly quantized) param tree — the analytic
+    weight-streaming denominator the costmodel's quant rows and the
+    quant A/B artifact quote (int8 twin vs f32: the >= 3x cut)."""
+    import numpy as np
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        # works for jax/np arrays AND ShapeDtypeStruct avals
+        total += int(np.prod(leaf.shape) if leaf.shape else 1) * \
+            np.dtype(leaf.dtype).itemsize
+    return int(total)
+
+
 class NetworkApply:
     """Thin convenience binding of jitted apply functions to a network spec.
 

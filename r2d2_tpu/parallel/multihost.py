@@ -585,6 +585,16 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     net = NetworkApply(action_dim, cfg.network, cfg.env.frame_stack,
                        cfg.env.frame_height, cfg.env.frame_width)
 
+    # quantized inference (ISSUE 14): the accuracy-probe aggregator for
+    # this host's THREAD actors (process children probe-free, the
+    # single-host rule); rank 0 wires it into the record below so the
+    # quant block + quant_divergence rule cover fleet mode too
+    quant_stats = None
+    if cfg.network.inference_dtype != "f32":
+        from r2d2_tpu.telemetry import QuantStats
+        quant_stats = QuantStats(cfg.network.inference_dtype,
+                                 cfg.telemetry.quant_probe_interval)
+
     # identical seed on every host -> identical initial params; the pmean'd
     # updates keep them identical forever (tested single-host; the loopback
     # demo asserts it cross-process)
@@ -676,7 +686,15 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
         from r2d2_tpu.runtime.weights import WeightPublisher
         ctx = mp.get_context("spawn")
         stop = ctx.Event()
-        publisher = WeightPublisher(ts.params)
+        # quantized inference (ISSUE 14): publish the inference bundle
+        # (f32 + quantized twin + stamp) through the same segment — the
+        # shared publish-time hook, so the lockstep fleet's actors
+        # stream the same publish-time twin single-host actors do
+        from r2d2_tpu.runtime.weights import (make_publish_preparer,
+                                              wrap_publish)
+        prep = make_publish_preparer(net)
+        publisher = WeightPublisher(
+            prep(ts.params, 1) if prep else ts.params)
         try:
             queue = BlockQueue(
                 use_mp=True, ctx=ctx,
@@ -688,7 +706,8 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             # construction below
             publisher.close()
             raise
-        publish = publisher.publish
+        publish = wrap_publish(publisher.publish, prep,
+                               lambda: publisher.publish_count)
     else:
         stop = threading.Event()
 
@@ -746,8 +765,14 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             p.start()
             return p
     else:
-        store = InProcWeightStore(ts.params)
-        publish = store.publish
+        # quantized inference (ISSUE 14): same publish-time bundle hook
+        # as the process path / the single-host orchestrator
+        from r2d2_tpu.runtime.weights import (make_publish_preparer,
+                                              wrap_publish)
+        prep = make_publish_preparer(net)
+        store = InProcWeightStore(prep(ts.params, 1) if prep else ts.params)
+        publish = wrap_publish(store.publish, prep,
+                               lambda: store.publish_count)
         queue = BlockQueue(use_mp=False)
 
         def spawn_actor(i: int) -> threading.Thread:
@@ -770,9 +795,14 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             uw = getattr(env, "envs", [env])[0]
             uw = getattr(uw, "unwrapped", uw)
             observed_wiring[i] = getattr(uw, "multiplayer_wiring", None)
+            # store.current: the prepared published tree (no per-policy
+            # requantization) that is also FRESH on a mid-training
+            # respawn — the predecessor consumed this reader's version,
+            # so a first poll() would return None against stale params
             policy, run_loop = make_actor_policy(
-                cfg, net, ts.params, gidx, seed, epsilon=eps,
-                total_actors=nprocs * n_local)
+                cfg, net, store.current(reader_id=i), gidx, seed,
+                epsilon=eps, total_actors=nprocs * n_local,
+                quant_stats=quant_stats)
 
             # per-spawn cancel event + instrumented sink: identical health
             # wiring to PlayerStack._spawn_thread_actor
@@ -874,6 +904,9 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                    if rank == 0 else None)
         if metrics is not None:
             metrics.set_telemetry(tele)   # stages ride the rank-0 record
+            if quant_stats is not None:
+                # quant accuracy block (ISSUE 14) on the rank-0 record
+                metrics.set_quant(quant_stats.interval_block)
         # rank-0 learning aggregation: the 'learning' block (+ NaN
         # forensics) rides the same rank-0 record as everything else
         learn_agg = (LearningAggregator(pid, cfg.runtime.save_dir,

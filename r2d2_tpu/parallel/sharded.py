@@ -118,7 +118,8 @@ def init_sharded_act_carry(env, spec: ReplaySpec, num_lanes: int,
 def make_sharded_anakin_act(env, net, spec: ReplaySpec, *, mesh: Mesh,
                             num_lanes: int, epsilons, gamma: float,
                             priority, near_greedy_eps: float,
-                            priority_eta: float = 0.9):
+                            priority_eta: float = 0.9,
+                            quant_probe: bool = True):
     """The dp-sharded fused acting segment (ISSUE 8 tentpole):
 
         act(params, carry, replay_state, weight_version)
@@ -171,7 +172,8 @@ def make_sharded_anakin_act(env, net, spec: ReplaySpec, *, mesh: Mesh,
         np.asarray([e <= near_greedy_eps for e in eps_list],
                    bool).reshape(dp, lps))
     core = make_act_core(env, net, spec, num_lanes=lps, gamma=gamma,
-                         priority=priority, priority_eta=priority_eta)
+                         priority=priority, priority_eta=priority_eta,
+                         quant_probe=quant_probe)
 
     @functools.partial(
         shard_map, mesh=mesh,

@@ -12,7 +12,9 @@
   trio (worker.py:251-390,502-591, train.py:21-66) as plain processes/threads.
 """
 
-from r2d2_tpu.runtime.weights import InProcWeightStore, WeightPublisher, WeightSubscriber
+from r2d2_tpu.runtime.weights import (InProcWeightStore, WeightPublisher,
+                                      WeightSubscriber,
+                                      make_publish_preparer, wrap_publish)
 from r2d2_tpu.runtime.feeder import BlockQueue
 from r2d2_tpu.runtime.metrics import TrainMetrics
 from r2d2_tpu.runtime.learner_loop import Learner
@@ -22,4 +24,5 @@ from r2d2_tpu.runtime.orchestrator import train
 __all__ = [
     "InProcWeightStore", "WeightPublisher", "WeightSubscriber",
     "BlockQueue", "TrainMetrics", "Learner", "run_actor", "train",
+    "make_publish_preparer", "wrap_publish",
 ]

@@ -48,7 +48,8 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
                       copy_updates: bool = True,
                       total_actors: Optional[int] = None,
                       serve_channel=None, serve_stats=None,
-                      should_stop: Optional[Callable[[], bool]] = None):
+                      should_stop: Optional[Callable[[], bool]] = None,
+                      quant_stats=None):
     """Build the policy matching the env shape ``make_actor_env`` produced;
     returns ``(policy, run_loop)`` where ``run_loop`` is run_actor or
     run_vector_actor. ``epsilon`` overrides the scalar path's Ape-X ladder
@@ -82,6 +83,18 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
                   should_stop=should_stop,
                   backoff_base_s=cfg.runtime.restart_backoff_base_s,
                   backoff_max_s=cfg.runtime.restart_backoff_max_s)
+    # quantized inference (ISSUE 14): local policies run the quantized
+    # forward whenever the config knob says so (the knob lives in
+    # NetworkConfig, so the policies see it through net); the accuracy
+    # probe runs only where a QuantStats can receive its results (thread
+    # actors — process children have no channel back to the record, and
+    # served workers' forwards probe server-side)
+    qkw = {}
+    if not serve and cfg.network.inference_dtype != "f32":
+        qkw = dict(quant_stats=quant_stats,
+                   quant_probe_interval=(
+                       cfg.telemetry.quant_probe_interval
+                       if quant_stats is not None else 0))
     if cfg.actor.envs_per_actor > 1:
         epsilons = vector_lane_epsilons(actor_idx, cfg.actor, total_actors)
         seeds = [seed + lane for lane in range(cfg.actor.envs_per_actor)]
@@ -92,7 +105,7 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
                 client_base=actor_idx * cfg.actor.envs_per_actor, **kw)
         else:
             policy = BatchedActorPolicy(net, params, epsilons, seeds=seeds,
-                                        copy_updates=copy_updates)
+                                        copy_updates=copy_updates, **qkw)
         return policy, run_vector_actor
     if epsilon is None:
         epsilon = apex_epsilon(actor_idx,
@@ -106,7 +119,7 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
                               **kw)
     else:
         policy = ActorPolicy(net, params, epsilon, seed=seed,
-                             copy_updates=copy_updates)
+                             copy_updates=copy_updates, **qkw)
     return policy, run_actor
 
 

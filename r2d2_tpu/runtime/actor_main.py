@@ -70,6 +70,14 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                                           serve_spec["port"])
     else:
         params = net.init(jax.random.PRNGKey(cfg.runtime.seed))
+        # quantized inference (ISSUE 14): the published tree is the
+        # inference bundle — the subscriber template must match its
+        # structure (a locally-quantized twin of the init params; the
+        # policy swaps it for the learner's published twin on first poll)
+        from r2d2_tpu.runtime.weights import make_publish_preparer
+        prep = make_publish_preparer(net)
+        if prep is not None:
+            params = jax.device_get(prep(params, 0))
         try:
             sub = WeightSubscriber(shm_name, params)
         except FileNotFoundError:

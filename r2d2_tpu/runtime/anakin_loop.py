@@ -146,6 +146,33 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
 
     learner.weight_version_fn = publish_count
 
+    # quantized acting (ISSUE 14): the by-reference pseudo-clock also
+    # drives publish-time quantization — the inference bundle is rebuilt
+    # only when the pseudo publish count TICKS (every
+    # weight_publish_interval learner steps), never per segment, so the
+    # acting scan streams a publish-time twin exactly like the host
+    # actors do (no hot-path requantization). At "f32" the segment keeps
+    # reading learner.train_state.params by reference, byte-identical.
+    from r2d2_tpu.runtime.weights import make_publish_preparer
+    prep = make_publish_preparer(net)
+    quant_stats = None
+    if prep is not None:
+        from r2d2_tpu.telemetry import QuantStats
+        quant_stats = QuantStats(cfg.network.inference_dtype,
+                                 cfg.telemetry.quant_probe_interval)
+        metrics.set_quant(quant_stats.interval_block)
+    _bundle = {"tree": None, "pub": -1}
+
+    def acting_params():
+        if prep is None:
+            return learner.train_state.params
+        pc = publish_count()
+        if _bundle["tree"] is None or _bundle["pub"] != pc:
+            _bundle["tree"] = prep(learner.train_state.params, pc)
+            _bundle["pub"] = pc
+            quant_stats.on_stamp(pc)
+        return _bundle["tree"]
+
     # the ε ladder spans the GLOBAL lane count whatever the mesh: dp
     # changes where lanes run, never the Ape-X exploration schedule
     epsilons = [apex_epsilon(i, num_lanes, cfg.actor.base_eps,
@@ -163,7 +190,8 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
             epsilons=epsilons, gamma=cfg.optim.gamma,
             priority=cfg.actor.anakin_priority,
             near_greedy_eps=cfg.actor.near_greedy_eps,
-            priority_eta=cfg.optim.priority_eta)
+            priority_eta=cfg.optim.priority_eta,
+            quant_probe=cfg.telemetry.quant_probe_interval > 0)
         carry = init_sharded_act_carry(env, spec, num_lanes, learner.mesh,
                                        act_key)
     else:
@@ -171,7 +199,8 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
             env, net, spec, num_lanes=num_lanes, epsilons=epsilons,
             gamma=cfg.optim.gamma, priority=cfg.actor.anakin_priority,
             near_greedy_eps=cfg.actor.near_greedy_eps,
-            priority_eta=cfg.optim.priority_eta)
+            priority_eta=cfg.optim.priority_eta,
+            quant_probe=cfg.telemetry.quant_probe_interval > 0)
         carry = init_act_carry(env, spec, num_lanes, act_key)
 
     # system-health pillar (ISSUE 7), the on-device twin of the
@@ -214,12 +243,12 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
             # shard's blocks land in its local replay without ever
             # leaving the shard, so there is no separate commit stage
             carry, learner.replay_state, stats = act_fn(
-                learner.train_state.params, carry, learner.replay_state,
+                acting_params(), carry, learner.replay_state,
                 np.int32(publish_count()))
             t1 = t2 = time.time()
         else:
             carry, blocks, stats = act_fn(
-                learner.train_state.params, carry,
+                acting_params(), carry,
                 np.int32(publish_count()))
             t1 = time.time()
             learner.replay_state = replay_add_many(
@@ -258,6 +287,15 @@ def run_anakin_train(cfg: Config, *, max_training_steps: Optional[int] = None,
         episodes = np.sum([np.atleast_1d(s["episodes"])
                            for s in fetched], axis=0)
         metrics.on_episodes(int(eps_counts.sum()), float(ret_sums.sum()))
+        if quant_stats is not None and "quant_dq" in fetched[0]:
+            # one probe per segment (per shard under dp > 1): interval
+            # max |ΔQ| and the lane-weighted mean agreement feed the
+            # record's quant block like the host actors' probes
+            for s in fetched:
+                quant_stats.on_probe(
+                    float(np.max(np.atleast_1d(s["quant_dq"]))),
+                    float(np.mean(np.atleast_1d(s["quant_agree"]))),
+                    lanes=num_lanes)
         if dp > 1:
             shard_env = np.sum([np.atleast_1d(s["env_steps"])
                                 for s in fetched], axis=0)

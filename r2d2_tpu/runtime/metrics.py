@@ -123,6 +123,13 @@ class TrainMetrics:
         # record byte-identical to the pre-PR13 schema.
         self._serving_fn = None
 
+        # quantized inference plane (ISSUE 14): a quant-block provider
+        # (QuantStats.interval_block, attached by the orchestrating loop
+        # when network.inference_dtype != "f32") — called once per
+        # log(); unattached (every f32 run) the record is byte-identical
+        # to the PR13 schema.
+        self._quant_fn = None
+
         # system-health pillar (ISSUE 7): a resources-block provider
         # (ResourceMonitor.block) and the alert engine, both attached by
         # the orchestrating loop. None = the blocks are OMITTED and the
@@ -228,6 +235,14 @@ class TrainMetrics:
         client lease churn. Called once per log(); None returns omit
         the block (consumers key on its presence)."""
         self._serving_fn = provider
+
+    def set_quant(self, provider) -> None:
+        """Attach the quant-block provider (ISSUE 14): a callable
+        returning ``QuantStats.interval_block()`` — the active inference
+        dtype, probe count, max |Q_f32 − Q_quant|, and greedy-action
+        agreement of the interval's in-graph accuracy probes. Called
+        once per log(); None returns omit the block."""
+        self._quant_fn = provider
 
     def set_resources(self, provider) -> None:
         """Attach the resources-block provider (ISSUE 7): a callable
@@ -377,6 +392,13 @@ class TrainMetrics:
             serving = self._serving_fn()
             if serving is not None:
                 record["serving"] = serving
+        if self._quant_fn is not None:
+            # quant block (ISSUE 14): the active inference dtype + the
+            # interval's accuracy-probe aggregates. Before the sentinel
+            # pass so the quant_divergence rule sees its own interval.
+            quant = self._quant_fn()
+            if quant is not None:
+                record["quant"] = quant
         if self._resources_fn is not None:
             # machine-side block (ISSUE 7): devices/host/buffer footprints
             # + the compile sub-block. Before the sentinel, which reads it.

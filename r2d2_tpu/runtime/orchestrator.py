@@ -32,7 +32,8 @@ from r2d2_tpu.runtime.actor_main import actor_process_main
 from r2d2_tpu.runtime.feeder import BlockQueue
 from r2d2_tpu.runtime.learner_loop import Learner
 from r2d2_tpu.runtime.metrics import TrainMetrics
-from r2d2_tpu.runtime.weights import InProcWeightStore, WeightPublisher
+from r2d2_tpu.runtime.weights import (InProcWeightStore, WeightPublisher,
+                                      make_publish_preparer, wrap_publish)
 
 
 class PlayerStack:
@@ -103,6 +104,22 @@ class PlayerStack:
                 lambda: self.serve_stats.interval_block(
                     deadline_ms=cfg.serve.deadline_ms,
                     max_batch=cfg.serve.max_batch))
+        # quantized inference plane (ISSUE 14): the publish-time
+        # quantizer (None at "f32" — the weight plumbing is then
+        # byte-identical to PR13) and the accuracy-probe aggregator
+        # feeding the record's 'quant' block. Thread actors and the
+        # policy server share ONE QuantStats; process actors run the
+        # quantized forward from the same published twin but probe-free
+        # (their probe results have no channel back to this record —
+        # served inference probes server-side instead).
+        self._publish_prep = make_publish_preparer(self.net)
+        self.quant_stats = None
+        if cfg.network.inference_dtype != "f32":
+            from r2d2_tpu.telemetry import QuantStats
+            self.quant_stats = QuantStats(
+                cfg.network.inference_dtype,
+                cfg.telemetry.quant_probe_interval)
+            self.metrics.set_quant(self.quant_stats.interval_block)
         # LAST: telemetry board shm + the span-drain's file I/O. Anything
         # raising after an shm allocation would leak the segment (train()
         # only closes stacks that made it into its list), so the file I/O
@@ -196,7 +213,8 @@ class PlayerStack:
             weight_version=self._serve_weight_version,
             copy_updates=self._serve_copy_updates,
             stats=self.serve_stats, telemetry=self.telemetry,
-            client_timed=self._serve_client_timed).start()
+            client_timed=self._serve_client_timed,
+            quant_stats=self.quant_stats).start()
 
     def restart_serve_server(self) -> None:
         """Replace a (possibly dead) server with a fresh one on the same
@@ -210,8 +228,16 @@ class PlayerStack:
 
     def start_actors_threads(self, stop: threading.Event) -> None:
         cfg = self.cfg
-        self.store = InProcWeightStore(self.learner.train_state.params)
-        self.learner.publish = self.store.publish
+        prep = self._publish_prep
+        params0 = self.learner.train_state.params
+        # quant mode publishes the inference bundle (f32 + twin + stamp)
+        # through the SAME store; construction counts as publication 1.
+        # Thread policies take their initial tree from store.current()
+        # (one shared prepared tree, fresh across respawns)
+        self.store = InProcWeightStore(
+            prep(params0, 1) if prep else params0)
+        self.learner.publish = wrap_publish(
+            self.store.publish, prep, lambda: self.store.publish_count)
         # staleness clock (ISSUE 5): the learner half of sample-age =
         # publish count at flush − the block's generation stamp
         self.learner.weight_version_fn = lambda: self.store.publish_count
@@ -255,10 +281,18 @@ class PlayerStack:
 
         serve_channel = (self.serve_endpoint.connect()
                          if self.serve_endpoint is not None else None)
+        # initial params: the store's CURRENT published tree — already
+        # prepared (the quant bundle; no per-policy requantization) AND
+        # fresh on a mid-training respawn, whose dead predecessor
+        # consumed the slot's reader version so its first poll() would
+        # return None; adopting here also fixes the staleness stamp
+        init_params = (self.store.current(reader_id=i)
+                       if self.store is not None
+                       else self.learner.train_state.params)
         policy, run_loop = make_actor_policy(
-            cfg, self.net, self.learner.train_state.params, i, seed,
+            cfg, self.net, init_params, i, seed,
             serve_channel=serve_channel, serve_stats=self.serve_stats,
-            should_stop=should_stop)
+            should_stop=should_stop, quant_stats=self.quant_stats)
 
         from r2d2_tpu.runtime.actor_loop import instrument_block_sink
         self.heartbeats.reset_slot(i)
@@ -316,8 +350,13 @@ class PlayerStack:
     def start_actors_processes(self, stop_event) -> None:
         cfg = self.cfg
         self._ctx = mp.get_context("spawn")
-        self.publisher = WeightPublisher(self.learner.train_state.params)
-        self.learner.publish = self.publisher.publish
+        prep = self._publish_prep
+        params0 = self.learner.train_state.params
+        self.publisher = WeightPublisher(
+            prep(params0, 1) if prep else params0)
+        self.learner.publish = wrap_publish(
+            self.publisher.publish, prep,
+            lambda: self.publisher.publish_count)
         self.learner.weight_version_fn = \
             lambda: self.publisher.publish_count
         self.queue = BlockQueue(
@@ -339,8 +378,13 @@ class PlayerStack:
         reader, zero new mechanisms)."""
         cfg = self.cfg
         from r2d2_tpu.runtime.weights import WeightSubscriber
-        sub = WeightSubscriber(self.publisher.name,
-                               self.learner.train_state.params)
+        # the subscriber template must match the PUBLISHED tree — the
+        # inference bundle in quant mode (stamp value irrelevant: the
+        # template only provides structure)
+        template = self.learner.train_state.params
+        if self._publish_prep is not None:
+            template = self._publish_prep(template, 0)
+        sub = WeightSubscriber(self.publisher.name, template)
         self._serve_weight_sub = sub
         self._serve_weight_poll = sub.poll
         self._serve_weight_version = lambda: sub.publish_count

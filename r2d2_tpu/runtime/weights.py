@@ -34,6 +34,17 @@ Torn-read impossibility, by architecture:
   identical in writer and readers because shm is same-host by nature.
 
 ``InProcWeightStore`` is the thread-mode twin (tests, single-process runs).
+
+Quantized inference (ISSUE 14): when ``network.inference_dtype`` is
+"bf16"/"int8" the published TREE is the inference bundle
+(models/network.py ``make_inference_bundle`` — f32 params + the
+quantized twin + the publication stamp), built ONCE per publish by the
+``make_publish_preparer`` wrapper below and shipped through the exact
+same publisher/subscriber machinery: ``ravel_pytree`` promotes the
+mixed int8/f32 bundle to one f32 payload and the unravel restores every
+leaf's dtype exactly (int8 values are integers ≤ 127, so the f32
+round-trip is lossless — tested). Readers therefore receive a
+publish-time twin and never requantize on the hot path.
 """
 
 import platform
@@ -70,6 +81,42 @@ def untrack_attached_shm(shm: shared_memory.SharedMemory) -> None:
 def _flatten(params) -> Tuple[np.ndarray, Any]:
     flat, unravel = ravel_pytree(params)
     return np.asarray(jax.device_get(flat), np.float32), unravel
+
+
+def make_publish_preparer(net):
+    """The ONE publish-time quantization hook (ISSUE 14), shared by the
+    single-host orchestrator and the multihost trainer so the two
+    cannot drift: None when ``net.config.inference_dtype == "f32"``
+    (callers publish raw params — byte-identical plumbing); otherwise a
+    jitted ``prepare(params, stamp) -> bundle`` building the inference
+    bundle (f32 + quantized twin + stamp) exactly once per publication.
+    Callers stamp ``publish_count + 1`` (the publication the bundle
+    rides in) so twin staleness is testable end-to-end."""
+    if net.config.inference_dtype == "f32":
+        return None
+    import jax as _jax
+
+    from r2d2_tpu.models.network import make_inference_bundle
+
+    @_jax.jit
+    def prepare(params, stamp):
+        return make_inference_bundle(net, params, stamp)
+
+    return lambda params, stamp: prepare(params, np.int32(stamp))
+
+
+def wrap_publish(publish, preparer, publish_count_fn):
+    """Compose a store/publisher ``publish`` with the quantization
+    preparer: the learner keeps calling ``publish(params)`` and the twin
+    is built + stamped here, once per publication. Identity when
+    ``preparer`` is None."""
+    if preparer is None:
+        return publish
+
+    def publish_bundle(params):
+        publish(preparer(params, publish_count_fn() + 1))
+
+    return publish_bundle
 
 
 class WeightPublisher:
@@ -193,4 +240,17 @@ class InProcWeightStore:
             if self._reader_versions.get(reader_id) == self._version:
                 return None
             self._reader_versions[reader_id] = self._version
+            return self._params
+
+    def current(self, reader_id: Optional[int] = None):
+        """The CURRENT published tree, without the poll's seen-version
+        gate — what a (re)spawned thread actor starts from: a respawn's
+        dead predecessor already consumed the slot's reader version, so
+        its first poll() returns None and construction from anything
+        but the live tree would act on stale weights until the next
+        publish. Passing ``reader_id`` also marks the version adopted
+        (the constructor took exactly this tree)."""
+        with self._lock:
+            if reader_id is not None:
+                self._reader_versions[reader_id] = self._version
             return self._params

@@ -241,11 +241,13 @@ class PolicyServer:
                  copy_updates: bool = True,
                  stats: Optional[ServingStats] = None,
                  telemetry=None, client_timed: bool = False,
-                 warmup: Optional[bool] = None):
+                 warmup: Optional[bool] = None, quant_stats=None):
         import jax
 
         from r2d2_tpu.actor.policy import (_force_f32, _pin_params,
                                            make_forward_fn)
+        from r2d2_tpu.models.network import (is_quant_bundle,
+                                             make_inference_bundle)
         from r2d2_tpu.telemetry import NULL_TELEMETRY
         sv = cfg.serve
         self.cfg = cfg
@@ -269,7 +271,22 @@ class PolicyServer:
             net = _force_f32(net)
         self.net = net
         self.action_dim = net.action_dim
-        self._fwd = make_forward_fn(net)
+        # quantized serving (ISSUE 14): the SAME shared forward the
+        # local policies build — the config knob flips all of them
+        # together. The server's tick is its dispatch counter, so the
+        # accuracy probe runs on a real live micro-batch every
+        # quant_probe_interval dispatches.
+        self._quant = net.config.inference_dtype != "f32"
+        self.quant_stats = quant_stats
+        self._quant_probe_interval = (cfg.telemetry.quant_probe_interval
+                                      if self._quant else 0)
+        self._fwd = make_forward_fn(
+            net, probe_interval=self._quant_probe_interval)
+        if self._quant and not is_quant_bundle(params):
+            # direct construction from raw params (cold start, the
+            # standalone CLI): build the twin once here — the weight
+            # poll hands over published bundles from then on
+            params = jax.device_get(make_inference_bundle(net, params))
         self._params = _pin_params(params, self._device, copy=True)
         h, w, s = net.obs_hw
         self.cache = StateCacheFromConfig(cfg, (h, w), s,
@@ -291,10 +308,16 @@ class PolicyServer:
         h, w, s = obs_hw
         hd = self.net.config.hidden_dim
         for b in self.buckets:
-            np.asarray(self._fwd(self._params,
-                                 np.zeros((b, h, w, s), np.float32),
-                                 np.zeros(b, np.int32),
-                                 np.zeros((b, 2, hd), np.float32))[0])
+            args = (self._params,
+                    np.zeros((b, h, w, s), np.float32),
+                    np.zeros(b, np.int32),
+                    np.zeros((b, 2, hd), np.float32))
+            if self._quant:
+                # tick 0 exercises the probe branch too (lax.cond
+                # compiles both; this keeps warm-up honest about it)
+                np.asarray(self._fwd(*args, np.int32(0), np.int32(b))[0])
+            else:
+                np.asarray(self._fwd(*args)[0])
 
     # -- lifecycle --
 
@@ -346,6 +369,14 @@ class PolicyServer:
             fresh = self._weight_poll()
             if fresh is not None:
                 from r2d2_tpu.actor.policy import _pin_params
+                from r2d2_tpu.models.network import is_quant_bundle
+                if self._quant and self.quant_stats is not None \
+                        and is_quant_bundle(fresh):
+                    # publish-time-twin staleness stamp: the publication
+                    # this twin was quantized at, surfaced in the quant
+                    # block alongside the agreement gauge
+                    self.quant_stats.on_stamp(
+                        int(np.asarray(fresh["stamp"])))
                 self._params = _pin_params(fresh, self._device,
                                            copy=self._copy_updates)
                 if self._weight_version_fn is not None:
@@ -439,7 +470,19 @@ class PolicyServer:
             hidden = np.concatenate(
                 [hidden, np.zeros((pad,) + hidden.shape[1:], hidden.dtype)])
         t0 = time.perf_counter()
-        actions, q, h = self._fwd(self._params, stacked, last_action, hidden)
+        if self._quant:
+            from r2d2_tpu.actor.policy import feed_quant_probe
+            # live=fill: the probe masks the bucket's padding rows out
+            # of the agreement/|dQ| signal
+            actions, q, h, probe = self._fwd(
+                self._params, stacked, last_action, hidden,
+                np.int32(self.batches_dispatched), np.int32(fill))
+            feed_quant_probe(self.quant_stats, self._quant_probe_interval,
+                             probe, lanes=fill,
+                             tick=self.batches_dispatched)
+        else:
+            actions, q, h = self._fwd(self._params, stacked, last_action,
+                                      hidden)
         actions = np.asarray(actions)
         q = np.asarray(q)
         h = np.asarray(h)

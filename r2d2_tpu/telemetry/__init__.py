@@ -49,6 +49,12 @@ cross-host trace merge aligns on, and size-capped host-row rotation —
 with 4 stock rules (rank_straggler, lockstep_wait_frac, fleet_desync,
 missing_rank) riding alerts.py.
 
+``quant.py`` (ISSUE 14) is the QUANTIZED-INFERENCE guard: the in-graph
+f32-twin probe results (max |Q_f32 − Q_quant|, greedy-action agreement)
+from local actors / the policy server / the anakin segment probe
+aggregated into the record's ``quant`` block, with the
+``quant_divergence`` rule riding alerts.py.
+
 ``costmodel.py`` / ``traceparse.py`` (ISSUE 9) are the COMPUTE pillar:
 XLA ``cost_analysis()``/``memory_analysis()`` per-program cost tables
 across every step factory (the ``make regress`` exact-match costs gate
@@ -82,6 +88,7 @@ from r2d2_tpu.telemetry.histogram import (NBUCKETS, LogHistogram,
                                           value_summary)
 from r2d2_tpu.telemetry.learning import LearningAggregator, LearningDiag
 from r2d2_tpu.telemetry.profiler import ProfilerCapture, trace
+from r2d2_tpu.telemetry.quant import QuantStats
 from r2d2_tpu.telemetry.replaydiag import ReplayDiag, ReplayDiagAggregator
 from r2d2_tpu.telemetry.resources import (BufferRegistry, ResourceMonitor,
                                           device_memory_stats, host_usage,
@@ -95,7 +102,7 @@ __all__ = [
     "AlertEngine", "AlertRule", "BufferRegistry", "CompileMonitor",
     "FleetAggregator", "LearningAggregator", "LearningDiag",
     "LogHistogram",
-    "ProfilerCapture", "ReplayDiag", "ReplayDiagAggregator",
+    "ProfilerCapture", "QuantStats", "ReplayDiag", "ReplayDiagAggregator",
     "ResourceMonitor", "RotatingJsonlWriter", "SpanTracer", "StageTimers",
     "Telemetry", "TelemetryBoard", "active_monitor",
     "analytic_component_costs", "aot_coverage", "attribute_trace",

@@ -204,6 +204,17 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("serve_client_churn", "counter",
                   ("serving", "clients", "disconnects"),
                   tcfg.alerts_serve_churn, "warn"),
+        # quantized-inference rule (ISSUE 14; the quant block,
+        # telemetry/quant.py — inactive on records without it, i.e.
+        # every inference_dtype="f32" run): the interval's lane-weighted
+        # greedy-action agreement between the quantized forward and its
+        # f32 twin fell to/below the floor — the quantized policy has
+        # stopped acting like the policy the learner is training. A
+        # probe-free interval carries agree_frac=None, which HOLDS the
+        # rule (no data ≠ recovery).
+        AlertRule("quant_divergence", "threshold",
+                  ("quant", "agree_frac"),
+                  tcfg.alerts_quant_agreement, "warn", below=True),
     )
 
 

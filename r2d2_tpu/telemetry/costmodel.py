@@ -322,7 +322,7 @@ def _cost_of(jitted, *args) -> Dict[str, Any]:
 
 GATE_VARIANTS = ("learner_step", "learner_step_multi", "learner_step_sharded",
                  "learner_step_tp", "replay_add_many", "replay_sample",
-                 "anakin_act")
+                 "anakin_act", "serve_forward", "quant_forward")
 
 
 def collect_cost_table(cfg, variants: Iterable[str] = GATE_VARIANTS,
@@ -450,6 +450,82 @@ def collect_cost_table(cfg, variants: Iterable[str] = GATE_VARIANTS,
     if "replay_sample" in variants:
         samp = jax.jit(lambda s, k: replay_sample(spec, s, k))
         programs["replay_sample"] = _cost_of(samp, rs_aval, key_aval)
+    if "serve_forward" in variants or "quant_forward" in variants:
+        h_f, w_f = cfg.env.frame_height, cfg.env.frame_width
+        s_f, hd_f = cfg.env.frame_stack, cfg.network.hidden_dim
+        params_aval = _sds(jax.eval_shape(net.init, jax.random.PRNGKey(0)))
+
+        def fwd_avals(b):
+            return (jax.ShapeDtypeStruct((b, h_f, w_f, s_f),
+                                         jax.numpy.float32),
+                    jax.ShapeDtypeStruct((b,), jax.numpy.int32),
+                    jax.ShapeDtypeStruct((b, 2, hd_f), jax.numpy.float32))
+    if "serve_forward" in variants:
+        # the serving plane's pow2 dispatch buckets (ISSUE 14 satellite:
+        # PR 12 added the micro-batched program but never tabled it) —
+        # one row per AOT-precompiled bucket of the PRODUCTION serve
+        # forward at this config's inference dtype, so `make costs` /
+        # tools/roofline.py cover the serving plane and the costs gate
+        # catches a program change at any width
+        from r2d2_tpu.actor.policy import make_forward_fn
+        from r2d2_tpu.serve.server import serve_buckets
+        fwd = make_forward_fn(
+            net, probe_interval=(cfg.telemetry.quant_probe_interval
+                                 if cfg.network.inference_dtype != "f32"
+                                 else 0))
+        quant_mode = cfg.network.inference_dtype != "f32"
+        if quant_mode:
+            from r2d2_tpu.models.network import make_inference_bundle
+            serve_params = _sds(jax.eval_shape(
+                lambda p: make_inference_bundle(net, p, 0), params_aval))
+        else:
+            serve_params = params_aval
+        for b in serve_buckets(cfg.serve.max_batch):
+            args = (serve_params,) + fwd_avals(b)
+            if quant_mode:
+                # + tick and live-row count (the quant signature)
+                args = args + (jax.ShapeDtypeStruct((), jax.numpy.int32),
+                               jax.ShapeDtypeStruct((), jax.numpy.int32))
+            programs[f"serve_forward_b{b}"] = dict(_cost_of(fwd, *args),
+                                                   batch=b)
+    if "quant_forward" in variants:
+        # the quantized-acting weight-streaming rows (ISSUE 14): the
+        # probe-free forward over EXACTLY the weight tree the steady
+        # state streams per dispatch — f32 params vs the bf16/int8
+        # twins — plus the analytic weight_bytes each one reads. The
+        # int8 row's weight_bytes / the f32 row's is the >= 3x cut the
+        # TPU projection rests on; both are exact-match-gated.
+        from r2d2_tpu.models.network import (param_tree_bytes,
+                                             quantize_params,
+                                             quantized_inference_apply)
+        bq = cfg.serve.max_batch
+        for mode in ("f32", "bf16", "int8"):
+            if mode == "f32":
+                from r2d2_tpu.actor.policy import make_forward_fn
+                fn = make_forward_fn(net, "f32")
+                tree_aval = params_aval
+            else:
+                net_m = NetworkApply(
+                    action_dim,
+                    dataclasses.replace(cfg.network, inference_dtype=mode),
+                    cfg.env.frame_stack, cfg.env.frame_height,
+                    cfg.env.frame_width)
+
+                def step(qt, stacked, last_action, hidden, _net=net_m):
+                    import jax.numpy as jnp
+                    obs = stacked[:, None]
+                    la = jax.nn.one_hot(last_action, _net.action_dim,
+                                        dtype=jnp.float32)[:, None]
+                    q, h2 = quantized_inference_apply(_net, qt, obs, la,
+                                                      hidden)
+                    return jnp.argmax(q[:, 0], axis=-1), q[:, 0], h2
+
+                fn = jax.jit(step)
+                tree_aval = _sds(jax.eval_shape(
+                    lambda p, _m=mode: quantize_params(p, _m), params_aval))
+            programs[f"acting_forward_{mode}"] = dict(
+                _cost_of(fn, tree_aval, *fwd_avals(bq)), batch=bq,
+                weight_bytes=param_tree_bytes(tree_aval))
     if "anakin_act" in variants:
         from r2d2_tpu.actor.anakin import init_act_carry, make_anakin_act
         from r2d2_tpu.config import apex_epsilon
@@ -466,8 +542,17 @@ def collect_cost_table(cfg, variants: Iterable[str] = GATE_VARIANTS,
             lambda k: init_act_carry(env, spec, lanes, k),
             jax.random.PRNGKey(0)))
         wv_aval = jax.ShapeDtypeStruct((), jax.numpy.int32)
+        act_params_aval = ts_aval.params
+        if cfg.network.inference_dtype != "f32":
+            # the quantized acting scan takes the published inference
+            # bundle, not raw params (actor/anakin.py)
+            from r2d2_tpu.models.network import make_inference_bundle
+            act_params_aval = _sds(jax.eval_shape(
+                lambda p: make_inference_bundle(net, p, 0),
+                ts_aval.params))
         programs["anakin_act"] = dict(
-            _cost_of(act, ts_aval.params, carry_aval, wv_aval), lanes=lanes)
+            _cost_of(act, act_params_aval, carry_aval, wv_aval),
+            lanes=lanes)
 
     return {
         "schema": 1,

@@ -1,0 +1,82 @@
+"""Quantized-inference accuracy aggregation (ISSUE 14).
+
+The quantized acting forward (models/network.py
+``quantized_inference_apply``, reached through the ONE shared
+``actor.policy.make_forward_fn``) carries an in-graph accuracy probe: on
+every ``telemetry.quant_probe_interval``-th tick a ``lax.cond`` branch
+also runs the f32 twin on the SAME live batch and emits
+max |Q_f32 − Q_quant| plus the greedy-action agreement fraction. This
+class is where those probe results (from thread actors, the policy
+server's dispatch loop, and the anakin segment probe alike) accumulate
+into the periodic record's ``quant`` block — the input of the
+``quant_divergence`` alert rule (telemetry/alerts.py).
+
+Thread-safe like ServingStats; ``interval_block`` consumes the interval.
+The block is emitted on EVERY record while the knob is on (the active
+dtype is run state worth seeing even in a probe-free interval);
+``agree_frac``/``dq_max`` are None when no probe fired, which keeps the
+alert rule held rather than falsely re-armed. With
+``network.inference_dtype = "f32"`` no provider is attached and the
+record schema is byte-identical to PR 13 (stability-tested).
+"""
+
+import threading
+from typing import Optional
+
+
+class QuantStats:
+    """Per-interval accumulator: probes are lane-weighted (a 16-lane
+    batched probe counts 16 lanes' agreement against a scalar actor's
+    1), ``dq_max`` is the interval max, ``agree_min`` the worst single
+    probe. ``publish_stamp`` is the newest adopted publish-time-twin
+    stamp (make_inference_bundle) — proof the twin the policy is acting
+    with was quantized at that publication, not drifting behind it."""
+
+    def __init__(self, dtype: str, probe_interval: int = 0):
+        self.dtype = str(dtype)
+        self.probe_interval = int(probe_interval)
+        self._lock = threading.Lock()
+        self._probes = 0
+        self._lanes = 0
+        self._agree_sum = 0.0
+        self._agree_min: Optional[float] = None
+        self._dq_max: Optional[float] = None
+        self.publish_stamp = 0
+
+    def on_probe(self, dq_max: float, agree_frac: float,
+                 lanes: int = 1) -> None:
+        with self._lock:
+            self._probes += 1
+            self._lanes += int(lanes)
+            self._agree_sum += float(agree_frac) * int(lanes)
+            self._agree_min = (float(agree_frac) if self._agree_min is None
+                               else min(self._agree_min, float(agree_frac)))
+            self._dq_max = (float(dq_max) if self._dq_max is None
+                            else max(self._dq_max, float(dq_max)))
+
+    def on_stamp(self, stamp: int) -> None:
+        with self._lock:
+            self.publish_stamp = max(self.publish_stamp, int(stamp))
+
+    def interval_block(self) -> dict:
+        """The record's ``quant`` block; consumes the interval."""
+        with self._lock:
+            block = {
+                "dtype": self.dtype,
+                "probe_interval": self.probe_interval,
+                "probes": self._probes,
+                "lanes_probed": self._lanes,
+                "dq_max": (round(self._dq_max, 6)
+                           if self._dq_max is not None else None),
+                "agree_frac": (round(self._agree_sum / self._lanes, 6)
+                               if self._lanes else None),
+                "agree_min": (round(self._agree_min, 6)
+                              if self._agree_min is not None else None),
+                "publish_stamp": self.publish_stamp,
+            }
+            self._probes = 0
+            self._lanes = 0
+            self._agree_sum = 0.0
+            self._agree_min = None
+            self._dq_max = None
+        return block

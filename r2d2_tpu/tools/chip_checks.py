@@ -154,6 +154,45 @@ def run_chip_checks(only: str = "") -> int:
                         f"{name} rel gap {gap:.4f} > {gtol} (block_t={bt})"
     add("lstm_scan", lstm)
 
+    # --- quantized acting forward (ISSUE 14): compile + parity ----------
+    def quant_forward():
+        # The int8 forward has no pallas kernel, but it is the first
+        # program that streams int8 weights + per-channel scales through
+        # the bf16 MXU matmul path — the compile itself (int8 dequant
+        # fusion, mixed f32 LSTM carry under bf16 torso/head) is what
+        # this cell validates on the real toolchain, plus tolerance
+        # parity and greedy agreement against the f32 twin.
+        import dataclasses
+
+        from r2d2_tpu.actor.policy import make_forward_fn
+        from r2d2_tpu.config import NetworkConfig
+        from r2d2_tpu.models.network import (NetworkApply,
+                                             make_inference_bundle)
+        ncfg = dataclasses.replace(NetworkConfig(), inference_dtype="int8",
+                                   space_to_depth="off")
+        net = NetworkApply(6, ncfg, 4, 84, 84)
+        params = net.init(jax.random.PRNGKey(0))
+        bundle = jax.device_get(make_inference_bundle(net, params, 1))
+        obs = rng.random((16, 84, 84, 4)).astype(np.float32)
+        la = rng.integers(0, 6, 16).astype(np.int32)
+        hid = rng.standard_normal((16, 2, 512)).astype(np.float32) * 0.1
+        qfwd = make_forward_fn(net, probe_interval=1)
+        a_q, q_q, h_q, probe = qfwd(bundle, obs, la, hid, np.int32(0),
+                                    np.int32(16))
+        f32fwd = make_forward_fn(net, "f32")
+        a_f, q_f, h_f = f32fwd(params, obs, la, hid)
+        dq, agree, probed = (float(np.asarray(x)) for x in probe)
+        assert probed == 1.0, "probe branch did not fire at tick 0"
+        scale = max(float(np.abs(np.asarray(q_f)).max()), 1e-3)
+        assert float(np.abs(np.asarray(q_q) - np.asarray(q_f)).max()) \
+            / scale < 0.05, "quantized Q diverges > 5% of Q range"
+        host_agree = float(np.mean(np.asarray(a_q) == np.asarray(a_f)))
+        assert agree >= 0.9 and host_agree >= 0.9, \
+            f"greedy agreement {agree:.3f}/{host_agree:.3f} < 0.9"
+        # the recurrent carry must come back f32 (drift containment)
+        assert np.asarray(h_q).dtype == np.float32
+    add("quant_forward", quant_forward)
+
     if not checks:
         print(f"no checks match --only={only!r}", file=sys.stderr)
         return 2

@@ -46,6 +46,12 @@ Phases:
      on vs off — the widened psum gauges, per-iteration lockstep timing,
      and the rank-0 FleetAggregator under the same < 2% budget
      (``E2E_r14.json``).
+  7. **Quant A/B** (``--quant-ab``): the quantized inference plane
+     (ISSUE 14) — thread-mode acting arm at ``network.inference_dtype``
+     f32 vs int8 (ABBA medians; int8 cells carry the ``quant`` accuracy
+     block), a serving-probe arm at both dtypes, and the analytic
+     weight-bytes table with the >= 3x int8 streaming cut
+     (``E2E_r16.json``).
 
 Output: ONE JSON line (the driver artifact), also written to ``--out``.
 Hermetic on any backend — the fake env and (for the e2e phase) a
@@ -255,6 +261,25 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
             serving = dict(sb)
         else:
             serving.update({k: v for k, v in sb.items() if v is not None})
+    # quantized-inference evidence (ISSUE 14): probe COUNTS accumulate
+    # across the run (per-interval ints read 0, not None, in a
+    # probe-free interval — last-wins would erase the run's evidence);
+    # the quality gauges take the newest non-null value. None on every
+    # inference_dtype="f32" run (the sibling serving/anakin convention:
+    # the key is always present, null when the plane is off).
+    quant = None
+    for r in records:
+        qb = r.get("quant")
+        if not qb:
+            continue
+        if quant is None:
+            quant = dict(qb)
+            continue
+        for k, v in qb.items():
+            if k in ("probes", "lanes_probed"):
+                quant[k] = (quant.get(k) or 0) + (v or 0)
+            elif v is not None:
+                quant[k] = v
     # system-health evidence (ISSUE 7): the newest resources block plus
     # the run's alert tally — proof the pillar actually flowed (or, with
     # the kill switch off, that the records carried neither key)
@@ -291,6 +316,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "replay_diag": replay_diag,
         "anakin": anakin,
         "serving": serving,
+        "quant": quant,
         "resources": resources,
         "alerts_present": alerts_present,
         "alerts_fired": alerts_fired,
@@ -640,6 +666,139 @@ def run_serve_ab(seconds: float, lanes: int = 16,
         out["serve_fill_mean_sweep_max"] = max(fills)
     out["serve_slo_ok_sweep"] = all(
         c.get("slo_ok") for c in out["client_sweep"])
+    return out
+
+
+def quant_weight_bytes_table(overrides: Optional[dict] = None) -> dict:
+    """Analytic weight-streaming table (ISSUE 14 acceptance): bytes of
+    the acting forward's weight tree per inference dtype at the
+    REFERENCE network shape (hidden 512 / cnn 1024 / Nature convs —
+    what the TPU projection is about), plus this bench's reduced shape
+    for context. Pure eval_shape math, no compile; the int8 ratio is
+    the >= 3x cut the costmodel gate also snapshots exactly."""
+    import dataclasses
+
+    import jax
+
+    from r2d2_tpu.config import Config, NetworkConfig
+    from r2d2_tpu.models.network import (NetworkApply, param_tree_bytes,
+                                         quantize_params)
+
+    def row(ncfg, stack, h, w):
+        net = NetworkApply(6, ncfg, stack, h, w)
+        params = jax.eval_shape(net.init, jax.random.PRNGKey(0))
+        out = {}
+        for mode in ("f32", "bf16", "int8"):
+            tree = (params if mode == "f32" else jax.eval_shape(
+                lambda p, _m=mode: quantize_params(p, _m), params))
+            out[f"weight_bytes_{mode}"] = param_tree_bytes(tree)
+        for mode in ("bf16", "int8"):
+            out[f"weight_bytes_ratio_{mode}"] = round(
+                out["weight_bytes_f32"] / out[f"weight_bytes_{mode}"], 3)
+        return out
+
+    ref = Config()
+    bench = _bench_config(dict(E2E_CPU_OVERRIDES, **(overrides or {})))
+    return {
+        "reference_shape": row(
+            dataclasses.replace(NetworkConfig(), space_to_depth="off"),
+            ref.env.frame_stack, ref.env.frame_height, ref.env.frame_width),
+        "bench_shape": row(
+            dataclasses.replace(bench.network, space_to_depth="off"),
+            bench.env.frame_stack, bench.env.frame_height,
+            bench.env.frame_width),
+    }
+
+
+def run_quant_ab(seconds: float, lanes: int = 16,
+                 overrides: Optional[dict] = None,
+                 repeats: int = 2) -> dict:
+    """Quantized-inference A/B (ISSUE 14 acceptance), three arms in one
+    artifact:
+
+      * **acting arm** — the SAME thread-mode e2e system (one vector
+        actor worker + the real learner) at ``network.inference_dtype``
+        f32 vs int8, ABBA-interleaved ``repeats`` times with per-arm
+        medians (the serve/fleet-AB noise treatment); the int8 cells
+        carry the ``quant`` block (probes, agreement, |ΔQ|) as
+        end-to-end evidence and f32 cells prove the records carry no
+        ``quant`` key (the kill-switch schema contract);
+      * **serving-probe arm** — the pure serving-plane latency probe
+        (no colocated learner) at f32 vs int8: requests/s, batch fill,
+        forward percentiles, the SLO leg;
+      * **weight-bytes table** — the analytic streaming cut per dtype
+        at the reference shape (the >= 3x int8 acceptance line, also
+        exact-match-gated through the costmodel table).
+
+    CPU-gate framing (PERF.md round 17): the acting ratio measures the
+    weight-streaming mechanism one memory tier down — the bench-shape
+    f32 tree spills this host's per-core cache while the int8 twin
+    stays resident (measured 1.19x) — and the weight-bytes table is
+    what projects to TPU, where the acting forward is
+    HBM-streaming-bound (the costmodel bytes tables)."""
+    base = dict(overrides or {})
+    base.setdefault("telemetry.quant_probe_interval", 64)
+    cells = {"acting_f32": [], "acting_int8": []}
+    for rep in range(max(repeats, 1)):
+        order = (("acting_f32", "f32"), ("acting_int8", "int8"))
+        if rep % 2:
+            order = order[::-1]    # ABBA: cancel monotonic host drift
+        for label, mode in order:
+            ov = dict(base)
+            ov["network.inference_dtype"] = mode
+            cells[label].append(run_e2e(
+                seconds, envs_per_actor=lanes, num_actors=1,
+                overrides=ov, actor_mode="thread"))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"acting_f32": cells["acting_f32"][-1],
+           "acting_int8": cells["acting_int8"][-1],
+           "lanes": lanes, "repeats": max(repeats, 1),
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("acting_f32", "env_steps_per_sec") > 0:
+        out["env_steps_ratio_quant"] = round(
+            med("acting_int8", "env_steps_per_sec")
+            / med("acting_f32", "env_steps_per_sec"), 3)
+    if med("acting_f32", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio_quant"] = round(
+            med("acting_int8", "learner_steps_per_sec")
+            / med("acting_f32", "learner_steps_per_sec"), 3)
+    qb = {}
+    for c in cells["acting_int8"]:
+        qb.update({k: v for k, v in (c.get("quant") or {}).items()
+                   if v is not None})
+    out["quant_block_on"] = bool(qb)
+    out["quant_agree_frac"] = qb.get("agree_frac")
+    out["quant_dq_max"] = qb.get("dq_max")
+    out["quant_probes"] = qb.get("probes")
+    out["quant_block_f32"] = any(c.get("quant")
+                                 for c in cells["acting_f32"])
+
+    # serving-probe arm: the micro-batcher itself at each dtype — the
+    # serving plane is the second consumer the ISSUE names, and the
+    # probe isolates it from the training loop's core contention
+    out["serve_probe"] = {}
+    for mode in ("f32", "int8"):
+        ov = dict(base)
+        ov["network.inference_dtype"] = mode
+        out["serve_probe"][mode] = serve_latency_probe(
+            min(seconds, 15.0), lanes, overrides=ov)
+    f32_rps = out["serve_probe"]["f32"].get("requests_per_sec") or 0
+    if f32_rps > 0:
+        out["serve_requests_ratio_quant"] = round(
+            (out["serve_probe"]["int8"].get("requests_per_sec") or 0)
+            / f32_rps, 3)
+    out["serve_slo_ok_quant"] = bool(
+        out["serve_probe"]["int8"].get("slo_ok"))
+
+    out["weight_bytes"] = quant_weight_bytes_table(overrides)
     return out
 
 
@@ -1124,6 +1283,15 @@ def main(argv=None) -> int:
     p.add_argument("--serve-lanes", type=int, default=16,
                    help="lanes (= serve clients) for the serve A/B's "
                         "equal-lane arms")
+    p.add_argument("--quant-ab", type=int, default=0,
+                   help="1: run the e2e phase as the quantized-inference "
+                        "A/B instead (ISSUE 14) — thread-mode acting arm "
+                        "at network.inference_dtype f32 vs int8 "
+                        "(ABBA-interleaved, per-arm medians, the int8 "
+                        "cells carry the 'quant' accuracy block) + a "
+                        "serving-probe arm at both dtypes + the analytic "
+                        "weight-bytes table (the >= 3x int8 cut); one "
+                        "artifact (E2E_r16.json)")
     p.add_argument("--resources-ab", type=int, default=0,
                    help="1: run the e2e phase as a resource/compile/alerts "
                         "on/off A/B instead (telemetry.resources_enabled; "
@@ -1185,6 +1353,10 @@ def main(argv=None) -> int:
                 args.e2e_seconds, args.envs_per_actor,
                 dp=args.sharded_dp, overrides=overrides,
                 repeats=args.ab_repeats)
+        elif args.quant_ab:
+            out["e2e_quant_ab"] = run_quant_ab(
+                args.e2e_seconds, lanes=args.serve_lanes,
+                overrides=overrides, repeats=args.ab_repeats)
         elif args.serve_ab:
             out["e2e_serve_ab"] = run_serve_ab(
                 args.e2e_seconds, lanes=args.serve_lanes,

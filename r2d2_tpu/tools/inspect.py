@@ -100,7 +100,7 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None,
     lines.append(ingest + ("   health: " + " ".join(health) if health else ""))
     an = record.get("anakin")
     if an:
-        lines.append(render_anakin(an))
+        lines.append(render_anakin(an, record.get("quant")))
     fb = record.get("fleet")
     if fb:
         lines.append("")
@@ -116,7 +116,13 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None,
     sv = record.get("serving")
     if sv:
         lines.append("")
-        lines.append(render_serving(sv))
+        lines.append(render_serving(sv, record.get("quant")))
+    qb = record.get("quant")
+    if qb and not sv:
+        # quantized LOCAL/anakin inference (no serving panel to ride):
+        # the dtype + live agreement gauge get their own line
+        lines.append("")
+        lines.append(render_quant(qb))
     rb = record.get("resources")
     if rb:
         lines.append("")
@@ -228,14 +234,17 @@ def render_host_rows(host_rows: List[dict]) -> str:
     return "\n".join(lines)
 
 
-def render_anakin(an: dict) -> str:
+def render_anakin(an: dict, quant: Optional[dict] = None) -> str:
     """The sharded-anakin composition panel (ISSUE 8): one row per
     shard (env steps, episodes, return sums this interval) plus the
-    env-step imbalance ratio the shard_imbalance alert watches."""
+    env-step imbalance ratio the shard_imbalance alert watches. A
+    quantized acting scan (ISSUE 14) adds the active inference dtype to
+    the head line (the agreement gauge renders as its own quant line)."""
     imb = an.get("shard_imbalance")
     head = (f"anakin mesh: dp={an.get('dp')} "
             f"lanes/shard={an.get('lanes_per_shard')}"
-            + (f"  imbalance={imb:.2f}" if imb is not None else ""))
+            + (f"  imbalance={imb:.2f}" if imb is not None else "")
+            + (f"  inference={quant.get('dtype')}" if quant else ""))
     lines = [head]
     env = an.get("shard_env_steps") or []
     eps = an.get("shard_episodes") or []
@@ -257,10 +266,32 @@ def render_anakin(an: dict) -> str:
     return "\n".join(lines)
 
 
-def render_serving(sv: dict) -> str:
+def render_quant(qb: dict) -> str:
+    """The quantized-inference gauge (ISSUE 14): active inference dtype
+    + the interval's live f32-twin agreement / max |ΔQ| probes — the
+    record's ``quant`` block."""
+    bits = [f"quant: dtype={qb.get('dtype')}"]
+    if qb.get("probes"):
+        bits.append(f"probes={qb['probes']}")
+        if qb.get("agree_frac") is not None:
+            bits.append(f"agree={100 * qb['agree_frac']:.1f}%")
+        if qb.get("agree_min") is not None:
+            bits.append(f"(min {100 * qb['agree_min']:.0f}%)")
+        if qb.get("dq_max") is not None:
+            bits.append(f"|dQ|max={qb['dq_max']:.4g}")
+    else:
+        bits.append("no probes this interval")
+    if qb.get("publish_stamp"):
+        bits.append(f"twin@pub={qb['publish_stamp']}")
+    return " ".join(bits)
+
+
+def render_serving(sv: dict, quant: Optional[dict] = None) -> str:
     """The serving panel (ISSUE 13): request latency percentiles, batch
     fill, dispatch causes, and client lease churn — the record's
-    ``serving`` block from the central policy inference server."""
+    ``serving`` block from the central policy inference server. When the
+    run serves a quantized forward (ISSUE 14), the active inference
+    dtype + live agreement gauge render as the panel's last line."""
     lat = sv.get("latency") or {}
     batch = sv.get("batch") or {}
     clients = sv.get("clients") or {}
@@ -291,6 +322,8 @@ def render_serving(sv: dict) -> str:
              if clients.get(k)]
     if churn:
         lines.append("  leases: " + " ".join(churn))
+    if quant:
+        lines.append("  " + render_quant(quant))
     return "\n".join(lines)
 
 

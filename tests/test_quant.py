@@ -1,0 +1,690 @@
+"""Quantized inference plane tests (ISSUE 14): per-channel int8
+quantize/dequantize round-trip bounds, greedy-action agreement vs the
+f32 twin on the fixture net, publish-time bundle round-trips through
+both weight stores (staleness stamps included), serve/local/anakin
+switching through the ONE shared forward, the in-graph accuracy probe +
+quant record block + quant_divergence rule, kill-switch schema
+stability, pre-PR14 config round-trips, the costmodel's serve-bucket and
+weight-bytes rows, and (slow) int8 gridworld learnability."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.models.network import (NetworkApply, initial_hidden,
+                                     is_quant_bundle, make_inference_bundle,
+                                     param_tree_bytes, quantize_leaf_int8,
+                                     quantize_params,
+                                     quantized_inference_apply)
+
+
+def small_cfg(**overrides) -> Config:
+    cfg = Config().replace(**{
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "env.episode_len": 40,
+        "network.hidden_dim": 32, "network.cnn_out_dim": 64,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "runtime.save_interval": 0,
+    })
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def small_net(cfg: Config, action_dim: int = 6) -> NetworkApply:
+    return NetworkApply(action_dim, cfg.network, cfg.env.frame_stack,
+                        cfg.env.frame_height, cfg.env.frame_width)
+
+
+def _inputs(cfg, n, seed=0, hidden_scale=0.0):
+    rng = np.random.default_rng(seed)
+    obs = rng.random((n, cfg.env.frame_height, cfg.env.frame_width,
+                      cfg.env.frame_stack)).astype(np.float32)
+    la = rng.integers(0, 6, n).astype(np.int32)
+    hid = (rng.standard_normal((n, 2, cfg.network.hidden_dim))
+           .astype(np.float32) * hidden_scale)
+    return obs, la, hid
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize math
+
+
+def test_int8_round_trip_bound(rng):
+    """Per-element reconstruction error of the per-channel symmetric
+    scheme is bounded by scale/2 (round-to-nearest of w/scale)."""
+    from r2d2_tpu.models.network import dequantize_leaf
+    w = rng.standard_normal((7, 5, 3, 16)).astype(np.float32) * \
+        rng.random(16).astype(np.float32)          # per-channel ranges
+    leaf = jax.device_get(quantize_leaf_int8(w))
+    assert leaf["q"].dtype == np.int8
+    assert leaf["scale"].shape == (1, 1, 1, 16)    # one scale per out chan
+    deq = np.asarray(dequantize_leaf(leaf, jnp.float32))
+    bound = 0.5 * leaf["scale"] + 1e-7
+    assert np.all(np.abs(deq - w) <= bound)
+
+
+def test_int8_zero_channel_is_stable(rng):
+    """An all-zero output channel must not divide by zero (scale floor)
+    and must reconstruct exactly zero."""
+    from r2d2_tpu.models.network import dequantize_leaf
+    w = rng.standard_normal((4, 8)).astype(np.float32)
+    w[:, 3] = 0.0
+    leaf = quantize_leaf_int8(w)
+    deq = np.asarray(dequantize_leaf(leaf, jnp.float32))
+    assert np.all(np.isfinite(deq))
+    assert np.all(deq[:, 3] == 0.0)
+
+
+def test_quantize_params_modes():
+    cfg = small_cfg()
+    net = small_net(cfg)
+    params = net.init(jax.random.PRNGKey(0))
+    # bf16: every float leaf halves
+    b16 = quantize_params(params, "bf16")
+    for leaf in jax.tree_util.tree_leaves(b16):
+        assert leaf.dtype == jnp.bfloat16
+    # int8: kernels (ndim >= 2) become {q, scale}; biases stay f32
+    q8 = quantize_params(params, "int8")
+    conv = q8["params"]["torso"]["Conv_0"]
+    assert conv["kernel"]["q"].dtype == jnp.int8
+    assert conv["bias"].dtype == jnp.float32
+    lstm = q8["params"]["lstm"]
+    assert lstm["recurrent_kernel"]["q"].dtype == jnp.int8
+    assert lstm["bias"].dtype == jnp.float32
+    # identity at f32
+    assert quantize_params(params, "f32") is params
+    # the byte cut the whole plane exists for
+    assert param_tree_bytes(params) / param_tree_bytes(q8) >= 3.0
+    assert abs(param_tree_bytes(params) / param_tree_bytes(b16) - 2.0) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# the shared forward: f32 identity + quant agreement + the probe
+
+
+def test_forward_f32_identical_to_module_apply():
+    """inference_dtype='f32' leaves the shared forward the EXACT
+    pre-PR14 program: same signature, outputs equal to a direct module
+    apply."""
+    from r2d2_tpu.actor.policy import make_forward_fn
+    cfg = small_cfg()
+    net = small_net(cfg)
+    params = net.init(jax.random.PRNGKey(0))
+    obs, la, hid = _inputs(cfg, 4)
+    fwd = make_forward_fn(net)                     # config default = f32
+    a, q, h = fwd(params, obs, la, hid)
+    la_1h = jax.nn.one_hot(la, 6, dtype=jnp.float32)[:, None]
+    q_ref, h_ref = net.module.apply(params, obs[:, None], la_1h, hid)
+    # allclose, not equal: the eager reference apply and the jitted
+    # forward fuse differently on XLA:CPU (~1 ulp — the PR1 batched-
+    # policy numerics note); actions are bit-identical regardless
+    np.testing.assert_allclose(np.asarray(q), np.asarray(q_ref[:, 0]),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_array_equal(np.asarray(a),
+                                  np.argmax(np.asarray(q_ref[:, 0]), -1))
+
+
+@pytest.mark.parametrize("mode", ["int8", "bf16"])
+def test_quant_forward_agreement(mode):
+    """Greedy-action agreement vs the f32 twin on the fixture net: >=
+    0.99 over states with any real Q margin, and every residual
+    disagreement is a TIE FLIP — the f32 top-2 gap there is within the
+    measured |ΔQ| (a random-init net's Q spread is ~1e-3, so counting
+    coin-flip ties against the guard would test tie-breaking, not
+    quantization; the trained-net line is the slow gridworld test +
+    the live agree gauge)."""
+    from r2d2_tpu.actor.policy import make_forward_fn
+    cfg = small_cfg(**{"network.inference_dtype": mode})
+    net = small_net(cfg)
+    params = net.init(jax.random.PRNGKey(1))
+    bundle = make_inference_bundle(net, params, 1)
+    qfwd = make_forward_fn(net)
+    ffwd = make_forward_fn(net, "f32")
+    agree = total = 0
+    dq_max = qscale = 0.0
+    for seed in range(4):
+        obs, la, hid = _inputs(cfg, 64, seed=seed, hidden_scale=0.1)
+        a_q, q_q, _, _probe = qfwd(bundle, obs, la, hid, np.int32(1),
+                                   np.int32(64))
+        a_f, q_f, _ = ffwd(params, obs, la, hid)
+        a_q, a_f = np.asarray(a_q), np.asarray(a_f)
+        q_f = np.asarray(q_f)
+        dq = float(np.max(np.abs(np.asarray(q_q) - q_f)))
+        dq_max = max(dq_max, dq)
+        qscale = max(qscale, float(np.max(np.abs(q_f))))
+        top2 = np.sort(q_f, axis=-1)
+        margin = top2[:, -1] - top2[:, -2]          # f32 top-2 gap
+        clear = margin > 2.0 * dq                   # not a tie flip
+        agree += int(np.sum((a_q == a_f)[clear]))
+        total += int(np.sum(clear))
+        # disagreements only ever happen inside the tie band
+        assert np.all((a_q == a_f) | ~clear)
+    assert total >= 128, total                      # the mask kept most
+    assert agree / total >= 0.99, (agree, total)
+    assert dq_max <= 0.05 * max(qscale, 1e-3), (dq_max, qscale)
+
+
+def test_quant_forward_f32_carry():
+    """The quantized forward's recurrent state is f32 end to end: the
+    returned packed hidden is f32, and feeding it back for many steps
+    tracks the f32 twin's hidden closely (quantization error stays
+    per-step, never compounding into the carry)."""
+    cfg = small_cfg(**{"network.inference_dtype": "int8"})
+    net = small_net(cfg)
+    params = net.init(jax.random.PRNGKey(2))
+    bundle = make_inference_bundle(net, params, 1)
+    obs, la, _ = _inputs(cfg, 2)
+    h_q = h_f = initial_hidden(2, cfg.network.hidden_dim)
+    la_1h = jax.nn.one_hot(la, 6, dtype=jnp.float32)[:, None]
+    for step in range(20):
+        o = jnp.asarray(np.roll(obs, step, axis=1))[:, None]
+        q_q, h_q = quantized_inference_apply(net, bundle["quant"], o,
+                                             la_1h, h_q)
+        q_f, h_f = net.module.apply(params, o, la_1h, h_f)
+        assert np.asarray(h_q).dtype == np.float32
+    gap = float(np.max(np.abs(np.asarray(h_q) - np.asarray(h_f))))
+    assert gap < 0.05, gap
+
+
+def test_probe_cadence():
+    """The lax.cond probe fires exactly on tick % interval == 0 and
+    reports sane numbers; probe_interval=0 compiles it out (flag always
+    zero)."""
+    from r2d2_tpu.actor.policy import make_forward_fn
+    cfg = small_cfg(**{"network.inference_dtype": "int8"})
+    net = small_net(cfg)
+    bundle = make_inference_bundle(net, net.init(jax.random.PRNGKey(0)), 1)
+    obs, la, hid = _inputs(cfg, 8)
+    fwd = make_forward_fn(net, probe_interval=4)
+    for tick, expect in ((0, 1.0), (1, 0.0), (3, 0.0), (4, 1.0), (8, 1.0)):
+        _, _, _, (dq, agree, probed) = fwd(bundle, obs, la, hid,
+                                           np.int32(tick), np.int32(8))
+        assert float(probed) == expect, tick
+        if expect:
+            assert 0.0 <= float(agree) <= 1.0
+            assert float(dq) >= 0.0
+    noprobe = make_forward_fn(net, probe_interval=0)
+    _, _, _, (dq, agree, probed) = noprobe(bundle, obs, la, hid,
+                                           np.int32(0), np.int32(8))
+    assert float(probed) == 0.0
+
+
+def test_probe_masks_padding_rows():
+    """The server pads under-filled dispatches to pow2 buckets with
+    degenerate zero rows; the probe's agreement/|dQ| must come from the
+    first `live` rows only — a tie flip on the fixed pad input must
+    neither fire nor dilute quant_divergence."""
+    from r2d2_tpu.actor.policy import make_forward_fn
+    cfg = small_cfg(**{"network.inference_dtype": "int8"})
+    net = small_net(cfg)
+    bundle = make_inference_bundle(net, net.init(jax.random.PRNGKey(0)), 1)
+    obs, la, hid = _inputs(cfg, 8)
+    obs[5:] = 0.0          # "padding": rows >= live are degenerate
+    la[5:] = -1
+    hid[5:] = 0.0
+    fwd = make_forward_fn(net, probe_interval=1)
+    _, _, _, (dq_live, agree_live, _p) = fwd(bundle, obs, la, hid,
+                                             np.int32(0), np.int32(5))
+    _, _, _, (dq_all, agree_all, _p2) = fwd(bundle, obs, la, hid,
+                                            np.int32(0), np.int32(8))
+    # masked stats must equal recomputing over the first 5 rows alone
+    obs5, la5, hid5 = obs[:5], la[:5], hid[:5]
+    _, _, _, (dq_ref, agree_ref, _p3) = fwd(bundle, obs5, la5, hid5,
+                                            np.int32(0), np.int32(5))
+    assert abs(float(agree_live) - float(agree_ref)) < 1e-6
+    assert abs(float(dq_live) - float(dq_ref)) < 1e-5
+    # and live < N genuinely excludes the tail (dq over all rows can
+    # only be >= the masked value)
+    assert float(dq_all) >= float(dq_live) - 1e-7
+
+
+# ---------------------------------------------------------------------------
+# publish-time bundle through the weight plumbing
+
+
+def test_bundle_structure_and_stamp():
+    cfg = small_cfg(**{"network.inference_dtype": "int8"})
+    net = small_net(cfg)
+    params = net.init(jax.random.PRNGKey(0))
+    bundle = make_inference_bundle(net, params, 7)
+    assert is_quant_bundle(bundle) and not is_quant_bundle(params)
+    assert int(np.asarray(bundle["stamp"])) == 7
+    # f32: the published tree IS the raw params (byte-identical plumbing)
+    f32net = small_net(small_cfg())
+    assert make_inference_bundle(f32net, params, 7) is params
+
+
+def test_publish_preparer_identity_at_f32():
+    from r2d2_tpu.runtime.weights import make_publish_preparer, wrap_publish
+    net = small_net(small_cfg())
+    assert make_publish_preparer(net) is None
+    sentinel = object()
+    assert wrap_publish(sentinel, None, lambda: 0) is sentinel
+
+
+def test_inproc_store_bundle_round_trip():
+    """Thread-mode plumbing: the wrapped publish builds one stamped
+    bundle per publication; readers adopt the twin with the matching
+    publish count (the staleness-stamp contract)."""
+    from r2d2_tpu.runtime.weights import (InProcWeightStore,
+                                          make_publish_preparer,
+                                          wrap_publish)
+    cfg = small_cfg(**{"network.inference_dtype": "int8"})
+    net = small_net(cfg)
+    p0 = net.init(jax.random.PRNGKey(0))
+    prep = make_publish_preparer(net)
+    store = InProcWeightStore(prep(p0, 1))
+    publish = wrap_publish(store.publish, prep, lambda: store.publish_count)
+    got = store.poll("r")
+    assert int(np.asarray(got["stamp"])) == 1 == store.reader_version("r")
+    p1 = net.init(jax.random.PRNGKey(1))
+    publish(p1)
+    got = store.poll("r")
+    assert int(np.asarray(got["stamp"])) == 2 == store.reader_version("r")
+    # the adopted twin IS the publish-time quantization of p1
+    ref = jax.device_get(make_inference_bundle(net, p1, 2))
+    np.testing.assert_array_equal(
+        np.asarray(got["quant"]["params"]["head"]["adv_out"]["kernel"]["q"]),
+        np.asarray(ref["quant"]["params"]["head"]["adv_out"]["kernel"]["q"]))
+
+
+def test_store_current_fresh_after_reader_consumed():
+    """The respawn contract: a dead actor's slot has already consumed
+    the store version (poll -> None), so a respawned thread policy is
+    constructed from store.current(), which must hand back the LIVE
+    published tree and mark the version adopted (the staleness stamp
+    matches the tree the policy actually holds)."""
+    from r2d2_tpu.runtime.weights import (InProcWeightStore,
+                                          make_publish_preparer,
+                                          wrap_publish)
+    cfg = small_cfg(**{"network.inference_dtype": "int8"})
+    net = small_net(cfg)
+    prep = make_publish_preparer(net)
+    store = InProcWeightStore(prep(net.init(jax.random.PRNGKey(0)), 1))
+    publish = wrap_publish(store.publish, prep,
+                           lambda: store.publish_count)
+    publish(net.init(jax.random.PRNGKey(1)))       # publication 2
+    assert store.poll(3) is not None               # reader 3 adopts v2
+    assert store.poll(3) is None                   # the respawn's view
+    cur = store.current(reader_id=3)
+    assert int(np.asarray(cur["stamp"])) == 2      # live tree, not init
+    assert store.reader_version(3) == store.publish_count
+
+
+def test_shm_publisher_bundle_round_trip():
+    """Process-mode plumbing: the int8 twin survives the shm segment's
+    f32 payload EXACTLY (int8 values are small integers, lossless in
+    f32), scales and stamps included."""
+    from r2d2_tpu.runtime.weights import (WeightPublisher, WeightSubscriber,
+                                          make_publish_preparer,
+                                          wrap_publish)
+    cfg = small_cfg(**{"network.inference_dtype": "int8"})
+    net = small_net(cfg)
+    p0 = net.init(jax.random.PRNGKey(0))
+    prep = make_publish_preparer(net)
+    pub = WeightPublisher(prep(p0, 1))
+    try:
+        template = jax.device_get(prep(net.init(jax.random.PRNGKey(9)), 0))
+        sub = WeightSubscriber(pub.name, template)
+        publish = wrap_publish(pub.publish, prep,
+                               lambda: pub.publish_count)
+        p1 = net.init(jax.random.PRNGKey(1))
+        publish(p1)
+        got = sub.poll()
+        assert got is not None
+        assert int(np.asarray(got["stamp"])) == 2 == sub.publish_count
+        # reference through the SAME jitted preparer publish used (the
+        # eager twin differs by ~1 ulp in the scale division)
+        ref = jax.device_get(prep(p1, 2))
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(ref)):
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        sub.close()
+    finally:
+        pub.close()
+
+
+# ---------------------------------------------------------------------------
+# policies / server / anakin switch together
+
+
+def test_actor_policy_int8_probes_and_stamps():
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.telemetry import QuantStats
+    cfg = small_cfg(**{"network.inference_dtype": "int8"})
+    net = small_net(cfg)
+    params = net.init(jax.random.PRNGKey(0))
+    stats = QuantStats("int8", probe_interval=2)
+    pol = ActorPolicy(net, params, epsilon=0.0, seed=0,
+                      quant_stats=stats, quant_probe_interval=2)
+    rng = np.random.default_rng(0)
+    pol.observe_reset(rng.integers(0, 255, (24, 24), np.uint8))
+    for _ in range(6):
+        a, q, _ = pol.act()
+        pol.observe(rng.integers(0, 255, (24, 24), np.uint8), a)
+    block = stats.interval_block()
+    assert block["dtype"] == "int8"
+    assert block["probes"] == 3            # ticks 0, 2, 4
+    assert block["agree_frac"] is not None
+    # update with a published bundle records the twin's stamp
+    pol.update_params(jax.device_get(make_inference_bundle(net, params, 5)))
+    assert stats.interval_block()["publish_stamp"] == 5
+
+
+def test_actor_policy_int8_tracks_f32_actions():
+    """A greedy int8 policy and its f32 twin, stepped through the same
+    observation stream, pick the same actions nearly always (the
+    fixture-net agreement line, end to end through the policy state)."""
+    from r2d2_tpu.actor.policy import ActorPolicy
+    cfg32 = small_cfg()
+    cfg8 = small_cfg(**{"network.inference_dtype": "int8"})
+    params = small_net(cfg32).init(jax.random.PRNGKey(1))
+    p32 = ActorPolicy(small_net(cfg32), params, epsilon=0.0, seed=0)
+    p8 = ActorPolicy(small_net(cfg8), params, epsilon=0.0, seed=0)
+    rng = np.random.default_rng(0)
+    obs0 = rng.integers(0, 255, (24, 24), np.uint8)
+    p32.observe_reset(obs0)
+    p8.observe_reset(obs0)
+    match = 0
+    for _ in range(40):
+        a32, _, _ = p32.act()
+        a8, _, _ = p8.act()
+        match += int(a32 == a8)
+        nxt = rng.integers(0, 255, (24, 24), np.uint8)
+        # drive BOTH with the f32 stream so state stays comparable
+        p32.observe(nxt, a32)
+        p8.observe(nxt, a32)
+    assert match >= 39, match
+
+
+def test_batched_policy_int8_runs():
+    from r2d2_tpu.actor.policy import BatchedActorPolicy
+    from r2d2_tpu.telemetry import QuantStats
+    cfg = small_cfg(**{"network.inference_dtype": "int8"})
+    net = small_net(cfg)
+    params = net.init(jax.random.PRNGKey(0))
+    stats = QuantStats("int8", probe_interval=1)
+    pol = BatchedActorPolicy(net, params, [0.1, 0.2], seeds=[0, 1],
+                             quant_stats=stats, quant_probe_interval=1)
+    rng = np.random.default_rng(0)
+    for lane in range(2):
+        pol.observe_reset_lane(lane, rng.integers(0, 255, (24, 24),
+                                                  np.uint8))
+    actions, q, hidden = pol.act()
+    assert actions.shape == (2,) and q.shape == (2, 6)
+    assert hidden.dtype == np.float32
+    block = stats.interval_block()
+    assert block["probes"] == 1 and block["lanes_probed"] == 2
+
+
+def test_server_int8_matches_local_quant_policy():
+    """Served int8 inference is the SAME program local int8 policies
+    run: at ε=0 and equal state the served action/Q stream is
+    bit-identical to the local quant policy's."""
+    from r2d2_tpu.actor.policy import ActorPolicy
+    from r2d2_tpu.serve import InprocEndpoint, PolicyServer, RemotePolicy
+    from r2d2_tpu.telemetry import QuantStats
+    cfg = small_cfg(**{"network.inference_dtype": "int8",
+                       "serve.max_batch": 2, "serve.deadline_ms": 1.0,
+                       "telemetry.quant_probe_interval": 1})
+    net = small_net(cfg)
+    params = net.init(jax.random.PRNGKey(0))
+    stats = QuantStats("int8", 1)
+    ep = InprocEndpoint()
+    srv = PolicyServer(cfg, net, params, endpoint=ep,
+                       quant_stats=stats).start()
+    try:
+        local = ActorPolicy(net, params, epsilon=0.0, seed=0)
+        remote = RemotePolicy(ep.connect(), net.action_dim, 0.0, seed=0,
+                              client_id=0)
+        rng = np.random.default_rng(0)
+        obs0 = rng.integers(0, 255, (24, 24), np.uint8)
+        local.observe_reset(obs0)
+        remote.observe_reset(obs0)
+        for _ in range(8):
+            al, ql, _ = local.act()
+            ar, qr, _ = remote.act()
+            assert al == ar
+            np.testing.assert_array_equal(np.asarray(ql), np.asarray(qr))
+            nxt = rng.integers(0, 255, (24, 24), np.uint8)
+            local.observe(nxt, al)
+            remote.observe(nxt, al)
+        # the server's dispatch loop fed the shared QuantStats
+        assert stats.interval_block()["probes"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_anakin_core_quant_probe_and_blocks():
+    """The acting scan switches to the quantized forward with the knob
+    and its per-segment probe lands in the stats dict; at f32 the stats
+    carry no quant keys (the program is the pre-PR14 one)."""
+    from r2d2_tpu.actor.anakin import init_act_carry, make_anakin_act
+    from r2d2_tpu.envs.factory import create_jax_env
+    from r2d2_tpu.replay.structs import ReplaySpec
+    base = {"env.frame_height": 12, "env.frame_width": 12,
+            "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+            "network.conv_layers": ((8, 4, 2),),
+            "replay.block_length": 20, "env.episode_len": 40,
+            "actor.on_device": True, "actor.anakin_lanes": 3}
+    cfg8 = small_cfg(**dict(base, **{"network.inference_dtype": "int8"}))
+    env = create_jax_env(cfg8.env)
+    net = small_net(cfg8)
+    spec = ReplaySpec.from_config(cfg8)
+    act = make_anakin_act(env, net, spec, num_lanes=3,
+                          epsilons=[0.1, 0.2, 0.3], gamma=0.99,
+                          priority=1.0, near_greedy_eps=0.5)
+    params = net.init(jax.random.PRNGKey(0))
+    bundle = make_inference_bundle(net, params, 1)
+    carry = init_act_carry(env, spec, 3, jax.random.PRNGKey(1))
+    carry, blocks, stats = act(bundle, carry, np.int32(1))
+    assert "quant_dq" in stats and "quant_agree" in stats
+    assert 0.0 <= float(stats["quant_agree"]) <= 1.0
+    assert float(stats["quant_dq"]) >= 0.0
+    assert np.isfinite(np.asarray(blocks.reward)).all()
+    assert np.asarray(blocks.obs_row).shape[0] == 3
+
+    # the probe honors its kill switch: off, the f32 twin never enters
+    # the quantized program's stats (telemetry.quant_probe_interval = 0)
+    act_np = make_anakin_act(env, net, spec, num_lanes=3,
+                             epsilons=[0.1, 0.2, 0.3], gamma=0.99,
+                             priority=1.0, near_greedy_eps=0.5,
+                             quant_probe=False)
+    carry_np = init_act_carry(env, spec, 3, jax.random.PRNGKey(1))
+    _, _, stats_np = act_np(bundle, carry_np, np.int32(1))
+    assert "quant_dq" not in stats_np
+
+    cfg32 = small_cfg(**base)
+    env32 = create_jax_env(cfg32.env)
+    net32 = small_net(cfg32)
+    act32 = make_anakin_act(env32, net32, spec, num_lanes=3,
+                            epsilons=[0.1, 0.2, 0.3], gamma=0.99,
+                            priority=1.0, near_greedy_eps=0.5)
+    carry32 = init_act_carry(env32, spec, 3, jax.random.PRNGKey(1))
+    _, _, stats32 = act32(params, carry32, np.int32(1))
+    assert "quant_dq" not in stats32
+
+
+# ---------------------------------------------------------------------------
+# record block, alert rule, schema stability, config
+
+
+def test_quant_stats_interval_semantics():
+    from r2d2_tpu.telemetry import QuantStats
+    s = QuantStats("bf16", 64)
+    empty = s.interval_block()
+    assert empty["dtype"] == "bf16" and empty["probes"] == 0
+    assert empty["agree_frac"] is None and empty["dq_max"] is None
+    s.on_probe(0.02, 1.0, lanes=3)
+    s.on_probe(0.5, 0.5, lanes=1)
+    b = s.interval_block()
+    assert b["probes"] == 2 and b["lanes_probed"] == 4
+    assert abs(b["agree_frac"] - 3.5 / 4) < 1e-6
+    assert b["agree_min"] == 0.5 and b["dq_max"] == 0.5
+    # consumed: the next interval starts clean
+    assert s.interval_block()["probes"] == 0
+
+
+def test_quant_divergence_rule():
+    from r2d2_tpu.telemetry import AlertEngine, default_rules
+    cfg = small_cfg()
+    engine = AlertEngine(default_rules(cfg.telemetry))
+    assert any(r.name == "quant_divergence" for r in engine.rules)
+
+    def rec(agree):
+        return {"quant": {"dtype": "int8", "agree_frac": agree}}
+
+    assert engine.evaluate(rec(0.999))["fired"] == []
+    out = engine.evaluate(rec(0.5))
+    assert [a["rule"] for a in out["fired"]] == ["quant_divergence"]
+    # a probe-free interval (None) HOLDS the breach — no refire either
+    held = engine.evaluate(rec(None))
+    assert held["fired"] == [] and "quant_divergence" in held["active"]
+    # recovery re-arms, next breach fires again
+    assert engine.evaluate(rec(0.99))["fired"] == []
+    assert len(engine.evaluate(rec(0.1))["fired"]) == 1
+
+
+def test_record_schema_stable_without_quant(tmp_path):
+    """No provider attached (every f32 run): the record carries no
+    'quant' key — byte-identical to the PR13 schema."""
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+    from r2d2_tpu.telemetry import QuantStats
+    m = TrainMetrics(0, str(tmp_path))
+    record = m.log(1.0)
+    assert "quant" not in record
+    m2 = TrainMetrics(1, str(tmp_path))
+    m2.set_quant(QuantStats("int8", 8).interval_block)
+    record2 = m2.log(1.0)
+    assert record2["quant"]["dtype"] == "int8"
+
+
+def test_config_round_trip_and_validation():
+    # pre-PR14 dicts (no inference_dtype / quant knobs) load with defaults
+    d = Config().to_dict()
+    for key in ("inference_dtype",):
+        d["network"].pop(key)
+    d["telemetry"].pop("quant_probe_interval")
+    d["telemetry"].pop("alerts_quant_agreement")
+    cfg = Config.from_dict(d)
+    assert cfg.network.inference_dtype == "f32"
+    assert cfg.telemetry.quant_probe_interval == 256
+    # full round-trip with the knob on
+    cfg8 = small_cfg(**{"network.inference_dtype": "int8"})
+    assert Config.from_json(cfg8.to_json()).network.inference_dtype == "int8"
+    with pytest.raises(ValueError, match="inference_dtype"):
+        small_cfg(**{"network.inference_dtype": "fp8"})
+    with pytest.raises(ValueError, match="quant_probe_interval"):
+        small_cfg(**{"telemetry.quant_probe_interval": -1})
+    with pytest.raises(ValueError, match="alerts_quant_agreement"):
+        small_cfg(**{"telemetry.alerts_quant_agreement": 0.0})
+
+
+def test_costmodel_quant_and_serve_rows():
+    """The costmodel satellite: the serve micro-batched forward's pow2
+    buckets are tabled, and the acting-forward weight-bytes rows show
+    the >= 3x int8 cut the acceptance names."""
+    from r2d2_tpu.serve.server import serve_buckets
+    from r2d2_tpu.telemetry.costmodel import collect_cost_table, gate_config
+    cfg = gate_config()
+    table = collect_cost_table(cfg, variants=("serve_forward",
+                                              "quant_forward"))
+    progs = table["programs"]
+    for b in serve_buckets(cfg.serve.max_batch):
+        row = progs[f"serve_forward_b{b}"]
+        assert row["batch"] == b and row.get("flops", 0) > 0
+    wb = {m: progs[f"acting_forward_{m}"]["weight_bytes"]
+          for m in ("f32", "bf16", "int8")}
+    assert wb["f32"] / wb["int8"] >= 3.0
+    assert wb["f32"] / wb["bf16"] >= 1.9
+    for m in ("f32", "bf16", "int8"):
+        assert progs[f"acting_forward_{m}"].get("flops", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# learnability (slow): int8 acting still trains
+
+
+GRID_TRAIN_STEPS = 2000
+
+
+def _grid_cfg(save_dir: str) -> Config:
+    return Config().replace(**{
+        "env.game_name": "Grid", "env.grid_size": 5,
+        "env.frame_height": 20, "env.frame_width": 20,
+        "env.frame_stack": 2, "env.episode_len": 40,
+        "network.hidden_dim": 32, "network.cnn_out_dim": 64,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "network.inference_dtype": "int8",
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 32_000, "replay.block_length": 40,
+        "replay.batch_size": 16, "replay.learning_starts": 2_000,
+        "replay.max_env_steps_per_train_step": 16.0,
+        "actor.on_device": True, "actor.anakin_lanes": 32,
+        "optim.lr": 1e-3, "optim.gamma": 0.99,
+        "runtime.save_interval": 0, "runtime.log_interval": 8.0,
+        "runtime.save_dir": save_dir,
+    })
+
+
+def _grid_train(save_dir: str) -> dict:
+    from r2d2_tpu.runtime.anakin_loop import run_anakin_train
+    records = []
+    stacks = run_anakin_train(_grid_cfg(save_dir),
+                              max_training_steps=GRID_TRAIN_STEPS,
+                              max_seconds=600, log_fn=records.append)
+    returns = [r["avg_episode_return"] for r in records
+               if r.get("avg_episode_return") is not None]
+    quant = [r["quant"] for r in records if r.get("quant")]
+    return {"training_steps": int(stacks[0].learner.training_steps),
+            "returns": returns,
+            "agree": [q.get("agree_frac") for q in quant
+                      if q.get("agree_frac") is not None]}
+
+
+@pytest.mark.slow
+def test_grid_learnability_int8_acting(tmp_path):
+    """The gridworld still visibly LEARNS when every acting forward is
+    int8 (the learner stays f32): multi-fold return growth from the
+    first logged interval to the last, with the live agreement gauge
+    confirming the quantized policy tracked its f32 twin throughout —
+    the acceptance's end-to-end quality line. Runs in a subprocess on a
+    plain single-device CPU backend (the anakin learnability recipe)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["training_steps"] >= GRID_TRAIN_STEPS
+    returns = result["returns"]
+    assert len(returns) >= 2, returns
+    early, late = returns[0], returns[-1]
+    assert late >= max(3.0 * early, early + 0.3), returns
+    assert result["agree"], "no quant probes reached the records"
+    assert np.mean(result["agree"]) >= 0.9, result["agree"]
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from r2d2_tpu.utils.platform import pin_platform
+    pin_platform()
+    print(json.dumps(_grid_train(sys.argv[1])))
