@@ -68,6 +68,18 @@
 #                schema stability); the slow e2e slice (real actors
 #                through the server into the learner) and the
 #                server-kill/restart chaos drill run with the full tier.
+#   make elastic — the fast-tier elastic-fleet suite
+#                (tests/test_elastic.py: service-vs-in-mesh replay
+#                parity, spill demote/promote round-trips + the >= 2x
+#                capacity geometry, lane-routing provenance, the
+#                socket rung, fan-out tree topology/stamp propagation
+#                incl. the quant bundle, membership
+#                lease/park/adopt/handoff, elastic supervision, the
+#                join/leave chaos grammar, the replay_service block +
+#                three fleet alert rules, the service-routed Learner);
+#                the slow churn drill (leave 25% of a running fleet,
+#                re-join it, zero learner stalls) runs with the full
+#                tier.
 #   make quant — the fast-tier quantized-inference suite
 #                (tests/test_quant.py: per-channel int8 round-trip
 #                bounds, greedy-action agreement vs the f32 twin,
@@ -100,8 +112,8 @@
 #                shape on TPU).
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
-	replaydiag fleet serve quant costmodel regress costs roofline \
-	check-fast-markers
+	replaydiag fleet serve quant elastic costmodel regress costs \
+	roofline check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -146,6 +158,10 @@ quant: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+elastic: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
 costmodel: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q \
 	    -m 'not slow' -p no:cacheprovider
@@ -178,6 +194,7 @@ FAST_MARKER_CHECKS := \
 	tests/test_fleet.py:not_slow:12:fleet \
 	tests/test_serve.py:not_slow:14:serve \
 	tests/test_quant.py:not_slow:14:quant \
+	tests/test_elastic.py:not_slow:20:elastic \
 	tests/test_costmodel.py:not_slow:10:cost-model
 
 check-fast-markers:

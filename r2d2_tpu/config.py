@@ -379,6 +379,84 @@ class ServeConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Elastic fleet control plane (ISSUE 15; r2d2_tpu/fleet/): the
+    disaggregated replay service with its host-RAM spill tier, the
+    weight fan-out relay tree, and live actor join/leave. Every field's
+    default leaves the pre-PR15 plumbing byte-identical (no service, no
+    relays, frozen fleet)."""
+
+    # Replay service (fleet/replay_service.py): 0 (default) = the legacy
+    # in-mesh replay (single ring or dp-sharded, byte-identical). >= 1 =
+    # the learner routes ingestion through a ReplayService of this many
+    # addressable shards (device capacity num_blocks/replay_shards rows
+    # each) and trains through the external-batch step on
+    # service-sampled batches — the disaggregated plane any producer
+    # (local feeder, remote socket rung) can route blocks into.
+    replay_shards: int = 0
+    # Host-RAM spill tier, PER SHARD, in blocks: a device ring-write
+    # that overwrites a live block demotes its host page into an LRU
+    # page store of this capacity instead of destroying it; pages
+    # rotate back into the samplable ring at sample time. Total
+    # effective capacity = device rings + spill (the >= 2x-HBM-budget
+    # acceptance). 0 = no spill (overwrite semantics unchanged).
+    spill_blocks: int = 0
+    # Spilled pages rotated back into the device ring per sample call
+    # (the promote-on-sample-hit cycle). 0 disables re-promotion (the
+    # spill tier becomes a pure archive until it evicts).
+    spill_promote_per_sample: int = 1
+    # Block -> shard routing: "round_robin" (the dp-sharded path's
+    # feeding order — what the service-vs-in-mesh parity test pins) or
+    # "lane" (shard = lane-provenance stamp % shards: a producer's
+    # blocks land by lane identity, so shard contents are
+    # provenance-checkable and a joiner adopting a slot's lanes adopts
+    # its routing — the churn drill's setting).
+    replay_route: str = "round_robin"
+    # Expose the service to REMOTE producers over the socket rung
+    # (fleet/replay_service.py ReplayServiceServer): "" (default) = off;
+    # "socket" = listen on service_host:service_port.
+    service_transport: str = ""
+    service_host: str = "127.0.0.1"
+    service_port: int = 0           # 0 = ephemeral
+    # Weight fan-out tree (fleet/fanout.py): 0 (default) = every actor
+    # polls the one publisher/store directly (pre-PR15). >= 2 = relay
+    # tree of this degree — the learner publishes once, relay nodes
+    # re-publish, actors read leaf relays (thread mode: in-proc relays;
+    # process mode + multihost hosts: shm relay segments). The stamped
+    # quant bundle rides through relays unchanged.
+    fanout_degree: int = 0
+    # In-proc relays pull upstream on this interval instead of being
+    # pushed per publish; 0 (default) = push-through on every publish
+    # (zero steady-state lag). Nonzero makes relay lag real — the
+    # fanout_lag alert's test hook and the cadence knob for
+    # pull-through deployments.
+    fanout_pull_interval_s: float = 0.0
+    # Maximum fleet width for elastic membership: 0 (default) =
+    # actor.num_actors (no spare slots). > num_actors reserves
+    # (max_slots - num_actors) FREE spare slots joiners can lease
+    # mid-training; the ε ladder and lane ranges span max_slots so the
+    # exploration schedule is fixed as the fleet churns.
+    max_slots: int = 0
+    # Elastic supervision policy: False (default) = a dead actor is
+    # respawned in place on the PR-3 backoff ladder (pre-PR15). True = a
+    # dead/left actor's slot PARKS for re-adoption (membership.park) and
+    # training continues on the remaining fleet — the join/leave drill's
+    # setting; re-admission goes through PlayerStack.join_actor.
+    elastic: bool = False
+
+    def resolved_max_slots(self, num_actors: int) -> int:
+        return self.max_slots if self.max_slots > 0 else num_actors
+
+    @property
+    def active(self) -> bool:
+        """Any fleet plane configured on — gates the record's
+        replay_service block so legacy runs keep a byte-identical
+        schema."""
+        return (self.replay_shards > 0 or self.fanout_degree > 0
+                or self.max_slots > 0 or self.elastic)
+
+
+@dataclass(frozen=True)
 class MultiplayerConfig:
     """Population self-play (ref config.py:43-45, train.py:28-45)."""
 
@@ -637,6 +715,26 @@ class TelemetryConfig:
     # like its f32 twin. Inactive on records without a quant block
     # (every inference_dtype="f32" run).
     alerts_quant_agreement: float = 0.95
+    # -- elastic fleet / replay service (ISSUE 15; the record's
+    # 'replay_service' block, r2d2_tpu/fleet/) --
+    # Interval spill-tier eviction/demotion ratio
+    # (replay_service.spill.thrash_frac) at/above which spill_thrash
+    # fires: demoted pages are falling off the LRU end before ever
+    # being re-promoted — the device ring is turning over faster than
+    # the spill tier can cycle experience back, so the tier is a pure
+    # write-through loss (grow spill_blocks or slow collection).
+    alerts_spill_thrash_frac: float = 0.5
+    # Max fan-out relay lag in publications
+    # (replay_service.fanout.max_lag: root publish count minus the
+    # slowest relay's adopted count) at/above which fanout_lag fires —
+    # a tier of the weight tree has stopped propagating and its
+    # subtree's actors act on stale params.
+    alerts_fanout_lag: float = 8.0
+    # Leased-but-silent slot count (replay_service.membership.orphaned:
+    # ACTIVE slots whose heartbeat is stale past the orphan horizon) at/
+    # above which orphaned_slot fires — a worker vanished without its
+    # lease being parked or re-adopted.
+    alerts_orphaned_slots: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -735,6 +833,7 @@ class Config:
     optim: OptimConfig = field(default_factory=OptimConfig)
     actor: ActorConfig = field(default_factory=ActorConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     multiplayer: MultiplayerConfig = field(default_factory=MultiplayerConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
@@ -877,13 +976,30 @@ class Config:
                     "loop never runs — a chaos run with actor.on_device "
                     "would inject nothing and report vacuously healthy")
         if self.actor.fault_spec:
-            from r2d2_tpu.tools.chaos import parse_fault_spec
+            from r2d2_tpu.tools.chaos import (parse_fault_spec,
+                                              parse_join_spec)
             faults = parse_fault_spec(self.actor.fault_spec)
-            bad = [s for s in faults if s >= self.actor.num_actors]
+            joins = parse_join_spec(self.actor.fault_spec)
+            # membership faults may target spare slots (joiners lease
+            # them), so the bound is the elastic fleet's MAX width
+            width = self.fleet.resolved_max_slots(self.actor.num_actors)
+            bad = sorted(s for s in set(faults) | set(joins) if s >= width)
             if bad:
                 raise ValueError(
                     f"actor.fault_spec targets slot(s) {bad} outside the "
-                    f"fleet of {self.actor.num_actors} workers")
+                    f"fleet of {width} slot(s) (actor.num_actors workers "
+                    "+ fleet.max_slots spares)")
+            membership_kinds = sorted(
+                s for s, f in faults.items() if f.kind == "leave")
+            if (joins or membership_kinds) and not self.fleet.elastic:
+                raise ValueError(
+                    "actor.fault_spec 'join'/'leave' entries require "
+                    "fleet.elastic=true: they are MEMBERSHIP faults — a "
+                    "leave parks the slot for re-adoption and a join "
+                    "adopts it, semantics the frozen fleet's "
+                    "respawn-in-place supervision does not have (a "
+                    "non-elastic leave would just crash-loop the "
+                    "worker)")
             if self.actor.inference != "server":
                 disc = [s for s, f in faults.items()
                         if f.kind == "disconnect"]
@@ -965,6 +1081,132 @@ class Config:
             raise ValueError(
                 f"telemetry.alerts_serve_churn "
                 f"({self.telemetry.alerts_serve_churn}) must be >= 1")
+        # -- elastic fleet (ISSUE 15): structural preconditions fail at
+        # config construction with the fix spelled out --
+        fl = self.fleet
+        if fl.replay_shards < 0:
+            raise ValueError(
+                f"fleet.replay_shards ({fl.replay_shards}) must be >= 0 "
+                "(0 = legacy in-mesh replay)")
+        if fl.replay_shards > 0:
+            if self.replay.placement != "device":
+                raise ValueError(
+                    "fleet.replay_shards requires replay.placement="
+                    "'device': the service's shards are the jitted "
+                    "HBM-resident rings (host placement already has its "
+                    "own CPU tree — disaggregate the device plane)")
+            if self.mesh.dp != 1 or self.mesh.mp != 1:
+                raise ValueError(
+                    "fleet.replay_shards composes with a 1x1 mesh only: "
+                    "the service IS the replay sharding layer (it "
+                    "generalizes the dp-sharded rings into addressable "
+                    "shards) — set mesh.dp=1/mesh.mp=1 or use the "
+                    "in-mesh dp sharding without the service")
+            if self.actor.on_device:
+                raise ValueError(
+                    "fleet.replay_shards requires the host actor fleet: "
+                    "the fused on-device loop ring-writes straight into "
+                    "its colocated replay (actor.on_device) — the "
+                    "service exists for producers that do NOT share the "
+                    "learner's program")
+            if self.mesh.multihost:
+                raise ValueError(
+                    "fleet.replay_shards is single-controller for now — "
+                    "the lockstep multihost trainer keeps its per-rank "
+                    "in-mesh shards (routing its ranks through the "
+                    "service is the ROADMAP item-1 composition)")
+            if self.num_blocks % fl.replay_shards != 0:
+                raise ValueError(
+                    f"fleet.replay_shards ({fl.replay_shards}) must "
+                    f"divide num_blocks ({self.num_blocks}): shards are "
+                    "equal device-ring slices — adjust replay.capacity "
+                    "or the shard count")
+            if fl.replay_route == "lane":
+                # lanes are contiguous [0, max_slots * envs_per_actor):
+                # residues mod replay_shards cover every shard iff there
+                # are at least as many lanes as shards — otherwise some
+                # shard can never receive a block and the per-shard
+                # training gate stays closed FOREVER (errorless stall)
+                lanes = (fl.resolved_max_slots(self.actor.num_actors)
+                         * self.actor.envs_per_actor)
+                if lanes < fl.replay_shards:
+                    raise ValueError(
+                        f"fleet.replay_route='lane' with "
+                        f"{fl.replay_shards} shards needs at least that "
+                        f"many ε-ladder lanes (fleet has {lanes}): shard "
+                        "s only receives lanes with lane % shards == s, "
+                        "so an uncovered shard would hold the training "
+                        "gate closed forever — grow the fleet or use "
+                        "replay_route='round_robin'")
+        if fl.spill_blocks < 0:
+            raise ValueError(
+                f"fleet.spill_blocks ({fl.spill_blocks}) must be >= 0")
+        if fl.spill_blocks > 0 and fl.replay_shards < 1:
+            raise ValueError(
+                "fleet.spill_blocks requires fleet.replay_shards >= 1: "
+                "the spill tier is the replay service's demotion target "
+                "(the in-mesh rings overwrite in place)")
+        if fl.spill_promote_per_sample < 0:
+            raise ValueError(
+                f"fleet.spill_promote_per_sample "
+                f"({fl.spill_promote_per_sample}) must be >= 0")
+        if fl.replay_route not in ("round_robin", "lane"):
+            raise ValueError(
+                f"fleet.replay_route ({fl.replay_route!r}) must be "
+                "'round_robin' or 'lane'")
+        if fl.service_transport not in ("", "socket"):
+            raise ValueError(
+                f"fleet.service_transport ({fl.service_transport!r}) "
+                "must be '' (in-proc producers only) or 'socket'")
+        if fl.service_transport and fl.replay_shards < 1:
+            raise ValueError(
+                "fleet.service_transport requires fleet.replay_shards "
+                ">= 1 (there is no service to listen for)")
+        if fl.fanout_degree < 0 or fl.fanout_degree == 1:
+            raise ValueError(
+                f"fleet.fanout_degree ({fl.fanout_degree}) must be 0 "
+                "(direct polling) or >= 2 (relay tree degree)")
+        if fl.fanout_pull_interval_s < 0:
+            raise ValueError(
+                f"fleet.fanout_pull_interval_s "
+                f"({fl.fanout_pull_interval_s}) must be >= 0")
+        if fl.max_slots < 0:
+            raise ValueError(
+                f"fleet.max_slots ({fl.max_slots}) must be >= 0 "
+                "(0 = actor.num_actors, no spares)")
+        if 0 < fl.max_slots < self.actor.num_actors:
+            raise ValueError(
+                f"fleet.max_slots ({fl.max_slots}) must be >= "
+                f"actor.num_actors ({self.actor.num_actors}): the "
+                "startup fleet occupies the first num_actors slots")
+        if self.actor.on_device and (fl.fanout_degree > 0 or fl.elastic
+                                     or fl.max_slots > 0):
+            raise ValueError(
+                "fleet fan-out / elastic membership require the host "
+                "actor fleet: the fused on-device loop (actor.on_device) "
+                "has no weight service and no worker slots to lease")
+        if self.mesh.multihost and (fl.elastic or fl.max_slots > 0):
+            raise ValueError(
+                "fleet.elastic / fleet.max_slots are single-controller "
+                "for now: the lockstep multihost trainer's per-rank "
+                "fleets have no membership plane (its supervision "
+                "respawns in place) — a multihost run would silently "
+                "ignore the knobs, so they are rejected instead "
+                "(ROADMAP item 4 names the composition)")
+        if not 0 < self.telemetry.alerts_spill_thrash_frac <= 1:
+            raise ValueError(
+                f"telemetry.alerts_spill_thrash_frac "
+                f"({self.telemetry.alerts_spill_thrash_frac}) must be "
+                "in (0, 1]")
+        if self.telemetry.alerts_fanout_lag < 1:
+            raise ValueError(
+                f"telemetry.alerts_fanout_lag "
+                f"({self.telemetry.alerts_fanout_lag}) must be >= 1 "
+                "(publications behind the root)")
+        if self.telemetry.alerts_orphaned_slots < 1:
+            raise ValueError(
+                f"telemetry.alerts_orphaned_slots "
+                f"({self.telemetry.alerts_orphaned_slots}) must be >= 1")
         if self.network.inference_dtype not in ("f32", "bf16", "int8"):
             raise ValueError(
                 f"network.inference_dtype "
@@ -1178,7 +1420,8 @@ class Config:
 _SECTION_TYPES = {
     "env": EnvConfig, "network": NetworkConfig, "sequence": SequenceConfig,
     "replay": ReplayConfig, "optim": OptimConfig, "actor": ActorConfig,
-    "serve": ServeConfig, "multiplayer": MultiplayerConfig,
+    "serve": ServeConfig, "fleet": FleetConfig,
+    "multiplayer": MultiplayerConfig,
     "mesh": MeshConfig, "runtime": RuntimeConfig,
     "telemetry": TelemetryConfig,
 }

@@ -1,0 +1,35 @@
+"""Elastic fleet control plane (ISSUE 15, ROADMAP item 4).
+
+Three planes that let producers (actors) and consumers (learners) scale
+and churn independently of one another:
+
+  * ``replay_service`` — the dp-sharded device replay generalized into N
+    addressable shards behind one :class:`ReplayService` interface, with
+    a host-RAM spill tier (LRU block pages demoted from the device ring,
+    re-promoted into the samplable ring) so capacity scales past the HBM
+    budget, and a socket rung so remote producers can route blocks in.
+  * ``fanout`` — weight distribution as a relay tree: the learner
+    publishes ONCE, intermediate relay nodes re-publish to their
+    children, and actors read from leaf relays — replacing
+    every-actor-polls-one-publisher. The stamped quant bundle (ISSUE 14)
+    rides through unchanged.
+  * ``membership`` — actors join/leave a RUNNING fleet: slots are leased,
+    a leaving/killed actor's slot parks for re-adoption, and a joiner
+    adopts a parked slot's lane range + ε-ladder slice + replay routing
+    mid-training.
+"""
+
+from r2d2_tpu.fleet.fanout import FanoutTree, ShmFanout
+from r2d2_tpu.fleet.membership import (SLOT_ACTIVE, SLOT_FREE, SLOT_PARKED,
+                                       FleetMembership, SlotLease)
+from r2d2_tpu.fleet.replay_service import (RemoteReplayProducer, ReplayShard,
+                                           ReplayService, ReplayServiceServer,
+                                           SpillTier)
+
+__all__ = [
+    "ReplayService", "ReplayShard", "SpillTier",
+    "ReplayServiceServer", "RemoteReplayProducer",
+    "FanoutTree", "ShmFanout",
+    "FleetMembership", "SlotLease",
+    "SLOT_FREE", "SLOT_ACTIVE", "SLOT_PARKED",
+]

@@ -1,0 +1,331 @@
+"""Weight fan-out tree (ISSUE 15 tentpole, plane b).
+
+Every weight consumer used to poll the ONE learner-side
+publisher/store — N readers per publication, which is fine at N=2 and a
+scaling wall at fleet scale (the 2012.04210 bottleneck analysis: the
+weight broadcast path saturates first). This module turns distribution
+into a TREE: the learner publishes once to its root store, intermediate
+RELAY nodes adopt and re-publish, and each consumer reads from its leaf
+relay — the root sees at most ``degree`` readers no matter how wide the
+fleet grows.
+
+Two implementations share the topology math (:func:`tier_sizes`):
+
+  * :class:`FanoutTree` — in-process relays over the thread-mode
+    ``InProcWeightStore`` contract (poll/version per reader). Relays
+    propagate on ``on_publish()`` (the learner's publish wrapper) and
+    lazily on consumer polls once ``pull_interval_s`` elapses — with a
+    nonzero interval the tree runs deliberately behind, which is what
+    makes relay LAG a real, testable signal (the ``fanout_lag`` alert).
+  * :class:`ShmFanout` — process-mode relays: each relay node is a
+    WeightSubscriber on its parent's shm segment plus its OWN
+    WeightPublisher segment; actor processes attach to their leaf
+    relay's segment name through the unchanged actor_main plumbing.
+
+Both carry the published tree OPAQUELY — the stamped quant bundle
+(ISSUE 14: {f32, int8/bf16 twin, publish stamp}) rides through relays
+unchanged, so staleness accounting and the quantized twins work at every
+tree depth for free (stamp-propagation-tested)."""
+
+import math
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import jax
+
+
+def tier_sizes(n_consumers: int, degree: int) -> List[int]:
+    """Relay-node count per tier, LEAF tier first: ceil(n/d) leaf relays,
+    then ceil(prev/d) above, until one tier holds <= degree nodes (those
+    read the root directly). Empty for n_consumers <= degree — the root
+    can serve the consumers itself, no relays needed."""
+    if degree < 2:
+        raise ValueError(f"fan-out degree ({degree}) must be >= 2")
+    sizes: List[int] = []
+    width = n_consumers
+    while width > degree:
+        width = math.ceil(width / degree)
+        sizes.append(width)
+    return sizes
+
+
+class _Relay:
+    """One in-process relay node: adopts (tree, version) from its
+    upstream poll/version pair and serves them to per-reader consumers
+    with the InProcWeightStore poll contract. The version is the ROOT
+    publish count propagated verbatim — block staleness stamps measured
+    against the learner's clock stay correct at any depth (a lagging
+    relay's consumers stamp OLDER versions, which is the truth)."""
+
+    def __init__(self, upstream_poll: Callable, upstream_version: Callable,
+                 pull_interval_s: float = 0.0):
+        self._up_poll = upstream_poll
+        self._up_version = upstream_version
+        self._pull_interval_s = pull_interval_s
+        self._lock = threading.Lock()
+        self._tree = None
+        self._version = 0
+        self._last_pull = 0.0
+        self._readers = {}
+
+    def pump(self) -> bool:
+        """Adopt the upstream's current tree if it moved; returns True
+        when fresh data was adopted."""
+        with self._lock:
+            fresh = self._up_poll()
+            self._last_pull = time.monotonic()
+            if fresh is None:
+                return False
+            self._tree = fresh
+            self._version = int(self._up_version())
+            return True
+
+    def _maybe_pull(self) -> None:
+        if self._pull_interval_s <= 0:
+            return                # push-through: on_publish pumps
+        if time.monotonic() - self._last_pull >= self._pull_interval_s:
+            self.pump()
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def poll(self, reader_id):
+        """Fresh tree for this reader, or None (unchanged / nothing
+        adopted yet)."""
+        self._maybe_pull()
+        with self._lock:
+            if self._tree is None or \
+                    self._readers.get(reader_id) == self._version:
+                return None
+            self._readers[reader_id] = self._version
+            return self._tree
+
+    def current(self, reader_id=None):
+        """The relay's current tree without the seen-version gate (the
+        spawn-time read, mirroring InProcWeightStore.current); pumps
+        first so a just-published tree is visible to a joiner."""
+        self.pump()
+        with self._lock:
+            if reader_id is not None and self._tree is not None:
+                self._readers[reader_id] = self._version
+            return self._tree
+
+    def reader_version(self, reader_id) -> int:
+        with self._lock:
+            return self._readers.get(reader_id, 0)
+
+
+class FanoutTree:
+    """In-process relay tree over a root InProcWeightStore.
+
+    ``endpoints(consumer)`` hands a consumer its leaf relay's
+    (poll, version, current) closures — a drop-in for the store-direct
+    closures the thread spawner builds; ``on_publish()`` propagates one
+    publication root→leaves (called by the learner's publish wrapper
+    when ``pull_interval_s`` is 0 — with a nonzero interval relays pull
+    on their own clock instead and lag becomes visible)."""
+
+    def __init__(self, store, n_consumers: int, degree: int,
+                 pull_interval_s: float = 0.0):
+        self.store = store
+        self.degree = degree
+        self.n_consumers = n_consumers
+        self._pull_interval_s = pull_interval_s
+        self.tiers: List[List[_Relay]] = []
+        sizes = tier_sizes(n_consumers, degree)
+        # build ROOT-ward tier first so each relay's upstream exists;
+        # tier_sizes is leaf-first, so reverse for construction
+        upstream_tier: Optional[List[_Relay]] = None
+        for size in reversed(sizes):
+            tier = []
+            for j in range(size):
+                if upstream_tier is None:
+                    up_poll = (lambda _j=j:
+                               self.store.poll(f"fanout-relay-{_j}"))
+                    up_version = (lambda _j=j: self.store.reader_version(
+                        f"fanout-relay-{_j}"))
+                else:
+                    parent = upstream_tier[j // degree]
+                    up_poll = (lambda _p=parent, _j=j:
+                               _p.poll(f"fanout-relay-{_j}"))
+                    up_version = _make_version(parent)
+                tier.append(_Relay(up_poll, up_version, pull_interval_s))
+            self.tiers.append(tier)
+            upstream_tier = tier
+        # tiers is now root-ward first; leaves last (possibly empty —
+        # degree >= n_consumers means consumers read the root directly)
+        self.relays = [r for tier in self.tiers for r in tier]
+        # initial propagation: relays adopt the store's construction
+        # publication (tier order is root-ward, so one pass reaches the
+        # leaves) — a consumer spawned before the first training publish
+        # must still read params, exactly like a store-direct reader
+        self.pump()
+
+    @property
+    def depth(self) -> int:
+        """Relay tiers between the root store and the consumers."""
+        return len(self.tiers)
+
+    def _leaf_for(self, consumer: int) -> Optional[_Relay]:
+        if not self.tiers:
+            return None
+        # leaf tier holds ceil(n_consumers/degree) relays, so
+        # consumer // degree is always a valid leaf index
+        return self.tiers[-1][consumer // self.degree]
+
+    def endpoints(self, consumer: int) -> Tuple[Callable, Callable, Callable]:
+        """(poll, version, current) closures for one consumer slot —
+        exactly the shapes PlayerStack's thread spawner wires from the
+        root store when no tree is configured."""
+        leaf = self._leaf_for(consumer)
+        if leaf is None:
+            return ((lambda: self.store.poll(consumer)),
+                    (lambda: self.store.reader_version(consumer)),
+                    (lambda: self.store.current(reader_id=consumer)))
+        return ((lambda: leaf.poll(consumer)),
+                (lambda: leaf.reader_version(consumer)),
+                (lambda: leaf.current(reader_id=consumer)))
+
+    def on_publish(self) -> None:
+        """Propagate the newest publication down every tier (root-ward
+        tier first so leaves see it in the same pass). Skipped when
+        relays pull on their own interval — then lag is the interval's."""
+        if self._pull_interval_s > 0:
+            return
+        self.pump()
+
+    def pump(self) -> None:
+        for tier in self.tiers:
+            for relay in tier:
+                relay.pump()
+
+    def stats(self) -> Optional[dict]:
+        """The record's ``fanout`` sub-block: topology + the max relay
+        lag in publications (root publish count − slowest relay's
+        adopted count) — the ``fanout_lag`` alert's signal."""
+        root = int(self.store.publish_count)
+        lags = [root - r.version for r in self.relays]
+        return {
+            "degree": self.degree,
+            "depth": self.depth,
+            "relays": len(self.relays),
+            "consumers": self.n_consumers,
+            "max_lag": (max(lags) if lags else 0),
+        }
+
+
+def _make_version(parent: _Relay) -> Callable[[], int]:
+    return lambda: parent.version
+
+
+class _ShmNode:
+    """One shm relay: subscriber on the parent segment + own publisher
+    segment + the root publication count last adopted (for lag)."""
+
+    __slots__ = ("sub", "pub", "parent", "adopted_root")
+
+    def __init__(self, sub, pub, parent: Optional["_ShmNode"]):
+        self.sub = sub
+        self.pub = pub
+        self.parent = parent
+        self.adopted_root = 0
+
+
+class ShmFanout:
+    """Process-mode fan-out: relay nodes re-publish the root
+    WeightPublisher's segment into their own shm segments; consumer
+    slot i attaches to ``segment_for(i)`` through the unchanged
+    WeightSubscriber/actor_main plumbing. Relays are pumped by the
+    owning (learner) process — on every root publish and on the
+    supervise cadence — one subscriber read + one publisher memcpy per
+    relay per publication, in exchange for the root segment seeing
+    ``degree`` readers instead of the whole fleet."""
+
+    def __init__(self, root_name: str, template, n_consumers: int,
+                 degree: int):
+        from r2d2_tpu.runtime.weights import (WeightPublisher,
+                                              WeightSubscriber)
+        self.degree = degree
+        self.n_consumers = n_consumers
+        self._nodes: List[List[_ShmNode]] = []   # tiers, root-ward first
+        sizes = tier_sizes(n_consumers, degree)
+        init = jax.device_get(template)
+        parent_tier: List[Optional[_ShmNode]] = [None]   # None = root
+        parent_names: List[str] = [root_name]
+        try:
+            for size in reversed(sizes):
+                tier = []
+                for j in range(size):
+                    # the tier above holds ceil(size/degree) segments
+                    # (or just the root), so j // degree always lands
+                    k = min(j // degree, len(parent_names) - 1)
+                    tier.append(_ShmNode(
+                        WeightSubscriber(parent_names[k], template),
+                        WeightPublisher(init), parent_tier[k]))
+                self._nodes.append(tier)
+                parent_tier = tier
+                parent_names = [n.pub.name for n in tier]
+        except BaseException:
+            self.close()
+            raise
+        self._leaf_names = parent_names
+
+    @property
+    def depth(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def relays(self) -> int:
+        return sum(len(t) for t in self._nodes)
+
+    def segment_for(self, consumer: int) -> str:
+        """The shm segment name consumer slot ``consumer`` subscribes
+        to — its leaf relay's, or the root's when no relays exist."""
+        if not self._nodes:
+            return self._leaf_names[0]
+        leaves = self._leaf_names
+        return leaves[min(consumer // self.degree, len(leaves) - 1)]
+
+    def pump(self) -> None:
+        """Propagate: each tier's subscribers poll their parents and
+        re-publish fresh trees (root-ward tier first, so one pass moves
+        a publication the full depth). Each node records the ROOT
+        publication count it last adopted (tier 0's subscriber counts
+        root publications directly; deeper nodes inherit their parent's
+        adopted count at adoption) — the lag gauge the fanout_lag rule
+        reads."""
+        for tier in self._nodes:
+            for node in tier:
+                fresh = node.sub.poll()
+                if fresh is not None:
+                    node.pub.publish(fresh)
+                    node.adopted_root = (node.sub.publish_count
+                                         if node.parent is None
+                                         else node.parent.adopted_root)
+
+    def stats(self, root_publish_count: int) -> dict:
+        lags = [root_publish_count - node.adopted_root
+                for tier in self._nodes for node in tier]
+        return {
+            "degree": self.degree,
+            "depth": self.depth,
+            "relays": self.relays,
+            "consumers": self.n_consumers,
+            "max_lag": (max(lags) if lags else 0),
+        }
+
+    def close(self) -> None:
+        for tier in self._nodes:
+            for node in tier:
+                try:
+                    node.sub.close()
+                except Exception:
+                    pass
+                try:
+                    node.pub.close()
+                except Exception:
+                    pass
+        self._nodes = []

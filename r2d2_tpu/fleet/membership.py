@@ -1,0 +1,190 @@
+"""Elastic fleet membership (ISSUE 15 tentpole, plane c).
+
+The actor fleet used to be FROZEN at startup: ``actor.num_actors``
+workers, each permanently owning slot i's heartbeat row, lane range
+``[i*k, (i+1)*k)``, ε-ladder slice, and replay routing. This module
+makes the slot table a LEASED resource over the PR-3 heartbeat board:
+
+  * a leaving (clean ``leave_actor``) or killed (``fleet.elastic``
+    supervision policy) worker's slot PARKS — its lane range, ε slice,
+    and routing key are preserved for re-adoption, and the learner keeps
+    training on the remaining fleet;
+  * a joining process LEASES a parked (or spare — ``fleet.max_slots`` >
+    ``actor.num_actors``) slot mid-training and adopts exactly that
+    slot's identity, so lane ranges can never overlap (the churn drill's
+    acceptance) and the ε ladder stays fixed as the fleet churns;
+  * a leased slot whose worker silently vanished (heartbeat stale past
+    the orphan horizon with no supervision verdict) reads as ORPHANED —
+    the ``orphaned_slot`` alert's signal, a leaked lease the operator
+    must reap.
+
+Leases are arbitrated by the ONE owning supervisor process (the
+orchestrator) — joiners go through :meth:`FleetMembership.lease`, never
+race on shared state — while LIVENESS stays on the shared-memory
+heartbeat board the workers already publish to."""
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+SLOT_FREE = "free"        # spare capacity, never yet leased
+SLOT_ACTIVE = "active"    # leased to a live worker
+SLOT_PARKED = "parked"    # left/killed; identity preserved for re-adoption
+
+
+@dataclass(frozen=True)
+class SlotLease:
+    """What a joiner adopts: the slot's full identity. ``generation``
+    counts adoptions of this slot (0 = the original startup worker), so
+    respawn-vs-adoption is distinguishable in logs and tests."""
+
+    slot: int
+    generation: int
+    lane_base: int
+    lanes: int
+    shard_key: int
+
+    def lane_range(self):
+        return range(self.lane_base, self.lane_base + self.lanes)
+
+
+class FleetMembership:
+    """The slot lease table. ``n_slots`` is the fleet's MAXIMUM width
+    (``fleet.max_slots``, default the startup ``actor.num_actors``);
+    slots [0, initial_active) start ACTIVE (the startup fleet), the rest
+    FREE (spare capacity joiners can claim)."""
+
+    def __init__(self, n_slots: int, envs_per_slot: int = 1,
+                 initial_active: Optional[int] = None, num_shards: int = 1):
+        self.n_slots = n_slots
+        self.envs_per_slot = envs_per_slot
+        self.num_shards = max(num_shards, 1)
+        active = n_slots if initial_active is None else initial_active
+        if not 0 <= active <= n_slots:
+            raise ValueError(
+                f"initial_active ({active}) must be in [0, {n_slots}]")
+        self._state = [SLOT_ACTIVE] * active + \
+            [SLOT_FREE] * (n_slots - active)
+        self._generation = [0] * n_slots
+        self._parked_at = [0.0] * n_slots
+        self._park_reason: List[Optional[str]] = [None] * n_slots
+        # cumulative churn counters for the telemetry block
+        self.leaves = 0
+        self.joins = 0
+
+    # -- identity derivation (ONE place: the layout every spawner and
+    # vector_lane_epsilons already agree on) --
+
+    def lane_base(self, slot: int) -> int:
+        return slot * self.envs_per_slot
+
+    def shard_key(self, slot: int) -> int:
+        """The slot's replay-routing key under lane routing: its first
+        lane's shard (ReplayService route='lane' sends lane l to shard
+        l % num_shards)."""
+        return self.lane_base(slot) % self.num_shards
+
+    def generation(self, slot: int) -> int:
+        """Adoptions of this slot so far (0 = the startup worker)."""
+        return self._generation[slot]
+
+    def lease_of(self, slot: int) -> SlotLease:
+        return SlotLease(slot=slot, generation=self._generation[slot],
+                         lane_base=self.lane_base(slot),
+                         lanes=self.envs_per_slot,
+                         shard_key=self.shard_key(slot))
+
+    # -- state machine --
+
+    def state(self, slot: int) -> str:
+        return self._state[slot]
+
+    def park(self, slot: int, reason: str = "left") -> None:
+        """A worker left or was killed: preserve the slot's identity for
+        re-adoption. Idempotent (a leave followed by the supervisor
+        observing the corpse must not double-count)."""
+        if self._state[slot] == SLOT_PARKED:
+            return
+        self._state[slot] = SLOT_PARKED
+        self._parked_at[slot] = time.time()
+        self._park_reason[slot] = reason
+        self.leaves += 1
+
+    def lease(self, slot: Optional[int] = None) -> SlotLease:
+        """Adopt a slot: the requested one (must be PARKED or FREE), or
+        the longest-parked slot, or a FREE spare. Raises when the fleet
+        is at full width with nothing parked."""
+        if slot is None:
+            parked = [(self._parked_at[s], s) for s in range(self.n_slots)
+                      if self._state[s] == SLOT_PARKED]
+            if parked:
+                slot = min(parked)[1]
+            else:
+                free = [s for s in range(self.n_slots)
+                        if self._state[s] == SLOT_FREE]
+                if not free:
+                    raise RuntimeError(
+                        "no parked or free slot to lease — the fleet is "
+                        "at full width; raise fleet.max_slots or leave a "
+                        "worker first")
+                slot = free[0]
+        elif self._state[slot] == SLOT_ACTIVE:
+            raise RuntimeError(
+                f"slot {slot} is ACTIVE — a live worker holds its lease "
+                "(leave it first, or lease a parked/free slot)")
+        self._state[slot] = SLOT_ACTIVE
+        self._generation[slot] += 1
+        self._park_reason[slot] = None
+        self.joins += 1
+        return self.lease_of(slot)
+
+    # -- views --
+
+    def active_slots(self) -> List[int]:
+        return [s for s in range(self.n_slots)
+                if self._state[s] == SLOT_ACTIVE]
+
+    def parked_slots(self) -> List[int]:
+        return [s for s in range(self.n_slots)
+                if self._state[s] == SLOT_PARKED]
+
+    def assert_no_overlap(self) -> None:
+        """Every active slot's lane range must be disjoint — the churn
+        drill's structural acceptance. Lane ranges derive from the slot
+        index, so overlap is impossible UNLESS a lease was duplicated;
+        this asserts the lease table itself is consistent."""
+        seen = set()
+        for s in self.active_slots():
+            lanes = set(self.lease_of(s).lane_range())
+            if lanes & seen:
+                raise AssertionError(
+                    f"lane-range overlap at slot {s}: {sorted(lanes & seen)}")
+            seen |= lanes
+
+    def orphaned(self, heartbeat_ages, horizon_s: float) -> int:
+        """Leased (ACTIVE) slots whose heartbeat went stale past the
+        orphan horizon: the worker vanished without the supervisor
+        parking the slot — a leaked lease (the ``orphaned_slot``
+        signal). ``heartbeat_ages`` is the board's per-slot age array
+        (may be shorter than n_slots on a legacy-sized board)."""
+        if horizon_s <= 0 or heartbeat_ages is None:
+            return 0
+        count = 0
+        for s in self.active_slots():
+            if s < len(heartbeat_ages) and \
+                    float(heartbeat_ages[s]) > horizon_s:
+                count += 1
+        return count
+
+    def snapshot(self, heartbeat_ages=None,
+                 orphan_horizon_s: float = 0.0) -> dict:
+        """The record's ``membership`` sub-block."""
+        return {
+            "slots": self.n_slots,
+            "active": len(self.active_slots()),
+            "parked": len(self.parked_slots()),
+            "free": sum(1 for s in self._state if s == SLOT_FREE),
+            "joins": self.joins,
+            "leaves": self.leaves,
+            "orphaned": self.orphaned(heartbeat_ages, orphan_horizon_s),
+        }

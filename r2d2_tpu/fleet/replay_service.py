@@ -1,0 +1,517 @@
+"""Disaggregated replay service (ISSUE 15 tentpole, plane a).
+
+The dp-sharded device replay (parallel/sharded.py) bound N replay rings
+to N mesh shards inside ONE shard_map program — producers and consumers
+were the same fused loop. This module generalizes that layout into N
+ADDRESSABLE shards behind one :class:`ReplayService` interface: any
+producer routes blocks by shard key (the same jitted
+``replay_add``/``replay_add_many`` ring-writes), any consumer draws
+prioritized sample batches (``replay_sample``) and writes priorities
+back (``replay_update_priorities``) — so the replay plane no longer
+assumes producers, consumers, and storage share a process, a mesh, or a
+lifetime.
+
+Capacity scales past the HBM budget through a host-RAM **spill tier**:
+when a device ring-write overwrites a live block, the overwritten
+block's host page is DEMOTED into an LRU page store instead of being
+destroyed; pages are RE-PROMOTED into the samplable device ring at
+sample time (``spill_promote_per_sample`` pages rotated per sample
+call), so spilled experience cycles back through the prioritized tree
+rather than being lost. With the spill tier cold (empty) the sample
+path is exactly ``replay_sample`` on the device state — parity with the
+in-mesh path is program identity, not a tolerance argument
+(tests/test_elastic.py).
+
+Routing policies:
+
+  * ``"round_robin"`` — block k lands in shard ``k % num_shards``:
+    EXACTLY the dp-sharded path's feeding order, which is what the
+    service-vs-in-mesh parity test pins bit-for-bit.
+  * ``"lane"`` — shard = ``block.lane % num_shards`` (the PR-10 ε-lane
+    provenance stamp): a producer's blocks land in a shard determined
+    by its lane identity, so shard contents are provenance-checkable
+    (the churn drill's acceptance) and an elastic joiner adopting a
+    slot's lane range adopts its replay routing with it. Unstamped
+    blocks (lane −1) fall back to round-robin.
+
+The transport ladder follows serve/transport.py's shape: in-proc
+producers call :meth:`ReplayService.add_block` directly;
+:class:`ReplayServiceServer` / :class:`RemoteReplayProducer` are the
+cross-host socket rung (length-prefixed-pickle frames, one connection
+per producer) feeding the same routing.
+"""
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from r2d2_tpu.replay.structs import Block, ReplaySpec, RingAccountant
+
+
+def _host_block(block: Block) -> Block:
+    """Materialize a block's leaves as host numpy arrays (the spill tier
+    stores pages in host RAM; feeder-queue blocks already are numpy, so
+    this is a cheap view in the common case)."""
+    import jax
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), block)
+
+
+class SpillTier:
+    """Host-RAM LRU page store for blocks demoted from a device ring.
+
+    A page is one block record (host numpy) plus its accounting meta.
+    ``demote`` inserts at the MRU end and drops the LRU page when the
+    tier is full (an ``eviction`` — that experience is now truly gone,
+    like a pre-service ring overwrite); ``promote_next`` pops the LRU
+    page for re-insertion into the device ring (a ``hit``: the page made
+    it back into the samplable set). ``hit_rate`` is therefore the share
+    of demoted pages that returned to the ring rather than falling off
+    the end — the spill tier's usefulness gauge; ``thrash_frac`` (the
+    per-interval eviction/demotion ratio in :meth:`take_interval`) is
+    the ``spill_thrash`` alert's signal: near 1.0 the ring is turning
+    over so fast the tier is a pure write-through loss."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._pages: "OrderedDict[int, tuple]" = OrderedDict()
+        self._next_id = 0
+        self.demotions = 0
+        self.promotions = 0
+        self.evictions = 0
+        self._interval = [0, 0, 0]   # demotions, promotions, evictions
+
+    @property
+    def occupancy(self) -> int:
+        return len(self._pages)
+
+    def demote(self, block: Block, learning: int, weight_version: int) -> bool:
+        """Insert one demoted page; returns False when the tier is
+        disabled (capacity 0 — the page is simply lost, the pre-service
+        overwrite semantics)."""
+        if self.capacity <= 0:
+            return False
+        self._pages[self._next_id] = (block, int(learning),
+                                      int(weight_version))
+        self._next_id += 1
+        self.demotions += 1
+        self._interval[0] += 1
+        if len(self._pages) > self.capacity:
+            self._pages.popitem(last=False)
+            self.evictions += 1
+            self._interval[2] += 1
+        return True
+
+    def promote_next(self) -> Optional[tuple]:
+        """Pop the least-recently-demoted page for re-insertion into the
+        device ring; None when the tier is empty."""
+        if not self._pages:
+            return None
+        _, page = self._pages.popitem(last=False)
+        self.promotions += 1
+        self._interval[1] += 1
+        return page
+
+    @property
+    def hit_rate(self) -> Optional[float]:
+        """Cumulative promoted / (promoted + evicted) — None before any
+        page has left the tier either way."""
+        done = self.promotions + self.evictions
+        return round(self.promotions / done, 4) if done else None
+
+    def take_interval(self) -> dict:
+        """Per-interval demotion/promotion/eviction deltas (reset on
+        read) + the interval thrash fraction for the alert rule."""
+        d, p, e = self._interval
+        self._interval = [0, 0, 0]
+        return {
+            "demotions": d, "promotions": p, "evictions": e,
+            "thrash_frac": (round(e / d, 4) if d else None),
+        }
+
+
+class ReplayShard:
+    """One addressable replay shard: a device ring (the exact jitted
+    add/sample/update programs of replay/device_replay.py), its
+    RingAccountant, and — when spill is enabled — the host page shadow
+    that makes demotion free (the overwritten block's page is already in
+    host RAM; no device read-back)."""
+
+    def __init__(self, spec: ReplaySpec, index: int,
+                 spill_blocks: int = 0):
+        from r2d2_tpu.replay.device_replay import replay_init
+        self.spec = spec
+        self.index = index
+        self.state = replay_init(spec)
+        self.ring = RingAccountant(spec.num_blocks)
+        self.spill = SpillTier(spill_blocks)
+        self._retain = spill_blocks > 0
+        # host page per live ring slot (spill mode only): (block,
+        # learning, weight_version), the demotion source
+        self._resident: List[Optional[tuple]] = [None] * spec.num_blocks
+
+    def add(self, block: Block) -> int:
+        """Ring-write one block (jitted replay_add); demotes the
+        overwritten slot's page into the spill tier first. Returns the
+        ring slot the block landed in."""
+        from r2d2_tpu.replay.device_replay import replay_add
+        learning = int(np.asarray(block.learning_steps).sum())
+        wv = int(np.asarray(block.weight_version))
+        slot = self.ring.ptr
+        if self._retain:
+            block = _host_block(block)
+            old = self._resident[slot]
+            if old is not None and self.ring.slot_steps[slot] > 0:
+                self.spill.demote(*old)
+        self.state = replay_add(self.spec, self.state, block)
+        self.ring.advance(learning, wv)
+        if self._retain:
+            self._resident[slot] = (block, learning, wv)
+        return slot
+
+    def promote(self, n: int) -> int:
+        """Rotate up to ``n`` spilled pages back into the device ring
+        (each re-entry demotes whatever it overwrites — the ring cycles
+        through the spilled set). Returns pages promoted."""
+        done = 0
+        for _ in range(max(n, 0)):
+            page = self.spill.promote_next()
+            if page is None:
+                break
+            self.add(page[0])
+            done += 1
+        return done
+
+    def sample(self, key):
+        from r2d2_tpu.replay.device_replay import replay_sample
+        return replay_sample(self.spec, self.state, key)
+
+    def update_priorities(self, idxes, td_errors) -> None:
+        from r2d2_tpu.replay.device_replay import replay_update_priorities
+        self.state = replay_update_priorities(self.spec, self.state,
+                                              idxes, td_errors)
+
+    @property
+    def live_blocks(self) -> int:
+        return sum(1 for s in self.ring.slot_steps if s > 0)
+
+    @property
+    def fill(self) -> float:
+        cap = self.spec.num_blocks * self.spec.block_length
+        return round(self.ring.buffer_steps / cap, 4) if cap else 0.0
+
+
+_ROUTES = ("round_robin", "lane")
+
+
+class ReplayService:
+    """N addressable replay shards behind one producer/consumer
+    interface, with the accountant facade the Learner's gate/metrics
+    read (``buffer_steps`` / ``total_adds`` / ``live_versions``) so a
+    service-backed learner needs no second accounting path."""
+
+    def __init__(self, spec: ReplaySpec, num_shards: int,
+                 spill_blocks: int = 0, route: str = "round_robin",
+                 promote_per_sample: int = 1):
+        if num_shards < 1:
+            raise ValueError(f"num_shards ({num_shards}) must be >= 1")
+        if route not in _ROUTES:
+            raise ValueError(f"route {route!r} must be one of {_ROUTES}")
+        self.spec = spec
+        self.num_shards = num_shards
+        self.route = route
+        self.promote_per_sample = promote_per_sample
+        self.shards = [ReplayShard(spec, s, spill_blocks=spill_blocks)
+                       for s in range(num_shards)]
+        self._rr_add = 0
+        self._rr_sample = 0
+        self._lock = threading.Lock()   # socket drain thread vs learner
+        # priority write-backs dropped by the staleness guard (a remote
+        # producer's add landed between a sample and its write-back and
+        # overwrote a sampled row) — surfaced in the telemetry block
+        self.stale_writebacks = 0
+
+    # -- producer side --
+
+    def route_shard(self, block: Block) -> int:
+        """The shard key: lane-provenance routing when configured and
+        the block is stamped; the dp path's round-robin otherwise."""
+        if self.route == "lane":
+            lane = int(np.asarray(block.lane))
+            if lane >= 0:
+                return lane % self.num_shards
+        shard = self._rr_add
+        self._rr_add = (self._rr_add + 1) % self.num_shards
+        return shard
+
+    def add_block(self, block: Block) -> int:
+        """Route + ring-write one block; returns the shard it landed in."""
+        with self._lock:
+            shard = self.route_shard(block)
+            self.shards[shard].add(block)
+            return shard
+
+    def add_blocks(self, blocks: List[Block]) -> List[int]:
+        return [self.add_block(b) for b in blocks]
+
+    # -- consumer side --
+
+    def sample(self, key) -> Tuple[object, int, int]:
+        """One prioritized batch from the next non-empty shard
+        (round-robin over shards, the dp learner's per-shard sampling
+        order flattened). Spill promotion happens HERE, before the tree
+        descent, so the returned ``idxes`` stay valid for the caller's
+        priority write-back as long as no add interleaves. Returns
+        (SampleBatch, shard_index, adds_snapshot) — the snapshot is the
+        write-back staleness token: the single-threaded in-proc loop
+        never moves it, but a SOCKET producer's add can land between
+        sample and write-back, and the guard in
+        :meth:`update_priorities` uses it to refuse writing the old
+        batch's priorities onto a row a new block just took."""
+        with self._lock:
+            for _ in range(self.num_shards):
+                shard = self.shards[self._rr_sample]
+                self._rr_sample = (self._rr_sample + 1) % self.num_shards
+                if shard.ring.total_adds == 0:
+                    continue
+                if self.promote_per_sample > 0:
+                    shard.promote(self.promote_per_sample)
+                return (shard.sample(key), shard.index,
+                        shard.ring.total_adds)
+        raise RuntimeError("ReplayService.sample on an empty service — "
+                           "gate on all_shards_nonempty first")
+
+    def update_priorities(self, shard: int, idxes, td_errors,
+                          adds_snapshot: Optional[int] = None) -> None:
+        """Write learner priorities back to ``shard``. With
+        ``adds_snapshot`` (the token :meth:`sample` returned), the
+        write-back is DROPPED — counted in ``stale_writebacks`` — when
+        any sampled row was overwritten by an add since the sample (the
+        reference worker's ring-pointer staleness guard, needed here
+        only when remote producers feed the service concurrently; the
+        drop degrades one batch toward its pre-update priorities, the
+        same accepted mode as the host path's backpressure drop)."""
+        with self._lock:
+            sh = self.shards[shard]
+            if adds_snapshot is not None:
+                delta = sh.ring.total_adds - adds_snapshot
+                if delta > 0:
+                    n = sh.spec.num_blocks
+                    if delta >= n:
+                        self.stale_writebacks += 1
+                        return      # the whole ring turned over
+                    ptr0 = adds_snapshot % n
+                    overwritten = {(ptr0 + j) % n for j in range(delta)}
+                    rows = np.asarray(idxes) // sh.spec.seqs_per_block
+                    if any(int(r) in overwritten for r in rows):
+                        self.stale_writebacks += 1
+                        return
+            sh.update_priorities(idxes, td_errors)
+
+    # -- accountant facade (the Learner's ring contract) --
+
+    @property
+    def buffer_steps(self) -> int:
+        return sum(s.ring.buffer_steps for s in self.shards)
+
+    @property
+    def total_adds(self) -> int:
+        return sum(s.ring.total_adds for s in self.shards)
+
+    @property
+    def all_shards_nonempty(self) -> bool:
+        """Per-shard training gate: sampling an empty tree yields NaN
+        importance weights (the dp learner's same precondition)."""
+        return all(s.ring.total_adds > 0 for s in self.shards)
+
+    def live_versions(self) -> List[int]:
+        out: List[int] = []
+        for s in self.shards:
+            out.extend(s.ring.live_versions())
+        return out
+
+    @property
+    def live_blocks(self) -> int:
+        """Blocks currently samplable OR held in spill — the service's
+        effective capacity (the >= 2x-device-ring acceptance reads
+        this)."""
+        return sum(s.live_blocks + s.spill.occupancy for s in self.shards)
+
+    @property
+    def device_ring_blocks(self) -> int:
+        return self.num_shards * self.spec.num_blocks
+
+    @property
+    def device_bytes(self) -> int:
+        return self.num_shards * self.spec.device_ring_bytes
+
+    # -- telemetry --
+
+    def interval_block(self) -> dict:
+        """The record's ``replay_service`` shard/spill sub-blocks
+        (per-interval spill deltas reset on read)."""
+        fills = [s.fill for s in self.shards]
+        interval = {"demotions": 0, "promotions": 0, "evictions": 0,
+                    "thrash_frac": None}
+        demo = 0
+        for s in self.shards:
+            iv = s.spill.take_interval()
+            interval["demotions"] += iv["demotions"]
+            interval["promotions"] += iv["promotions"]
+            interval["evictions"] += iv["evictions"]
+            demo += iv["demotions"]
+        if demo:
+            interval["thrash_frac"] = round(
+                interval["evictions"] / demo, 4)
+        cap = sum(s.spill.capacity for s in self.shards)
+        occ = sum(s.spill.occupancy for s in self.shards)
+        hits = [s.spill.hit_rate for s in self.shards
+                if s.spill.hit_rate is not None]
+        return {
+            "shards": {
+                "n": self.num_shards,
+                "route": self.route,
+                "fill": fills,
+                "fill_min": min(fills),
+                "fill_max": max(fills),
+                "adds": [s.ring.total_adds for s in self.shards],
+                "live_blocks": [s.live_blocks for s in self.shards],
+                "stale_writebacks": self.stale_writebacks,
+            },
+            "spill": {
+                "capacity": cap,
+                "occupancy": occ,
+                "occupancy_frac": (round(occ / cap, 4) if cap else 0.0),
+                "hit_rate": (round(float(np.mean(hits)), 4)
+                             if hits else None),
+                **interval,
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# Socket rung: remote producers route blocks into the service over TCP —
+# the serve/transport.py frame discipline applied to the experience path.
+
+
+class ReplayServiceServer:
+    """TCP listener feeding a ReplayService: one reader thread per
+    producer connection; each ``("add", field_dict)`` frame is routed
+    through :meth:`ReplayService.add_block` and acked with the shard it
+    landed in (producers can assert routing end-to-end)."""
+
+    def __init__(self, service: ReplayService, host: str = "127.0.0.1",
+                 port: int = 0):
+        import socket
+
+        from r2d2_tpu.serve.transport import recv_frame, send_frame
+        self._recv_frame, self._send_frame = recv_frame, send_frame
+        self.service = service
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.25)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._conns: list = []
+        self.blocks_received = 0
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="replay-svc-accept")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        import socket
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(None)
+            self._conns.append(conn)
+            threading.Thread(target=self._reader_loop, args=(conn,),
+                             daemon=True, name="replay-svc-conn").start()
+
+    def _reader_loop(self, conn) -> None:
+        import pickle
+        lock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                kind, payload = self._recv_frame(conn)
+                if kind != "add":
+                    continue
+                block = Block(**{k: np.asarray(v)
+                                 for k, v in payload.items()})
+                shard = self.service.add_block(block)
+                self.blocks_received += 1
+                self._send_frame(conn, ("ack", shard), lock)
+        except (ConnectionError, OSError, EOFError, pickle.PickleError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=2.0)
+
+
+class RemoteReplayProducer:
+    """Producer-side socket channel: ``add_block`` ships one block and
+    returns the shard the service routed it to. Lazily (re)dials like
+    serve/transport.SocketChannel."""
+
+    def __init__(self, host: str, port: int, dial_timeout: float = 2.0):
+        self._addr = (host, port)
+        self._dial_timeout = dial_timeout
+        self._sock = None
+        self._lock = threading.Lock()
+        from r2d2_tpu.serve.transport import recv_frame, send_frame
+        self._recv_frame, self._send_frame = recv_frame, send_frame
+
+    def _ensure(self):
+        import socket
+        if self._sock is None:
+            s = socket.create_connection(self._addr,
+                                         timeout=self._dial_timeout)
+            s.settimeout(self._dial_timeout)
+            self._sock = s
+        return self._sock
+
+    def add_block(self, block: Block, timeout: float = 5.0) -> int:
+        fields = _block_fields(block)
+        sock = self._ensure()
+        sock.settimeout(timeout)
+        self._send_frame(sock, ("add", fields), self._lock)
+        kind, shard = self._recv_frame(sock)
+        if kind != "ack":
+            raise ConnectionError(f"unexpected reply kind {kind!r}")
+        return int(shard)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+def _block_fields(block: Block) -> Dict[str, np.ndarray]:
+    """Block → {field: numpy} for the socket frame (flax PyTreeNodes
+    expose their fields through __dataclass_fields__)."""
+    return {name: np.asarray(getattr(block, name))
+            for name in block.__dataclass_fields__
+            if getattr(block, name) is not None}

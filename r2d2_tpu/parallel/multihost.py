@@ -708,8 +708,33 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             raise
         publish = wrap_publish(publisher.publish, prep,
                                lambda: publisher.publish_count)
+        # weight fan-out tree (ISSUE 15): this host's relay tier of the
+        # fleet-wide tree — the rank's learner publishes ONCE to its
+        # root segment, shm relays re-publish, and the host's local
+        # actors subscribe to leaf relays (the root sees <= degree
+        # readers per host no matter the local fan-out). Relays carry
+        # the stamped quant bundle unchanged.
+        shm_fanout = None
+        if cfg.fleet.fanout_degree >= 2:
+            from r2d2_tpu.fleet.fanout import ShmFanout
+            try:
+                shm_fanout = ShmFanout(
+                    publisher.name,
+                    prep(ts.params, 0) if prep else ts.params,
+                    n_local, cfg.fleet.fanout_degree)
+                shm_fanout.pump()   # adopt the construction publish
+            except BaseException:
+                queue.close()
+                publisher.close()
+                raise
+            _root_publish = publish
+
+            def publish(params, _pub=_root_publish, _f=shm_fanout):
+                _pub(params)
+                _f.pump()
     else:
         stop = threading.Event()
+        shm_fanout = None
 
     # SIGTERM/SIGINT land on the stop event, which feeds the next
     # iteration's local_stop flag into the psum consensus — the signaled
@@ -753,9 +778,11 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
             heartbeats.reset_slot(i)
             if tele_board is not None:
                 tele_board.reset_slot(i)
+            seg = (shm_fanout.segment_for(i) if shm_fanout is not None
+                   else publisher.name)
             p = ctx.Process(
                 target=actor_process_main,
-                args=(cfg.to_dict(), pid, gidx, eps, publisher.name,
+                args=(cfg.to_dict(), pid, gidx, eps, seg,
                       queue._q, stop),
                 kwargs={**cfg.multiplayer.env_args(pid, gidx),
                         "total_actors": nprocs * n_local,
@@ -1336,6 +1363,10 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 pass
         if fleet is not None:
             fleet.join(timeout=5.0)
+        if shm_fanout is not None:
+            # relays close BEFORE the root publisher (each holds a
+            # subscriber on the root/parent segment)
+            shm_fanout.close()
         if publisher is not None:
             publisher.close()
         queue.close()    # releases/unlinks the shm ring (owner side)

@@ -126,7 +126,9 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
 def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
                           board=None, telemetry=None,
                           weight_version: Optional[Callable[[], int]] = None,
-                          lane_base: Optional[int] = None) -> Callable:
+                          lane_base: Optional[int] = None,
+                          on_leave: Optional[Callable[[], None]] = None,
+                          generation: int = 0) -> Callable:
     """Health + telemetry instrumentation around a block sink — the ONE
     wrapping point shared by every actor spawner (thread, process,
     single-host, multihost), so scalar and vector loops alike publish
@@ -176,8 +178,22 @@ def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
         # process kinds stay at the sink there
         sink_kinds = (SINK_KINDS_SERVER if cfg.actor.inference == "server"
                       else SINK_KINDS_LOCAL)
+        # a LEAVE fault models the slot's ORIGINAL worker departing; a
+        # joiner adopting the slot (generation > 0) is a new worker and
+        # must not inherit the departure — otherwise a rejoined slot
+        # leaves again N blocks after every adoption and the churn
+        # drill/A-B measure a permanently-narrowed fleet instead of a
+        # bounded gap. Crash/hang faults DO re-apply across respawns
+        # (the crash-loop/breaker drills depend on that).
+        if (fault is not None and fault.kind == "leave"
+                and generation > 0):
+            fault = None
         if fault is not None and fault.kind in sink_kinds:
-            wrapped = apply_fault(wrapped, fault)
+            # on_leave (ISSUE 15): the spawner's membership hook — an
+            # injected 'leave' parks the slot for re-adoption BEFORE the
+            # worker unwinds, so the supervisor sees a detached slot,
+            # never a crash
+            wrapped = apply_fault(wrapped, fault, on_leave=on_leave)
     if board is not None:
         def sink_with_heartbeat(block, _wrapped=wrapped):
             board.beat(slot)

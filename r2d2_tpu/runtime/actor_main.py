@@ -16,7 +16,8 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                        is_host: bool, port: int,
                        total_actors: int = None,
                        health_board=None, health_slot: int = None,
-                       telemetry_board=None, serve_spec: dict = None) -> None:
+                       telemetry_board=None, serve_spec: dict = None,
+                       generation: int = 0) -> None:
     # total_actors: the GLOBAL worker-fleet size for the vector ε ladder —
     # multihost spawners pass process_count * num_actors with a global
     # actor_idx; None = single-host (cfg.actor.num_actors)
@@ -140,8 +141,12 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
         # lane provenance (ISSUE 10): actor_idx is the GLOBAL worker
         # index (multihost fleets pass theirs), matching the ladder
         # layout vector_lane_epsilons spreads ε over
-        lane_base=actor_idx * cfg.actor.envs_per_actor)
+        lane_base=actor_idx * cfg.actor.envs_per_actor,
+        # membership generation (ISSUE 15): an adopted slot's joiner
+        # (generation > 0) must not inherit the slot's 'leave' fault
+        generation=generation)
 
+    from r2d2_tpu.tools.chaos import ChaosLeave
     try:
         run_loop(cfg, env, policy,
                  block_sink=sink,
@@ -149,6 +154,11 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                               else (lambda: None)),
                  should_stop=stop_event.is_set,
                  telemetry=tele)
+    except ChaosLeave:
+        # deliberate departure (ISSUE 15 leave@block=N): exit 0 — the
+        # elastic supervisor parks the slot for re-adoption; a loud
+        # nonzero exit here would read as a crash in the logs
+        pass
     except Exception:
         if not stop_event.is_set():
             raise      # a served policy raising at shutdown is clean-stop

@@ -175,6 +175,12 @@ class WorkerHealth:
         self._windows = [deque() for _ in range(n_slots)]  # failure times
         self._next_allowed = [0.0] * n_slots
         self._parked = [False] * n_slots
+        # membership-detached slots (ISSUE 15): a slot whose lease is
+        # parked/vacant — the supervisor must neither hang-check nor
+        # respawn it (distinct from the breaker's _parked: detachment is
+        # a deliberate membership state, not a failure verdict, and it
+        # re-attaches on join)
+        self._detached = [False] * n_slots
         self.restarts = 0
         self.hangs_detected = 0
         self.breaker_trips = 0
@@ -241,6 +247,23 @@ class WorkerHealth:
 
     def is_parked(self, slot: int) -> bool:
         return self._parked[slot]
+
+    def detach(self, slot: int) -> None:
+        """Membership detachment (ISSUE 15): the slot's lease parked (a
+        worker left/died under the elastic policy) or the slot is spare
+        capacity awaiting a joiner — supervision skips it entirely."""
+        self._detached[slot] = True
+
+    def attach(self, slot: int) -> None:
+        """Re-admission: a joiner adopted the slot. The failure window
+        and backoff reset — the new incarnation is a fresh lease, not a
+        continuation of the departed worker's crash history."""
+        self._detached[slot] = False
+        self._windows[slot].clear()
+        self._next_allowed[slot] = 0.0
+
+    def is_detached(self, slot: int) -> bool:
+        return self._detached[slot]
 
     def respawn_due(self, slot: int, now: float) -> bool:
         return not self._parked[slot] and now >= self._next_allowed[slot]
@@ -378,7 +401,9 @@ class RingRecoveryScheduler:
 
 def supervise_workers(workers, seen_dead: set, respawn=None,
                       ring: Optional[RingRecoveryScheduler] = None,
-                      health: Optional[WorkerHealth] = None) -> int:
+                      health: Optional[WorkerHealth] = None,
+                      park: Optional[Callable[[int, bool], None]] = None
+                      ) -> int:
     """The ONE worker-health scan shared by the single-host supervisor
     (orchestrator.PlayerStack) and the multihost fleet
     (parallel/multihost.LocalActorFleet).
@@ -394,11 +419,19 @@ def supervise_workers(workers, seen_dead: set, respawn=None,
     (holding the objects — no id reuse) makes every corpse count exactly
     once, so a slot waiting out its backoff cannot re-arm ring reclamation
     or re-advance the backoff ladder every tick. Returns the number
-    respawned."""
+    respawned.
+
+    ``park`` (ISSUE 15, fleet.elastic): the membership policy — a
+    newly-failed worker's slot is PARKED (``park(i, hung)``) instead of
+    fed to the backoff ladder and respawned in place; ring reclamation
+    still runs (a crashed producer wedges shm slots either way), and
+    slots the membership plane detached are skipped like breaker-parked
+    ones."""
     restarted = 0
     now = time.time()
     for i, w in enumerate(workers):
-        if health is not None and health.is_parked(i):
+        if health is not None and (health.is_parked(i)
+                                   or health.is_detached(i)):
             continue
         known_corpse = w in seen_dead
         if not known_corpse:
@@ -412,6 +445,11 @@ def supervise_workers(workers, seen_dead: set, respawn=None,
             seen_dead.add(w)
             if ring is not None:
                 ring.on_death()
+            if park is not None:
+                # elastic membership: the slot parks for re-adoption —
+                # no backoff, no in-place respawn; a joiner re-attaches
+                park(i, hung)
+                continue
             if health is not None:
                 health.on_failure(i, now, hung=hung)
         if respawn is None:

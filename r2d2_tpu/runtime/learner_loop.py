@@ -81,7 +81,49 @@ class Learner:
         # service's current publish count — the learner half of the
         # sample-age clock (None = ages reported as unknown)
         self.weight_version_fn: Optional[Callable[[], int]] = None
-        if self.host_mode:
+        # -- disaggregated replay service (ISSUE 15) --
+        # fleet.replay_shards >= 1 routes ingestion through N
+        # addressable device shards (fleet/replay_service.py: the
+        # dp-sharded rings generalized, plus the host-RAM spill tier)
+        # and trains through the EXTERNAL-BATCH step on service-sampled
+        # prioritized batches — the consumer draws from the service
+        # instead of fusing sample+train over one in-mesh ring, which is
+        # what lets producers/consumers/storage stop sharing a program.
+        self.service = None
+        if cfg.fleet.replay_shards >= 1 and not self.host_mode:
+            import dataclasses
+
+            from r2d2_tpu.fleet.replay_service import ReplayService
+            # equal device-ring slices per shard; the fused-path replay
+            # diagnostics state stays off (the service's own telemetry
+            # block carries shard/spill health; the external-batch
+            # step's batch-side rdiag — lane composition — still runs)
+            shard_spec = dataclasses.replace(
+                self.spec,
+                num_blocks=self.spec.num_blocks // cfg.fleet.replay_shards,
+                replay_diag=False)
+            self.service = ReplayService(
+                shard_spec, cfg.fleet.replay_shards,
+                spill_blocks=cfg.fleet.spill_blocks,
+                route=cfg.fleet.replay_route,
+                promote_per_sample=cfg.fleet.spill_promote_per_sample)
+            # one service-sampled batch per step — same degradation the
+            # host branch warns about, made equally loud here
+            if cfg.runtime.steps_per_dispatch > 1:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "fleet.replay_shards: ignoring "
+                    "runtime.steps_per_dispatch=%d (the service-routed "
+                    "learner trains one service-sampled batch per step)",
+                    cfg.runtime.steps_per_dispatch)
+            self._k = 1
+            self.replay_state = None
+            self._step_fn = make_external_batch_step(
+                net, shard_spec, cfg.optim, cfg.network.use_double,
+                diag=self._diag, rdiag=self._rdiag)
+            self._service_key = jax.random.PRNGKey(seed + 777
+                                                   + 1000 * player_idx)
+        elif self.host_mode:
             # dispatch amortization needs the device-resident replay (each
             # host-mode step consumes one host-sampled batch); degrade
             # rather than reject. Warn only for an explicitly-set value > 1
@@ -177,7 +219,13 @@ class Learner:
         # block, and replay_add advances the device pointer with the
         # identical wrap rule (asserted in tests/test_replay.py).
         from r2d2_tpu.replay.structs import RingAccountant
-        if self.host_mode:
+        if self.service is not None:
+            # the service IS the accounting facade: per-shard
+            # RingAccountants advance inside add_block, and the facade's
+            # buffer_steps/total_adds/live_versions sum them — the same
+            # duck-typed surface the gate/metrics/flush read
+            self.ring = self.service
+        elif self.host_mode:
             self.ring = self.host_replay.ring
         else:
             # round-robin feeding visits the dp shards' ring slots in a
@@ -210,7 +258,11 @@ class Learner:
         # path uses, so the fused step's priority write-back stays
         # race-free. Host placement keeps K = 1: its ingest is a numpy
         # copy, not a device dispatch.
-        self._ingest_k = (1 if self.host_mode else
+        # service mode keeps the per-block drain (K = 1): spill
+        # retention shadows each block's host page at add time, and the
+        # service's routing is per-block by definition
+        self._ingest_k = (1 if (self.host_mode or self.service is not None)
+                          else
                           min(cfg.replay.resolved_ingest_batch_blocks(),
                               self.spec.num_blocks))
         self._sharded_add_many = None
@@ -263,6 +315,9 @@ class Learner:
             if self.replay_state is not None:
                 register_buffer(f"p{player_idx}/replay_ring",
                                 pytree_nbytes(self.replay_state))
+            if self.service is not None:
+                register_buffer(f"p{player_idx}/replay_service",
+                                self.service.device_bytes)
         # depth 2: one batch committing + one transfer in flight bounds
         # staged memory at 2K blocks while keeping the pipeline full
         self._ingest_q: queue_mod.Queue = queue_mod.Queue(maxsize=2)
@@ -291,6 +346,11 @@ class Learner:
         learning = int(np.asarray(block.learning_steps).sum())
         if self.host_mode:
             self.host_replay.add(block)   # advances the shared accountant
+        elif self.service is not None:
+            # routed by shard key; the per-shard accountants (and the
+            # spill-tier demotion of whatever the ring-write overwrote)
+            # advance inside the service
+            self.service.add_block(block)
         else:
             if self.mesh is not None:
                 self.replay_state = self._sharded_add(
@@ -599,6 +659,11 @@ class Learner:
         if (self.mesh is not None
                 and self.ring.total_adds + extra_blocks < self._dp):
             return False
+        if self.service is not None and not self.service.all_shards_nonempty:
+            # every service shard must hold a block before sampling (an
+            # empty tree yields NaN importance weights — the dp mesh's
+            # same precondition, enforced per addressable shard)
+            return False
         return (self.ring.buffer_steps + extra_steps
                 >= self.cfg.replay.learning_starts)
 
@@ -731,6 +796,35 @@ class Learner:
             self.metrics.on_dropped_priority_update()
         return m
 
+    # -- service-mode step (ISSUE 15) --
+
+    def _service_step_once(self) -> dict:
+        """Disaggregated consumer loop: draw one prioritized batch from
+        the service's next shard, train through the external-batch step,
+        write the new priorities straight back to that shard. In-proc
+        producers never interleave an add here (the single-threaded
+        drain/step cadence is the same interleaving point the fused
+        path relies on); SOCKET producers can, so the write-back rides
+        the sample's adds-snapshot through the service's staleness
+        guard (a raced batch's update is dropped and counted, never
+        written onto the overwriting block). Spill promotion happens
+        inside service.sample BEFORE the tree descent, keeping the
+        returned idxes valid for this write-back."""
+        self._service_key, key = jax.random.split(self._service_key)
+        t0 = time.time()
+        batch, shard, snapshot = self.service.sample(key)
+        self.tele.observe("learner/sample", time.time() - t0)
+        self.train_state, m = self._step_fn(self.train_state, batch)
+        t0 = time.time()
+        # the snapshot arms the staleness guard: with socket producers
+        # feeding the service concurrently, an add landing mid-step must
+        # not have its fresh block's priorities clobbered by this batch
+        self.service.update_priorities(shard, batch.idxes,
+                                       m.pop("priorities"),
+                                       adds_snapshot=snapshot)
+        self.tele.observe("learner/priority_writeback", time.time() - t0)
+        return m
+
     # -- training --
 
     def step(self) -> dict:
@@ -743,6 +837,8 @@ class Learner:
         t0 = time.time()
         if self.host_mode:
             m = self._host_step_once()
+        elif self.service is not None:
+            m = self._service_step_once()
         else:
             self.train_state, self.replay_state, m = self._step_fn(
                 self.train_state, self.replay_state)

@@ -130,6 +130,14 @@ class TrainMetrics:
         # to the PR13 schema.
         self._quant_fn = None
 
+        # elastic fleet plane (ISSUE 15): a replay_service-block
+        # provider (per-shard fill, spill occupancy/hit-rate, fan-out
+        # relay depth/lag, membership lease counts) attached by the
+        # orchestrating loop when any fleet plane is configured on —
+        # unattached (every legacy run) the record is byte-identical to
+        # the PR14 schema.
+        self._replay_service_fn = None
+
         # system-health pillar (ISSUE 7): a resources-block provider
         # (ResourceMonitor.block) and the alert engine, both attached by
         # the orchestrating loop. None = the blocks are OMITTED and the
@@ -243,6 +251,15 @@ class TrainMetrics:
         agreement of the interval's in-graph accuracy probes. Called
         once per log(); None returns omit the block."""
         self._quant_fn = provider
+
+    def set_replay_service(self, provider) -> None:
+        """Attach the replay_service-block provider (ISSUE 15): a
+        callable returning the elastic-fleet telemetry dict — per-shard
+        fill/adds, spill-tier occupancy + hit-rate + interval thrash,
+        fan-out relay depth/lag, membership lease counts. Called once
+        per log(); None returns omit the block (consumers key on its
+        presence)."""
+        self._replay_service_fn = provider
 
     def set_resources(self, provider) -> None:
         """Attach the resources-block provider (ISSUE 7): a callable
@@ -399,6 +416,14 @@ class TrainMetrics:
             quant = self._quant_fn()
             if quant is not None:
                 record["quant"] = quant
+        if self._replay_service_fn is not None:
+            # elastic-fleet block (ISSUE 15): shard fill / spill health /
+            # fan-out lag / membership leases. Before the sentinel pass
+            # so the spill_thrash / fanout_lag / orphaned_slot rules see
+            # their own interval.
+            rs = self._replay_service_fn()
+            if rs is not None:
+                record["replay_service"] = rs
         if self._resources_fn is not None:
             # machine-side block (ISSUE 7): devices/host/buffer footprints
             # + the compile sub-block. Before the sentinel, which reads it.

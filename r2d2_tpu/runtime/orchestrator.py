@@ -36,6 +36,16 @@ from r2d2_tpu.runtime.weights import (InProcWeightStore, WeightPublisher,
                                       make_publish_preparer, wrap_publish)
 
 
+class _VacantSlot:
+    """Placeholder worker for a spare membership slot (ISSUE 15): keeps
+    the worker lists index-aligned with the slot table so a joiner can
+    land in ANY leased slot. Never alive; supervision skips it anyway
+    (spare slots are health-detached until adopted)."""
+
+    def is_alive(self) -> bool:
+        return False
+
+
 class PlayerStack:
     """One player's buffer+learner+actors (the reference creates these per
     player in train.py:28-45)."""
@@ -67,13 +77,56 @@ class PlayerStack:
             WorkerHealth)
         self._seen_dead: set = set()    # reaped dead process objects
         self._ring_recovery = RingRecoveryScheduler()
+        # elastic membership (ISSUE 15): the slot table spans the
+        # fleet's MAX width (fleet.max_slots spare slots lease-able by
+        # joiners); the heartbeat board / health policy / telemetry
+        # board size to it so an adopted spare publishes through the
+        # same rows the startup fleet does. Default config: n_slots ==
+        # num_actors and everything below is byte-identical to PR14.
+        self.n_slots = cfg.fleet.resolved_max_slots(cfg.actor.num_actors)
+        from r2d2_tpu.fleet.membership import FleetMembership
+        self.membership = FleetMembership(
+            self.n_slots, cfg.actor.envs_per_actor,
+            initial_active=cfg.actor.num_actors,
+            num_shards=max(cfg.fleet.replay_shards, 1))
         # worker-health subsystem: per-slot heartbeats + the shared
         # watchdog/backoff/breaker policy (feeder.py) + the learner-side
         # ingest stall detector
-        self.heartbeats = HeartbeatBoard(cfg.actor.num_actors)
+        self.heartbeats = HeartbeatBoard(self.n_slots)
         self.health = WorkerHealth.from_runtime(
-            cfg.actor.num_actors, self.heartbeats, cfg.runtime)
+            self.n_slots, self.heartbeats, cfg.runtime)
+        for spare in range(cfg.actor.num_actors, self.n_slots):
+            # spare slots carry no worker until a joiner leases them —
+            # supervision must neither hang-check nor respawn them
+            self.health.detach(spare)
         self._stall = IngestStallDetector(cfg.runtime.ingest_stall_timeout_s)
+        # grammar-scheduled joins (tools/chaos.py join@t=S): admitted by
+        # supervise() once the slot is parked/free and t has elapsed
+        from r2d2_tpu.tools.chaos import parse_join_spec
+        self._join_schedule = (parse_join_spec(cfg.actor.fault_spec)
+                               if cfg.actor.fault_spec else {})
+        self._joins_done: set = set()
+        self._run_start = time.time()
+        # weight fan-out tree (ISSUE 15): built by the actor spawners
+        # when fleet.fanout_degree >= 2 (in-proc relays in thread mode,
+        # shm relay segments in process mode)
+        self._fanout = None
+        self._shm_fanout = None
+        self._actor_mode = None
+        # replay-service socket rung: remote producers route blocks in
+        self._service_server = None
+        if (cfg.fleet.service_transport == "socket"
+                and self.learner.service is not None):
+            from r2d2_tpu.fleet.replay_service import ReplayServiceServer
+            self._service_server = ReplayServiceServer(
+                self.learner.service, cfg.fleet.service_host,
+                cfg.fleet.service_port)
+        # fleet telemetry: the record's replay_service block (per-shard
+        # fill, spill health, fan-out lag, membership leases) — attached
+        # only when a fleet plane is configured on, so legacy records
+        # stay byte-identical to the PR14 schema
+        if cfg.fleet.active and cfg.telemetry.enabled:
+            self.metrics.set_replay_service(self._replay_service_block)
         self.publisher = None
         self.store = None
         self.queue: Optional[BlockQueue] = None
@@ -126,7 +179,7 @@ class PlayerStack:
         # is guarded to unwind BOTH boards created above.
         if cfg.telemetry.enabled:
             from r2d2_tpu.telemetry import TelemetryBoard
-            self.tele_board = TelemetryBoard(cfg.actor.num_actors)
+            self.tele_board = TelemetryBoard(self.n_slots)
             self.telemetry.attach_board(self.tele_board)
             try:
                 resume = bool(cfg.runtime.resume)
@@ -236,13 +289,30 @@ class PlayerStack:
         # (one shared prepared tree, fresh across respawns)
         self.store = InProcWeightStore(
             prep(params0, 1) if prep else params0)
-        self.learner.publish = wrap_publish(
+        publish = wrap_publish(
             self.store.publish, prep, lambda: self.store.publish_count)
+        # weight fan-out tree (ISSUE 15): the learner publishes ONCE to
+        # the root store; in-proc relays re-publish and each actor slot
+        # reads its leaf relay — the root sees <= degree readers no
+        # matter the fleet width. The published tree (incl. the stamped
+        # quant bundle) rides through relays unchanged.
+        if cfg.fleet.fanout_degree >= 2:
+            from r2d2_tpu.fleet.fanout import FanoutTree
+            self._fanout = FanoutTree(
+                self.store, self.n_slots, cfg.fleet.fanout_degree,
+                pull_interval_s=cfg.fleet.fanout_pull_interval_s)
+
+            def publish_and_pump(params, _pub=publish):
+                _pub(params)
+                self._fanout.on_publish()
+            publish = publish_and_pump
+        self.learner.publish = publish
         # staleness clock (ISSUE 5): the learner half of sample-age =
         # publish count at flush − the block's generation stamp
         self.learner.weight_version_fn = lambda: self.store.publish_count
         self.queue = BlockQueue(use_mp=False)
         self._stop = stop
+        self._actor_mode = "thread"
         if self.serve_endpoint is not None:
             # thread-mode serving: the server polls the in-proc store
             # under its own reader id; clients share the stats object so
@@ -256,6 +326,8 @@ class PlayerStack:
             self._start_serve_server()
         for i in range(cfg.actor.num_actors):
             self._spawn_thread_actor(i)
+        while len(self.threads) < self.n_slots:
+            self.threads.append(_VacantSlot())
 
     def _spawn_thread_actor(self, i: int) -> threading.Thread:
         cfg = self.cfg
@@ -281,16 +353,30 @@ class PlayerStack:
 
         serve_channel = (self.serve_endpoint.connect()
                          if self.serve_endpoint is not None else None)
-        # initial params: the store's CURRENT published tree — already
-        # prepared (the quant bundle; no per-policy requantization) AND
-        # fresh on a mid-training respawn, whose dead predecessor
-        # consumed the slot's reader version so its first poll() would
-        # return None; adopting here also fixes the staleness stamp
-        init_params = (self.store.current(reader_id=i)
-                       if self.store is not None
+        # weight distribution endpoints for this slot: its leaf relay of
+        # the fan-out tree when configured (ISSUE 15), the root store
+        # directly otherwise — identical (poll, version, current) shapes
+        if self._fanout is not None:
+            fo_poll, fo_version, fo_current = self._fanout.endpoints(i)
+        elif self.store is not None:
+            fo_poll = (lambda reader_id=i: self.store.poll(reader_id))
+            fo_version = (
+                lambda reader_id=i: self.store.reader_version(reader_id))
+            fo_current = (
+                lambda reader_id=i: self.store.current(reader_id=reader_id))
+        else:
+            fo_poll = fo_version = fo_current = None
+        # initial params: the distribution plane's CURRENT published
+        # tree — already prepared (the quant bundle; no per-policy
+        # requantization) AND fresh on a mid-training respawn/adoption,
+        # whose dead predecessor consumed the slot's reader version so
+        # its first poll() would return None; adopting here also fixes
+        # the staleness stamp
+        init_params = (fo_current() if fo_current is not None
                        else self.learner.train_state.params)
         policy, run_loop = make_actor_policy(
             cfg, self.net, init_params, i, seed,
+            total_actors=self.n_slots,
             serve_channel=serve_channel, serve_stats=self.serve_stats,
             should_stop=should_stop, quant_stats=self.quant_stats)
 
@@ -302,12 +388,11 @@ class PlayerStack:
             weight_version = lambda: policy.weight_version  # noqa: E731
             weight_poll = lambda: None                      # noqa: E731
         else:
-            # generation stamp: the store version this thread actor last
-            # adopted (reader_id = slot index, matching weight_poll)
-            weight_version = (
-                lambda reader_id=i: self.store.reader_version(reader_id))
-            weight_poll = (
-                lambda reader_id=i: self.store.poll(reader_id))
+            # generation stamp: the version this slot's distribution
+            # endpoint last adopted (relay-aware: a lagging relay's
+            # consumers stamp OLDER versions, which is the truth)
+            weight_version = fo_version
+            weight_poll = fo_poll
         sink = instrument_block_sink(
             cfg, i,
             lambda b: self.queue.put_patient(
@@ -318,12 +403,21 @@ class PlayerStack:
             weight_version=weight_version,
             # lane provenance (ISSUE 10): worker i owns the contiguous
             # global-ladder slice [i*k, (i+1)*k) — the same layout
-            # vector_lane_epsilons spreads ε over
-            lane_base=i * cfg.actor.envs_per_actor)
+            # vector_lane_epsilons spreads ε over, and the identity a
+            # joiner adopts with the slot (ISSUE 15)
+            lane_base=i * cfg.actor.envs_per_actor,
+            # injected 'leave' faults park the slot for re-adoption
+            # BEFORE the worker unwinds (tools/chaos.py ChaosLeave);
+            # the generation gates leave injection to the slot's
+            # ORIGINAL worker — an adopted incarnation is a new worker
+            on_leave=lambda: self._on_worker_leave(i),
+            generation=self.membership.generation(i))
 
         def loop(env=env, policy=policy, run_loop=run_loop,
                  weight_poll=weight_poll, sink=sink,
                  should_stop=should_stop):
+            from r2d2_tpu.tools.chaos import ChaosLeave
+
             # the run loop owns env and closes it on every exit
             try:
                 run_loop(cfg, env, policy,
@@ -331,6 +425,10 @@ class PlayerStack:
                          weight_poll=weight_poll,
                          should_stop=should_stop,
                          telemetry=self.telemetry)
+            except ChaosLeave:
+                # deliberate departure (ISSUE 15): the slot already
+                # parked via on_leave — unwind quietly, not as a crash
+                pass
             except Exception:
                 # a served policy raising ServeUnavailable DURING
                 # shutdown is the clean-stop path, not a failure
@@ -354,19 +452,40 @@ class PlayerStack:
         params0 = self.learner.train_state.params
         self.publisher = WeightPublisher(
             prep(params0, 1) if prep else params0)
-        self.learner.publish = wrap_publish(
+        publish = wrap_publish(
             self.publisher.publish, prep,
             lambda: self.publisher.publish_count)
+        # shm fan-out tree (ISSUE 15): relay nodes re-publish the root
+        # segment into their own segments; each actor process attaches
+        # to its leaf relay's segment name through the unchanged
+        # actor_main plumbing. Pumped on every publish + the supervise
+        # cadence.
+        if cfg.fleet.fanout_degree >= 2:
+            from r2d2_tpu.fleet.fanout import ShmFanout
+            template = prep(params0, 0) if prep else params0
+            self._shm_fanout = ShmFanout(
+                self.publisher.name, template, self.n_slots,
+                cfg.fleet.fanout_degree)
+            self._shm_fanout.pump()   # relays adopt the initial publish
+
+            def publish_and_pump(params, _pub=publish):
+                _pub(params)
+                self._shm_fanout.pump()
+            publish = publish_and_pump
+        self.learner.publish = publish
         self.learner.weight_version_fn = \
             lambda: self.publisher.publish_count
         self.queue = BlockQueue(
             use_mp=True, ctx=self._ctx,
             shm_spec=self.learner.spec if cfg.runtime.shm_transport else None)
         self._stop = stop_event
+        self._actor_mode = "process"
         if self.serve_endpoint is not None:
             self._start_serve_transport()
         for i in range(cfg.actor.num_actors):
             self._spawn_process_actor(i)
+        while len(self.processes) < self.n_slots:
+            self.processes.append(_VacantSlot())
 
     def _start_serve_transport(self) -> None:
         """Process-mode serving: the server lives in THIS (learner)
@@ -432,21 +551,31 @@ class PlayerStack:
 
     def _spawn_process_actor(self, i: int) -> mp.Process:
         cfg = self.cfg
-        eps = apex_epsilon(i, cfg.actor.num_actors, cfg.actor.base_eps,
+        # the ε ladder spans the fleet's MAX width (n_slots == num_actors
+        # unless fleet.max_slots reserves spares), so the exploration
+        # schedule is fixed as the fleet churns
+        eps = apex_epsilon(i, self.n_slots, cfg.actor.base_eps,
                            cfg.actor.eps_alpha)
         self.heartbeats.reset_slot(i)
         if self.tele_board is not None:
             # fresh incarnation: cumulative telemetry counts restart at
             # zero (the aggregator's reset detection handles the edge)
             self.tele_board.reset_slot(i)
+        # weight segment: the slot's leaf relay under the shm fan-out
+        # tree, the root publisher otherwise (identical subscriber API)
+        shm_name = (self._shm_fanout.segment_for(i)
+                    if self._shm_fanout is not None
+                    else self.publisher.name)
         p = self._ctx.Process(
             target=actor_process_main,
             args=(cfg.to_dict(), self.player_idx, i, eps,
-                  self.publisher.name, self.queue._q, self._stop),
+                  shm_name, self.queue._q, self._stop),
             kwargs={**self.actor_env_args(i),
+                    "total_actors": self.n_slots,
                     "health_board": self.heartbeats, "health_slot": i,
                     "telemetry_board": self.tele_board,
-                    "serve_spec": self._serve_spec},
+                    "serve_spec": self._serve_spec,
+                    "generation": self.membership.generation(i)},
             daemon=True, name=f"actor-p{self.player_idx}-{i}")
         p.start()
         if i < len(self.processes):
@@ -482,7 +611,16 @@ class PlayerStack:
             # compile of a known fn with new avals is a retrace (mark_warm
             # is idempotent — called every pass, latches once)
             self.compile_monitor.mark_warm()
+        if self._shm_fanout is not None:
+            # relay propagation rides the supervise cadence too, so a
+            # publish between supervision passes still reaches leaves
+            # promptly even if the publish-time pump raced a subscriber
+            self._shm_fanout.pump()
         restart = self.cfg.runtime.restart_dead_actors
+        # elastic membership (ISSUE 15): a dead/left worker's slot PARKS
+        # for re-adoption instead of respawning in place — joiners
+        # (join_actor / the grammar's join@t schedule) re-admit it
+        park = self._park_slot if self.cfg.fleet.elastic else None
         restarted = 0
         # threads are scanned even with restarts off (respawn=None), like
         # processes below: the hang watchdog must still flag a wedged
@@ -490,15 +628,30 @@ class PlayerStack:
         # gates RESPAWNING, not detection
         restarted += supervise_workers(
             self.threads, self._seen_dead,
-            respawn=self._spawn_thread_actor if restart else None,
-            health=self.health)
+            respawn=(self._spawn_thread_actor
+                     if restart and park is None else None),
+            health=self.health, park=park)
         restarted += supervise_workers(
             self.processes, self._seen_dead,
-            respawn=self._spawn_process_actor if restart else None,
+            respawn=(self._spawn_process_actor
+                     if restart and park is None else None),
             ring=self._ring_recovery,
-            health=self.health)
+            health=self.health, park=park)
         self.health.ring_slots_recovered += self._ring_recovery.tick(
             self.queue)
+        # grammar-scheduled joins (join@t=S): admit once the slot is
+        # parked/free and the schedule time elapsed
+        if self._join_schedule:
+            from r2d2_tpu.fleet.membership import SLOT_ACTIVE
+            now_rel = time.time() - self._run_start
+            for slot, fault in self._join_schedule.items():
+                if slot in self._joins_done or now_rel < fault.t:
+                    continue
+                if self.membership.state(slot) == SLOT_ACTIVE:
+                    continue       # still occupied; retry next pass
+                self.join_actor(slot)
+                self._joins_done.add(slot)
+                restarted += 1
         workers = self.processes or self.threads
         self._stall.check(
             self.metrics.ingest_blocks_total,
@@ -509,6 +662,85 @@ class PlayerStack:
             {**self.health.snapshot(),
              "ingest_stall_dumps": self._stall.dumps})
         return restarted
+
+    # -- elastic membership (ISSUE 15) --
+
+    def _on_worker_leave(self, slot: int) -> None:
+        """The sink's on_leave hook (an injected ``leave`` fault): park
+        the slot BEFORE the worker unwinds, so the supervisor sees a
+        detached slot, never a crash."""
+        self.membership.park(slot, reason="left")
+        self.health.detach(slot)
+
+    def _park_slot(self, slot: int, hung: bool) -> None:
+        """Elastic supervision policy: a dead (or watchdog-killed hung)
+        worker's slot parks for re-adoption — no in-place respawn, no
+        backoff ladder; training continues on the remaining fleet."""
+        import logging
+        self.membership.park(slot, reason="hung" if hung else "died")
+        self.health.detach(slot)
+        logging.getLogger(__name__).warning(
+            "elastic fleet: worker slot %d %s — slot PARKED for "
+            "re-adoption (active fleet now %d/%d)", slot,
+            "hung" if hung else "died",
+            len(self.membership.active_slots()), self.n_slots)
+
+    def leave_actor(self, slot: int) -> None:
+        """Deliberate departure: park the slot's lease and stop its
+        worker. The slot's lane range / ε slice / replay routing are
+        preserved for the next joiner; the learner keeps training on
+        the remaining fleet."""
+        from r2d2_tpu.runtime.feeder import kill_worker
+        self.membership.park(slot, reason="left")
+        self.health.detach(slot)
+        workers = self.processes if self.processes else self.threads
+        if slot < len(workers):
+            w = workers[slot]
+            if not isinstance(w, _VacantSlot):
+                kill_worker(w)
+                self._seen_dead.add(w)
+
+    def join_actor(self, slot: Optional[int] = None):
+        """Admit a joiner into a RUNNING fleet: lease a parked (or
+        spare) slot and spawn a worker that adopts its full identity —
+        heartbeat row, lane range, ε-ladder slice, replay routing. The
+        new worker reads weights through the slot's distribution
+        endpoint (leaf relay under fan-out) and its blocks carry the
+        adopted lane stamps, so provenance checks span the churn."""
+        lease = self.membership.lease(slot)
+        i = lease.slot
+        self.health.attach(i)
+        corpse = None
+        workers = self.processes if self._actor_mode == "process" \
+            else self.threads
+        if i < len(workers):
+            corpse = workers[i]
+        if self._actor_mode == "process":
+            self._spawn_process_actor(i)
+        else:
+            self._spawn_thread_actor(i)
+        if corpse is not None:
+            self._seen_dead.discard(corpse)
+        return lease
+
+    def _replay_service_block(self):
+        """The record's ``replay_service`` block: shard/spill health
+        from the learner's service, fan-out relay stats, membership
+        lease counts (orphan horizon = 2x the hang timeout — a leased
+        slot silent that long has no supervision verdict coming)."""
+        block = {}
+        if self.learner.service is not None:
+            block.update(self.learner.service.interval_block())
+        if self._fanout is not None:
+            block["fanout"] = self._fanout.stats()
+        elif self._shm_fanout is not None:
+            block["fanout"] = self._shm_fanout.stats(
+                self.publisher.publish_count)
+        horizon = 2.0 * self.cfg.runtime.hang_timeout_s
+        block["membership"] = self.membership.snapshot(
+            self.heartbeats.ages() if self.heartbeats is not None else None,
+            orphan_horizon_s=horizon)
+        return block
 
     def _stall_diagnostics(self) -> dict:
         """Snapshot for the one-shot stall dump: who was alive, how stale
@@ -531,15 +763,23 @@ class PlayerStack:
 
     def close(self) -> None:
         self.learner.stop_background()
+        if self._service_server is not None:
+            self._service_server.close()
         if self.serve_server is not None:
             self.serve_server.stop()
         if self._serve_transport is not None:
             self._serve_transport.close()
         if self._serve_weight_sub is not None:
             self._serve_weight_sub.close()
+        if self._shm_fanout is not None:
+            # relays close BEFORE the root publisher: each holds a
+            # subscriber on the root (or a parent relay's) segment
+            self._shm_fanout.close()
         if self.publisher is not None:
             self.publisher.close()
         for p in self.processes:
+            if isinstance(p, _VacantSlot):
+                continue           # spare membership slot, never spawned
             p.join(timeout=5.0)
             if p.is_alive():
                 p.terminate()
@@ -553,6 +793,8 @@ class PlayerStack:
         # compile when the interpreter exits dies with a C++ abort
         # ("FATAL: exception not rethrown") — harmless but alarming noise
         for t in self.threads:
+            if isinstance(t, _VacantSlot):
+                continue
             t.join(timeout=5.0)
         if self.queue is not None:
             self.queue.close()   # releases/unlinks the shm ring (owner)

@@ -215,6 +215,19 @@ def _recv_frame(sock: socket.socket):
     return pickle.loads(_recv_exact(sock, n))
 
 
+def send_frame(sock: socket.socket, obj, lock: threading.Lock) -> None:
+    """Public length-prefixed-pickle frame writer — the serving plane's
+    wire discipline, shared with the replay service's socket rung
+    (fleet/replay_service.py) so the experience and inference paths
+    cannot drift on framing."""
+    _send_frame(sock, obj, lock)
+
+
+def recv_frame(sock: socket.socket):
+    """Public frame reader — see :func:`send_frame`."""
+    return _recv_frame(sock)
+
+
 class SocketServerTransport:
     """TCP listener feeding the server inbox: one reader thread per
     connection; replies go back over the same connection under a per-

@@ -215,6 +215,27 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("quant_divergence", "threshold",
                   ("quant", "agree_frac"),
                   tcfg.alerts_quant_agreement, "warn", below=True),
+        # elastic-fleet rules (ISSUE 15; the replay_service block,
+        # r2d2_tpu/fleet/ — inactive on records without it, i.e. every
+        # run with no fleet plane configured):
+        # spill thrash — the interval's demoted pages are falling off
+        # the LRU end before re-promotion (eviction/demotion ratio): the
+        # device ring turns over faster than the spill tier can cycle
+        # experience back, so the tier is pure write-through loss
+        AlertRule("spill_thrash", "threshold",
+                  ("replay_service", "spill", "thrash_frac"),
+                  tcfg.alerts_spill_thrash_frac, "warn"),
+        # a weight-tree relay stopped propagating: its subtree's actors
+        # act publications behind the learner (max root-to-relay lag)
+        AlertRule("fanout_lag", "threshold",
+                  ("replay_service", "fanout", "max_lag"),
+                  tcfg.alerts_fanout_lag, "warn"),
+        # a leased slot went silent without being parked or re-adopted —
+        # a leaked lease the membership plane cannot fill (crit: the
+        # fleet is silently narrower than the lease table claims)
+        AlertRule("orphaned_slot", "threshold",
+                  ("replay_service", "membership", "orphaned"),
+                  tcfg.alerts_orphaned_slots, "crit"),
     )
 
 

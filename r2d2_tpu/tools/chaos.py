@@ -29,16 +29,20 @@ synthetic stubs. This module is that exerciser:
 
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
-_KINDS = ("crash", "hang", "slow", "disconnect")
+_KINDS = ("crash", "hang", "slow", "disconnect", "leave", "join")
 # kinds that inject at the worker's BLOCK SINK vs at its SERVE CLIENT
 # (actor.inference="server"): crash/hang are about the worker process
 # and stay at the sink either way; slow moves to the request path in
 # served mode (a laggy client against the micro-batcher); disconnect
 # only exists at the client (there is no connection to drop locally).
-SINK_KINDS_LOCAL = ("crash", "hang", "slow")
-SINK_KINDS_SERVER = ("crash", "hang")
+# Membership kinds (ISSUE 15): ``leave`` injects at the sink (the
+# worker departs cleanly after its Nth emit and its slot PARKS for
+# re-adoption); ``join`` is a FLEET-level schedule, not a worker fault
+# — parse_join_spec extracts it and the supervisor admits the joiner.
+SINK_KINDS_LOCAL = ("crash", "hang", "slow", "leave")
+SINK_KINDS_SERVER = ("crash", "hang", "leave")
 CLIENT_KINDS = ("disconnect", "slow")
 
 
@@ -46,19 +50,28 @@ class ChaosFault(RuntimeError):
     """Raised by an injected crash fault (distinguishable from real bugs)."""
 
 
+class ChaosLeave(RuntimeError):
+    """Raised by an injected ``leave`` fault: the worker departs the
+    running fleet — its slot has already been parked for re-adoption
+    via the sink's on_leave hook, so supervision treats the corpse as a
+    detached slot, not a failure."""
+
+
 @dataclass(frozen=True)
 class FaultSpec:
-    kind: str            # "crash" | "hang" | "slow" | "disconnect"
-    block: int = 0       # 1-based emit ordinal (crash/hang) or request
-    #                      period (disconnect@req=N: drop every Nth)
+    kind: str            # "crash" | "hang" | "slow" | "disconnect" |
+    #                      "leave" | "join"
+    block: int = 0       # 1-based emit ordinal (crash/hang/leave) or
+    #                      request period (disconnect@req=N)
     factor: float = 1.0  # slow-down multiplier (slow only)
+    t: float = 0.0       # run-relative seconds (join@t=S only)
 
 
-def parse_fault_spec(spec: str) -> Dict[int, FaultSpec]:
-    """Parse ``actor.fault_spec`` into {slot: FaultSpec}; raises ValueError
-    on malformed input so a bad spec fails at Config construction, not
-    mid-run inside a spawned worker."""
-    faults: Dict[int, FaultSpec] = {}
+def _iter_entries(spec: str):
+    """Shared entry parser: yields (slot, kind, kv, entry) with the
+    slot/kind syntax validated — both parse_fault_spec and
+    parse_join_spec consume it, so one bad entry fails identically
+    through either."""
     for entry in filter(None, (e.strip() for e in spec.split(";"))):
         slot_s, sep, rest = entry.partition(":")
         if not sep or not rest:
@@ -72,8 +85,6 @@ def parse_fault_spec(spec: str) -> Dict[int, FaultSpec]:
                 from None
         if slot < 0:
             raise ValueError(f"fault_spec entry {entry!r}: slot must be >= 0")
-        if slot in faults:
-            raise ValueError(f"fault_spec: duplicate slot {slot}")
         kind, _, params = rest.partition("@")
         kv = {}
         if params:
@@ -88,7 +99,47 @@ def parse_fault_spec(spec: str) -> Dict[int, FaultSpec]:
             raise ValueError(
                 f"fault_spec entry {entry!r}: unknown kind {kind!r} "
                 f"(expected one of {_KINDS})")
-        if kind in ("crash", "hang"):
+        yield slot, kind, kv, entry
+
+
+def parse_join_spec(spec: str) -> Dict[int, FaultSpec]:
+    """Extract the MEMBERSHIP join schedule (``slot:join@t=S``) from a
+    fault spec: {slot: FaultSpec("join", t=S)}. Joins are fleet-level
+    events (the supervisor admits a joiner into the parked/spare slot
+    at t >= S), so they live beside — not instead of — the same slot's
+    worker fault (``0:leave@block=3;0:join@t=12`` is the leave-then-
+    rejoin drill)."""
+    joins: Dict[int, FaultSpec] = {}
+    for slot, kind, kv, entry in _iter_entries(spec):
+        if kind != "join":
+            continue
+        if slot in joins:
+            raise ValueError(f"fault_spec: duplicate join for slot {slot}")
+        try:
+            t = float(kv.get("t", ""))
+        except ValueError:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: join needs @t=S "
+                "(run-relative seconds)") from None
+        if t < 0:
+            raise ValueError(
+                f"fault_spec entry {entry!r}: join t must be >= 0")
+        joins[slot] = FaultSpec("join", t=t)
+    return joins
+
+
+def parse_fault_spec(spec: str) -> Dict[int, FaultSpec]:
+    """Parse ``actor.fault_spec`` into {slot: FaultSpec} of WORKER
+    faults (join entries are fleet-level; parse_join_spec extracts
+    those); raises ValueError on malformed input so a bad spec fails at
+    Config construction, not mid-run inside a spawned worker."""
+    faults: Dict[int, FaultSpec] = {}
+    for slot, kind, kv, entry in _iter_entries(spec):
+        if kind == "join":
+            continue
+        if slot in faults:
+            raise ValueError(f"fault_spec: duplicate slot {slot}")
+        if kind in ("crash", "hang", "leave"):
             try:
                 block = int(kv.get("block", ""))
             except ValueError:
@@ -128,18 +179,29 @@ def parse_fault_spec(spec: str) -> Dict[int, FaultSpec]:
     return faults
 
 
-def apply_fault(sink: Callable, fault: FaultSpec) -> Callable:
+def apply_fault(sink: Callable, fault: FaultSpec,
+                on_leave: Optional[Callable[[], None]] = None) -> Callable:
     """Wrap a block sink with one injected fault. Crash raises ChaosFault
     INSTEAD of emitting block N (the worker dies with the block in hand —
     the mid-production death shape); hang wedges there forever (a truly
     unresponsive worker: it ignores stop signals by design, so only the
     watchdog can clear it); slow sleeps (factor-1) x the observed
     inter-emit interval, genuinely stretching block production by
-    ``factor`` without guessing at step timings."""
+    ``factor`` without guessing at step timings; leave EMITS block N
+    then departs — ``on_leave`` (the spawner's membership hook) parks
+    the slot for re-adoption before ChaosLeave unwinds the worker, so a
+    clean departure is never mistaken for a crash."""
     state = {"emitted": 0, "last": None}
 
     def faulty_sink(block):
         state["emitted"] += 1
+        if fault.kind == "leave" and state["emitted"] >= fault.block:
+            out = sink(block)   # the departing worker's last block SHIPS
+            del out
+            if on_leave is not None:
+                on_leave()
+            raise ChaosLeave(
+                f"injected leave after block emit {state['emitted']}")
         if fault.kind == "crash" and state["emitted"] >= fault.block:
             raise ChaosFault(
                 f"injected crash at block emit {state['emitted']}")
@@ -415,6 +477,151 @@ def run_serve_chaos(seconds: float = 45.0, outage_s: float = 6.0,
     return report
 
 
+# ---------------------------------------------------------------------------
+# Membership churn drill (ISSUE 15): live leave + re-join on a running fleet.
+
+
+def run_churn_drill(seconds: float = 45.0, num_actors: int = 4,
+                    leave_frac: float = 0.25,
+                    config_overrides: dict = None) -> dict:
+    """Elastic-fleet churn drill: thread actors on the fake env with
+    ``fleet.elastic`` supervision and the service-routed replay
+    (``fleet.replay_shards=2``, lane routing). A quarter of the fleet
+    LEAVES mid-training via the grammar's ``leave@block=N`` fault (slot
+    parks for re-adoption) and RE-JOINS via ``join@t=S`` (the supervisor
+    admits a joiner that adopts the parked slot's lane range + ε slice +
+    replay routing). The claims under test:
+
+      * zero learner stalls — training advances in every post-warm-up
+        log interval, through the departure window and the re-join;
+      * no lane-range overlap — the adopted slot's lanes are exactly
+        the departed worker's (membership.assert_no_overlap);
+      * provenance — every block row in replay shard s carries a lane
+        stamp with ``lane % num_shards == s`` (the PR-10 stamps prove
+        adopted slots route into the correct shards)."""
+    import threading
+
+    import numpy as np
+
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.runtime.orchestrator import PlayerStack
+
+    n_leave = max(1, int(num_actors * leave_frac))
+    join_at = max(seconds * 0.55, 12.0)
+    spec_parts = []
+    for s in range(n_leave):
+        spec_parts.append(f"{s}:leave@block={3 + s}")
+        spec_parts.append(f"{s}:join@t={join_at + 2.0 * s:.1f}")
+    overrides = {
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "actor.num_actors": num_actors,
+        "actor.fault_spec": ";".join(spec_parts),
+        "fleet.elastic": True,
+        "fleet.replay_shards": 2,
+        "fleet.replay_route": "lane",
+        "runtime.save_interval": 0, "runtime.log_interval": 2.0,
+        "runtime.steps_per_dispatch": 1,
+        "runtime.supervise_interval_s": 0.5,
+        "runtime.ingest_stall_timeout_s": 0.0,
+    }
+    overrides.update(config_overrides or {})
+    cfg = Config().replace(**overrides)
+
+    probe = create_env(cfg.env, seed=0)
+    action_dim = probe.action_space.n
+    probe.close()
+
+    stop = threading.Event()
+    stack = PlayerStack(cfg, 0, action_dim)
+    records = []
+    t0 = time.time()
+    steps_at_leave = steps_at_join = None
+    last_log = last_supervise = t0
+    try:
+        stack.start_actors_threads(stop)
+        while time.time() - t0 < seconds:
+            stack.learner.drain(stack.queue)
+            if stack.learner.ready:
+                stack.learner.step()
+            now = time.time()
+            if now - last_supervise >= cfg.runtime.supervise_interval_s:
+                stack.supervise()
+                last_supervise = now
+            if steps_at_leave is None and stack.membership.leaves >= n_leave:
+                steps_at_leave = stack.learner.training_steps
+            if steps_at_join is None and stack.membership.joins >= n_leave:
+                steps_at_join = stack.learner.training_steps
+            if now - last_log >= cfg.runtime.log_interval:
+                stack.learner.flush_metrics()
+                records.append(stack.metrics.log(now - last_log))
+                last_log = now
+            if not stack.learner.ready:
+                time.sleep(0.01)
+        stack.membership.assert_no_overlap()
+        # provenance (PR-10 lane stamps through the service's lane
+        # routing): every live row of shard s must carry lane % S == s
+        shard_lanes = []
+        routed_ok = True
+        service = stack.learner.service
+        if service is not None:
+            for shard in service.shards:
+                lanes = np.asarray(shard.state.lane)
+                live = lanes[lanes >= 0]
+                shard_lanes.append(sorted(set(int(x) for x in live)))
+                if live.size and not bool(np.all(
+                        live % service.num_shards == shard.index)):
+                    routed_ok = False
+        membership = stack.membership.snapshot(stack.heartbeats.ages(),
+                                               orphan_horizon_s=0.0)
+    finally:
+        stop.set()
+        stack.close()
+
+    trained = [r for r in records if r.get("training_speed")]
+    # zero-stall: once training started, EVERY interval advanced (the
+    # churn window included)
+    started = False
+    stalled_intervals = 0
+    for r in records:
+        speed = r.get("training_speed") or 0.0
+        if speed > 0:
+            started = True
+        elif started:
+            stalled_intervals += 1
+    report = {
+        "metric": "churn_drill",
+        "duration_s": round(time.time() - t0, 1),
+        "fault_spec": cfg.actor.fault_spec,
+        "num_actors": num_actors, "left_and_rejoined": n_leave,
+        "training_steps": records[-1]["training_steps"] if records else 0,
+        "steps_at_leave": steps_at_leave,
+        "steps_at_join": steps_at_join,
+        "stalled_intervals": stalled_intervals,
+        "membership": membership,
+        "shard_lanes": shard_lanes,
+        "records": records[-3:],
+    }
+    report["verdict"] = {
+        "left": membership["leaves"] >= n_leave,
+        "rejoined": membership["joins"] >= n_leave,
+        "zero_learner_stalls": (bool(trained) and stalled_intervals == 0
+                                and steps_at_join is not None
+                                and steps_at_leave is not None
+                                and steps_at_join > steps_at_leave),
+        "no_lane_overlap": True,    # assert_no_overlap raised otherwise
+        "shards_routed_by_lane": routed_ok,
+    }
+    return report
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -427,6 +634,11 @@ def main(argv=None) -> int:
     p.add_argument("--serve", action="store_true",
                    help="run the ISSUE-13 server-kill/restart drill "
                         "instead of the worker-fault phase")
+    p.add_argument("--churn", action="store_true",
+                   help="run the ISSUE-15 membership churn drill "
+                        "(leave 25%% of the fleet mid-training, re-join "
+                        "it, assert zero learner stalls + shard-routing "
+                        "provenance) instead of the worker-fault phase")
     p.add_argument("--outage-seconds", type=float, default=6.0,
                    help="--serve: how long the policy server stays down")
     p.add_argument("--override", action="append", default=[],
@@ -439,7 +651,9 @@ def main(argv=None) -> int:
             overrides[k] = json.loads(v)
         except (json.JSONDecodeError, ValueError):
             overrides[k] = v
-    if args.serve:
+    if args.churn:
+        out = run_churn_drill(args.seconds, config_overrides=overrides)
+    elif args.serve:
         out = run_serve_chaos(args.seconds, args.outage_seconds, overrides)
     else:
         out = run_chaos(args.seconds, args.actor_mode, overrides)

@@ -280,6 +280,21 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
                 quant[k] = (quant.get(k) or 0) + (v or 0)
             elif v is not None:
                 quant[k] = v
+    # elastic-fleet evidence (ISSUE 15): field-wise merge of the
+    # replay_service blocks, newest non-null per sub-block (membership
+    # joins/leaves are cumulative so last-wins is exact; spill interval
+    # counters take the newest populated snapshot); None on every run
+    # with no fleet plane configured (the key-absence contract)
+    replay_service = None
+    for r in records:
+        fb = r.get("replay_service")
+        if not fb:
+            continue
+        if replay_service is None:
+            replay_service = dict(fb)
+        else:
+            replay_service.update(
+                {k: v for k, v in fb.items() if v is not None})
     # system-health evidence (ISSUE 7): the newest resources block plus
     # the run's alert tally — proof the pillar actually flowed (or, with
     # the kill switch off, that the records carried neither key)
@@ -317,6 +332,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "anakin": anakin,
         "serving": serving,
         "quant": quant,
+        "replay_service": replay_service,
         "resources": resources,
         "alerts_present": alerts_present,
         "alerts_fired": alerts_fired,
@@ -799,6 +815,116 @@ def run_quant_ab(seconds: float, lanes: int = 16,
         out["serve_probe"]["int8"].get("slo_ok"))
 
     out["weight_bytes"] = quant_weight_bytes_table(overrides)
+    return out
+
+
+def run_elastic_ab(seconds: float, overrides: Optional[dict] = None,
+                   repeats: int = 2, num_actors: int = 4,
+                   lanes_per_actor: int = 4) -> dict:
+    """Elastic-fleet A/B (ISSUE 15 acceptance), two arm pairs in one
+    artifact:
+
+      * **churn arm** — the SAME thread-mode e2e system (num_actors
+        vector workers + the real learner) fixed vs CHURNED at equal
+        lanes: the churned cells run ``fleet.elastic`` with a
+        grammar-injected ``leave@block`` on 25%% of the fleet and a
+        ``join@t`` re-adoption mid-run (the supervisor admits the
+        joiner; the slot's lane range/ε slice are adopted). ABBA-
+        interleaved ``repeats`` times with per-arm medians; churned
+        cells carry the ``replay_service`` membership block (joins/
+        leaves) as end-to-end evidence. The claim: churn costs bounded
+        throughput (the departed slot's share for the gap), and the
+        learner NEVER stalls — training_speed stays nonzero in every
+        churned record after warm-up.
+      * **spill arm** — the service-routed learner
+        (``fleet.replay_shards=2``) with the host-RAM spill tier off vs
+        on (spill sized to 1x the device rings → 2x total capacity):
+        learner updates/s ratio ON/OFF bounds the spill tier's cost on
+        the training path, and the ON cell's spill occupancy/hit-rate
+        prove pages actually demote and re-promote."""
+    base = dict(overrides or {})
+    lanes = num_actors * lanes_per_actor
+    n_leave = max(1, int(num_actors * 0.25))
+    join_at = max(seconds * 0.55, 10.0)
+    spec_parts = []
+    for s in range(n_leave):
+        spec_parts.append(f"{s}:leave@block={30 + 5 * s}")
+        spec_parts.append(f"{s}:join@t={join_at + 2.0 * s:.1f}")
+    churn_ov = {
+        "fleet.elastic": True,
+        "actor.fault_spec": ";".join(spec_parts),
+        "runtime.supervise_interval_s": 1.0,
+    }
+    cells = {"fixed": [], "churned": []}
+    for rep in range(max(repeats, 1)):
+        order = (("fixed", {}), ("churned", churn_ov))
+        if rep % 2:
+            order = order[::-1]    # ABBA: cancel monotonic host drift
+        for label, extra in order:
+            ov = dict(base)
+            ov.update(extra)
+            cells[label].append(run_e2e(
+                seconds, envs_per_actor=lanes_per_actor,
+                num_actors=num_actors, overrides=ov, actor_mode="thread"))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"fixed": cells["fixed"][-1], "churned": cells["churned"][-1],
+           "lanes": lanes, "repeats": max(repeats, 1),
+           "left_and_rejoined": n_leave,
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("fixed", "env_steps_per_sec") > 0:
+        out["env_steps_ratio_churn"] = round(
+            med("churned", "env_steps_per_sec")
+            / med("fixed", "env_steps_per_sec"), 3)
+    if med("fixed", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio_churn"] = round(
+            med("churned", "learner_steps_per_sec")
+            / med("fixed", "learner_steps_per_sec"), 3)
+    mb = {}
+    for c in cells["churned"]:
+        mb.update(((c.get("replay_service") or {}).get("membership")
+                   or {}))
+    out["membership_block_on"] = bool(mb)
+    out["churn_joins"] = mb.get("joins")
+    out["churn_leaves"] = mb.get("leaves")
+    out["membership_block_fixed"] = any(c.get("replay_service")
+                                        for c in cells["fixed"])
+
+    # spill arm: the service-routed learner with the spill tier off/on.
+    # Device rings shrink so the ring cycles within the bench window
+    # (demotions need overwrites); spill ON sizes the tier to the whole
+    # device budget — 2x effective capacity, the acceptance geometry.
+    svc_base = dict(base)
+    svc_base.update({
+        "fleet.replay_shards": 2,
+        "replay.capacity": 8_000,          # 100 blocks -> 50/shard
+        "replay.learning_starts": 400,
+    })
+    spill_cells = {}
+    for label, spill in (("spill_off", 0), ("spill_on", 50)):
+        ov = dict(svc_base)
+        ov["fleet.spill_blocks"] = spill
+        spill_cells[label] = run_e2e(
+            min(seconds, 30.0), envs_per_actor=lanes_per_actor,
+            num_actors=num_actors, overrides=ov, actor_mode="thread")
+    out["spill_off"] = spill_cells["spill_off"]
+    out["spill_on"] = spill_cells["spill_on"]
+    if spill_cells["spill_off"]["learner_steps_per_sec"] > 0:
+        out["learner_steps_ratio_spill"] = round(
+            spill_cells["spill_on"]["learner_steps_per_sec"]
+            / spill_cells["spill_off"]["learner_steps_per_sec"], 3)
+    sp = ((spill_cells["spill_on"].get("replay_service") or {})
+          .get("spill") or {})
+    out["spill_occupancy"] = sp.get("occupancy")
+    out["spill_hit_rate"] = sp.get("hit_rate")
+    out["spill_capacity"] = sp.get("capacity")
     return out
 
 
@@ -1292,6 +1418,15 @@ def main(argv=None) -> int:
                         "serving-probe arm at both dtypes + the analytic "
                         "weight-bytes table (the >= 3x int8 cut); one "
                         "artifact (E2E_r16.json)")
+    p.add_argument("--elastic-ab", type=int, default=0,
+                   help="1: run the e2e phase as the elastic-fleet A/B "
+                        "instead (ISSUE 15) — fixed vs churned fleet at "
+                        "equal lanes (grammar-injected leave@block + "
+                        "join@t re-adoption under fleet.elastic; the "
+                        "learner must never stall) plus a spill-tier "
+                        "on/off pair on the service-routed learner "
+                        "(fleet.replay_shards=2, 2x-capacity spill); "
+                        "one artifact (E2E_r17.json)")
     p.add_argument("--resources-ab", type=int, default=0,
                    help="1: run the e2e phase as a resource/compile/alerts "
                         "on/off A/B instead (telemetry.resources_enabled; "
@@ -1352,6 +1487,10 @@ def main(argv=None) -> int:
             out["e2e_fleet_ab"] = run_fleet_ab(
                 args.e2e_seconds, args.envs_per_actor,
                 dp=args.sharded_dp, overrides=overrides,
+                repeats=args.ab_repeats)
+        elif args.elastic_ab:
+            out["e2e_elastic_ab"] = run_elastic_ab(
+                args.e2e_seconds, overrides=overrides,
                 repeats=args.ab_repeats)
         elif args.quant_ab:
             out["e2e_quant_ab"] = run_quant_ab(
