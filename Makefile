@@ -80,6 +80,17 @@
 #                the slow churn drill (leave 25% of a running fleet,
 #                re-join it, zero learner stalls) runs with the full
 #                tier.
+#   make service-ingest — the fast-tier batched service data-plane
+#                suite (tests/test_service_ingest.py: grouped-ingest
+#                bit-parity with the sequential path incl. ring wrap /
+#                mid-group spill demotion / lane routing, the AOT chunk
+#                plan, windowed socket cumulative acks under
+#                drop_ack@every chaos injection, spilled-page priority
+#                write-backs, priority-ordered async prefetch, the
+#                producer pump + run_replay_producer wiring, the new
+#                fleet knobs' round-trip/validation, the ingest_backlog
+#                rule); the slow sample-stager parity slice runs with
+#                the full tier.
 #   make quant — the fast-tier quantized-inference suite
 #                (tests/test_quant.py: per-channel int8 round-trip
 #                bounds, greedy-action agreement vs the f32 twin,
@@ -112,8 +123,8 @@
 #                shape on TPU).
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
-	replaydiag fleet serve quant elastic costmodel regress costs \
-	roofline check-fast-markers
+	replaydiag fleet serve quant elastic service-ingest costmodel \
+	regress costs roofline check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -162,6 +173,10 @@ elastic: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+service-ingest: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_service_ingest.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
 costmodel: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q \
 	    -m 'not slow' -p no:cacheprovider
@@ -195,6 +210,7 @@ FAST_MARKER_CHECKS := \
 	tests/test_serve.py:not_slow:14:serve \
 	tests/test_quant.py:not_slow:14:quant \
 	tests/test_elastic.py:not_slow:20:elastic \
+	tests/test_service_ingest.py:not_slow:20:service-ingest \
 	tests/test_costmodel.py:not_slow:10:cost-model
 
 check-fast-markers:

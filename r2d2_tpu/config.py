@@ -443,6 +443,35 @@ class FleetConfig:
     # training continues on the remaining fleet — the join/leave drill's
     # setting; re-admission goes through PlayerStack.join_actor.
     elastic: bool = False
+    # -- batched/pipelined service data plane (ISSUE 16) --
+    # Blocks the service commits per jitted dispatch: 1 (default) = the
+    # PR-15 per-block replay_add path, byte-identical. K > 1 = the
+    # learner's service drain stacks up to K queued blocks and
+    # ReplayService.add_blocks groups them by routed shard, committing
+    # each group through the donated replay_add_many program
+    # (pow2-bucketed, AOT-precompiled at service start) — bit-identical
+    # contents to K sequential adds, one dispatch instead of K.
+    ingest_batch_blocks: int = 1
+    # In-flight frame window for the socket rung's producer: 1 (default)
+    # = PR-15's one-frame-one-ack lockstep (a full RTT per frame). W > 1
+    # = RemoteReplayProducer keeps up to W unacked frames in flight
+    # (cumulative acks, back-pressure at the window bound) so remote
+    # producers stop paying a blocking round-trip per block.
+    socket_window: int = 1
+    # Priority-aware async spill promotion: False (default) = PR-15's
+    # inline LRU rotation inside the sample call. True = spilled pages
+    # promote by STORED priority (max-heap over each page's leaf
+    # priorities) and promotion is kicked asynchronously at write-back
+    # time, so the sample path stops paying promotion latency inline.
+    spill_prefetch: bool = False
+    # Service-mode sample staging: False (default) = the fully
+    # synchronous PR-15 service step (sample -> train -> write-back on
+    # one thread). True = the PR-2 stager treatment for the service
+    # path: a staging thread drains the next per-shard sample batch
+    # while the train dispatch runs, and priority write-backs batch per
+    # sampled shard on a writeback thread (the PR-14 staleness guard
+    # applies per entry, now reaching spilled pages too).
+    sample_staging: bool = False
 
     def resolved_max_slots(self, num_actors: int) -> int:
         return self.max_slots if self.max_slots > 0 else num_actors
@@ -735,6 +764,13 @@ class TelemetryConfig:
     # above which orphaned_slot fires — a worker vanished without its
     # lease being parked or re-adopted.
     alerts_orphaned_slots: float = 1.0
+    # Service ingest backlog (replay_service.ingest.backlog: blocks
+    # queued behind the service's grouped commit at the last drain) at/
+    # above which ingest_backlog fires — producers are bursting faster
+    # than the service's dispatch plane drains, so blocks age in the
+    # queue before ever becoming samplable (raise
+    # fleet.ingest_batch_blocks or slow collection).
+    alerts_ingest_backlog: float = 64.0
 
 
 @dataclass(frozen=True)
@@ -1162,6 +1198,39 @@ class Config:
             raise ValueError(
                 "fleet.service_transport requires fleet.replay_shards "
                 ">= 1 (there is no service to listen for)")
+        # -- batched/pipelined service data plane (ISSUE 16) --
+        if fl.ingest_batch_blocks < 1:
+            raise ValueError(
+                f"fleet.ingest_batch_blocks ({fl.ingest_batch_blocks}) "
+                "must be >= 1 (1 = the per-block replay_add path)")
+        if fl.ingest_batch_blocks > 1 and fl.replay_shards < 1:
+            raise ValueError(
+                "fleet.ingest_batch_blocks > 1 requires "
+                "fleet.replay_shards >= 1: grouped ingest is the "
+                "service's commit plane (the in-mesh path already has "
+                "replay.ingest_batch_blocks) — a run without the "
+                "service would silently ignore the knob")
+        if fl.socket_window < 1:
+            raise ValueError(
+                f"fleet.socket_window ({fl.socket_window}) must be >= 1 "
+                "(1 = one-frame-one-ack lockstep)")
+        if fl.socket_window > 1 and fl.service_transport != "socket":
+            raise ValueError(
+                "fleet.socket_window > 1 requires "
+                "fleet.service_transport='socket': the in-flight window "
+                "is the socket rung's ack pipeline — in-proc producers "
+                "have no frames to window")
+        if fl.spill_prefetch and fl.spill_blocks < 1:
+            raise ValueError(
+                "fleet.spill_prefetch requires fleet.spill_blocks >= 1: "
+                "priority-aware prefetch promotes from the spill tier — "
+                "with no tier the knob would be silently ignored")
+        if fl.sample_staging and fl.replay_shards < 1:
+            raise ValueError(
+                "fleet.sample_staging requires fleet.replay_shards >= 1:"
+                " the stager pipelines the SERVICE sample path (the "
+                "in-mesh learner already pipelines via the PR-2 ingest "
+                "stager)")
         if fl.fanout_degree < 0 or fl.fanout_degree == 1:
             raise ValueError(
                 f"fleet.fanout_degree ({fl.fanout_degree}) must be 0 "
@@ -1207,6 +1276,11 @@ class Config:
             raise ValueError(
                 f"telemetry.alerts_orphaned_slots "
                 f"({self.telemetry.alerts_orphaned_slots}) must be >= 1")
+        if self.telemetry.alerts_ingest_backlog < 1:
+            raise ValueError(
+                f"telemetry.alerts_ingest_backlog "
+                f"({self.telemetry.alerts_ingest_backlog}) must be >= 1 "
+                "(blocks queued behind the service drain)")
         if self.network.inference_dtype not in ("f32", "bf16", "int8"):
             raise ValueError(
                 f"network.inference_dtype "

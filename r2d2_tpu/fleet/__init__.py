@@ -22,13 +22,14 @@ and churn independently of one another:
 from r2d2_tpu.fleet.fanout import FanoutTree, ShmFanout
 from r2d2_tpu.fleet.membership import (SLOT_ACTIVE, SLOT_FREE, SLOT_PARKED,
                                        FleetMembership, SlotLease)
-from r2d2_tpu.fleet.replay_service import (RemoteReplayProducer, ReplayShard,
+from r2d2_tpu.fleet.replay_service import (RemoteReplayProducer,
+                                           ReplayProducerPump, ReplayShard,
                                            ReplayService, ReplayServiceServer,
                                            SpillTier)
 
 __all__ = [
     "ReplayService", "ReplayShard", "SpillTier",
-    "ReplayServiceServer", "RemoteReplayProducer",
+    "ReplayServiceServer", "RemoteReplayProducer", "ReplayProducerPump",
     "FanoutTree", "ShmFanout",
     "FleetMembership", "SlotLease",
     "SLOT_FREE", "SLOT_ACTIVE", "SLOT_PARKED",

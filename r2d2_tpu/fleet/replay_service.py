@@ -1,4 +1,5 @@
-"""Disaggregated replay service (ISSUE 15 tentpole, plane a).
+"""Disaggregated replay service (ISSUE 15 tentpole, plane a; ISSUE 16
+batched/pipelined data plane).
 
 The dp-sharded device replay (parallel/sharded.py) bound N replay rings
 to N mesh shards inside ONE shard_map program — producers and consumers
@@ -34,6 +35,33 @@ Routing policies:
     slot's lane range adopts its replay routing with it. Unstamped
     blocks (lane −1) fall back to round-robin.
 
+The batched data plane (ISSUE 16) removes the per-block dispatch tax at
+every rung while keeping bit-parity with the sequential path:
+
+  * **Grouped ingest** — :meth:`ReplayService.add_blocks` routes K
+    blocks in arrival order (the round-robin counter advances exactly
+    as K sequential :meth:`add_block` calls would), groups them by
+    routed shard, and commits each per-shard group through the donated
+    ``replay_add_many`` program in pow2 chunks AOT-precompiled at
+    service start. Per-shard ring rows, spill demotions (order and
+    LRU position), and lane/staleness stamps are bit-identical to the
+    sequential adds (tests/test_service_ingest.py); a configured
+    ``ingest_batch_blocks=1`` keeps the per-block loop byte-identical.
+  * **Windowed socket rung** — one ``addw`` frame carries a stacked
+    group; the producer keeps up to ``window`` unacked frames in
+    flight with CUMULATIVE acks (an ack for seq confirms every frame
+    ≤ seq), so a dropped ack is absorbed by the next one and
+    ``flush`` (always acked) is the resync point.
+  * **Priority-aware spill prefetch** — pages carry their stored leaf
+    priorities; with ``spill_prefetch`` promotion pops the
+    highest-priority page (max-heap) instead of the LRU end, and runs
+    on a service-owned background thread kicked at write-back time so
+    the sample path stops paying promotion latency inline.
+  * **Spilled-page write-backs** (ROADMAP 4a) — a priority write-back
+    whose sampled row was demoted since the sample routes to the
+    page's stored priorities instead of being dropped as stale, so
+    the spill tier holds the cold tail rather than random victims.
+
 The transport ladder follows serve/transport.py's shape: in-proc
 producers call :meth:`ReplayService.add_block` directly;
 :class:`ReplayServiceServer` / :class:`RemoteReplayProducer` are the
@@ -41,9 +69,10 @@ cross-host socket rung (length-prefixed-pickle frames, one connection
 per producer) feeding the same routing.
 """
 
+import heapq
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -72,47 +101,107 @@ class SpillTier:
     the end — the spill tier's usefulness gauge; ``thrash_frac`` (the
     per-interval eviction/demotion ratio in :meth:`take_interval`) is
     the ``spill_thrash`` alert's signal: near 1.0 the ring is turning
-    over so fast the tier is a pure write-through loss."""
+    over so fast the tier is a pure write-through loss.
+
+    ISSUE 16: every page also carries its max stored leaf priority
+    (``demote`` reads it from the page's ``block.priority`` — the raw
+    |TD| record both add-time seeding and write-backs are expressed in).
+    ``promote_best`` pops the highest-priority page via a lazy-deletion
+    max-heap, and :meth:`write_back` lets a post-demotion priority
+    write-back reach the page in place (ROADMAP 4a) — the re-seeded
+    priorities take effect at promotion through the same ``replay_add``
+    seeding as a fresh block. Eviction stays LRU in BOTH modes: the
+    heap orders what comes back first, not what falls off the end."""
 
     def __init__(self, capacity: int):
         self.capacity = int(capacity)
         self._pages: "OrderedDict[int, tuple]" = OrderedDict()
-        self._next_id = 0
+        # page id -> max stored leaf priority; the heap holds
+        # (-priority, id) with lazy deletion (stale ids skipped on pop)
+        self._prio: Dict[int, float] = {}
+        self._heap: List[Tuple[float, int]] = []
+        self._next_id = 1          # 1-based so a page id is always truthy
         self.demotions = 0
         self.promotions = 0
         self.evictions = 0
+        self.writebacks = 0
         self._interval = [0, 0, 0]   # demotions, promotions, evictions
 
     @property
     def occupancy(self) -> int:
         return len(self._pages)
 
-    def demote(self, block: Block, learning: int, weight_version: int) -> bool:
-        """Insert one demoted page; returns False when the tier is
-        disabled (capacity 0 — the page is simply lost, the pre-service
-        overwrite semantics)."""
+    def demote(self, block: Block, learning: int,
+               weight_version: int) -> Optional[int]:
+        """Insert one demoted page; returns its page id (the write-back
+        routing token) or None when the tier is disabled (capacity 0 —
+        the page is simply lost, the pre-service overwrite
+        semantics)."""
         if self.capacity <= 0:
-            return False
-        self._pages[self._next_id] = (block, int(learning),
-                                      int(weight_version))
+            return None
+        pid = self._next_id
         self._next_id += 1
+        self._pages[pid] = (block, int(learning), int(weight_version))
+        prio = float(np.max(np.asarray(block.priority)))
+        self._prio[pid] = prio
+        heapq.heappush(self._heap, (-prio, pid))
         self.demotions += 1
         self._interval[0] += 1
         if len(self._pages) > self.capacity:
-            self._pages.popitem(last=False)
+            old_id, _ = self._pages.popitem(last=False)
+            self._prio.pop(old_id, None)
             self.evictions += 1
             self._interval[2] += 1
-        return True
+        return pid
 
     def promote_next(self) -> Optional[tuple]:
         """Pop the least-recently-demoted page for re-insertion into the
         device ring; None when the tier is empty."""
         if not self._pages:
             return None
-        _, page = self._pages.popitem(last=False)
+        pid, page = self._pages.popitem(last=False)
+        self._prio.pop(pid, None)
         self.promotions += 1
         self._interval[1] += 1
         return page
+
+    def promote_best(self) -> Optional[tuple]:
+        """Pop the HIGHEST-priority page (ISSUE 16 priority-aware
+        promotion): lazy-deletion max-heap over the stored per-page
+        priorities — evicted/promoted/re-written ids are skipped on
+        pop. None when the tier is empty."""
+        while self._heap:
+            neg_prio, pid = heapq.heappop(self._heap)
+            if self._prio.get(pid) != -neg_prio or pid not in self._pages:
+                continue            # evicted, promoted, or re-prioritized
+            page = self._pages.pop(pid)
+            self._prio.pop(pid, None)
+            self.promotions += 1
+            self._interval[1] += 1
+            return page
+        return None
+
+    def write_back(self, page_id: int, seq: int, abs_td: float) -> bool:
+        """Write one sequence's new |TD| priority into a spilled page
+        (ROADMAP 4a): the page's ``block.priority[seq]`` is the raw-|TD|
+        record ``replay_add`` seeds the tree from at promotion, so the
+        write-back re-prioritizes the page exactly as a live-row
+        write-back would have. False when the page is gone (evicted or
+        already promoted) — the caller counts that as a dropped row."""
+        page = self._pages.get(page_id)
+        if page is None:
+            return False
+        block, learning, wv = page
+        prio = np.array(np.asarray(block.priority), copy=True)
+        if not 0 <= seq < prio.shape[0]:
+            return False
+        prio[seq] = abs_td
+        self._pages[page_id] = (block.replace(priority=prio), learning, wv)
+        new_max = float(np.max(prio))
+        self._prio[page_id] = new_max
+        heapq.heappush(self._heap, (-new_max, page_id))
+        self.writebacks += 1
+        return True
 
     @property
     def hit_rate(self) -> Optional[float]:
@@ -151,6 +240,10 @@ class ReplayShard:
         # host page per live ring slot (spill mode only): (block,
         # learning, weight_version), the demotion source
         self._resident: List[Optional[tuple]] = [None] * spec.num_blocks
+        # spill page id the slot's LAST overwritten occupant demoted to
+        # (the write-back routing table: a sampled row overwritten since
+        # its snapshot lives at _demote_ids[row] if anywhere)
+        self._demote_ids: List[Optional[int]] = [None] * spec.num_blocks
 
     def add(self, block: Block) -> int:
         """Ring-write one block (jitted replay_add); demotes the
@@ -164,20 +257,79 @@ class ReplayShard:
             block = _host_block(block)
             old = self._resident[slot]
             if old is not None and self.ring.slot_steps[slot] > 0:
-                self.spill.demote(*old)
+                self._demote_ids[slot] = self.spill.demote(*old)
         self.state = replay_add(self.spec, self.state, block)
         self.ring.advance(learning, wv)
         if self._retain:
             self._resident[slot] = (block, learning, wv)
         return slot
 
-    def promote(self, n: int) -> int:
+    def add_group(self, blocks: List[Block], get_exe,
+                  max_chunk: int) -> Tuple[int, float, float]:
+        """Commit a routed group through ``replay_add_many`` in chunks:
+        ``max_chunk`` when enough blocks remain, else the largest pow2
+        that fits (every size AOT-precompiled at service start; a chunk
+        of 1 routes through :meth:`add` — program identity with the
+        per-block path). Bit-parity with len(blocks) sequential adds
+        holds because a chunk's ring rows ``(ptr + j) % n`` are DISTINCT
+        (chunks never exceed num_blocks), so the per-slot demotion
+        reads/writes and the spill tier's LRU insertion order are
+        exactly the sequential ones, and ``replay_add_many`` is pinned
+        bit-identical to sequential ``replay_add`` (PR 2,
+        tests/test_service_ingest.py). Returns (dispatches, stage
+        seconds, commit seconds) for the ingest telemetry."""
+        import jax
+        dispatches, stage_s, commit_s = 0, 0.0, 0.0
+        n = self.spec.num_blocks
+        i, total = 0, len(blocks)
+        while i < total:
+            rem = total - i
+            k = max_chunk if rem >= max_chunk else 1 << (rem.bit_length() - 1)
+            if k == 1:
+                t0 = time.perf_counter()
+                self.add(blocks[i])
+                commit_s += time.perf_counter() - t0
+                dispatches += 1
+                i += 1
+                continue
+            chunk = blocks[i:i + k]
+            t0 = time.perf_counter()
+            if self._retain:
+                chunk = [_host_block(b) for b in chunk]
+            metas = [(int(np.asarray(b.learning_steps).sum()),
+                      int(np.asarray(b.weight_version))) for b in chunk]
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *chunk)
+            t1 = time.perf_counter()
+            slots = [(self.ring.ptr + j) % n for j in range(k)]
+            if self._retain:
+                for slot in slots:
+                    old = self._resident[slot]
+                    if old is not None and self.ring.slot_steps[slot] > 0:
+                        self._demote_ids[slot] = self.spill.demote(*old)
+            self.state = get_exe(k)(self.state, stacked)
+            for learning, wv in metas:
+                self.ring.advance(learning, wv)
+            if self._retain:
+                for slot, blk, (learning, wv) in zip(slots, chunk, metas):
+                    self._resident[slot] = (blk, learning, wv)
+            t2 = time.perf_counter()
+            stage_s += t1 - t0
+            commit_s += t2 - t1
+            dispatches += 1
+            i += k
+        return dispatches, stage_s, commit_s
+
+    def promote(self, n: int, by_priority: bool = False) -> int:
         """Rotate up to ``n`` spilled pages back into the device ring
         (each re-entry demotes whatever it overwrites — the ring cycles
-        through the spilled set). Returns pages promoted."""
+        through the spilled set). ``by_priority`` pops the
+        highest-priority page (ISSUE 16 prefetch order) instead of the
+        LRU end. Returns pages promoted."""
         done = 0
         for _ in range(max(n, 0)):
-            page = self.spill.promote_next()
+            page = (self.spill.promote_best() if by_priority
+                    else self.spill.promote_next())
             if page is None:
                 break
             self.add(page[0])
@@ -214,7 +366,9 @@ class ReplayService:
 
     def __init__(self, spec: ReplaySpec, num_shards: int,
                  spill_blocks: int = 0, route: str = "round_robin",
-                 promote_per_sample: int = 1):
+                 promote_per_sample: int = 1,
+                 ingest_batch_blocks: int = 1,
+                 spill_prefetch: bool = False):
         if num_shards < 1:
             raise ValueError(f"num_shards ({num_shards}) must be >= 1")
         if route not in _ROUTES:
@@ -223,6 +377,8 @@ class ReplayService:
         self.num_shards = num_shards
         self.route = route
         self.promote_per_sample = promote_per_sample
+        self.spill_prefetch = bool(spill_prefetch)
+        self.ingest_k = max(int(ingest_batch_blocks), 1)
         self.shards = [ReplayShard(spec, s, spill_blocks=spill_blocks)
                        for s in range(num_shards)]
         self._rr_add = 0
@@ -232,6 +388,83 @@ class ReplayService:
         # producer's add landed between a sample and its write-back and
         # overwrote a sampled row) — surfaced in the telemetry block
         self.stale_writebacks = 0
+        # ISSUE 16: write-back rows routed to spilled pages / dropped
+        # because their page was already gone (evicted or promoted)
+        self.spilled_writebacks = 0
+        self.stale_rows_dropped = 0
+        # grouped-ingest dispatch plane: AOT executables per chunk size,
+        # compiled at service start so the first burst never pays a
+        # mid-run XLA compile (the stager lesson, learner_loop PR 2)
+        self._max_chunk = min(self.ingest_k, spec.num_blocks)
+        self._add_many_cache: Dict[int, object] = {}
+        if self.ingest_k > 1:
+            for kb in self._aot_chunk_sizes():
+                self._add_many_cache[kb] = self._compile_add_many(kb)
+        # per-interval ingest counters: blocks, dispatches, stage s,
+        # commit s (reset on interval_block read) + the backlog gauge
+        self._ingest_iv = [0, 0, 0.0, 0.0]
+        self._backlog = 0
+        # async spill prefetch (ISSUE 16): shard indices awaiting a
+        # priority-ordered promotion pass, drained by a lazy-started
+        # background thread kicked at write-back time
+        self._prefetch_pending: set = set()
+        self._prefetch_event = threading.Event()
+        self._prefetch_stop = threading.Event()
+        self._prefetch_thread: Optional[threading.Thread] = None
+        self._prefetch_iv = 0
+        self._prefetch_popped = 0
+        self._prefetch_done = 0
+
+    # -- grouped-ingest dispatch plane (ISSUE 16) --
+
+    def _aot_chunk_sizes(self) -> List[int]:
+        """Every pow2 chunk below the configured group size PLUS the
+        group size itself (the steady-state chunk under load) — the
+        stager's bucket rule (learner_loop._aot_bucket_sizes) applied
+        to the service's commit plane. Size 1 is excluded: it routes
+        through the already-jitted per-block ``replay_add``."""
+        sizes, kb = [], 2
+        while kb < self._max_chunk:
+            sizes.append(kb)
+            kb *= 2
+        if self._max_chunk > 1:
+            sizes.append(self._max_chunk)
+        return sizes
+
+    def _compile_add_many(self, kb: int):
+        """Lower + AOT-compile the donated add_many executable for chunk
+        size ``kb``, deriving block avals from the authoritative record
+        layout (empty_block_np) — the learner stager's one lowering
+        recipe, aimed at the shard-sized spec."""
+        import jax
+
+        from r2d2_tpu.replay.device_replay import replay_add_many
+        from r2d2_tpu.replay.structs import empty_block_np
+        proto = empty_block_np(self.spec)
+        blocks = Block(**{
+            name: jax.ShapeDtypeStruct((kb,) + arr.shape, arr.dtype)
+            for name, arr in proto.items()})
+        state_avals = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self.shards[0].state)
+        return replay_add_many.lower(self.spec, state_avals,
+                                     blocks).compile()
+
+    def _exe_for(self, k: int):
+        exe = self._add_many_cache.get(k)
+        if exe is None:     # defensive: an un-precompiled odd size
+            exe = self._compile_add_many(k)
+            self._add_many_cache[k] = exe
+        return exe
+
+    def aot_chunk_coverage(self) -> dict:
+        """Precompiled chunk sizes vs the expected set — the compile
+        observability hook (mirrors Learner.aot_coverage)."""
+        expected = self._aot_chunk_sizes()
+        return {"expected": expected,
+                "compiled": sorted(self._add_many_cache),
+                "complete": all(k in self._add_many_cache
+                                for k in expected)}
 
     # -- producer side --
 
@@ -254,7 +487,41 @@ class ReplayService:
             return shard
 
     def add_blocks(self, blocks: List[Block]) -> List[int]:
-        return [self.add_block(b) for b in blocks]
+        """Route + commit a group of blocks. With ``ingest_batch_blocks``
+        > 1 (ISSUE 16) the group is routed in arrival order (the
+        round-robin counter advances exactly as sequential add_block
+        calls would), grouped by shard, and each per-shard run commits
+        through the AOT ``replay_add_many`` chunks — bit-identical
+        contents, one dispatch per chunk instead of per block. At the
+        default 1 this IS the sequential loop (byte-identity with
+        PR 15). Returns the routed shard per block, in input order."""
+        if self.ingest_k <= 1 or len(blocks) <= 1:
+            return [self.add_block(b) for b in blocks]
+        with self._lock:
+            t0 = time.perf_counter()
+            routed = [self.route_shard(b) for b in blocks]
+            groups: "OrderedDict[int, List[Block]]" = OrderedDict()
+            for shard, block in zip(routed, blocks):
+                groups.setdefault(shard, []).append(block)
+            stage_s = time.perf_counter() - t0
+            dispatches, commit_s = 0, 0.0
+            for shard, group in groups.items():
+                d, s, c = self.shards[shard].add_group(
+                    group, self._exe_for, self._max_chunk)
+                dispatches += d
+                stage_s += s
+                commit_s += c
+            self._ingest_iv[0] += len(blocks)
+            self._ingest_iv[1] += dispatches
+            self._ingest_iv[2] += stage_s
+            self._ingest_iv[3] += commit_s
+            return routed
+
+    def note_backlog(self, queued_blocks: int) -> None:
+        """Record the producer-side queue depth observed at the last
+        drain — the ``ingest_backlog`` alert's gauge (negative = the
+        transport can't report a depth; kept at 0)."""
+        self._backlog = max(int(queued_blocks), 0)
 
     # -- consumer side --
 
@@ -263,10 +530,13 @@ class ReplayService:
         (round-robin over shards, the dp learner's per-shard sampling
         order flattened). Spill promotion happens HERE, before the tree
         descent, so the returned ``idxes`` stay valid for the caller's
-        priority write-back as long as no add interleaves. Returns
-        (SampleBatch, shard_index, adds_snapshot) — the snapshot is the
-        write-back staleness token: the single-threaded in-proc loop
-        never moves it, but a SOCKET producer's add can land between
+        priority write-back as long as no add interleaves — unless
+        ``spill_prefetch`` moved promotion to the async write-back-time
+        pass (ISSUE 16), in which case the sample path is exactly
+        ``replay_sample``. Returns (SampleBatch, shard_index,
+        adds_snapshot) — the snapshot is the write-back staleness
+        token: the single-threaded in-proc loop never moves it, but a
+        SOCKET producer's add (or an async promotion) can land between
         sample and write-back, and the guard in
         :meth:`update_priorities` uses it to refuse writing the old
         batch's priorities onto a row a new block just took."""
@@ -276,39 +546,144 @@ class ReplayService:
                 self._rr_sample = (self._rr_sample + 1) % self.num_shards
                 if shard.ring.total_adds == 0:
                     continue
-                if self.promote_per_sample > 0:
+                if self.promote_per_sample > 0 and not self.spill_prefetch:
                     shard.promote(self.promote_per_sample)
                 return (shard.sample(key), shard.index,
                         shard.ring.total_adds)
         raise RuntimeError("ReplayService.sample on an empty service — "
                            "gate on all_shards_nonempty first")
 
+    def _update_one(self, sh: ReplayShard, idxes, td_errors,
+                    adds_snapshot: Optional[int]) -> None:
+        """One write-back under the held lock, with the PR-14 staleness
+        guard extended to route stale rows to spilled pages (ROADMAP
+        4a): a sampled row overwritten since its snapshot was — with the
+        spill tier on — demoted to a known page (``_demote_ids``), so
+        its new |TD| is written into the page's stored priorities
+        instead of being dropped; the remaining fresh rows are applied
+        through the SAME-SHAPE program (stale positions padded with a
+        duplicate of a fresh entry — an identical-value scatter, so the
+        result is deterministic and no per-count recompile exists).
+        Without the tier the PR-14 whole-batch drop is preserved
+        exactly."""
+        if adds_snapshot is not None:
+            delta = sh.ring.total_adds - adds_snapshot
+            if delta > 0:
+                n = sh.spec.num_blocks
+                if delta >= n:
+                    self.stale_writebacks += 1
+                    return      # the whole ring turned over
+                ptr0 = adds_snapshot % n
+                overwritten = {(ptr0 + j) % n for j in range(delta)}
+                spb = sh.spec.seqs_per_block
+                idxes_np = np.asarray(idxes)
+                rows = idxes_np // spb
+                stale = np.array([int(r) in overwritten for r in rows])
+                if stale.any():
+                    if not sh._retain:
+                        self.stale_writebacks += 1
+                        return
+                    td_np = np.asarray(td_errors)
+                    for i in np.nonzero(stale)[0]:
+                        slot = int(rows[i])
+                        seq = int(idxes_np[i]) % spb
+                        pid = sh._demote_ids[slot]
+                        if pid is not None and sh.spill.write_back(
+                                pid, seq, abs(float(td_np[i]))):
+                            self.spilled_writebacks += 1
+                        else:
+                            self.stale_rows_dropped += 1
+                    fresh = np.nonzero(~stale)[0]
+                    if fresh.size == 0:
+                        return
+                    sel = np.where(stale, fresh[0],
+                                   np.arange(idxes_np.shape[0]))
+                    sh.update_priorities(idxes_np[sel], td_np[sel])
+                    return
+        sh.update_priorities(idxes, td_errors)
+
     def update_priorities(self, shard: int, idxes, td_errors,
                           adds_snapshot: Optional[int] = None) -> None:
         """Write learner priorities back to ``shard``. With
-        ``adds_snapshot`` (the token :meth:`sample` returned), the
-        write-back is DROPPED — counted in ``stale_writebacks`` — when
-        any sampled row was overwritten by an add since the sample (the
-        reference worker's ring-pointer staleness guard, needed here
-        only when remote producers feed the service concurrently; the
-        drop degrades one batch toward its pre-update priorities, the
-        same accepted mode as the host path's backpressure drop)."""
+        ``adds_snapshot`` (the token :meth:`sample` returned), rows
+        overwritten by an add since the sample are guarded: dropped
+        whole-batch without the spill tier (counted in
+        ``stale_writebacks`` — the reference worker's ring-pointer
+        staleness guard), routed to their spilled pages with it
+        (``spilled_writebacks``; see :meth:`_update_one`)."""
+        with self._lock:
+            self._update_one(self.shards[shard], idxes, td_errors,
+                             adds_snapshot)
+        self._kick_prefetch(shard)
+
+    def update_priorities_group(
+            self, shard: int,
+            entries: List[Tuple[object, object, Optional[int]]]) -> None:
+        """Apply a batch of write-backs to ONE shard under a single lock
+        acquisition (the service stager's grouped write-back path).
+        Entries — (idxes, td_errors, adds_snapshot) — apply
+        SEQUENTIALLY, each with its own snapshot guard: concatenating
+        would change the update program's batch shape per group size
+        (a recompile per count) and reorder guard decisions; grouping
+        here buys the lock/dispatch locality, not a fused scatter."""
         with self._lock:
             sh = self.shards[shard]
-            if adds_snapshot is not None:
-                delta = sh.ring.total_adds - adds_snapshot
-                if delta > 0:
-                    n = sh.spec.num_blocks
-                    if delta >= n:
-                        self.stale_writebacks += 1
-                        return      # the whole ring turned over
-                    ptr0 = adds_snapshot % n
-                    overwritten = {(ptr0 + j) % n for j in range(delta)}
-                    rows = np.asarray(idxes) // sh.spec.seqs_per_block
-                    if any(int(r) in overwritten for r in rows):
-                        self.stale_writebacks += 1
-                        return
-            sh.update_priorities(idxes, td_errors)
+            for idxes, td_errors, adds_snapshot in entries:
+                self._update_one(sh, idxes, td_errors, adds_snapshot)
+        self._kick_prefetch(shard)
+
+    # -- async spill prefetch (ISSUE 16) --
+
+    def _kick_prefetch(self, shard: int) -> None:
+        """Queue a priority-ordered promotion pass for ``shard`` on the
+        service-owned background thread (lazy-started). Called at
+        write-back time — the natural moment: the learner just finished
+        a batch, so promotion latency lands OFF the sample path."""
+        if not self.spill_prefetch or self.promote_per_sample <= 0:
+            return
+        self._prefetch_pending.add(shard)
+        if self._prefetch_thread is None:
+            self._prefetch_thread = threading.Thread(
+                target=self._prefetch_loop, daemon=True,
+                name="replay-svc-prefetch")
+            self._prefetch_thread.start()
+        self._prefetch_event.set()
+
+    def _prefetch_loop(self) -> None:
+        while not self._prefetch_stop.is_set():
+            if not self._prefetch_event.wait(timeout=0.25):
+                continue
+            self._prefetch_event.clear()
+            while self._prefetch_pending and not self._prefetch_stop.is_set():
+                shard = self._prefetch_pending.pop()
+                self._prefetch_popped += 1
+                with self._lock:
+                    done = self.shards[shard].promote(
+                        self.promote_per_sample, by_priority=True)
+                    self._prefetch_iv += done
+                self._prefetch_done += 1
+
+    def drain_prefetch(self, timeout: float = 2.0) -> None:
+        """Block until the queued prefetch passes have RUN — pending set
+        empty AND no pass in flight (``_prefetch_done`` advances only
+        after a popped shard's promotion finishes, so a popped-but-not-
+        yet-promoted pass can't satisfy the drain). Test and shutdown
+        hook; the thread itself is free-running."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self._prefetch_pending \
+                    and self._prefetch_done >= self._prefetch_popped:
+                return
+            time.sleep(0.002)
+
+    def close(self) -> None:
+        """Stop the prefetch thread (idempotent; the shards themselves
+        hold no threads)."""
+        self._prefetch_stop.set()
+        self._prefetch_event.set()
+        if self._prefetch_thread is not None:
+            self._prefetch_thread.join(timeout=2.0)
+            self._prefetch_thread = None
 
     # -- accountant facade (the Learner's ring contract) --
 
@@ -351,7 +726,10 @@ class ReplayService:
 
     def interval_block(self) -> dict:
         """The record's ``replay_service`` shard/spill sub-blocks
-        (per-interval spill deltas reset on read)."""
+        (per-interval spill deltas reset on read). The ISSUE-16 keys —
+        the ``ingest`` sub-block and the spill prefetch gauges — appear
+        only when their planes are configured on, so a default-knob run
+        keeps the PR-15 record byte-identical."""
         fills = [s.fill for s in self.shards]
         interval = {"demotions": 0, "promotions": 0, "evictions": 0,
                     "thrash_frac": None}
@@ -369,7 +747,21 @@ class ReplayService:
         occ = sum(s.spill.occupancy for s in self.shards)
         hits = [s.spill.hit_rate for s in self.shards
                 if s.spill.hit_rate is not None]
-        return {
+        spill = {
+            "capacity": cap,
+            "occupancy": occ,
+            "occupancy_frac": (round(occ / cap, 4) if cap else 0.0),
+            "hit_rate": (round(float(np.mean(hits)), 4)
+                         if hits else None),
+            **interval,
+        }
+        if self.spill_prefetch:
+            spill["prefetch"] = True
+            spill["prefetch_promotions"] = self._prefetch_iv
+            self._prefetch_iv = 0
+            spill["spilled_writebacks"] = self.spilled_writebacks
+            spill["stale_rows_dropped"] = self.stale_rows_dropped
+        out = {
             "shards": {
                 "n": self.num_shards,
                 "route": self.route,
@@ -380,15 +772,24 @@ class ReplayService:
                 "live_blocks": [s.live_blocks for s in self.shards],
                 "stale_writebacks": self.stale_writebacks,
             },
-            "spill": {
-                "capacity": cap,
-                "occupancy": occ,
-                "occupancy_frac": (round(occ / cap, 4) if cap else 0.0),
-                "hit_rate": (round(float(np.mean(hits)), 4)
-                             if hits else None),
-                **interval,
-            },
+            "spill": spill,
         }
+        if self.ingest_k > 1:
+            blocks, dispatches, stage_s, commit_s = self._ingest_iv
+            self._ingest_iv = [0, 0, 0.0, 0.0]
+            out["ingest"] = {
+                "batch_blocks": self.ingest_k,
+                "blocks": blocks,
+                "dispatches": dispatches,
+                "blocks_per_dispatch": (round(blocks / dispatches, 2)
+                                        if dispatches else None),
+                "stage_ms": round(stage_s * 1e3, 3),
+                "commit_ms": round(commit_s * 1e3, 3),
+                "backlog": self._backlog,
+                "spilled_writebacks": self.spilled_writebacks,
+                "stale_rows_dropped": self.stale_rows_dropped,
+            }
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -398,23 +799,46 @@ class ReplayService:
 
 class ReplayServiceServer:
     """TCP listener feeding a ReplayService: one reader thread per
-    producer connection; each ``("add", field_dict)`` frame is routed
-    through :meth:`ReplayService.add_block` and acked with the shard it
-    landed in (producers can assert routing end-to-end)."""
+    producer connection. Two frame dialects share the wire:
+
+      * ``("add", field_dict)`` — PR 15's per-block lockstep, acked
+        ``("ack", shard)`` with the shard it landed in (producers can
+        assert routing end-to-end);
+      * ``("addw", seq, inflight, k, stacked_fields)`` — ISSUE 16's
+        windowed rung: one frame carries a K-stacked group (leading
+        axis K on every field), committed through
+        :meth:`ReplayService.add_blocks` (the grouped dispatch plane)
+        and acked ``("ackw", seq, k)`` — CUMULATIVE: an ack for seq
+        confirms every frame ≤ seq on that connection (frames process
+        in order), so a dropped ack is absorbed by the next one.
+        ``("flushw", seq)`` is ALWAYS acked (never subject to the drop
+        injection) — the producer's resync point.
+
+    ``drop_ack_every`` > 0 drops every Nth DATA ack (the chaos
+    grammar's ``drop_ack@every=N`` injection) to drill the cumulative
+    semantics."""
 
     def __init__(self, service: ReplayService, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, drop_ack_every: int = 0):
         import socket
 
         from r2d2_tpu.serve.transport import recv_frame, send_frame
         self._recv_frame, self._send_frame = recv_frame, send_frame
         self.service = service
+        self.drop_ack_every = int(drop_ack_every)
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.25)
         self.host, self.port = self._sock.getsockname()[:2]
         self._stop = threading.Event()
         self._conns: list = []
         self.blocks_received = 0
+        self.acks_dropped = 0
+        self._stats_lock = threading.Lock()
+        # per-interval socket gauges: frames, blocks, max in-flight
+        # window occupancy observed (the producer stamps its depth into
+        # every addw frame), acks dropped by injection
+        self._socket_iv = [0, 0, 0, 0]
+        self._data_frames = 0
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True, name="replay-svc-accept")
         self._thread.start()
@@ -428,24 +852,56 @@ class ReplayServiceServer:
                 continue
             except OSError:
                 return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn.settimeout(None)
             self._conns.append(conn)
             threading.Thread(target=self._reader_loop, args=(conn,),
                              daemon=True, name="replay-svc-conn").start()
+
+    def _note_frame(self, blocks: int, inflight: int) -> None:
+        with self._stats_lock:
+            self.blocks_received += blocks
+            self._socket_iv[0] += 1
+            self._socket_iv[1] += blocks
+            self._socket_iv[2] = max(self._socket_iv[2], inflight)
+
+    def _drop_this_ack(self) -> bool:
+        if self.drop_ack_every <= 0:
+            return False
+        with self._stats_lock:
+            self._data_frames += 1
+            if self._data_frames % self.drop_ack_every == 0:
+                self.acks_dropped += 1
+                self._socket_iv[3] += 1
+                return True
+        return False
 
     def _reader_loop(self, conn) -> None:
         import pickle
         lock = threading.Lock()
         try:
             while not self._stop.is_set():
-                kind, payload = self._recv_frame(conn)
-                if kind != "add":
-                    continue
-                block = Block(**{k: np.asarray(v)
-                                 for k, v in payload.items()})
-                shard = self.service.add_block(block)
-                self.blocks_received += 1
-                self._send_frame(conn, ("ack", shard), lock)
+                frame = self._recv_frame(conn)
+                kind = frame[0]
+                if kind == "add":
+                    _, payload = frame
+                    block = Block(**{k: np.asarray(v)
+                                     for k, v in payload.items()})
+                    shard = self.service.add_block(block)
+                    self._note_frame(1, 1)
+                    self._send_frame(conn, ("ack", shard), lock)
+                elif kind == "addw":
+                    _, seq, inflight, k, fields = frame
+                    blocks = [Block(**{name: np.asarray(v[i])
+                                       for name, v in fields.items()})
+                              for i in range(k)]
+                    self.service.add_blocks(blocks)
+                    self._note_frame(k, inflight)
+                    if not self._drop_this_ack():
+                        self._send_frame(conn, ("ackw", seq, k), lock)
+                elif kind == "flushw":
+                    _, seq = frame
+                    self._send_frame(conn, ("ackw", seq, 0), lock)
         except (ConnectionError, OSError, EOFError, pickle.PickleError):
             pass
         finally:
@@ -453,6 +909,17 @@ class ReplayServiceServer:
                 conn.close()
             except OSError:
                 pass
+
+    def interval_stats(self) -> dict:
+        """Per-interval socket gauges (reset on read) — merged into the
+        record's ``replay_service.socket`` sub-block by the
+        orchestrator."""
+        with self._stats_lock:
+            frames, blocks, window_max, dropped = self._socket_iv
+            self._socket_iv = [0, 0, 0, 0]
+        return {"frames": frames, "blocks": blocks,
+                "window_max": window_max, "acks_dropped": dropped,
+                "blocks_total": self.blocks_received}
 
     def close(self) -> None:
         self._stop.set()
@@ -469,15 +936,25 @@ class ReplayServiceServer:
 
 
 class RemoteReplayProducer:
-    """Producer-side socket channel: ``add_block`` ships one block and
-    returns the shard the service routed it to. Lazily (re)dials like
+    """Producer-side socket channel. ``add_block`` is PR 15's lockstep
+    rung (one frame, one blocking ack — routing-assertable).
+    ``add_blocks`` / ``add_stacked`` are the ISSUE-16 windowed rung: one
+    ``addw`` frame per stacked group, up to ``window`` unacked frames in
+    flight, cumulative acks reaped at the window bound (back-pressure)
+    and on :meth:`flush`. Lazily (re)dials like
     serve/transport.SocketChannel."""
 
-    def __init__(self, host: str, port: int, dial_timeout: float = 2.0):
+    def __init__(self, host: str, port: int, dial_timeout: float = 2.0,
+                 window: int = 1):
         self._addr = (host, port)
         self._dial_timeout = dial_timeout
+        self.window = max(int(window), 1)
         self._sock = None
         self._lock = threading.Lock()
+        self._seq = 0
+        self._inflight: "deque[Tuple[int, int]]" = deque()
+        self.frames_sent = 0
+        self.blocks_acked = 0
         from r2d2_tpu.serve.transport import recv_frame, send_frame
         self._recv_frame, self._send_frame = recv_frame, send_frame
 
@@ -486,6 +963,12 @@ class RemoteReplayProducer:
         if self._sock is None:
             s = socket.create_connection(self._addr,
                                          timeout=self._dial_timeout)
+            # Windowed frames interleave large data writes one way with
+            # small cumulative acks the other; Nagle holding an ack
+            # behind the peer's delayed ACK stalls the pipeline ~40 ms
+            # per occurrence. Frames are whole sendall() calls, so
+            # nothing is gained by coalescing.
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             s.settimeout(self._dial_timeout)
             self._sock = s
         return self._sock
@@ -500,6 +983,76 @@ class RemoteReplayProducer:
             raise ConnectionError(f"unexpected reply kind {kind!r}")
         return int(shard)
 
+    def add_blocks(self, blocks: List[Block], timeout: float = 5.0) -> None:
+        """Ship a group of blocks as ONE windowed frame (fields stacked
+        on a new leading axis)."""
+        if not blocks:
+            return
+        fields = {name: np.stack([np.asarray(getattr(b, name))
+                                  for b in blocks])
+                  for name in blocks[0].__dataclass_fields__
+                  if getattr(blocks[0], name) is not None}
+        self._send_windowed(fields, len(blocks), timeout)
+
+    def add_stacked(self, stacked: Block, k: int,
+                    timeout: float = 5.0) -> None:
+        """Ship an already-stacked group (leading axis ``k`` on every
+        field — feeder.BlockQueue.drain_stacked's native layout, so the
+        shm fast path reaches the wire without restacking)."""
+        if k <= 0:
+            return
+        self._send_windowed(_block_fields(stacked), k, timeout)
+
+    def _send_windowed(self, fields, k: int, timeout: float) -> None:
+        sock = self._ensure()
+        sock.settimeout(timeout)
+        self._seq += 1
+        self._send_frame(
+            sock, ("addw", self._seq, len(self._inflight), k, fields),
+            self._lock)
+        self._inflight.append((self._seq, k))
+        self.frames_sent += 1
+        while len(self._inflight) >= self.window:
+            self._await_ack(sock)
+
+    def _await_ack(self, sock) -> None:
+        """Reap one cumulative ack: pops every in-flight frame ≤ the
+        acked seq (a dropped ack is covered by the next). On a recv
+        timeout a flush probe is sent once — the server always acks
+        flushes, so a window stalled behind a dropped final ack
+        self-heals instead of deadlocking."""
+        import socket as _socket
+        try:
+            frame = self._recv_frame(sock)
+        except _socket.timeout:
+            self._seq += 1
+            self._send_frame(sock, ("flushw", self._seq), self._lock)
+            self._inflight.append((self._seq, 0))
+            frame = self._recv_frame(sock)
+        kind, seq, _k = frame
+        if kind != "ackw":
+            raise ConnectionError(f"unexpected reply kind {kind!r}")
+        while self._inflight and self._inflight[0][0] <= seq:
+            _, nblocks = self._inflight.popleft()
+            self.blocks_acked += nblocks
+
+    def flush(self, timeout: float = 5.0) -> int:
+        """Drain the in-flight window: one always-acked flush frame,
+        then reap until empty. Returns cumulative blocks acked."""
+        if self._sock is not None:
+            sock = self._sock
+            sock.settimeout(timeout)
+            self._seq += 1
+            self._send_frame(sock, ("flushw", self._seq), self._lock)
+            self._inflight.append((self._seq, 0))
+            while self._inflight:
+                self._await_ack(sock)
+        return self.blocks_acked
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
     def close(self) -> None:
         if self._sock is not None:
             try:
@@ -507,6 +1060,74 @@ class RemoteReplayProducer:
             except OSError:
                 pass
             self._sock = None
+        self._inflight.clear()
+
+
+class ReplayProducerPump:
+    """Producer-side emit pump: drains an actor fleet's BlockQueue in
+    stacked groups (``drain_stacked`` — the shm transport stacks
+    natively, mp/thread queues fall back to np.stack) and ships each
+    group as one windowed frame through a :class:`RemoteReplayProducer`.
+    This is the socket rung's feeder half for a producer-only host
+    (parallel/multihost.run_replay_producer): the actors never learn
+    that replay is remote — they emit into the same queue, the pump
+    turns queue depth into frames."""
+
+    def __init__(self, queue, producer: RemoteReplayProducer,
+                 group: int = 8, idle_sleep_s: float = 0.002):
+        self.queue = queue
+        self.producer = producer
+        self.group = max(int(group), 1)
+        self.idle_sleep_s = idle_sleep_s
+        self.blocks_sent = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def pump_once(self) -> int:
+        """Drain up to one group and ship it; returns blocks shipped
+        (0 = the queue was empty)."""
+        stacked, k = self.queue.drain_stacked(self.group)
+        if k == 0:
+            return 0
+        if k == 1 and self.producer.window <= 1:
+            # degenerate shape: the lockstep rung's exact cadence
+            import jax
+            block = jax.tree_util.tree_map(lambda x: np.asarray(x)[0],
+                                           stacked)
+            self.producer.add_block(block)
+        else:
+            self.producer.add_stacked(stacked, k)
+        self.blocks_sent += k
+        return k
+
+    def run(self, stop: Optional[threading.Event] = None,
+            seconds: Optional[float] = None) -> int:
+        """Pump until ``stop`` is set (and the queue is drained) or
+        ``seconds`` elapse; flushes the window on exit. Returns blocks
+        shipped."""
+        stop = stop or self._stop
+        deadline = (time.monotonic() + seconds) if seconds else None
+        while True:
+            n = self.pump_once()
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if n == 0:
+                if stop.is_set():
+                    break
+                time.sleep(self.idle_sleep_s)
+        self.producer.flush()
+        return self.blocks_sent
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="replay-producer-pump")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 def _block_fields(block: Block) -> Dict[str, np.ndarray]:

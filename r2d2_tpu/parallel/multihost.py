@@ -1385,6 +1385,47 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
 
 
 # ---------------------------------------------------------------------------
+# Producer-only host (ISSUE 16): actors on a host with NO replay shards
+# emit into the usual BlockQueue; this pump drains stacked groups and
+# ships them over the replay service's socket rung.  Config validation
+# rejects fleet.replay_shards x mesh.multihost (the sharded service is a
+# single-controller plane), so a multihost fleet reaches a remote
+# ReplayService exclusively through this producer-side wiring — the
+# learner host runs the service + ReplayServiceServer, producer hosts
+# run their actor loops plus run_replay_producer against it.
+
+
+def run_replay_producer(queue, host: str, port: int, *,
+                        window: int = 1, group: int = 8,
+                        stop: Optional[threading.Event] = None,
+                        seconds: Optional[float] = None) -> dict:
+    """Drain ``queue`` (a runtime.feeder.BlockQueue fed by this host's
+    actor fleet) into the remote ReplayService at ``host:port`` until
+    ``stop`` is set or ``seconds`` elapse.
+
+    ``group`` is the stacked-frame size (mirrors
+    ``fleet.ingest_batch_blocks`` on the serving side: one frame becomes
+    one grouped ingest dispatch there) and ``window`` the pipelined
+    in-flight frame bound (``fleet.socket_window``).  Blocks ship in
+    arrival order, so the server-side routing (round-robin or lane) sees
+    the exact sequence a local fleet would have produced.  Returns
+    {"blocks_sent", "frames_sent", "blocks_acked"} — acked==sent after
+    the final flush unless the connection died."""
+    from r2d2_tpu.fleet.replay_service import (RemoteReplayProducer,
+                                               ReplayProducerPump)
+    producer = RemoteReplayProducer(host, port, window=window)
+    pump = ReplayProducerPump(queue, producer, group=group)
+    try:
+        pump.run(stop=stop, seconds=seconds)
+    finally:
+        stats = {"blocks_sent": pump.blocks_sent,
+                 "frames_sent": producer.frames_sent,
+                 "blocks_acked": producer.blocks_acked}
+        producer.close()
+    return stats
+
+
+# ---------------------------------------------------------------------------
 # Loopback demo/validation: N controller processes on one machine, virtual
 # CPU devices, fake env — the full rank-aware loop end-to-end (the test in
 # tests/test_parallel.py runs this).

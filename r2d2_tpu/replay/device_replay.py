@@ -151,8 +151,10 @@ def replay_add_many(spec: ReplaySpec, state: ReplayState,
         raise ValueError(
             f"replay_add_many got {k} blocks but the ring has only "
             f"{spec.num_blocks} rows — scatter rows would alias; cap "
-            "replay.ingest_batch_blocks (or the per-shard "
-            "actor.anakin_lanes lane group) at num_blocks")
+            "replay.ingest_batch_blocks / fleet.ingest_batch_blocks "
+            "(or the per-shard actor.anakin_lanes lane group) at "
+            "num_blocks — note a sharded service ring has only "
+            "num_blocks // fleet.replay_shards rows per shard")
     ptr = state.block_ptr
     rows = (ptr + jnp.arange(k, dtype=jnp.int32)) % spec.num_blocks
     idxes = (rows[:, None] * spec.seqs_per_block

@@ -185,7 +185,14 @@ class HostReplay:
         """Drop updates for ring slots overwritten since the sample was taken
         (ref worker.py:196-206). ``adds_snapshot`` is the total_adds value
         returned by sample(); being monotonic it detects full ring laps that
-        raw pointer comparison cannot."""
+        raw pointer comparison cannot.
+
+        This host ring DROPS stale rows outright (they left the buffer
+        for good). The sharded service (fleet/replay_service.py) keeps
+        the same mask shape but, when a spill tier retains evicted
+        blocks, ROUTES stale rows to the demoted page's priority array
+        instead of dropping them — a promoted page then re-enters the
+        ring with the learner's freshest TD estimates."""
         spec = self.spec
         idxes = np.asarray(idxes, np.int64)
         td_errors = np.asarray(td_errors, np.float64)

@@ -533,6 +533,22 @@ class BlockQueue:
             lambda *xs: np.stack([np.asarray(x) for x in xs]), *blocks)
         return stacked, len(blocks)
 
+    def drain_groups(self, group: int, max_groups: int = 4):
+        """Non-blocking drain as a LIST of stacked groups, each of up to
+        ``group`` blocks: [(stacked_block, k), ...] in arrival order.
+        This is the producer-pump shape (fleet.ReplayProducerPump): a
+        deep backlog becomes several window-sized frames in one pass
+        instead of one oversized frame, so the socket rung's pipelining
+        (fleet.socket_window) has frames to overlap. Returns [] when the
+        queue is empty."""
+        groups = []
+        for _ in range(max(int(max_groups), 1)):
+            stacked, k = self.drain_stacked(group)
+            if k == 0:
+                break
+            groups.append((stacked, k))
+        return groups
+
     def qsize(self) -> int:
         """Best-effort queue depth; -1 when the backend cannot say (the
         ingest stager then drains without accumulation/bucketing)."""

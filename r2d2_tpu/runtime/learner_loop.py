@@ -21,7 +21,7 @@ batches over RPC and a train thread running torch ops
 import queue as queue_mod
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 import jax
 import numpy as np
@@ -106,7 +106,24 @@ class Learner:
                 shard_spec, cfg.fleet.replay_shards,
                 spill_blocks=cfg.fleet.spill_blocks,
                 route=cfg.fleet.replay_route,
-                promote_per_sample=cfg.fleet.spill_promote_per_sample)
+                promote_per_sample=cfg.fleet.spill_promote_per_sample,
+                ingest_batch_blocks=cfg.fleet.ingest_batch_blocks,
+                spill_prefetch=cfg.fleet.spill_prefetch)
+            # service-mode sample staging (ISSUE 16): the PR-2 stager
+            # treatment for the consumer side — a prefetch thread draws
+            # the next per-shard batch while the train dispatch runs,
+            # and priority write-backs batch per sampled shard on a
+            # writeback thread (off = the synchronous PR-15 step,
+            # byte-identical)
+            self._svc_staging = cfg.fleet.sample_staging
+            if self._svc_staging:
+                self._svc_error: Optional[BaseException] = None
+                self._svc_prefetch_q: queue_mod.Queue = queue_mod.Queue(
+                    maxsize=2)
+                self._svc_writeback_q: queue_mod.Queue = queue_mod.Queue(
+                    maxsize=64)
+                self._svc_stop = threading.Event()
+                self._svc_threads: list = []
             # one service-sampled batch per step — same degradation the
             # host branch warns about, made equally loud here
             if cfg.runtime.steps_per_dispatch > 1:
@@ -426,8 +443,22 @@ class Learner:
         t0 = time.time()
         blocks = queue.drain(max_items)
         t_get = time.time()
-        for blk in blocks:
-            self.ingest(blk)
+        if (self.service is not None and self.service.ingest_k > 1
+                and len(blocks) > 1):
+            # grouped service ingest (ISSUE 16): one routed add_blocks
+            # call commits the whole drain through per-shard
+            # replay_add_many chunks — bit-identical contents, one
+            # dispatch per chunk instead of per block. The
+            # orchestrator's warm-up loop reaches this through the same
+            # drain(), so bring-up bursts get the grouped plane too.
+            self._ingest_group(blocks)
+        else:
+            for blk in blocks:
+                self.ingest(blk)
+        if self.service is not None and self.service.ingest_k > 1:
+            # producer-side depth left behind this drain — the
+            # ingest_backlog alert's gauge (qsize -1 = unknown -> 0)
+            self.service.note_backlog(queue.qsize())
         if blocks:
             t1 = time.time()
             self.metrics.on_ingest_drain(len(blocks), t1 - t0)
@@ -437,6 +468,19 @@ class Learner:
             tele.record_span("ingest/commit", t0, t1,
                              {"blocks": len(blocks)})
         return len(blocks)
+
+    def _ingest_group(self, blocks: List[Block]) -> None:
+        """Grouped service commit with the same per-block accounting the
+        sequential :meth:`ingest` loop performs (env steps, episode
+        returns, buffer gauge) — the ring facade's totals advance inside
+        the service exactly as K sequential adds would."""
+        self.service.add_blocks(blocks)
+        for block in blocks:
+            learning = int(np.asarray(block.learning_steps).sum())
+            self.env_steps += learning
+            ret = float(np.asarray(block.sum_reward))
+            self.metrics.on_block(learning, None if np.isnan(ret) else ret)
+        self.metrics.set_buffer_size(self.ring.buffer_steps)
 
     # -- pipelined ingestion (stager thread + commit) --
 
@@ -741,6 +785,24 @@ class Learner:
                 stuck.append(self._stager.name)
             else:
                 self._stager = None
+        if self.service is not None:
+            # service stager threads (ISSUE 16 sample staging) + the
+            # service's own prefetch thread; both no-ops when off
+            if self._svc_staging and self._svc_threads:
+                self._svc_stop.set()
+                for t in self._svc_threads:
+                    deadline = time.time() + join_timeout
+                    while t.is_alive() and time.time() < deadline:
+                        try:
+                            self._svc_prefetch_q.get_nowait()
+                        except queue_mod.Empty:
+                            pass
+                        t.join(timeout=0.1)
+                    if t.is_alive():
+                        stuck.append(t.name)
+                self._svc_threads = [t for t in self._svc_threads
+                                     if t.is_alive()]
+            self.service.close()
         if not self.host_mode:
             if stuck:
                 import logging
@@ -796,7 +858,93 @@ class Learner:
             self.metrics.on_dropped_priority_update()
         return m
 
-    # -- service-mode step (ISSUE 15) --
+    # -- service-mode step (ISSUE 15; ISSUE 16 sample staging) --
+
+    def _start_service_stager(self) -> None:
+        """fleet.sample_staging: the host-placement pipeline's shape on
+        the service path — a prefetch thread draws the next prioritized
+        batch (service.sample is already device-resident, so staging
+        hides the sample/promotion latency, not a transfer) and a
+        writeback thread applies priority updates grouped per sampled
+        shard (one lock acquisition per group via
+        service.update_priorities_group; each entry keeps its own
+        adds-snapshot staleness guard)."""
+        def prefetch():
+            try:
+                while not self._svc_stop.is_set():
+                    self._service_key, key = jax.random.split(
+                        self._service_key)
+                    t0 = time.time()
+                    staged = self.service.sample(key)
+                    self.tele.observe("learner/sample", time.time() - t0)
+                    while not self._svc_stop.is_set():
+                        try:
+                            self._svc_prefetch_q.put(staged, timeout=0.5)
+                            break
+                        except queue_mod.Full:
+                            continue
+            except BaseException as e:  # surfaced by _service_step_staged
+                self._svc_error = e
+                raise
+
+        def writeback():
+            try:
+                while not self._svc_stop.is_set():
+                    try:
+                        first = self._svc_writeback_q.get(timeout=0.5)
+                    except queue_mod.Empty:
+                        continue
+                    entries = [first]
+                    while True:     # batch whatever is immediately ready
+                        try:
+                            entries.append(self._svc_writeback_q.get_nowait())
+                        except queue_mod.Empty:
+                            break
+                    groups: dict = {}
+                    for shard, idxes, prios, snapshot in entries:
+                        groups.setdefault(shard, []).append(
+                            (np.asarray(idxes),
+                             np.asarray(jax.device_get(prios)), snapshot))
+                    t0 = time.time()
+                    for shard, group in groups.items():
+                        self.service.update_priorities_group(shard, group)
+                    self.tele.observe("learner/priority_writeback",
+                                      time.time() - t0)
+            except BaseException as e:
+                self._svc_error = e
+                raise
+
+        for fn, name in ((prefetch, "svc-prefetch"),
+                         (writeback, "svc-writeback")):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"learner-{name}-p{self.player_idx}")
+            t.start()
+            self._svc_threads.append(t)
+
+    def _service_step_staged(self) -> dict:
+        if not self._svc_threads:
+            self._start_service_stager()
+        while True:
+            try:
+                batch, shard, snapshot = self._svc_prefetch_q.get(
+                    timeout=2.0)
+                break
+            except queue_mod.Empty:
+                # fail loudly instead of hanging if a stager thread died
+                if self._svc_error is not None:
+                    raise RuntimeError(
+                        "service stager thread died") from self._svc_error
+                if not any(t.is_alive() for t in self._svc_threads):
+                    raise RuntimeError(
+                        "service stager threads exited without error")
+        self.train_state, m = self._step_fn(self.train_state, batch)
+        try:
+            self._svc_writeback_q.put_nowait(
+                (shard, batch.idxes, m.pop("priorities"), snapshot))
+        except queue_mod.Full:
+            m.pop("priorities", None)   # drop under backpressure — counted
+            self.metrics.on_dropped_priority_update()
+        return m
 
     def _service_step_once(self) -> dict:
         """Disaggregated consumer loop: draw one prioritized batch from
@@ -810,6 +958,8 @@ class Learner:
         written onto the overwriting block). Spill promotion happens
         inside service.sample BEFORE the tree descent, keeping the
         returned idxes valid for this write-back."""
+        if self._svc_staging:
+            return self._service_step_staged()
         self._service_key, key = jax.random.split(self._service_key)
         t0 = time.time()
         batch, shard, snapshot = self.service.sample(key)

@@ -256,9 +256,14 @@ class TrainMetrics:
         """Attach the replay_service-block provider (ISSUE 15): a
         callable returning the elastic-fleet telemetry dict — per-shard
         fill/adds, spill-tier occupancy + hit-rate + interval thrash,
-        fan-out relay depth/lag, membership lease counts. Called once
-        per log(); None returns omit the block (consumers key on its
-        presence)."""
+        fan-out relay depth/lag, membership lease counts. ISSUE 16 adds
+        key-gated sub-blocks the provider emits only when their feature
+        is on (record-schema byte-identity at defaults): "ingest"
+        (grouped-dispatch counters + backlog — the ingest_backlog alert
+        rule reads replay_service.ingest.backlog from here), "socket"
+        (windowed-frame server stats), and spill prefetch/write-back
+        counters inside "spill". Called once per log(); None returns
+        omit the block (consumers key on its presence)."""
         self._replay_service_fn = provider
 
     def set_resources(self, provider) -> None:

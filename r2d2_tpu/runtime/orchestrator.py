@@ -731,6 +731,10 @@ class PlayerStack:
         block = {}
         if self.learner.service is not None:
             block.update(self.learner.service.interval_block())
+        if self._service_server is not None:
+            # windowed socket rung (ISSUE 16): per-interval frame/block
+            # counts, max in-flight window occupancy, injected ack drops
+            block["socket"] = self._service_server.interval_stats()
         if self._fanout is not None:
             block["fanout"] = self._fanout.stats()
         elif self._shm_fanout is not None:

@@ -236,6 +236,15 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("orphaned_slot", "threshold",
                   ("replay_service", "membership", "orphaned"),
                   tcfg.alerts_orphaned_slots, "crit"),
+        # batched service ingest (ISSUE 16; the replay_service.ingest
+        # sub-block — present only with fleet.ingest_batch_blocks > 1):
+        # blocks left queued behind the service's grouped drain —
+        # producers burst faster than the dispatch plane commits, so
+        # experience ages in the feeder queue before ever becoming
+        # samplable
+        AlertRule("ingest_backlog", "threshold",
+                  ("replay_service", "ingest", "backlog"),
+                  tcfg.alerts_ingest_backlog, "warn"),
     )
 
 

@@ -601,12 +601,21 @@ def gate_config():
     return Config().replace(**GATE_OVERRIDES)
 
 
+_gate_table_cache: Optional[Dict[str, Any]] = None
+
+
 def gate_table() -> Dict[str, Any]:
     """The gated cost table: the pinned fixture config through every
     step-factory variant, in production (scan) form. Deterministic for a
-    given jax/XLA build + backend; `make regress` runs it CPU-pinned."""
-    return collect_cost_table(gate_config(), variants=GATE_VARIANTS,
-                              unroll_scans=False)
+    given jax/XLA build + backend; `make regress` runs it CPU-pinned.
+    Memoized per process — the ~20-30 s of tiny-config compiles are a
+    pure function of the checked-out code, and the regress-gate tests
+    drive the CLI's main() several times in one process."""
+    global _gate_table_cache
+    if _gate_table_cache is None:
+        _gate_table_cache = collect_cost_table(
+            gate_config(), variants=GATE_VARIANTS, unroll_scans=False)
+    return _gate_table_cache
 
 
 def compare_cost_tables(baseline: Dict[str, Any], current: Dict[str, Any],

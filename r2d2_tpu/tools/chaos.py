@@ -14,6 +14,9 @@ synthetic stubs. This module is that exerciser:
         1:crash@block=3     slot 1 raises on its 3rd block emit (1-based)
         2:hang@block=5      slot 2 wedges forever at its 5th emit
         0:slow@factor=4     slot 0's emit interval stretched 4x (alias 0:slowx4)
+        0:drop_ack@every=3  replay-service server drops every 3rd data ack
+                            (ISSUE 16 — feed spec.block into
+                            ReplayServiceServer(drop_ack_every=...))
 
   * ``apply_fault``: wraps a block sink with one fault. Injection lives at
     the sink because every actor loop funnels through it — the one
@@ -31,7 +34,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
-_KINDS = ("crash", "hang", "slow", "disconnect", "leave", "join")
+_KINDS = ("crash", "hang", "slow", "disconnect", "leave", "join",
+          "drop_ack")
 # kinds that inject at the worker's BLOCK SINK vs at its SERVE CLIENT
 # (actor.inference="server"): crash/hang are about the worker process
 # and stay at the sink either way; slow moves to the request path in
@@ -165,6 +169,22 @@ def parse_fault_spec(spec: str) -> Dict[int, FaultSpec]:
                 raise ValueError(
                     f"fault_spec entry {entry!r}: req must be >= 1")
             faults[slot] = FaultSpec("disconnect", block=req)
+        elif kind == "drop_ack":
+            # replay-service socket fault (ISSUE 16): the server drops
+            # every Nth DATA ack so the windowed producer's cumulative
+            # acks must heal the gap via its flush probe — tests feed
+            # spec.block into ReplayServiceServer(drop_ack_every=...)
+            try:
+                every = int(kv.get("every", ""))
+            except ValueError:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: drop_ack needs "
+                    "@every=N (drop every Nth replay-service data "
+                    "ack)") from None
+            if every < 1:
+                raise ValueError(
+                    f"fault_spec entry {entry!r}: every must be >= 1")
+            faults[slot] = FaultSpec("drop_ack", block=every)
         else:
             try:
                 factor = float(kv.get("factor", ""))

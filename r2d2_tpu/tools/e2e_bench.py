@@ -928,6 +928,190 @@ def run_elastic_ab(seconds: float, overrides: Optional[dict] = None,
     return out
 
 
+def _synth_service_blocks(spec, n: int, seed: int = 0) -> list:
+    """Synthetic filled block records at ``spec``'s exact layout (the
+    socket/spill cells need wire-shaped payloads, not real episodes):
+    positive priorities so the sampled tree is well-formed, stamped
+    learning steps so the accountant advances."""
+    from r2d2_tpu.replay.structs import Block, empty_block_np
+    rng = np.random.default_rng(seed)
+    proto = empty_block_np(spec)
+    blocks = []
+    for i in range(n):
+        fields = {k: v.copy() for k, v in proto.items()}
+        fields["priority"] = np.abs(rng.normal(
+            1.0, 0.5, spec.seqs_per_block)).astype(np.float32) + 1e-3
+        fields["learning_steps"] = np.full(
+            (spec.seqs_per_block,), spec.learning, np.int32)
+        fields["num_sequences"] = np.asarray(spec.seqs_per_block, np.int32)
+        fields["weight_version"] = np.asarray(i, np.int32)
+        blocks.append(Block(**fields))
+    return blocks
+
+
+def run_service_ingest_ab(seconds: float, overrides: Optional[dict] = None,
+                          repeats: int = 3, num_actors: int = 4,
+                          lanes_per_actor: int = 4,
+                          ingest_blocks: int = 8,
+                          socket_window: int = 4) -> dict:
+    """Batched/pipelined service data-plane A/B (ISSUE 16 acceptance),
+    three cells in one artifact:
+
+      * **socket rung** — an in-proc ReplayService behind its TCP
+        server, one remote producer pushing a fixed synthetic block
+        budget: per-block lockstep frames (PR 15's rung) vs stacked
+        windowed frames (one ``addw`` frame per group of
+        ``ingest_blocks``, ``socket_window`` unacked frames in flight)
+        into a grouped-ingest service. ABBA-interleaved ``repeats``
+        with per-arm medians; ``socket_speedup`` is the >= 1.3x
+        headline (frame count and ack round-trips both drop ~Kx).
+      * **e2e arms** — the SAME service-routed thread-mode system
+        (``fleet.replay_shards=2``) at ``fleet.ingest_batch_blocks``
+        1 vs ``ingest_blocks``: ``learner_steps_ratio_ingest`` bounds
+        the grouped commit plane's cost on the training path (>= 0.98
+        acceptance).
+      * **spill prefetch** — a populated spill tier sampled under
+        inline promotion vs the async priority-ordered prefetch
+        (``fleet.spill_prefetch``): median sample-path latency per arm;
+        ``prefetch_sample_speedup`` >= 1 means moving promotion off the
+        sample path never cost latency."""
+    from r2d2_tpu.fleet.replay_service import (RemoteReplayProducer,
+                                               ReplayService,
+                                               ReplayServiceServer)
+    from r2d2_tpu.replay.structs import ReplaySpec
+
+    base = dict(overrides or {})
+    out: dict = {}
+
+    # -- socket-rung producer cell ---------------------------------------
+    spec = ReplaySpec(
+        num_blocks=64, seqs_per_block=4, block_length=20, burn_in=4,
+        learning=5, forward=3, frame_stack=2, frame_height=12,
+        frame_width=12, hidden_dim=16, batch_size=16, prio_exponent=0.9,
+        is_exponent=0.6, replay_diag=False)
+    n_blocks = 192
+    blocks = _synth_service_blocks(spec, n_blocks)
+    cells = {"per_block": [], "batched": []}
+
+    def socket_arm(batched: bool) -> float:
+        svc = ReplayService(spec, 2, ingest_batch_blocks=(
+            ingest_blocks if batched else 1))
+        server = ReplayServiceServer(svc)
+        producer = RemoteReplayProducer(
+            server.host, server.port,
+            window=(socket_window if batched else 1))
+        try:
+            t0 = time.perf_counter()
+            if batched:
+                for i in range(0, n_blocks, ingest_blocks):
+                    producer.add_blocks(blocks[i:i + ingest_blocks])
+                producer.flush()
+            else:
+                for blk in blocks:
+                    producer.add_block(blk)
+            dt = time.perf_counter() - t0
+            assert server.blocks_received == n_blocks
+            return n_blocks / dt
+        finally:
+            producer.close()
+            server.close()
+
+    # One untimed pass per arm first: the grouped arm's first run pays the
+    # replay_add_many AOT chunk compiles and the per-block arm pays the
+    # replay_add jit — neither belongs in a timed cell.
+    socket_arm(False)
+    socket_arm(True)
+    for rep in range(max(repeats, 1)):
+        order = (("per_block", False), ("batched", True))
+        if rep % 2:
+            order = order[::-1]    # ABBA: cancel monotonic host drift
+        for label, batched in order:
+            cells[label].append(socket_arm(batched))
+    med_off = float(np.median(cells["per_block"]))
+    med_on = float(np.median(cells["batched"]))
+    out["socket_rung"] = {
+        "blocks": n_blocks, "group": ingest_blocks,
+        "window": socket_window, "repeats": max(repeats, 1),
+        "per_block_blocks_per_sec_cells": [round(v, 1)
+                                           for v in cells["per_block"]],
+        "batched_blocks_per_sec_cells": [round(v, 1)
+                                         for v in cells["batched"]],
+        "per_block_blocks_per_sec": round(med_off, 1),
+        "batched_blocks_per_sec": round(med_on, 1),
+    }
+    if med_off > 0:
+        out["socket_speedup"] = round(med_on / med_off, 3)
+
+    # -- e2e arms: grouped commit plane on the real learner path ---------
+    svc_base = dict(base)
+    svc_base.update({
+        "fleet.replay_shards": 2,
+        "replay.capacity": 8_000,          # 100 blocks -> 50/shard
+        "replay.learning_starts": 400,
+    })
+    e2e_cells = {"ingest_off": [], "ingest_on": []}
+    for rep in range(max(repeats - 1, 1)):
+        order = (("ingest_off", 1), ("ingest_on", ingest_blocks))
+        if rep % 2:
+            order = order[::-1]
+        for label, k in order:
+            ov = dict(svc_base)
+            ov["fleet.ingest_batch_blocks"] = k
+            e2e_cells[label].append(run_e2e(
+                min(seconds, 30.0), envs_per_actor=lanes_per_actor,
+                num_actors=num_actors, overrides=ov, actor_mode="thread"))
+    out["ingest_off"] = e2e_cells["ingest_off"][-1]
+    out["ingest_on"] = e2e_cells["ingest_on"][-1]
+    out["learner_steps_per_sec_cells"] = {
+        k: [c["learner_steps_per_sec"] for c in v]
+        for k, v in e2e_cells.items()}
+
+    def med(label):
+        return float(np.median(
+            [c["learner_steps_per_sec"] for c in e2e_cells[label]]))
+
+    if med("ingest_off") > 0:
+        out["learner_steps_ratio_ingest"] = round(
+            med("ingest_on") / med("ingest_off"), 3)
+    ingest_tel = (out["ingest_on"].get("replay_service") or {}).get(
+        "ingest") or {}
+    out["ingest_blocks_per_dispatch"] = ingest_tel.get("blocks_per_dispatch")
+
+    # -- spill prefetch: sample-path latency, inline vs async ------------
+    def prefetch_arm(prefetch: bool) -> float:
+        svc = ReplayService(spec, 1, spill_blocks=64, promote_per_sample=1,
+                            spill_prefetch=prefetch)
+        try:
+            for blk in _synth_service_blocks(spec, 128, seed=7):
+                svc.add_block(blk)       # 64 demoted into the tier
+            import jax
+            key = jax.random.PRNGKey(0)
+            lat = []
+            for _ in range(40):
+                key, sub = jax.random.split(key)
+                t0 = time.perf_counter()
+                batch, shard, _snap = svc.sample(sub)
+                jax.block_until_ready(batch.obs)
+                lat.append(time.perf_counter() - t0)
+                svc.update_priorities(
+                    shard, batch.idxes,
+                    np.ones(spec.batch_size, np.float32))
+            svc.drain_prefetch()
+            return float(np.median(lat))
+        finally:
+            svc.close()
+
+    inline_s = prefetch_arm(False)
+    prefetch_s = prefetch_arm(True)
+    out["spill_prefetch"] = {
+        "inline_sample_ms": round(inline_s * 1e3, 3),
+        "prefetch_sample_ms": round(prefetch_s * 1e3, 3),
+    }
+    if prefetch_s > 0:
+        out["prefetch_sample_speedup"] = round(inline_s / prefetch_s, 3)
+    return out
+
+
 def serve_latency_probe(seconds: float, clients: int,
                         overrides: Optional[dict] = None) -> dict:
     """Pure serving-plane cell: one in-proc PolicyServer, ``clients``
@@ -1418,6 +1602,19 @@ def main(argv=None) -> int:
                         "serving-probe arm at both dtypes + the analytic "
                         "weight-bytes table (the >= 3x int8 cut); one "
                         "artifact (E2E_r16.json)")
+    p.add_argument("--service-ingest-ab", type=int, default=0,
+                   help="1: run the e2e phase as the batched service "
+                        "data-plane A/B instead (ISSUE 16) — socket-rung "
+                        "producer cell (per-block lockstep vs stacked "
+                        "windowed frames, ABBA medians, the >= 1.3x "
+                        "headline), service-routed learner at "
+                        "fleet.ingest_batch_blocks 1 vs "
+                        "--ingest-batch-blocks (updates/s ratio >= 0.98), "
+                        "and the spill-prefetch sample-latency pair; one "
+                        "artifact (E2E_r18.json)")
+    p.add_argument("--socket-window", type=int, default=4,
+                   help="in-flight frame bound for the service-ingest "
+                        "A/B's windowed arm (fleet.socket_window)")
     p.add_argument("--elastic-ab", type=int, default=0,
                    help="1: run the e2e phase as the elastic-fleet A/B "
                         "instead (ISSUE 15) — fixed vs churned fleet at "
@@ -1488,6 +1685,12 @@ def main(argv=None) -> int:
                 args.e2e_seconds, args.envs_per_actor,
                 dp=args.sharded_dp, overrides=overrides,
                 repeats=args.ab_repeats)
+        elif args.service_ingest_ab:
+            out["e2e_service_ingest_ab"] = run_service_ingest_ab(
+                args.e2e_seconds, overrides=overrides,
+                repeats=args.ab_repeats,
+                ingest_blocks=args.ingest_batch_blocks,
+                socket_window=args.socket_window)
         elif args.elastic_ab:
             out["e2e_elastic_ab"] = run_elastic_ab(
                 args.e2e_seconds, overrides=overrides,

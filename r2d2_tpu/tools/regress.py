@@ -153,6 +153,19 @@ def compare(baseline: Dict[str, dict], current: Dict[str, dict],
     return rows
 
 
+def _current_costs():
+    # CPU-pinned with a >= 2-device virtual mesh (the sharded variant)
+    # so the snapshot is identical on a TPU host and the test
+    # container; a no-op when a wide-enough backend is already
+    # initialized (the pin only binds before first backend init).
+    # gate_table() itself memoizes per process; the attribute lookup
+    # stays late-bound so tests can stub the recompute.
+    from r2d2_tpu.telemetry import costmodel
+    from r2d2_tpu.utils.platform import pin_cpu_platform
+    pin_cpu_platform(2)
+    return costmodel.gate_table()
+
+
 def main(argv=None) -> int:
     import argparse
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -186,15 +199,7 @@ def main(argv=None) -> int:
 
     current = collect(args.dir, names=args.artifacts)
 
-    def current_costs():
-        # CPU-pinned with a >= 2-device virtual mesh (the sharded
-        # variant) so the snapshot is identical on a TPU host and the
-        # test container; a no-op when a wide-enough backend is already
-        # initialized (the pin only binds before first backend init)
-        from r2d2_tpu.telemetry.costmodel import gate_table
-        from r2d2_tpu.utils.platform import pin_cpu_platform
-        pin_cpu_platform(2)
-        return gate_table()
+    current_costs = _current_costs
 
     if args.update:
         baseline_doc["bench"] = current

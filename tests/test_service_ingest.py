@@ -1,0 +1,502 @@
+"""Batched/pipelined ReplayService data-plane tests (ISSUE 16): grouped
+multi-shard ingest bit-parity with the sequential path (ring wrap, spill
+demotion mid-group, lane routing, staleness stamps), the AOT chunk plan,
+the windowed socket rung's cumulative acks under chaos-grammar ack drops,
+spilled-page priority write-backs (ROADMAP 4a), priority-aware async
+spill prefetch, the service-mode sample stager vs the legacy step,
+producer-pump wiring (parallel/multihost.run_replay_producer), the
+batched frame writer, the new fleet knobs' round-trip/validation, and
+the ingest_backlog alert rule.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tests.test_elastic import assert_trees_equal
+from tests.test_replay import _fill_blocks, make_spec
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.fleet.replay_service import (RemoteReplayProducer,
+                                           ReplayProducerPump, ReplayService,
+                                           ReplayServiceServer, SpillTier)
+from r2d2_tpu.tools.chaos import parse_fault_spec
+
+import jax
+
+
+def _ring_equal(a, b):
+    assert a.ring.ptr == b.ring.ptr
+    assert a.ring.total_adds == b.ring.total_adds
+    assert a.ring.buffer_steps == b.ring.buffer_steps
+    assert a.ring.slot_steps == b.ring.slot_steps
+    assert a.ring.slot_versions == b.ring.slot_versions
+
+
+def _spill_equal(a, b):
+    assert a.spill.occupancy == b.spill.occupancy
+    assert list(a.spill._pages.keys()) == list(b.spill._pages.keys())
+    for pid in a.spill._pages:
+        (ba, la, va), (bb, lb, vb) = a.spill._pages[pid], b.spill._pages[pid]
+        assert (la, va) == (lb, vb)
+        assert_trees_equal(ba, bb)
+    assert a._demote_ids == b._demote_ids
+
+
+# ---------------------------------------------------------------------------
+# Grouped ingest: bit-parity with the sequential path.
+
+
+@pytest.mark.parametrize("spill", [0, 3])
+@pytest.mark.parametrize("route", ["round_robin", "lane"])
+def test_grouped_ingest_bit_parity(rng, spill, route):
+    """add_blocks at ingest_batch_blocks=4 is BIT-identical to the same
+    blocks through sequential add_block calls — routed shards, ring
+    state (incl. wrap + weight_version/lane stamps), accountant, spill
+    demotion order, and the write-back routing table all match. Lane
+    blocks include unstamped (-1) ones so the round-robin fallback
+    counter is exercised inside a group."""
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 11, rng)
+    stamped = []
+    for k, blk in enumerate(blocks):
+        lane = k % 3 if (route == "lane" and k % 4 != 3) else -1
+        stamped.append(blk.replace(
+            lane=np.asarray(lane, np.int32),
+            weight_version=np.asarray(k, np.int32)))
+    svc = ReplayService(spec, 2, spill_blocks=spill, route=route,
+                        ingest_batch_blocks=4)
+    ref = ReplayService(spec, 2, spill_blocks=spill, route=route)
+    routed = svc.add_blocks(stamped)
+    want = [ref.add_block(b) for b in stamped]
+    assert routed == want
+    for got, exp in zip(svc.shards, ref.shards):
+        assert_trees_equal(got.state, exp.state)
+        _ring_equal(got, exp)
+        _spill_equal(got, exp)
+    iv = svc.interval_block()["ingest"]
+    assert iv["blocks"] == 11 and iv["dispatches"] < 11
+    assert "ingest" not in ref.interval_block()
+
+
+def test_grouped_ingest_chunk_plan_and_aot_coverage(rng):
+    """The chunk rule (group size while enough blocks remain, largest
+    pow2 on the tail, 1 via the per-block jit) and the AOT plan that
+    covers it: 11 blocks into one shard at group 4 = chunks 4+4+2+1."""
+    spec = make_spec(num_blocks=8)
+    svc = ReplayService(spec, 1, ingest_batch_blocks=4)
+    cov = svc.aot_chunk_coverage()
+    assert cov == {"expected": [2, 4], "compiled": [2, 4],
+                   "complete": True}
+    blocks = _fill_blocks(spec, 11, rng)
+    svc.add_blocks(blocks)
+    iv = svc.interval_block()["ingest"]
+    assert iv["blocks"] == 11 and iv["dispatches"] == 4
+    assert iv["blocks_per_dispatch"] == round(11 / 4, 2)
+    svc.note_backlog(100)
+    assert svc.interval_block()["ingest"]["backlog"] == 100
+
+
+def test_default_knobs_keep_pr15_record_schema(rng):
+    """Off-defaults = PR 15 byte-identity: add_blocks routes through the
+    sequential path and the telemetry block carries neither the ingest
+    sub-block nor the spill prefetch keys."""
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 3, rng)
+    svc = ReplayService(spec, 2)
+    ref = ReplayService(spec, 2)
+    assert svc.add_blocks(blocks) == [ref.add_block(b) for b in blocks]
+    for got, exp in zip(svc.shards, ref.shards):
+        assert_trees_equal(got.state, exp.state)
+    block = svc.interval_block()
+    assert "ingest" not in block
+    assert "prefetch" not in block["spill"]
+
+
+# ---------------------------------------------------------------------------
+# Windowed socket rung: pipelined frames, cumulative acks, drop healing.
+
+
+def test_windowed_socket_cumulative_acks_under_drops(rng):
+    """The chaos grammar's drop_ack@every=N injection against the
+    windowed producer: every block still lands exactly once (cumulative
+    acks absorb the dropped ones), flush reaps the full window, and the
+    server's shard states match a direct grouped ingest."""
+    fault = parse_fault_spec("0:drop_ack@every=2")[0]
+    assert fault.kind == "drop_ack" and fault.block == 2
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 12, rng)
+    svc = ReplayService(spec, 2, ingest_batch_blocks=4)
+    ref = ReplayService(spec, 2, ingest_batch_blocks=4)
+    server = ReplayServiceServer(svc, drop_ack_every=fault.block)
+    producer = RemoteReplayProducer(server.host, server.port, window=3)
+    try:
+        for i in range(0, 12, 4):
+            producer.add_blocks(blocks[i:i + 4])
+        acked = producer.flush()
+        assert acked == 12 and producer.inflight == 0
+        assert producer.frames_sent == 3
+        assert server.blocks_received == 12
+        assert server.acks_dropped >= 1
+        stats = server.interval_stats()
+        assert stats["blocks"] == 12 and stats["frames"] == 3
+        assert stats["window_max"] >= 1 and stats["acks_dropped"] >= 1
+        assert stats["blocks_total"] == 12
+        # reset-on-read except the lifetime total
+        assert server.interval_stats()["blocks"] == 0
+        for i in range(0, 12, 4):
+            ref.add_blocks(blocks[i:i + 4])
+        for got, exp in zip(svc.shards, ref.shards):
+            assert_trees_equal(got.state, exp.state)
+    finally:
+        producer.close()
+        server.close()
+
+
+def test_window_stall_heals_via_flush_probe(rng):
+    """EVERY data ack dropped + window 1 (each send must reap an ack
+    before returning): the producer's recv times out, sends a flush
+    probe — always acked — and the cumulative ack covers the stalled
+    frame. No deadlock, no duplicate delivery."""
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 2, rng)
+    svc = ReplayService(spec, 1, ingest_batch_blocks=2)
+    server = ReplayServiceServer(svc, drop_ack_every=1)
+    producer = RemoteReplayProducer(server.host, server.port,
+                                    dial_timeout=0.5, window=1)
+    try:
+        producer.add_blocks(blocks, timeout=0.5)
+        assert producer.blocks_acked == 2 and producer.inflight == 0
+        assert server.blocks_received == 2
+        assert server.acks_dropped == 1
+    finally:
+        producer.close()
+        server.close()
+
+
+def test_producer_pump_and_run_replay_producer(rng):
+    """The producer-only-host wiring end-to-end: blocks emitted into a
+    BlockQueue reach a remote service via stacked windowed frames
+    (parallel/multihost.run_replay_producer), landing bit-identical to
+    local sequential adds."""
+    from r2d2_tpu.parallel.multihost import run_replay_producer
+    from r2d2_tpu.runtime.feeder import BlockQueue
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 6, rng)
+    svc = ReplayService(spec, 2, ingest_batch_blocks=4)
+    ref = ReplayService(spec, 2)
+    server = ReplayServiceServer(svc)
+    queue = BlockQueue(use_mp=False)
+    for blk in blocks:
+        queue.put(blk)
+    stop = threading.Event()
+    stop.set()                      # drain-then-exit
+    try:
+        stats = run_replay_producer(queue, server.host, server.port,
+                                    window=2, group=4, stop=stop)
+        assert stats["blocks_sent"] == 6
+        assert stats["blocks_acked"] == 6
+        assert server.blocks_received == 6
+        for blk in blocks:
+            ref.add_block(blk)
+        for got, exp in zip(svc.shards, ref.shards):
+            assert_trees_equal(got.state, exp.state)
+    finally:
+        server.close()
+
+
+def test_feeder_drain_groups(rng):
+    """drain_groups turns a deep backlog into window-sized stacked
+    frames in arrival order."""
+    from r2d2_tpu.runtime.feeder import BlockQueue
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 5, rng)
+    queue = BlockQueue(use_mp=False)
+    for blk in blocks:
+        queue.put(blk)
+    groups = queue.drain_groups(group=2, max_groups=4)
+    assert [k for _, k in groups] == [2, 2, 1]
+    got = np.concatenate([np.asarray(g.priority).reshape(k, -1)
+                          for g, k in groups])
+    want = np.stack([np.asarray(b.priority) for b in blocks])
+    np.testing.assert_array_equal(got, want.reshape(5, -1))
+    assert queue.drain_groups(group=2) == []
+
+
+def test_send_frames_wire_identity():
+    """The batched frame writer's bytes are indistinguishable from N
+    send_frame calls — the receiver reads them back one by one."""
+    import socket
+
+    from r2d2_tpu.serve.transport import recv_frame, send_frames
+    a, b = socket.socketpair()
+    try:
+        objs = [("addw", 1, 0, 2, {"x": np.arange(3)}), ("flushw", 2), "z"]
+        send_frames(a, objs, threading.Lock())
+        for want in objs:
+            got = recv_frame(b)
+            if isinstance(want, tuple):
+                assert got[:-1] == want[:-1] if isinstance(
+                    want[-1], dict) else got == want
+                if isinstance(want[-1], dict):
+                    np.testing.assert_array_equal(got[-1]["x"],
+                                                  want[-1]["x"])
+            else:
+                assert got == want
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Spilled-page write-backs (ROADMAP 4a) + priority-aware prefetch.
+
+
+def test_stale_writeback_routes_to_spilled_pages(rng):
+    """With the spill tier retaining evicted blocks, a write-back whose
+    sampled rows were overwritten routes those rows' |TD| into the
+    demoted pages' priority arrays; the fresh rows land through the
+    same-shape padded update, identically to applying them alone."""
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 6, rng)
+    svc = ReplayService(spec, 1, spill_blocks=4, promote_per_sample=0)
+    ref = ReplayService(spec, 1, spill_blocks=4, promote_per_sample=0)
+    for blk in blocks[:4]:
+        svc.add_block(blk)
+        ref.add_block(blk)
+    snap = svc.shards[0].ring.total_adds
+    for blk in blocks[4:]:          # overwrite rows 0 and 1, demoting them
+        svc.add_block(blk)
+        ref.add_block(blk)
+    spb = spec.seqs_per_block
+    idxes = np.asarray([0 * spb + 2, 1 * spb + 1, 2 * spb, 3 * spb + 3],
+                       np.int32)
+    tds = np.asarray([5.0, 7.0, 1.5, 2.5], np.float32)
+    svc.update_priorities(0, idxes, tds, adds_snapshot=snap)
+    assert svc.spilled_writebacks == 2 and svc.stale_rows_dropped == 0
+    assert svc.stale_writebacks == 0
+    for slot, seq, td in ((0, 2, 5.0), (1, 1, 7.0)):
+        pid = svc.shards[0]._demote_ids[slot]
+        page_block = svc.shards[0].spill._pages[pid][0]
+        assert float(np.asarray(page_block.priority)[seq]) == td
+        assert svc.shards[0].spill._prio[pid] >= td
+    # fresh rows == applying them alone on the reference
+    ref.shards[0].update_priorities(idxes[2:], tds[2:])
+    assert_trees_equal(svc.shards[0].state, ref.shards[0].state)
+    assert svc.shards[0].spill.writebacks == 2
+
+
+def test_stale_writeback_whole_drop_without_spill(rng):
+    """spill_blocks=0 keeps the PR-14/15 semantics exactly: any stale
+    row drops the WHOLE batch (there is no page to route to)."""
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 5, rng)
+    svc = ReplayService(spec, 1, promote_per_sample=0)
+    for blk in blocks[:4]:
+        svc.add_block(blk)
+    snap = svc.shards[0].ring.total_adds
+    svc.add_block(blocks[4])        # overwrites row 0
+    tree_before = np.asarray(svc.shards[0].state.tree).copy()
+    spb = spec.seqs_per_block
+    svc.update_priorities(0, np.asarray([0, 2 * spb], np.int32),
+                          np.asarray([9.0, 9.0], np.float32),
+                          adds_snapshot=snap)
+    assert svc.stale_writebacks == 1 and svc.spilled_writebacks == 0
+    np.testing.assert_array_equal(np.asarray(svc.shards[0].state.tree),
+                                  tree_before)
+
+
+def test_promote_best_order_and_writeback_reorder(rng):
+    """promote_best pops pages in stored-priority order, and a
+    write_back re-orders the heap (lazy deletion: the stale entry is
+    skipped). Eviction stays LRU regardless of priority."""
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 4, rng)
+    tier = SpillTier(4)
+    prios = [1.0, 5.0, 3.0, 2.0]
+    pids = []
+    for blk, p in zip(blocks, prios):
+        prio = np.full_like(np.asarray(blk.priority), p)
+        pids.append(tier.demote(blk.replace(priority=prio), 5, -1))
+    assert pids == [1, 2, 3, 4]
+    first = tier.promote_best()
+    assert float(np.max(np.asarray(first[0].priority))) == 5.0
+    # raise the lowest page above everything else
+    assert tier.write_back(pids[0], 0, 9.0)
+    second = tier.promote_best()
+    assert float(np.max(np.asarray(second[0].priority))) == 9.0
+    assert not tier.write_back(pids[1], 0, 1.0)     # already promoted
+    # LRU eviction removes the page from the heap's reachable set
+    small = SpillTier(1)
+    small.demote(blocks[0].replace(
+        priority=np.full_like(np.asarray(blocks[0].priority), 8.0)), 5, -1)
+    small.demote(blocks[1].replace(
+        priority=np.full_like(np.asarray(blocks[1].priority), 2.0)), 5, -1)
+    assert small.evictions == 1
+    best = small.promote_best()
+    assert float(np.max(np.asarray(best[0].priority))) == 2.0
+    assert small.promote_best() is None
+
+
+def test_spill_prefetch_moves_promotion_off_sample_path(rng):
+    """spill_prefetch=True: sample() performs NO inline promotion (the
+    batch is exactly replay_sample); the write-back kicks the async
+    pass, which promotes the highest-priority page."""
+    from r2d2_tpu.replay import replay_sample
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 6, rng)
+    svc = ReplayService(spec, 1, spill_blocks=4, promote_per_sample=1,
+                        spill_prefetch=True)
+    try:
+        for blk in blocks:
+            svc.add_block(blk)
+        assert svc.shards[0].spill.occupancy == 2
+        state_before = svc.shards[0].state
+        key = jax.random.PRNGKey(3)
+        batch, shard, snap = svc.sample(key)
+        assert svc.shards[0].spill.occupancy == 2   # promotion skipped
+        assert_trees_equal(batch, replay_sample(spec, state_before, key))
+        best_prio = max(svc.shards[0].spill._prio.values())
+        svc.update_priorities(shard, batch.idxes,
+                              np.zeros(spec.batch_size, np.float32))
+        svc.drain_prefetch()
+        assert svc.shards[0].spill.promotions == 1  # async pass ran
+        # each promotion's ring re-entry demotes the overwritten
+        # resident, so occupancy cycles rather than shrinking — and the
+        # page that came back was the heap's best (it is gone from the
+        # tier's priority map)
+        assert svc.shards[0].spill.occupancy == 2
+        assert best_prio not in svc.shards[0].spill._prio.values()
+        block = svc.interval_block()
+        assert block["spill"]["prefetch"] is True
+        assert block["spill"]["prefetch_promotions"] == 1
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Service-mode sample staging (learner pipeline parity).
+
+
+def _svc_cfg(tmp_path, **extra):
+    base = {
+        "env.game_name": "Fake",
+        "env.frame_height": 12, "env.frame_width": 12, "env.frame_stack": 2,
+        "network.hidden_dim": 8, "network.cnn_out_dim": 16,
+        "network.conv_layers": ((4, 3, 2),),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 160, "replay.block_length": 20,
+        "replay.batch_size": 4, "replay.learning_starts": 40,
+        "runtime.save_interval": 0, "runtime.steps_per_dispatch": 1,
+        "runtime.save_dir": str(tmp_path),
+        "fleet.replay_shards": 2,
+    }
+    base.update(extra)
+    return Config().replace(**base)
+
+
+@pytest.mark.slow
+def test_service_stager_matches_legacy_step(rng, tmp_path):
+    """fleet.sample_staging: the staged learner's first step trains on
+    the SAME batch the legacy step draws (same service key sequence,
+    same priorities) and its grouped write-back lands the identical
+    replay state; further steps keep training and shutdown joins the
+    stager threads."""
+    import time
+
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.learner_loop import Learner
+    cfg_sync = _svc_cfg(tmp_path / "sync")
+    cfg_staged = _svc_cfg(tmp_path / "staged",
+                          **{"fleet.sample_staging": True})
+    net = NetworkApply(4, cfg_sync.network, cfg_sync.env.frame_stack,
+                       cfg_sync.env.frame_height, cfg_sync.env.frame_width)
+    sync, staged = Learner(cfg_sync, net, 0), Learner(cfg_staged, net, 0)
+    try:
+        from r2d2_tpu.replay.structs import ReplaySpec
+        blocks = _fill_blocks(ReplaySpec.from_config(cfg_sync), 4, rng)
+        for blk in blocks:
+            sync.ingest(blk)
+            staged.ingest(blk)
+        assert sync.ready and staged.ready
+        m_sync = sync.step()
+        m_staged = staged.step()
+        np.testing.assert_allclose(float(m_staged["loss"]),
+                                   float(m_sync["loss"]), rtol=1e-6)
+        want = [np.asarray(s.state.tree) for s in sync.service.shards]
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            got = [np.asarray(s.state.tree) for s in staged.service.shards]
+            if all(np.array_equal(g, w) for g, w in zip(got, want)):
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("staged write-back never matched the "
+                                 "legacy step's replay state")
+        for _ in range(3):
+            staged.step()
+        assert staged.training_steps == 4
+        assert staged.service.stale_writebacks == 0
+    finally:
+        sync.stop_background()
+        staged.stop_background()
+        assert not any(t.is_alive() for t in staged._svc_threads)
+
+
+# ---------------------------------------------------------------------------
+# Config + alert plumbing.
+
+
+def test_ingest_config_round_trip():
+    cfg = Config().replace(**{
+        "fleet.replay_shards": 2, "fleet.ingest_batch_blocks": 8,
+        "fleet.spill_blocks": 10, "fleet.spill_prefetch": True,
+        "fleet.sample_staging": True,
+        "fleet.service_transport": "socket", "fleet.socket_window": 4,
+        "replay.capacity": 8_000,
+    })
+    again = Config.from_dict(cfg.to_dict())
+    assert again.fleet == cfg.fleet
+    # pre-PR16 serialized configs (no new keys) load with off defaults
+    d = Config().to_dict()
+    for key in ("ingest_batch_blocks", "socket_window", "spill_prefetch",
+                "sample_staging"):
+        d["fleet"].pop(key, None)
+    legacy = Config.from_dict(d)
+    assert legacy.fleet.ingest_batch_blocks == 1
+    assert legacy.fleet.socket_window == 1
+    assert not legacy.fleet.spill_prefetch
+    assert not legacy.fleet.sample_staging
+
+
+@pytest.mark.parametrize("overrides", [
+    {"fleet.ingest_batch_blocks": 0},
+    {"fleet.ingest_batch_blocks": 4},             # no service
+    {"fleet.socket_window": 0},
+    {"fleet.socket_window": 2},                   # transport not socket
+    {"fleet.replay_shards": 2, "fleet.socket_window": 2,
+     "replay.capacity": 8_000},                   # still not socket
+    {"fleet.spill_prefetch": True},               # no spill tier
+    {"fleet.sample_staging": True},               # no service
+    {"telemetry.alerts_ingest_backlog": 0.0},
+])
+def test_ingest_config_validation(overrides):
+    with pytest.raises((ValueError, SystemExit)):
+        Config().replace(**overrides)
+
+
+def test_ingest_backlog_alert_rule():
+    from r2d2_tpu.telemetry.alerts import AlertEngine, default_rules
+    rules = [r for r in default_rules(Config().telemetry)
+             if r.name == "ingest_backlog"]
+    assert len(rules) == 1
+    rule = rules[0]
+    assert rule.path == ("replay_service", "ingest", "backlog")
+    assert rule.bound == Config().telemetry.alerts_ingest_backlog
+    engine = AlertEngine(rules)
+    quiet = engine.evaluate({"replay_service": {"ingest": {"backlog": 3}}})
+    assert quiet["fired"] == []
+    hot = engine.evaluate({"replay_service": {"ingest": {"backlog": 500}}})
+    assert {a["rule"] for a in hot["fired"]} == {"ingest_backlog"}
