@@ -65,7 +65,10 @@
 #                lease/evict/reconnect, local-vs-server action parity,
 #                transport round-trips (in-proc + shm + socket), serving
 #                record schema + the serve_* alert rules, kill-switch
-#                schema stability); the slow e2e slice (real actors
+#                schema stability, the sharded fleet: shard routing +
+#                handoff, single-server parity, kill/adopt failover,
+#                grow/shrink reslice, admission shed + brownout alert,
+#                membership leases); the slow e2e slice (real actors
 #                through the server into the learner) and the
 #                server-kill/restart chaos drill run with the full tier.
 #   make elastic — the fast-tier elastic-fleet suite
@@ -207,7 +210,7 @@ FAST_MARKER_CHECKS := \
 	tests/test_sentinel.py:not_slow:20:sentinel \
 	tests/test_replay_diag.py:not_slow:10:replay-diag \
 	tests/test_fleet.py:not_slow:12:fleet \
-	tests/test_serve.py:not_slow:14:serve \
+	tests/test_serve.py:not_slow:40:serve \
 	tests/test_quant.py:not_slow:14:quant \
 	tests/test_elastic.py:not_slow:20:elastic \
 	tests/test_service_ingest.py:not_slow:20:service-ingest \

@@ -1,0 +1,78 @@
+"""Join (or leave) a RUNNING fleet from a fresh process (ROADMAP 2c).
+
+Dials the supervisor's lease API (``fleet.lease_transport=socket``;
+the orchestrator logs its ``host:port`` at startup) and asks it to
+admit a worker — acting or serving — through the SAME slot-adoption
+plumbing the in-process join schedule uses (``PlayerStack.join_actor``
+for actors, ``ServerFleet.grow_server`` for the serving fleet):
+
+    python -m r2d2_tpu.cli.join --port 6100                # admit an actor
+    python -m r2d2_tpu.cli.join --port 6100 --slot 3       # that slot only
+    python -m r2d2_tpu.cli.join --port 6100 --leave 3      # retire slot 3
+    python -m r2d2_tpu.cli.join --port 6100 --role serve          # grow
+    python -m r2d2_tpu.cli.join --port 6100 --role serve --leave 2  # shrink
+    python -m r2d2_tpu.cli.join --port 6100 --info         # fleet snapshot
+
+The reply (the adopted lease for joins — slot, generation, lane range,
+replay shard key — or the membership/serving snapshot for ``--info``)
+prints as one JSON object on stdout; a refused op (fleet at full width,
+slot still ACTIVE, serving not sharded) exits 1 with the supervisor's
+message on stderr.
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host", default="127.0.0.1",
+                   help="lease API host (the supervisor logs it)")
+    p.add_argument("--port", type=int, required=True,
+                   help="lease API port")
+    p.add_argument("--role", choices=("actor", "serve"), default="actor",
+                   help="what to admit: an acting worker (default) or one "
+                        "more serving-fleet server")
+    p.add_argument("--slot", type=int, default=None,
+                   help="request a specific slot (actors: must be parked "
+                        "or free; default: longest-parked, then spare)")
+    p.add_argument("--leave", type=int, default=None, metavar="SLOT",
+                   help="retire this slot instead of joining (actors "
+                        "park it; serving rehomes its cache shards)")
+    p.add_argument("--info", action="store_true",
+                   help="print the fleet snapshot and exit")
+    p.add_argument("--timeout", type=float, default=30.0,
+                   help="dial/round-trip timeout in seconds")
+    args = p.parse_args(argv)
+
+    from r2d2_tpu.fleet.membership import lease_call
+    try:
+        if args.info:
+            reply = lease_call(args.host, args.port, "info",
+                               timeout_s=args.timeout)
+        elif args.role == "actor":
+            if args.leave is not None:
+                reply = lease_call(args.host, args.port, "leave",
+                                   timeout_s=args.timeout, slot=args.leave)
+            else:
+                reply = lease_call(args.host, args.port, "join",
+                                   timeout_s=args.timeout, slot=args.slot)
+        else:
+            if args.leave is not None:
+                reply = lease_call(args.host, args.port, "shrink_serve",
+                                   timeout_s=args.timeout, slot=args.leave)
+            else:
+                reply = lease_call(args.host, args.port, "grow_serve",
+                                   timeout_s=args.timeout)
+    except (RuntimeError, ConnectionError, OSError) as e:
+        print(f"join failed: {e}", file=sys.stderr)
+        return 1
+    reply.pop("ok", None)
+    print(json.dumps(reply), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

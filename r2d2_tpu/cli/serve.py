@@ -13,6 +13,13 @@ stock alert rules (``serve_latency_slo``, ``serve_batch_starvation``,
 ``serve_client_churn``) evaluated per record into
 ``serve_alerts.jsonl`` — the same SLO plumbing the in-training server
 rides. SIGTERM/SIGINT stop cleanly.
+
+With ``serve.servers=N`` (N > 1) the process hosts a sharded serving
+FLEET instead: N server loops over client-hash cache slices, one TCP
+listener per fleet slot, and the printed ``socket_fleet`` spec is what
+clients feed a ``RoutingChannel``. ``serve.queue_depth_bound`` arms
+admission control (overflow sheds with retry-after; the
+``serve_brownout`` rule fires on the shed fraction).
 """
 
 import argparse
@@ -73,28 +80,6 @@ def main(argv=None) -> int:
             lambda t, p_: np.asarray(p_, np.asarray(t).dtype),
             params, restored["params"])
 
-    stats = ServingStats()
-    telemetry = Telemetry.from_config(cfg, name="serve")
-    endpoint = InprocEndpoint()
-    transports = [SocketServerTransport(endpoint.submit, cfg.serve.host,
-                                        cfg.serve.port)]
-    print(f"serving on {transports[0].host}:{transports[0].port} "
-          f"(action_dim={action_dim})", flush=True)
-    if args.shm:
-        shm_t = ShmServeTransport(
-            endpoint.submit, (cfg.env.frame_height, cfg.env.frame_width),
-            action_dim, cfg.network.hidden_dim,
-            request_slots=cfg.serve.request_ring_slots)
-        transports.append(shm_t)
-        print(f"shm request ring: {shm_t.request_ring.name}", flush=True)
-
-    os.makedirs(args.save_dir or ".", exist_ok=True)
-    metrics_path = os.path.join(args.save_dir or ".", "serve_metrics.jsonl")
-    open(metrics_path, "w").close()
-    engine = AlertEngine(
-        default_rules(cfg.telemetry),
-        jsonl_path=os.path.join(args.save_dir or ".", "serve_alerts.jsonl"))
-
     quant_stats = None
     if cfg.network.inference_dtype != "f32":
         # quantized serving (ISSUE 14): the server builds the twin at
@@ -104,9 +89,75 @@ def main(argv=None) -> int:
         from r2d2_tpu.telemetry import QuantStats
         quant_stats = QuantStats(cfg.network.inference_dtype,
                                  cfg.telemetry.quant_probe_interval)
-    server = PolicyServer(cfg, net, params, endpoint=endpoint,
-                          stats=stats, telemetry=telemetry,
-                          quant_stats=quant_stats).start()
+
+    stats = ServingStats()
+    telemetry = Telemetry.from_config(cfg, name="serve")
+    fleet = None
+    endpoint = None
+    transports = []
+    if cfg.serve.servers > 1:
+        # sharded serving fleet (ISSUE 17): N server loops, one TCP
+        # listener per fleet slot (parked slots included — their
+        # listeners bounce MISROUTED so growth never changes an
+        # address). The printed spec is exactly what actor_main's
+        # socket_fleet branch consumes to build a RoutingChannel.
+        if args.shm:
+            p.error("--shm is single-server only (serve.servers > 1 "
+                    "rejects the shm rung)")
+        from r2d2_tpu.serve import ServerFleet
+        fleet = ServerFleet(cfg, net, params, stats=stats,
+                            telemetry=telemetry, quant_stats=quant_stats)
+        spec_servers = {}
+        for slot, ep in fleet.serve_spec_servers().items():
+            port = cfg.serve.port + slot if cfg.serve.port else 0
+            t = SocketServerTransport(ep.submit, cfg.serve.host, port)
+            transports.append(t)
+            spec_servers[slot] = [t.host, t.port]
+        spec = {"transport": "socket_fleet", "servers": spec_servers,
+                "total_shards": fleet.total_shards,
+                "assign": [fleet.shard_map.version,
+                           list(fleet.shard_map.assignment())]}
+        print(f"serving fleet of {cfg.serve.servers} "
+              f"(max {fleet.max_servers}) — spec: "
+              + json.dumps(spec), flush=True)
+    else:
+        endpoint = InprocEndpoint()
+        transports = [SocketServerTransport(endpoint.submit, cfg.serve.host,
+                                            cfg.serve.port)]
+        print(f"serving on {transports[0].host}:{transports[0].port} "
+              f"(action_dim={action_dim})", flush=True)
+        if args.shm:
+            shm_t = ShmServeTransport(
+                endpoint.submit, (cfg.env.frame_height, cfg.env.frame_width),
+                action_dim, cfg.network.hidden_dim,
+                request_slots=cfg.serve.request_ring_slots)
+            transports.append(shm_t)
+            print(f"shm request ring: {shm_t.request_ring.name}", flush=True)
+
+    os.makedirs(args.save_dir or ".", exist_ok=True)
+    metrics_path = os.path.join(args.save_dir or ".", "serve_metrics.jsonl")
+    open(metrics_path, "w").close()
+    engine = AlertEngine(
+        default_rules(cfg.telemetry),
+        jsonl_path=os.path.join(args.save_dir or ".", "serve_alerts.jsonl"))
+
+    server = None
+    if fleet is None:
+        server = PolicyServer(cfg, net, params, endpoint=endpoint,
+                              stats=stats, telemetry=telemetry,
+                              quant_stats=quant_stats).start()
+
+    def _batches() -> int:
+        if server is not None:
+            return server.batches_dispatched
+        return sum(s.batches_dispatched for s in fleet.servers.values())
+
+    def _serving_block():
+        if fleet is not None:
+            return fleet.interval_block(deadline_ms=cfg.serve.deadline_ms,
+                                        max_batch=cfg.serve.max_batch)
+        return stats.interval_block(deadline_ms=cfg.serve.deadline_ms,
+                                    max_batch=cfg.serve.max_batch)
 
     stop = {"flag": False}
 
@@ -126,14 +177,17 @@ def main(argv=None) -> int:
             if args.seconds and time.time() - t0 >= args.seconds:
                 break
             time.sleep(0.2)
+            if fleet is not None:
+                # fleet supervision on the log-loop cadence: a dead
+                # server's shards rehome to survivors (clients re-route
+                # off the MISROUTED bounces)
+                fleet.supervise()
             now = time.time()
             if now - last_log >= cfg.runtime.log_interval:
                 last_log = now
-                block = stats.interval_block(
-                    deadline_ms=cfg.serve.deadline_ms,
-                    max_batch=cfg.serve.max_batch)
+                block = _serving_block()
                 record = {"t": round(now - t0, 1),
-                          "batches": server.batches_dispatched}
+                          "batches": _batches()}
                 if block is not None:   # the TrainMetrics omission contract
                     record["serving"] = block
                 if quant_stats is not None:
@@ -142,15 +196,18 @@ def main(argv=None) -> int:
                 with open(metrics_path, "a") as f:
                     f.write(json.dumps(record) + "\n")
     finally:
-        server.stop()
+        final_batches = _batches()
+        if server is not None:
+            server.stop()
+        if fleet is not None:
+            fleet.stop()
         for t in transports:
             t.close()
         telemetry.close()
         # final record so short runs still leave evidence
-        block = stats.interval_block(deadline_ms=cfg.serve.deadline_ms,
-                                     max_batch=cfg.serve.max_batch)
+        block = _serving_block()
         record = {"t": round(time.time() - t0, 1),
-                  "batches": server.batches_dispatched, "final": True}
+                  "batches": final_batches, "final": True}
         if block is not None:
             record["serving"] = block
         if quant_stats is not None:
@@ -158,7 +215,7 @@ def main(argv=None) -> int:
         record["alerts"] = engine.evaluate(record)
         with open(metrics_path, "a") as f:
             f.write(json.dumps(record) + "\n")
-        print(f"served {server.batches_dispatched} batches in "
+        print(f"served {final_batches} batches in "
               f"{time.time() - t0:.1f}s; records in {metrics_path}",
               flush=True)
     return 0

@@ -336,6 +336,28 @@ class ServeConfig:
     # (all pre-compiled at server start) so fill jitter never retraces.
     max_batch: int = 32
     deadline_ms: float = 5.0
+    # Serving fleet width (ISSUE 17): 1 (default) = the single PR-12
+    # server loop, byte-identical. >= 2 = N server loops behind the
+    # client-side router (serve/router.py), each owning a contiguous
+    # slice of the state cache's shard groups; a request routes by
+    # client_id % state_shards and never crosses servers. Thread-mode
+    # actors ride in-proc endpoints; process-mode actors and cli/serve.py
+    # ride one socket listener per server (the shm rung stays
+    # single-server).
+    servers: int = 1
+    # Maximum fleet width (grow_server headroom): 0 (default) = servers
+    # (no spare server slots). Spare slots pre-create their endpoints/
+    # listeners so remote clients know every address up front; a grown
+    # server attaches to its persistent endpoint (the PR-12 restart
+    # pattern, now per slot).
+    max_servers: int = 0
+    # Admission control / brownout (ISSUE 17): a server whose inbox
+    # backlog exceeds this many requests AFTER filling a dispatch sheds
+    # the excess with STATUS_RETRY (+ retry_after hint) instead of
+    # letting batch_wait run away — shed clients back off on the
+    # WorkerHealth ladder and resend (the op was NOT applied). 0
+    # (default) = no admission control, byte-identical records.
+    queue_depth_bound: int = 0
     # State cache geometry: total per-client slots (each holds one packed
     # LSTM hidden + rolling frame stack + last action) partitioned into
     # ``state_shards`` independently-leased shard groups (client ids hash
@@ -472,6 +494,17 @@ class FleetConfig:
     # sampled shard on a writeback thread (the PR-14 staleness guard
     # applies per entry, now reaching spilled pages too).
     sample_staging: bool = False
+    # Fleet lease API (ISSUE 17, ROADMAP 2c): "" (default) = joins are
+    # in-process only (PlayerStack.join_actor). "socket" = the
+    # orchestrator listens on lease_host:lease_port
+    # (fleet/membership.py MembershipServer) and a FRESH process joins
+    # the running fleet through cli/join.py — it leases a slot over the
+    # wire, adopts the slot's identity, routes blocks in via the replay
+    # service's socket rung, and reaches served inference through the
+    # serve fleet's socket listeners.
+    lease_transport: str = ""
+    lease_host: str = "127.0.0.1"
+    lease_port: int = 0             # 0 = ephemeral
 
     def resolved_max_slots(self, num_actors: int) -> int:
         return self.max_slots if self.max_slots > 0 else num_actors
@@ -728,6 +761,12 @@ class TelemetryConfig:
     # growing by at least this much within one interval fires
     # serve_client_churn (counter semantics — one burst, one alert).
     alerts_serve_churn: float = 3.0
+    # Interval shed fraction (serving.admission.shed_frac — requests
+    # rejected at the queue-depth bound over shed+replied) at/above
+    # which serve_brownout fires: the fleet is actively shedding load to
+    # hold the latency SLO — capacity is the problem, not the server.
+    # Inactive when admission control is off (no admission sub-block).
+    alerts_serve_shed_frac: float = 0.2
     # -- quantized inference plane (ISSUE 14; the record's 'quant' block) --
     # Forward calls between accuracy probes when network.inference_dtype
     # != "f32": every probe_interval-th acting forward also runs the f32
@@ -1117,6 +1156,51 @@ class Config:
             raise ValueError(
                 f"telemetry.alerts_serve_churn "
                 f"({self.telemetry.alerts_serve_churn}) must be >= 1")
+        # -- serving fleet (ISSUE 17): the router partitions whole
+        # client-hash shard groups, so the server count is bounded by
+        # the shard count and shm (single-ring) cannot host N loops --
+        if self.serve.servers < 1:
+            raise ValueError(
+                f"serve.servers ({self.serve.servers}) must be >= 1")
+        if self.serve.servers > self.serve.state_shards:
+            raise ValueError(
+                f"serve.servers ({self.serve.servers}) must be <= "
+                f"serve.state_shards ({self.serve.state_shards}): each "
+                "server owns at least one whole client-hash shard group "
+                "— raise state_shards or lower servers")
+        if self.serve.max_servers != 0 and not (
+                self.serve.servers <= self.serve.max_servers
+                <= self.serve.state_shards):
+            raise ValueError(
+                f"serve.max_servers ({self.serve.max_servers}) must be 0 "
+                f"(= serve.servers) or in [serve.servers, "
+                f"serve.state_shards] — it is the elastic fleet's slot "
+                "board width and every server needs >= 1 shard")
+        if self.serve.queue_depth_bound < 0:
+            raise ValueError(
+                f"serve.queue_depth_bound ({self.serve.queue_depth_bound})"
+                " must be >= 0 (0 disables admission control)")
+        if self.serve.servers > 1 and self.serve.transport == "shm":
+            raise ValueError(
+                "serve.servers > 1 requires transport 'auto' or "
+                "'socket': the shm rung is a single request ring with "
+                "one server-side consumer — multi-server routing rides "
+                "per-server sockets (process mode) or in-proc endpoints "
+                "(thread mode)")
+        if not 0 < self.telemetry.alerts_serve_shed_frac <= 1:
+            raise ValueError(
+                f"telemetry.alerts_serve_shed_frac "
+                f"({self.telemetry.alerts_serve_shed_frac}) must be in "
+                "(0, 1]")
+        if self.fleet.lease_transport not in ("", "socket"):
+            raise ValueError(
+                f"fleet.lease_transport ({self.fleet.lease_transport!r}) "
+                "must be '' (in-proc only) or 'socket' (serve the lease "
+                "API for cli/join.py)")
+        if self.fleet.lease_port < 0:
+            raise ValueError(
+                f"fleet.lease_port ({self.fleet.lease_port}) must be "
+                ">= 0 (0 = ephemeral)")
         # -- elastic fleet (ISSUE 15): structural preconditions fail at
         # config construction with the fix spelled out --
         fl = self.fleet

@@ -21,7 +21,8 @@ and churn independently of one another:
 
 from r2d2_tpu.fleet.fanout import FanoutTree, ShmFanout
 from r2d2_tpu.fleet.membership import (SLOT_ACTIVE, SLOT_FREE, SLOT_PARKED,
-                                       FleetMembership, SlotLease)
+                                       FleetMembership, MembershipServer,
+                                       SlotLease, lease_call)
 from r2d2_tpu.fleet.replay_service import (RemoteReplayProducer,
                                            ReplayProducerPump, ReplayShard,
                                            ReplayService, ReplayServiceServer,
@@ -31,6 +32,6 @@ __all__ = [
     "ReplayService", "ReplayShard", "SpillTier",
     "ReplayServiceServer", "RemoteReplayProducer", "ReplayProducerPump",
     "FanoutTree", "ShmFanout",
-    "FleetMembership", "SlotLease",
+    "FleetMembership", "SlotLease", "MembershipServer", "lease_call",
     "SLOT_FREE", "SLOT_ACTIVE", "SLOT_PARKED",
 ]

@@ -23,9 +23,11 @@ orchestrator) — joiners go through :meth:`FleetMembership.lease`, never
 race on shared state — while LIVENESS stays on the shared-memory
 heartbeat board the workers already publish to."""
 
+import socket
+import threading
 import time
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional
 
 SLOT_FREE = "free"        # spare capacity, never yet leased
 SLOT_ACTIVE = "active"    # leased to a live worker
@@ -188,3 +190,100 @@ class FleetMembership:
             "leaves": self.leaves,
             "orphaned": self.orphaned(heartbeat_ages, orphan_horizon_s),
         }
+
+
+class MembershipServer:
+    """The fleet lease API over TCP (ROADMAP 2c; gated on
+    ``fleet.lease_transport == "socket"``): a fresh process —
+    ``cli/join.py`` — dials the supervisor and asks it to admit an acting
+    worker (the same :meth:`PlayerStack.join_actor` slot-adoption path
+    the in-process join schedule uses) or to grow/shrink the serving
+    fleet (ISSUE 17). Leases stay arbitrated by the ONE owning
+    supervisor; this is a remote-procedure face on it, not a second
+    arbiter.
+
+    Wire discipline: the serving plane's length-prefixed pickle frames
+    (serve/transport.py ``send_frame``/``recv_frame``) — one request
+    dict ``{"op": ..., **kwargs}`` per frame, one reply dict
+    ``{"ok": bool, ...}`` back. Connections are served concurrently;
+    handlers run on the connection thread, so the callables passed in
+    must be safe to call off the training thread (join_actor and the
+    fleet grow/shrink are — they only touch supervisor-owned state)."""
+
+    def __init__(self, handlers: Dict[str, Callable],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._handlers = dict(handlers)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="lease-accept")
+        self._accept.start()
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.25)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="lease-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        from r2d2_tpu.serve.transport import recv_frame, send_frame
+        lock = threading.Lock()
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                req = recv_frame(conn)
+                op = req.get("op")
+                handler = self._handlers.get(op)
+                if handler is None:
+                    reply = {"ok": False,
+                             "error": f"unknown op {op!r} (have "
+                                      f"{sorted(self._handlers)})"}
+                else:
+                    try:
+                        kwargs = {k: v for k, v in req.items() if k != "op"}
+                        reply = {"ok": True, **(handler(**kwargs) or {})}
+                    except Exception as e:     # surfaces to the dialer
+                        reply = {"ok": False, "error": str(e)}
+                send_frame(conn, reply, lock)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._accept.join(timeout=2.0)
+
+
+def lease_call(host: str, port: int, op: str, timeout_s: float = 10.0,
+               **kwargs) -> dict:
+    """One round-trip against a :class:`MembershipServer`: dial, send
+    ``{"op": op, **kwargs}``, return the reply dict. Raises
+    ``RuntimeError`` with the server's message when the op failed —
+    callers never have to inspect ``ok`` themselves."""
+    from r2d2_tpu.serve.transport import recv_frame, send_frame
+    s = socket.create_connection((host, port), timeout=timeout_s)
+    try:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_frame(s, {"op": op, **kwargs}, threading.Lock())
+        reply = recv_frame(s)
+    finally:
+        s.close()
+    if not reply.get("ok"):
+        raise RuntimeError(f"lease op {op!r} failed: "
+                           f"{reply.get('error', 'unknown error')}")
+    return reply

@@ -65,6 +65,19 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
                 serve_spec["request_ring"], serve_spec["action_dim"],
                 serve_spec["hidden_dim"],
                 reply_slots=serve_spec["reply_slots"])
+        elif serve_spec["transport"] == "socket_fleet":
+            # sharded serving (ISSUE 17): one socket per fleet server,
+            # routed client-id → shard → server off the shipped
+            # assignment; MISROUTED bounces re-aim as the fleet churns
+            from r2d2_tpu.serve import (RoutingChannel, ShardMap,
+                                        SocketChannel)
+            version, assign = serve_spec["assign"]
+            smap = ShardMap(serve_spec["total_shards"], assign)
+            smap.version = int(version)
+            serve_channel = RoutingChannel(
+                {slot: SocketChannel(host, port)
+                 for slot, (host, port) in serve_spec["servers"].items()},
+                smap)
         else:
             from r2d2_tpu.serve import SocketChannel
             serve_channel = SocketChannel(serve_spec["host"],

@@ -142,21 +142,29 @@ class PlayerStack:
         self.serve_stats = None
         self.serve_endpoint = None
         self.serve_server = None
+        # serving fleet (ISSUE 17): serve.servers > 1 swaps the ONE
+        # PolicyServer for a ServerFleet (per-server cache slices behind
+        # the shard→server router); the shared stats aggregator and the
+        # construction entry points are unchanged, so the single-server
+        # path stays byte-identical
+        self.serve_fleet = None
         self._serve_transport = None
+        self._serve_fleet_transports = []
         self._serve_weight_sub = None
+        self._serve_weight_subs = []
         self._serve_weight_poll = None
+        self._serve_weight_poll_factory = None
         self._serve_weight_version = None
+        self._serve_weight_version_factory = None
         self._serve_copy_updates = True
         self._serve_client_timed = True
         self._serve_spec = None
+        self._lease_server = None
         if cfg.actor.inference == "server":
             from r2d2_tpu.serve import InprocEndpoint, ServingStats
             self.serve_stats = ServingStats()
             self.serve_endpoint = InprocEndpoint()
-            self.metrics.set_serving(
-                lambda: self.serve_stats.interval_block(
-                    deadline_ms=cfg.serve.deadline_ms,
-                    max_batch=cfg.serve.max_batch))
+            self.metrics.set_serving(self._serving_block)
         # quantized inference plane (ISSUE 14): the publish-time
         # quantizer (None at "f32" — the weight plumbing is then
         # byte-identical to PR13) and the accuracy-probe aggregator
@@ -253,11 +261,39 @@ class PlayerStack:
         the per-player-job multihost path via MultiplayerConfig.env_args)."""
         return self.cfg.multiplayer.env_args(self.player_idx, actor_idx)
 
+    def _serving_block(self):
+        """Periodic-record 'serving' block provider: the fleet's
+        aggregate (shared stats + per-server rows) when serving is
+        sharded, the single server's stats otherwise — same schema for
+        everything that existed before the fleet."""
+        if self.serve_fleet is not None:
+            return self.serve_fleet.interval_block(
+                deadline_ms=self.cfg.serve.deadline_ms,
+                max_batch=self.cfg.serve.max_batch)
+        return self.serve_stats.interval_block(
+            deadline_ms=self.cfg.serve.deadline_ms,
+            max_batch=self.cfg.serve.max_batch)
+
     def _start_serve_server(self) -> None:
-        """(Re)build the policy server against the persistent endpoint —
+        """(Re)build the serving plane against persistent endpoints —
         the ONE construction path for cold start and the chaos drill's
         restart (the replacement adopts the learner's CURRENT params and
-        the same weight-service reader)."""
+        the same weight-service reader). serve.servers > 1 builds the
+        sharded ServerFleet (ISSUE 17) instead of one PolicyServer; the
+        default leaves this path byte-identical to the single-server
+        plane."""
+        if self.cfg.serve.servers > 1:
+            from r2d2_tpu.serve import ServerFleet
+            self.serve_fleet = ServerFleet(
+                self.cfg, self.net, self.learner.train_state.params,
+                stats=self.serve_stats, telemetry=self.telemetry,
+                client_timed=self._serve_client_timed,
+                weight_poll_factory=self._serve_weight_poll_factory,
+                weight_version=self._serve_weight_version,
+                weight_version_factory=self._serve_weight_version_factory,
+                copy_updates=self._serve_copy_updates,
+                quant_stats=self.quant_stats)
+            return
         from r2d2_tpu.serve import PolicyServer
         self.serve_server = PolicyServer(
             self.cfg, self.net, self.learner.train_state.params,
@@ -274,7 +310,12 @@ class PlayerStack:
         endpoint; connected clients reconnect transparently (their
         retries drain into the replacement; the lost state cache resets
         served episodes to the episode-initial state, the same grace as
-        an eviction)."""
+        an eviction). In fleet mode the chaos drill targets individual
+        servers through kill/supervise instead — a full restart rebuilds
+        the whole fleet."""
+        if self.serve_fleet is not None:
+            self.serve_fleet.stop()
+            self.serve_fleet = None
         if self.serve_server is not None:
             self.serve_server.stop()
         self._start_serve_server()
@@ -321,6 +362,14 @@ class PlayerStack:
             self._serve_weight_poll = lambda: self.store.poll("serve")
             self._serve_weight_version = \
                 lambda: self.store.reader_version("serve")
+            # fleet mode: each server slot is its OWN store reader
+            # ("serve0", "serve1", ...) so the slots' weight adoption
+            # and staleness stamps stay independent
+            self._serve_weight_poll_factory = (
+                lambda slot: (lambda: self.store.poll(f"serve{slot}")))
+            self._serve_weight_version_factory = (
+                lambda slot: (
+                    lambda: self.store.reader_version(f"serve{slot}")))
             self._serve_copy_updates = True
             self._serve_client_timed = True
             self._start_serve_server()
@@ -328,6 +377,7 @@ class PlayerStack:
             self._spawn_thread_actor(i)
         while len(self.threads) < self.n_slots:
             self.threads.append(_VacantSlot())
+        self._start_lease_server()
 
     def _spawn_thread_actor(self, i: int) -> threading.Thread:
         cfg = self.cfg
@@ -351,8 +401,15 @@ class PlayerStack:
         def should_stop(cancel=cancel):
             return self._stop.is_set() or cancel.is_set()
 
-        serve_channel = (self.serve_endpoint.connect()
-                         if self.serve_endpoint is not None else None)
+        if self.serve_fleet is not None:
+            # sharded serving: a routing channel over ALL fleet
+            # endpoints — requests aim by client-id hash and re-aim on
+            # MISROUTED bounces as the fleet grows/shrinks
+            serve_channel = self.serve_fleet.connect()
+        elif self.serve_endpoint is not None:
+            serve_channel = self.serve_endpoint.connect()
+        else:
+            serve_channel = None
         # weight distribution endpoints for this slot: its leaf relay of
         # the fan-out tree when configured (ISSUE 15), the root store
         # directly otherwise — identical (poll, version, current) shapes
@@ -486,6 +543,7 @@ class PlayerStack:
             self._spawn_process_actor(i)
         while len(self.processes) < self.n_slots:
             self.processes.append(_VacantSlot())
+        self._start_lease_server()
 
     def _start_serve_transport(self) -> None:
         """Process-mode serving: the server lives in THIS (learner)
@@ -503,6 +561,44 @@ class PlayerStack:
         template = self.learner.train_state.params
         if self._publish_prep is not None:
             template = self._publish_prep(template, 0)
+        if cfg.serve.servers > 1:
+            # sharded serving over processes (ISSUE 17): sockets only
+            # (config validation rejects shm + servers>1 — the shm rings
+            # are single-consumer). Each fleet slot reads weights through
+            # its OWN WeightSubscriber (independent adoption cursors) and
+            # listens on its own TCP port; the spec ships the full
+            # address map + the initial shard assignment so actor
+            # processes build a RoutingChannel without a handshake.
+            subs = {}
+
+            def _sub_for(slot):
+                if slot not in subs:
+                    s = WeightSubscriber(self.publisher.name, template)
+                    subs[slot] = s
+                    self._serve_weight_subs.append(s)
+                return subs[slot]
+
+            self._serve_weight_poll_factory = \
+                lambda slot: _sub_for(slot).poll
+            self._serve_weight_version_factory = (
+                lambda slot: (lambda: _sub_for(slot).publish_count))
+            self._serve_copy_updates = False
+            self._serve_client_timed = False
+            self._start_serve_server()     # builds the ServerFleet
+            from r2d2_tpu.serve import SocketServerTransport
+            servers = {}
+            for slot, ep in self.serve_fleet.serve_spec_servers().items():
+                port = cfg.serve.port + slot if cfg.serve.port else 0
+                t = SocketServerTransport(ep.submit, cfg.serve.host, port)
+                self._serve_fleet_transports.append(t)
+                servers[slot] = (t.host, t.port)
+            self._serve_spec = {
+                "transport": "socket_fleet",
+                "servers": servers,
+                "total_shards": self.serve_fleet.total_shards,
+                "assign": self.serve_fleet.shard_map.to_wire(),
+            }
+            return
         sub = WeightSubscriber(self.publisher.name, template)
         self._serve_weight_sub = sub
         self._serve_weight_poll = sub.poll
@@ -622,6 +718,11 @@ class PlayerStack:
         # (join_actor / the grammar's join@t schedule) re-admit it
         park = self._park_slot if self.cfg.fleet.elastic else None
         restarted = 0
+        if self.serve_fleet is not None:
+            # serving-fleet health rides the same cadence (ISSUE 17): a
+            # dead server's slot parks, survivors adopt its orphaned
+            # cache shards, and clients re-route off MISROUTED bounces
+            restarted += self.serve_fleet.supervise()
         # threads are scanned even with restarts off (respawn=None), like
         # processes below: the hang watchdog must still flag a wedged
         # thread and feed the failure counters — restart_dead_actors
@@ -723,6 +824,77 @@ class PlayerStack:
             self._seen_dead.discard(corpse)
         return lease
 
+    def _start_lease_server(self) -> None:
+        """Socket face of the lease table (ROADMAP 2c; gated on
+        ``fleet.lease_transport == "socket"``): ``cli/join.py`` dials
+        this to admit an acting worker into the running fleet — the SAME
+        ``join_actor`` slot-adoption path the in-process join schedule
+        uses — or to grow/shrink the serving fleet (ISSUE 17)."""
+        if self.cfg.fleet.lease_transport != "socket":
+            return
+        from r2d2_tpu.fleet.membership import MembershipServer
+
+        def _join(slot=None):
+            lease = self.join_actor(slot)
+            return {"slot": lease.slot, "generation": lease.generation,
+                    "lane_base": lease.lane_base, "lanes": lease.lanes,
+                    "shard_key": lease.shard_key}
+
+        def _leave(slot):
+            self.leave_actor(int(slot))
+            return {"slot": int(slot)}
+
+        def _grow_serve():
+            return {"slot": self.grow_serve_server(),
+                    "servers": sorted(self.serve_fleet.servers)}
+
+        def _shrink_serve(slot=None):
+            return {"slot": self.shrink_serve_server(slot),
+                    "servers": sorted(self.serve_fleet.servers)}
+
+        def _info():
+            info = {"membership": self.membership.snapshot(),
+                    "actor_mode": self._actor_mode}
+            if self.serve_fleet is not None:
+                info["serving"] = {
+                    "servers": sorted(self.serve_fleet.servers),
+                    "map_version": self.serve_fleet.shard_map.version,
+                }
+            if (self._serve_spec is not None
+                    and self._serve_spec.get("transport") != "shm"):
+                # socket specs travel (a joiner can dial the servers);
+                # the shm spec's ring handle is same-host/spawn-only
+                info["serve_spec"] = self._serve_spec
+            return info
+
+        self._lease_server = MembershipServer(
+            {"join": _join, "leave": _leave, "grow_serve": _grow_serve,
+             "shrink_serve": _shrink_serve, "info": _info},
+            host=self.cfg.fleet.lease_host,
+            port=self.cfg.fleet.lease_port)
+        import logging
+        logging.getLogger(__name__).info(
+            "fleet lease API on %s:%d", self._lease_server.host,
+            self._lease_server.port)
+
+    def grow_serve_server(self) -> int:
+        """Elastic serving fleet (ISSUE 17): lease a parked/free server
+        slot, re-slice the shard map, and hand the boundary shard groups
+        to the new server. Returns the grown slot."""
+        if self.serve_fleet is None:
+            raise RuntimeError("grow_serve_server requires serve.servers"
+                               " > 1 (a running ServerFleet)")
+        return self.serve_fleet.grow_server()
+
+    def shrink_serve_server(self, slot: Optional[int] = None) -> int:
+        """Retire a serving-fleet server: its shard groups rehome to the
+        survivors (leases, op-dedup and hidden state ride along), then
+        the slot parks. Returns the retired slot."""
+        if self.serve_fleet is None:
+            raise RuntimeError("shrink_serve_server requires serve.servers"
+                               " > 1 (a running ServerFleet)")
+        return self.serve_fleet.shrink_server(slot)
+
     def _replay_service_block(self):
         """The record's ``replay_service`` block: shard/spill health
         from the learner's service, fan-out relay stats, membership
@@ -767,14 +939,22 @@ class PlayerStack:
 
     def close(self) -> None:
         self.learner.stop_background()
+        if self._lease_server is not None:
+            self._lease_server.close()
         if self._service_server is not None:
             self._service_server.close()
         if self.serve_server is not None:
             self.serve_server.stop()
+        if self.serve_fleet is not None:
+            self.serve_fleet.stop()
         if self._serve_transport is not None:
             self._serve_transport.close()
+        for t in self._serve_fleet_transports:
+            t.close()
         if self._serve_weight_sub is not None:
             self._serve_weight_sub.close()
+        for s in self._serve_weight_subs:
+            s.close()
         if self._shm_fanout is not None:
             # relays close BEFORE the root publisher: each holds a
             # subscriber on the root (or a parent relay's) segment

@@ -4,19 +4,26 @@ server with server-side recurrent state — the SEED-style serving plane.
     transport.py   — the rung ladder: in-proc queue, shm record rings
                      (the shm_feeder discipline), TCP sockets
     state_cache.py — sharded per-client LSTM/frame-stack cache with
-                     lease/evict/reconnect semantics
-    server.py      — the micro-batcher + jitted forward loop, ServingStats
+                     lease/evict/reconnect + shard-handoff semantics
+    server.py      — the micro-batcher + jitted forward loop, ServingStats,
+                     admission control (queue-depth brownout)
+    router.py      — the serving fleet (ISSUE 17): shard→server routing,
+                     ServerFleet with elastic grow/shrink/adopt
     client.py      — RemotePolicy / RemoteBatchedPolicy (the local
                      policies' surface, served)
 """
 
 from r2d2_tpu.serve.client import RemoteBatchedPolicy, RemotePolicy
+from r2d2_tpu.serve.router import (RoutingChannel, ServerFleet, ShardMap,
+                                   contiguous_partition)
 from r2d2_tpu.serve.server import (PolicyServer, ServingStats, collect_batch,
                                    serve_buckets)
-from r2d2_tpu.serve.state_cache import StateCache
+from r2d2_tpu.serve.state_cache import MisroutedClient, StateCache
 from r2d2_tpu.serve.transport import (InprocChannel, InprocEndpoint,
                                       KIND_BOOTSTRAP, KIND_DISCONNECT,
                                       KIND_STEP, Reply, Request,
+                                      STATUS_EXPIRED, STATUS_MISROUTED,
+                                      STATUS_OK, STATUS_RETRY,
                                       ServeTimeout, ServeUnavailable,
                                       ShmRecordRing, ShmServeChannel,
                                       ShmServeTransport, SocketChannel,
@@ -24,9 +31,11 @@ from r2d2_tpu.serve.transport import (InprocChannel, InprocEndpoint,
 
 __all__ = [
     "RemoteBatchedPolicy", "RemotePolicy", "PolicyServer", "ServingStats",
-    "collect_batch", "serve_buckets", "StateCache", "InprocChannel",
-    "InprocEndpoint", "KIND_BOOTSTRAP", "KIND_DISCONNECT", "KIND_STEP",
-    "Reply", "Request", "ServeTimeout", "ServeUnavailable", "ShmRecordRing",
-    "ShmServeChannel", "ShmServeTransport", "SocketChannel",
+    "collect_batch", "serve_buckets", "MisroutedClient", "StateCache",
+    "RoutingChannel", "ServerFleet", "ShardMap", "contiguous_partition",
+    "InprocChannel", "InprocEndpoint", "KIND_BOOTSTRAP", "KIND_DISCONNECT",
+    "KIND_STEP", "Reply", "Request", "STATUS_EXPIRED", "STATUS_MISROUTED",
+    "STATUS_OK", "STATUS_RETRY", "ServeTimeout", "ServeUnavailable",
+    "ShmRecordRing", "ShmServeChannel", "ShmServeTransport", "SocketChannel",
     "SocketServerTransport",
 ]

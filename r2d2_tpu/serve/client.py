@@ -33,8 +33,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from r2d2_tpu.serve.transport import (KIND_BOOTSTRAP, KIND_STEP, Reply,
-                                      Request, STATUS_OK, ServeTimeout,
-                                      ServeUnavailable)
+                                      Request, STATUS_OK, STATUS_RETRY,
+                                      ServeTimeout, ServeUnavailable)
 
 
 class _Lane:
@@ -101,8 +101,10 @@ class _RetryPolicy:
         self.health = WorkerHealth(
             1, None, backoff_base_s=backoff_base_s,
             backoff_max_s=backoff_max_s, max_restarts_per_window=0)
+        self.failures = 0
 
     def on_failure(self) -> None:
+        self.failures += 1
         self.health.on_failure(0, time.time())
 
     def wait(self, should_stop: Optional[Callable[[], bool]] = None) -> None:
@@ -122,11 +124,20 @@ class _RemoteBase:
         self.stats = stats
         self.timeout_s = timeout_s
         self.max_retry_s = max_retry_s
+        self._backoff = (backoff_base_s, backoff_max_s)
         self._retry = _RetryPolicy(backoff_base_s, backoff_max_s)
+        # shed (brownout) pacing is its OWN ladder, reset once an
+        # exchange completes: the crash ladder accumulates across
+        # exchanges (right for a flapping server), but a browning-out
+        # server that still makes progress every tick would walk the
+        # client to the multi-second cap and collapse goodput far below
+        # what the server is actually shedding
+        self._shed_retry = _RetryPolicy(backoff_base_s, backoff_max_s)
         self._should_stop = should_stop
         self.weight_version = 0
         self.timeouts = 0
         self.reconnects = 0
+        self.shed_retries = 0      # STATUS_RETRY rejections absorbed
 
     def update_params(self, params) -> None:
         """No-op: the server owns (and syncs) the weights."""
@@ -151,20 +162,22 @@ class _RemoteBase:
                 [reqs[lane.client_id] for lane in pending_lanes],
                 timeout=self.timeout_s)
             now = time.monotonic()
-            missing, expired = [], []
+            missing, expired, shed = [], [], []
             for lane in pending_lanes:
                 reply = got.get(reqs[lane.client_id].req_id)
                 if reply is None:
                     missing.append(lane)
                 elif reply.status == STATUS_OK:
                     out[lane.client_id] = reply
+                elif reply.status == STATUS_RETRY:
+                    shed.append((lane, reply))
                 else:
                     expired.append(lane)
-            if now - t0 > self.max_retry_s and (missing or expired):
+            if now - t0 > self.max_retry_s and (missing or expired or shed):
                 raise ServeUnavailable(
                     f"policy server unreachable for {now - t0:.1f}s")
             if self._should_stop is not None and self._should_stop() \
-                    and (missing or expired):
+                    and (missing or expired or shed):
                 raise ServeUnavailable("stopped while retrying")
             # EXPIRED: the server is alive but judged the request stale
             # (its TTL guards against replaying a dead server's backlog)
@@ -174,9 +187,25 @@ class _RemoteBase:
             # cannot busy-spin the core at full request rate
             for lane in expired:
                 reqs[lane.client_id] = lane.build(kind)
+            # SHED (brownout): admission control rejected at the queue
+            # bound — NOT applied. Same rebuild + ladder as EXPIRED, but
+            # honor the server's retry-after hint first so a browning-out
+            # server is not re-hammered at the ladder's immediate first
+            # retry
+            if shed:
+                self.shed_retries += len(shed)
+                for lane, _r in shed:
+                    reqs[lane.client_id] = lane.build(kind)
+            if shed and not missing:
+                pause = max(r.retry_after_ms for _, r in shed) / 1e3
+                if pause > 0:
+                    time.sleep(min(pause, 1.0))
             if expired and not missing:
                 self._retry.on_failure()
                 self._retry.wait(self._should_stop)
+            elif shed and not missing:
+                self._shed_retry.on_failure()
+                self._shed_retry.wait(self._should_stop)
             if missing:
                 self.timeouts += len(missing)
                 if self.stats is not None:
@@ -191,6 +220,10 @@ class _RemoteBase:
                 for lane in missing:
                     reqs[lane.client_id] = lane.build(kind)
         elapsed = time.monotonic() - t0
+        if self._shed_retry.failures:
+            # exchange completed: the brownout is admitting us again, so
+            # the next shed starts back at the ladder's first rung
+            self._shed_retry = _RetryPolicy(*self._backoff)
         if self.stats is not None:
             for _ in lanes:
                 self.stats.on_request_latency(elapsed)

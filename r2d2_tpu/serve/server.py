@@ -34,8 +34,11 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from r2d2_tpu.serve.state_cache import MisroutedClient
 from r2d2_tpu.serve.transport import (KIND_DISCONNECT, KIND_STEP, Reply,
-                                      Request, STATUS_EXPIRED, STATUS_OK)
+                                      Request, STATUS_EXPIRED,
+                                      STATUS_MISROUTED, STATUS_OK,
+                                      STATUS_RETRY)
 
 
 def serve_buckets(max_batch: int) -> List[int]:
@@ -63,22 +66,28 @@ def collect_batch(inbox: "queue.Queue", first, max_batch: int,
     represented, waiting out the deadline is pure added latency — the
     measured cost was a full deadline per dispatch at steady state).
     Reaching it stops the WAIT but still drains any immediately-pending
-    backlog up to ``max_batch``. Module-level so the deadline/fill
-    semantics unit-test without a server."""
+    backlog up to ``max_batch``.
+
+    The deadline bounds WAITING only: when ``first`` is already past it
+    (it aged in the queue while the server was mid-forward), the
+    immediately-pending backlog is still drained before dispatch —
+    otherwise a backlogged server degenerates into batch-1 dispatches
+    of stale requests, each one aging the rest of the queue further
+    (measured as fill ~1 at 4x the per-request latency under a 4-deep
+    backlog). Module-level so the deadline/fill semantics unit-test
+    without a server."""
     batch = [first]
     deadline = first[0].t_recv + deadline_s
     target = (max_batch if expected is None
               else min(max_batch, max(int(expected), 1)))
     while len(batch) < max_batch:
-        if len(batch) >= target:
+        remaining = deadline - time.monotonic()
+        if len(batch) >= target or remaining <= 0:
             try:
                 batch.append(inbox.get_nowait())
                 continue           # burst backlog: take it, don't wait
             except queue.Empty:
                 break
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            break
         try:
             batch.append(inbox.get(timeout=remaining))
         except queue.Empty:
@@ -116,6 +125,14 @@ class ServingStats:
         self._reconnects = 0
         self._evictions = 0
         self.active_clients = 0
+        # -- admission control / routing (ISSUE 17) -- the ``admission``
+        # sub-block only exists when the fleet features are ON
+        # (admission_enabled), which is what keeps the default
+        # single-server record byte-identical (kill-switch contract).
+        self.admission_enabled = False
+        self._shed = 0
+        self._misrouted = 0
+        self._adm_lat = np.zeros(NBUCKETS, np.int64)
 
     # -- feed points --
 
@@ -155,6 +172,26 @@ class ServingStats:
     def on_expired(self, n: int = 1) -> None:
         with self._lock:
             self._expired += n
+
+    def on_shed(self, n: int = 1) -> None:
+        """Requests rejected at the queue-depth bound (STATUS_RETRY) —
+        they count as requests seen but never reach a dispatch."""
+        with self._lock:
+            self._shed += n
+            self._requests += n
+
+    def on_misrouted(self, n: int = 1) -> None:
+        """Requests aimed at a server that does not own the client's
+        shard (stale routing map) — bounced with the current map."""
+        with self._lock:
+            self._misrouted += n
+
+    def on_admitted_latency(self, seconds: float) -> None:
+        """Server-side receive→reply latency of an ADMITTED request —
+        the brownout contract's p99 (shed requests never enter it)."""
+        from r2d2_tpu.telemetry.histogram import bucket_index
+        with self._lock:
+            self._adm_lat[bucket_index(seconds)] += 1
 
     def on_clients(self, connects: int = 0, reconnects: int = 0,
                    disconnects: int = 0, evictions: int = 0) -> None:
@@ -210,12 +247,23 @@ class ServingStats:
                 block["deadline_ms"] = deadline_ms
             if max_batch is not None:
                 block["max_batch"] = max_batch
+            if self.admission_enabled:
+                adm = summarize(self._adm_lat)
+                block["admission"] = {
+                    "shed": self._shed,
+                    "shed_frac": (round(self._shed / self._requests, 3)
+                                  if self._requests else 0.0),
+                    "misrouted": self._misrouted,
+                    "admitted_latency": adm,
+                }
             self._lat[:] = 0
             self._fill[:] = 0
             self._fill_sum = 0
             self._batches = self._full = self._deadline = self._starved = 0
             self._requests = self._replies = self._expired = 0
             self._connects = self._reconnects = self._evictions = 0
+            self._shed = self._misrouted = 0
+            self._adm_lat[:] = 0
         return block
 
 
@@ -241,7 +289,11 @@ class PolicyServer:
                  copy_updates: bool = True,
                  stats: Optional[ServingStats] = None,
                  telemetry=None, client_timed: bool = False,
-                 warmup: Optional[bool] = None, quant_stats=None):
+                 warmup: Optional[bool] = None, quant_stats=None,
+                 cache=None, server_id: int = 0, shard_map=None,
+                 queue_depth_bound: Optional[int] = None,
+                 device_index: int = 0, forward_fn=None,
+                 local_stats: Optional[ServingStats] = None):
         import jax
 
         from r2d2_tpu.actor.policy import (_force_f32, _pin_params,
@@ -262,11 +314,28 @@ class PolicyServer:
         self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
         self._client_timed = client_timed
         self.endpoint = endpoint
+        # -- serving fleet (ISSUE 17) --
+        self.server_id = server_id
+        self._shard_map = shard_map
+        self.queue_depth_bound = (sv.queue_depth_bound
+                                  if queue_depth_bound is None
+                                  else queue_depth_bound)
+        self.local_stats = local_stats
+        # grow/shrink moves whole shard groups between live servers:
+        # the fleet holds this lock while detaching/importing, and the
+        # dispatch path holds it across every cache mutation
+        self.cache_lock = threading.Lock()
+        if self.queue_depth_bound > 0 or sv.servers > 1:
+            self.stats.admission_enabled = True
+            if local_stats is not None:
+                local_stats.admission_enabled = True
         # The serving forward runs on THIS process's default backend —
         # the accelerator, when there is one: central placement is the
         # point (SEED). On CPU hosts force f32 like the local policies
-        # (bf16 is emulated and slower there).
-        self._device = jax.local_devices()[0]
+        # (bf16 is emulated and slower there). Fleet servers pin by
+        # slot (device_index) so N loops spread over N devices.
+        devs = jax.local_devices()
+        self._device = devs[device_index % len(devs)]
         if self._device.platform != "tpu":
             net = _force_f32(net)
         self.net = net
@@ -280,8 +349,15 @@ class PolicyServer:
         self.quant_stats = quant_stats
         self._quant_probe_interval = (cfg.telemetry.quant_probe_interval
                                       if self._quant else 0)
-        self._fwd = make_forward_fn(
-            net, probe_interval=self._quant_probe_interval)
+        if forward_fn is not None:
+            # bench-only device stand-in (timed-forward emulation):
+            # plain f32 signature, no quant probe, no warmup needed
+            self._quant = False
+            self._quant_probe_interval = 0
+            self._fwd = forward_fn
+        else:
+            self._fwd = make_forward_fn(
+                net, probe_interval=self._quant_probe_interval)
         if self._quant and not is_quant_bundle(params):
             # direct construction from raw params (cold start, the
             # standalone CLI): build the twin once here — the weight
@@ -289,16 +365,18 @@ class PolicyServer:
             params = jax.device_get(make_inference_bundle(net, params))
         self._params = _pin_params(params, self._device, copy=True)
         h, w, s = net.obs_hw
-        self.cache = StateCacheFromConfig(cfg, (h, w), s,
-                                          net.config.hidden_dim,
-                                          net.action_dim)
+        self.cache = (cache if cache is not None
+                      else StateCacheFromConfig(cfg, (h, w), s,
+                                                net.config.hidden_dim,
+                                                net.action_dim))
         self.buckets = serve_buckets(self.max_batch)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_weight_poll = 0.0
         self._last_sweep = 0.0
         self.batches_dispatched = 0
-        if warmup if warmup is not None else sv.warmup:
+        if forward_fn is None and (warmup if warmup is not None
+                                   else sv.warmup):
             self._warmup((h, w, s))
 
     def _warmup(self, obs_hw: Tuple[int, int, int]) -> None:
@@ -353,12 +431,68 @@ class PolicyServer:
                 batch = collect_batch(self.endpoint.inbox, first,
                                       self.max_batch, self.deadline_s,
                                       expected=self.cache.active_clients)
-                self._dispatch(batch)
+                self._shed_overflow()
+                with self.cache_lock:
+                    self._dispatch(batch)
                 self._idle_work()
         except Exception:
             logging.getLogger(__name__).exception(
                 "policy server loop died; clients will time out and back "
                 "off until a replacement starts")
+
+    def _each_stats(self):
+        yield self.stats
+        if self.local_stats is not None:
+            yield self.local_stats
+
+    def _shed_overflow(self) -> None:
+        """Admission control (ISSUE 17): after each batch fill, shed the
+        OLDEST still-queued requests while the backlog exceeds
+        ``queue_depth_bound`` — a fast STATUS_RETRY (with a retry-after
+        hint one deadline out) instead of letting batch_wait run away.
+        Shedding the queue head converts the worst-latency waits into
+        rejects the client backs off on (WorkerHealth ladder).
+        Disconnects are never shed: retention bookkeeping must run."""
+        bound = self.queue_depth_bound
+        if bound <= 0:
+            return
+        inbox = self.endpoint.inbox
+        shed = 0
+        while inbox.qsize() > bound:
+            try:
+                req, cb = inbox.get_nowait()
+            except queue.Empty:
+                break
+            if req.kind == KIND_DISCONNECT:
+                now = time.monotonic()
+                with self.cache_lock:
+                    try:
+                        if self.cache.release(req.client_id, now):
+                            for st in self._each_stats():
+                                st.on_clients(disconnects=1)
+                    except MisroutedClient:
+                        pass        # unowned client: disconnect is a no-op
+                self._safe_reply(cb, Reply(
+                    req.req_id, STATUS_OK,
+                    weight_version=self.weight_version))
+                continue
+            shed += 1
+            self._safe_reply(cb, Reply(
+                req.req_id, STATUS_RETRY,
+                retry_after_ms=self.cfg.serve.deadline_ms))
+        if shed:
+            for st in self._each_stats():
+                st.on_shed(shed)
+
+    def _misroute_reply(self, cb: Callable, req: Request) -> None:
+        """Stale routing map: bounce with the CURRENT map so the routing
+        client re-aims without a discovery round trip."""
+        wire = (self._shard_map.to_wire()
+                if self._shard_map is not None else None)
+        for st in self._each_stats():
+            st.on_misrouted(1)
+        self._safe_reply(cb, Reply(req.req_id, STATUS_MISROUTED,
+                                   shard_map=wire))
 
     def _idle_work(self) -> None:
         now = time.monotonic()
@@ -383,10 +517,13 @@ class PolicyServer:
                     self.weight_version = int(self._weight_version_fn())
         if now - self._last_sweep >= 1.0:
             self._last_sweep = now
-            evicted = self.cache.sweep(now)
-            if evicted:
-                self.stats.on_clients(evictions=evicted)
-            self.stats.active_clients = self.cache.active_clients
+            with self.cache_lock:
+                evicted = self.cache.sweep(now)
+                active = self.cache.active_clients
+            for st in self._each_stats():
+                if evicted:
+                    st.on_clients(evictions=evicted)
+                st.active_clients = active
 
     def _dispatch(self, batch: list) -> None:
         now = time.monotonic()
@@ -394,14 +531,21 @@ class PolicyServer:
         tele.observe("serve/batch_wait", max(now - batch[0][0].t_recv, 0.0))
         for req, _cb in batch:
             tele.observe("serve/enqueue", max(now - req.t_recv, 0.0))
-        self.stats.on_requests(len(batch))
+        for st in self._each_stats():
+            st.on_requests(len(batch))
         live: List[Tuple[Request, Callable, int]] = []
         ev0 = self.cache.evictions
         co0, rc0 = self.cache.connects, self.cache.reconnects
         for req, cb in batch:
             if req.kind == KIND_DISCONNECT:
-                if self.cache.release(req.client_id, now):
-                    self.stats.on_clients(disconnects=1)
+                try:
+                    released = self.cache.release(req.client_id, now)
+                except MisroutedClient:
+                    self._misroute_reply(cb, req)
+                    continue
+                if released:
+                    for st in self._each_stats():
+                        st.on_clients(disconnects=1)
                 self._safe_reply(cb, Reply(req.req_id, STATUS_OK,
                                            weight_version=self.weight_version))
                 continue
@@ -412,10 +556,15 @@ class PolicyServer:
                 # the SERVER-side arrival stamp (t_recv), which is
                 # comparable across processes and hosts; the client's
                 # t_submit monotonic clock is neither.
-                self.stats.on_expired()
+                for st in self._each_stats():
+                    st.on_expired()
                 self._safe_reply(cb, Reply(req.req_id, STATUS_EXPIRED))
                 continue
-            slot, fresh = self.cache.lease(req.client_id, now)
+            try:
+                slot, fresh = self.cache.lease(req.client_id, now)
+            except MisroutedClient:
+                self._misroute_reply(cb, req)
+                continue
             if fresh:
                 # unknown client (first contact, post-eviction, or a
                 # server that restarted and lost the cache): start from
@@ -436,12 +585,14 @@ class PolicyServer:
                         req.req_id, STATUS_OK, action, q,
                         self.cache.hidden[slot].copy(),
                         weight_version=self.weight_version))
-                    self.stats.on_replies(1)
+                    for st in self._each_stats():
+                        st.on_replies(1)
                     continue
                 if req.op_seq < last:
                     # older than the applied horizon: a stale copy the
                     # client has already moved past — never re-apply
-                    self.stats.on_expired()
+                    for st in self._each_stats():
+                        st.on_expired()
                     self._safe_reply(cb, Reply(req.req_id, STATUS_EXPIRED))
                     continue
             if req.reset_obs is not None:
@@ -449,11 +600,12 @@ class PolicyServer:
             elif req.obs is not None:
                 self.cache.observe(slot, req.obs, req.action)
             live.append((req, cb, slot))
-        self.stats.on_clients(
-            connects=self.cache.connects - co0,
-            reconnects=self.cache.reconnects - rc0,
-            evictions=self.cache.evictions - ev0)
-        self.stats.active_clients = self.cache.active_clients
+        for st in self._each_stats():
+            st.on_clients(
+                connects=self.cache.connects - co0,
+                reconnects=self.cache.reconnects - rc0,
+                evictions=self.cache.evictions - ev0)
+            st.active_clients = self.cache.active_clients
         if not live:
             return
         fill = len(live)
@@ -498,17 +650,23 @@ class PolicyServer:
             self._safe_reply(cb, Reply(
                 req.req_id, STATUS_OK, int(actions[i]), q[i].copy(),
                 h[i].copy(), weight_version=self.weight_version))
-            if not self._client_timed:
-                self.stats.on_request_latency(
-                    max(reply_t - req.t_recv, 0.0))
+            lat = max(reply_t - req.t_recv, 0.0)
+            for st in self._each_stats():
+                if not self._client_timed:
+                    st.on_request_latency(lat)
+                if st.admission_enabled:
+                    # the brownout contract's p99: server-side
+                    # receive→reply of ADMITTED requests only
+                    st.on_admitted_latency(lat)
         tele.observe("serve/reply", time.perf_counter() - t1)
-        self.stats.on_replies(fill)
-        self.stats.on_batch(
-            fill,
-            hit_full=len(batch) >= self.max_batch,
-            hit_deadline=(len(batch) < self.max_batch
-                          and now - batch[0][0].t_recv >= self.deadline_s),
-            starved=(fill == 1 and self.cache.active_clients > 1))
+        for st in self._each_stats():
+            st.on_replies(fill)
+            st.on_batch(
+                fill,
+                hit_full=len(batch) >= self.max_batch,
+                hit_deadline=(len(batch) < self.max_batch
+                              and now - batch[0][0].t_recv >= self.deadline_s),
+                starved=(fill == 1 and self.cache.active_clients > 1))
         self.batches_dispatched += 1
 
     @staticmethod

@@ -28,22 +28,54 @@ a served actor's blocks are indistinguishable from a local one's.
 """
 
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 
+class MisroutedClient(Exception):
+    """A request reached a server whose cache does not own the client's
+    shard group (the fleet re-sliced mid-flight): the server replies
+    STATUS_MISROUTED with the current shard→server map instead of
+    touching state, and the routing client re-aims."""
+
+    def __init__(self, shard: int):
+        super().__init__(f"client shard {shard} not owned by this cache")
+        self.shard = shard
+
+
 class StateCache:
+    """``owned_shards``/``total_shards`` (fleet mode): this cache holds
+    only the named GLOBAL shard groups of a ``total_shards``-wide hash
+    space — server k's contiguous slice. Slot indices stay local and
+    contiguous (owned position p covers ``[p*per_shard, (p+1)*per_shard)``);
+    only the client→shard hash spans the global space. Defaults keep the
+    single-server layout byte-identical (owns every shard)."""
+
     def __init__(self, slots: int, shards: int, frame_hw: Tuple[int, int],
                  frame_stack: int, hidden_dim: int,
-                 lease_timeout_s: float = 120.0, action_dim: int = 1):
-        if slots % shards != 0:
+                 lease_timeout_s: float = 120.0, action_dim: int = 1,
+                 owned_shards: Optional[Sequence[int]] = None,
+                 total_shards: Optional[int] = None):
+        if shards > 0 and slots % shards != 0:
             raise ValueError(f"state slots ({slots}) must be divisible by "
                              f"shards ({shards})")
         self.slots = slots
         self.shards = shards
-        self.per_shard = slots // shards
+        self.per_shard = slots // shards if shards else 0
+        self.total_shards = shards if total_shards is None else total_shards
+        self._owned = (list(range(shards)) if owned_shards is None
+                       else [int(g) for g in owned_shards])
+        if len(self._owned) != shards:
+            raise ValueError(
+                f"owned_shards has {len(self._owned)} entries for "
+                f"{shards} shard groups")
+        self._pos = {g: p for p, g in enumerate(self._owned)}
         self.lease_timeout_s = lease_timeout_s
+        self._frame_hw = tuple(frame_hw)
+        self._frame_stack = frame_stack
+        self._hidden_dim = hidden_dim
+        self._action_dim = action_dim
         h, w = frame_hw
         self.hidden = np.zeros((slots, 2, hidden_dim), np.float32)
         self.stacked = np.zeros((slots, h, w, frame_stack), np.float32)
@@ -69,7 +101,15 @@ class StateCache:
     # -- leases --
 
     def _shard_of(self, client_id: int) -> int:
-        return int(client_id) % self.shards
+        g = int(client_id) % self.total_shards
+        p = self._pos.get(g)
+        if p is None:
+            raise MisroutedClient(g)
+        return p
+
+    @property
+    def owned_shards(self) -> List[int]:
+        return list(self._owned)
 
     @property
     def active_clients(self) -> int:
@@ -199,3 +239,87 @@ class StateCache:
 
     def cached_reply(self, slot: int) -> Tuple[int, np.ndarray]:
         return int(self.reply_action[slot]), self.reply_q[slot].copy()
+
+    # -- shard lease-handoff (the elastic serve fleet's re-slice) --
+    #
+    # A shard group moves between servers as ONE package: its state
+    # arrays (hidden/stack/last_action), the idempotent-op bookkeeping
+    # (op_seq + cached replies — a retried op deduplicates across the
+    # handoff, which is what makes a mid-kill re-route bit-identical),
+    # and the lease table with connect/last-seen ages (disconnect
+    # retention survives the move).
+
+    _ARRAYS = ("hidden", "stacked", "last_action", "op_seq",
+               "reply_action", "reply_q", "_slot_client", "_last_seen",
+               "_connected")
+
+    def export_shard(self, shard: int) -> dict:
+        """Copy global shard group ``shard``'s full state out (the donor
+        keeps it — see :meth:`detach_shard` for the removing variant)."""
+        p = self._pos[int(shard)]
+        lo, hi = p * self.per_shard, (p + 1) * self.per_shard
+        state = {name: getattr(self, name)[lo:hi].copy()
+                 for name in self._ARRAYS}
+        state["shard"] = int(shard)
+        state["per_shard"] = self.per_shard
+        state["leases"] = {c: s - lo for c, s in self._leases[p].items()}
+        return state
+
+    def detach_shard(self, shard: int) -> dict:
+        """Export global shard group ``shard`` and REMOVE it from this
+        cache — the donor half of a re-slice. Later requests hashing onto
+        it raise :class:`MisroutedClient` (→ STATUS_MISROUTED + map)."""
+        state = self.export_shard(shard)
+        p = self._pos.pop(int(shard))
+        lo = p * self.per_shard
+        keep = np.ones(self.slots, bool)
+        keep[lo:lo + self.per_shard] = False
+        for name in self._ARRAYS:
+            setattr(self, name, getattr(self, name)[keep])
+        self._leases.pop(p)
+        # the compaction shifted every later group's rows down one
+        # group: rebase those groups' lease slot indices to match
+        for q in range(p, len(self._leases)):
+            self._leases[q] = {c: s - self.per_shard
+                               for c, s in self._leases[q].items()}
+        self._owned.pop(p)
+        self._pos = {g: q for q, g in enumerate(self._owned)}
+        self.shards -= 1
+        self.slots -= self.per_shard
+        return state
+
+    def import_shard(self, state: dict) -> None:
+        """Append a handed-off shard group (the adopter half). The group
+        arrives with its leases, ages, and op bookkeeping intact, so
+        retained-state reconnects and retry dedup span the handoff."""
+        if state["per_shard"] != self.per_shard:
+            raise ValueError(
+                f"shard geometry mismatch: incoming per_shard "
+                f"{state['per_shard']} != {self.per_shard}")
+        g = int(state["shard"])
+        if g in self._pos:
+            raise ValueError(f"shard {g} already owned")
+        lo = self.slots
+        for name in self._ARRAYS:
+            setattr(self, name,
+                    np.concatenate([getattr(self, name), state[name]]))
+        self._leases.append({c: s + lo for c, s in state["leases"].items()})
+        self._owned.append(g)
+        self._pos[g] = len(self._owned) - 1
+        self.shards += 1
+        self.slots += self.per_shard
+
+    def restore_shard(self, state: dict) -> None:
+        """Overwrite an ALREADY-OWNED (fresh) shard group in place with
+        handed-off state — how a newly-grown server adopts the shards the
+        re-slice assigned to it."""
+        g = int(state["shard"])
+        if state["per_shard"] != self.per_shard:
+            raise ValueError(
+                f"shard geometry mismatch: incoming per_shard "
+                f"{state['per_shard']} != {self.per_shard}")
+        p = self._pos[g]
+        lo = p * self.per_shard
+        for name in self._ARRAYS:
+            getattr(self, name)[lo:lo + self.per_shard] = state[name]
+        self._leases[p] = {c: s + lo for c, s in state["leases"].items()}

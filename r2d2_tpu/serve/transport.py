@@ -40,7 +40,13 @@ import numpy as np
 # the client's state-slot lease (state retained until the lease times
 # out, so a reconnect resumes mid-episode).
 KIND_STEP, KIND_BOOTSTRAP, KIND_DISCONNECT = 0, 1, 2
-STATUS_OK, STATUS_EXPIRED = 0, 1
+# Reply statuses. EXPIRED: judged stale, NOT applied — rebuild + resend.
+# MISROUTED: this server does not own the client's state shard (the
+# fleet re-sliced); the reply carries the current shard→server map so a
+# routing client re-aims before resending. RETRY: admission control shed
+# the request at the queue-depth bound (brownout) — NOT applied; back
+# off ``retry_after_ms`` on the ladder and resend.
+STATUS_OK, STATUS_EXPIRED, STATUS_MISROUTED, STATUS_RETRY = 0, 1, 2, 3
 
 # shm layout: reply-ring names are materialized into a fixed char field
 _REPLY_NAME_BYTES = 48
@@ -91,6 +97,13 @@ class Reply:
     q: Optional[np.ndarray] = None           # (A,) f32
     hidden: Optional[np.ndarray] = None      # (2, hidden) f32 post-step
     weight_version: int = 0        # server's adopted publish count
+    # Admission control (STATUS_RETRY): suggested client pause before the
+    # resend — informational; the client's WorkerHealth ladder paces it.
+    retry_after_ms: float = 0.0
+    # Fleet routing (STATUS_MISROUTED): the replying server's current
+    # shard→server assignment, so a RoutingChannel re-aims without a
+    # separate map-fetch round trip. None on every other status.
+    shard_map: Optional[tuple] = None
 
 
 # ---------------------------------------------------------------------------
@@ -273,6 +286,11 @@ class SocketServerTransport:
             except OSError:
                 return
             conn.settimeout(None)
+            # request/reply at env-step cadence is exactly the small-
+            # write/small-read pattern Nagle + delayed ACK turns into a
+            # ~40 ms stall per exchange — same fix as the replay service
+            # rung (fleet/replay_service.py), which left serving behind
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._conns.append(conn)
             threading.Thread(target=self._reader_loop, args=(conn,),
                              daemon=True, name="serve-conn").start()
@@ -331,6 +349,10 @@ class SocketChannel:
             s = socket.create_connection(self._addr,
                                          timeout=self._dial_timeout)
             s.settimeout(self._dial_timeout)
+            # disable Nagle on the client side too: a reply ACK riding a
+            # delayed timer stalls the next pipelined send (the replay
+            # rung's measured ~40 ms per small exchange)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
             self._stash.clear()
         return self._sock

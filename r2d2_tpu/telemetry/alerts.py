@@ -204,6 +204,14 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("serve_client_churn", "counter",
                   ("serving", "clients", "disconnects"),
                   tcfg.alerts_serve_churn, "warn"),
+        # brownout (ISSUE 17; the serving block's admission sub-block —
+        # present only when admission control or the serving fleet is
+        # ON): the interval's shed fraction crossed the ceiling — the
+        # fleet is rejecting a sustained share of offered load at the
+        # queue-depth bound, i.e. under-provisioned, not just bursty
+        AlertRule("serve_brownout", "threshold",
+                  ("serving", "admission", "shed_frac"),
+                  tcfg.alerts_serve_shed_frac, "warn"),
         # quantized-inference rule (ISSUE 14; the quant block,
         # telemetry/quant.py — inactive on records without it, i.e.
         # every inference_dtype="f32" run): the interval's lane-weighted

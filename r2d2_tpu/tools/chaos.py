@@ -497,6 +497,127 @@ def run_serve_chaos(seconds: float = 45.0, outage_s: float = 6.0,
     return report
 
 
+def run_serve_fleet_chaos(seconds: float = 45.0, servers: int = 2,
+                          config_overrides: dict = None) -> dict:
+    """Kill-one-of-N serving-fleet drill (ISSUE 17): thread actors act
+    through a SHARDED serving fleet; mid-run one server loop is killed
+    abruptly (no handoff). The claims under test: (a) the learner never
+    stalls; (b) the supervision pass ADOPTS the victim's orphaned cache
+    shards into survivors (leases + op-dedup + hidden state ride along,
+    so re-routed streams stay bit-identical — the fast tests pin that
+    exactly); (c) clients re-route off the MISROUTED bounces (the shard
+    map version moves forward) and resume feeding blocks; (d) the
+    serving fleet ends the run one server smaller with every shard
+    owned."""
+    import threading
+
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.envs.factory import create_env
+    from r2d2_tpu.runtime.orchestrator import PlayerStack
+
+    overrides = {
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        # 4 actors spread client ids over the shard ring so every server
+        # owns live streams when the victim dies
+        "actor.num_actors": 4, "actor.inference": "server",
+        "serve.servers": servers, "serve.max_servers": servers,
+        "serve.state_shards": 8, "serve.state_slots": 1024,
+        "serve.max_batch": 8, "serve.deadline_ms": 3.0,
+        "serve.request_timeout_s": 0.5,
+        "serve.max_retry_s": 600.0,
+        "telemetry.alerts_serve_p99_ms": 200.0,
+        "runtime.save_interval": 0, "runtime.log_interval": 1.5,
+        "runtime.steps_per_dispatch": 1,
+        "runtime.supervise_interval_s": 1.0,
+        "runtime.ingest_stall_timeout_s": 0.0,
+    }
+    overrides.update(config_overrides or {})
+    cfg = Config().replace(**overrides)
+
+    probe = create_env(cfg.env, seed=0)
+    action_dim = probe.action_space.n
+    probe.close()
+
+    stop = threading.Event()
+    stack = PlayerStack(cfg, 0, action_dim)
+    records = []
+    t0 = time.time()
+    kill_at = t0 + max(seconds * 0.35, 8.0)
+    state = "healthy"
+    steps_at_kill = None
+    victim = None
+    map_v0 = None
+    last_log = last_supervise = t0
+    try:
+        stack.start_actors_threads(stop)
+        map_v0 = stack.serve_fleet.shard_map.version
+        while time.time() - t0 < seconds:
+            stack.learner.drain(stack.queue)
+            if stack.learner.ready:
+                stack.learner.step()
+            now = time.time()
+            if state == "healthy" and now >= kill_at:
+                steps_at_kill = stack.learner.training_steps
+                victim = max(stack.serve_fleet.servers)
+                stack.serve_fleet.kill_server(victim)
+                state = "killed"
+            if now - last_supervise >= cfg.runtime.supervise_interval_s:
+                stack.supervise()   # adopts the victim's orphaned shards
+                last_supervise = now
+            if now - last_log >= cfg.runtime.log_interval:
+                stack.learner.flush_metrics()
+                records.append(
+                    {"phase": state, **stack.metrics.log(now - last_log)})
+                last_log = now
+            if not stack.learner.ready:
+                time.sleep(0.01)
+        fleet = stack.serve_fleet
+        owned = sorted(g for s in fleet.servers.values()
+                       for g in s.cache.owned_shards)
+        survivors = sorted(fleet.servers)
+        adoptions = fleet.adoptions
+        map_v1 = fleet.shard_map.version
+        final_steps = stack.learner.training_steps
+    finally:
+        stop.set()
+        stack.close()
+
+    after_kill = [r for r in records if r.get("phase") == "killed"]
+    resumed = any(((r.get("serving") or {}).get("replies") or 0) > 0
+                  for r in after_kill[1:] or after_kill)
+    report = {
+        "metric": "serve_fleet_chaos",
+        "duration_s": round(time.time() - t0, 1),
+        "servers": servers,
+        "victim": victim,
+        "survivors": survivors,
+        "adoptions": adoptions,
+        "map_version": [map_v0, map_v1],
+        "training_steps": final_steps,
+        "steps_at_kill": steps_at_kill,
+        "records": records[-3:],
+    }
+    report["verdict"] = {
+        "no_learner_stall": (steps_at_kill is not None
+                             and final_steps > steps_at_kill),
+        "shards_adopted": adoptions > 0,
+        "all_shards_owned": owned == list(range(cfg.serve.state_shards)),
+        "fleet_shrunk": (victim is not None
+                         and victim not in survivors
+                         and len(survivors) == servers - 1),
+        "clients_rerouted": map_v1 > map_v0,
+        "clients_resumed": resumed,
+    }
+    return report
+
+
 # ---------------------------------------------------------------------------
 # Membership churn drill (ISSUE 15): live leave + re-join on a running fleet.
 
@@ -659,6 +780,13 @@ def main(argv=None) -> int:
                         "(leave 25%% of the fleet mid-training, re-join "
                         "it, assert zero learner stalls + shard-routing "
                         "provenance) instead of the worker-fault phase")
+    p.add_argument("--serve-fleet", action="store_true",
+                   help="run the ISSUE-17 kill-one-of-N serving-fleet "
+                        "drill: survivors adopt the victim's cache "
+                        "shards, clients re-route, the learner never "
+                        "stalls")
+    p.add_argument("--servers", type=int, default=2,
+                   help="--serve-fleet: fleet width before the kill")
     p.add_argument("--outage-seconds", type=float, default=6.0,
                    help="--serve: how long the policy server stays down")
     p.add_argument("--override", action="append", default=[],
@@ -673,6 +801,8 @@ def main(argv=None) -> int:
             overrides[k] = v
     if args.churn:
         out = run_churn_drill(args.seconds, config_overrides=overrides)
+    elif args.serve_fleet:
+        out = run_serve_fleet_chaos(args.seconds, args.servers, overrides)
     elif args.serve:
         out = run_serve_chaos(args.seconds, args.outage_seconds, overrides)
     else:

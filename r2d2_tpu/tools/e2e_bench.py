@@ -1186,6 +1186,341 @@ def serve_latency_probe(seconds: float, clients: int,
         srv.stop()
 
 
+# ---------------------------------------------------------------------------
+# Sharded serving fleet A/B (ISSUE 17): scaling curve + brownout anatomy.
+#
+# This container exposes ONE core, so N REAL server forwards cannot
+# overlap — a real-compute scaling cell would measure GIL arbitration,
+# not the fleet. The scaling cells therefore run TIMED-FORWARD
+# EMULATION: the real jitted forward is calibrated once per dispatch
+# bucket (median of repeated runs), then each emulated server's forward
+# is a GIL-releasing sleep of the calibrated time returning zeros. What
+# stays REAL: the whole serving plane around the forward — routing,
+# micro-batching, cache leases, admission, reply paths. Parity/failover
+# correctness runs with REAL forwards in tests/test_serve.py.
+
+
+def _calibrate_forward_table(cfg, net, params, buckets,
+                             repeats: int = 5) -> dict:
+    """Median real single-forward latency per pow2 dispatch bucket —
+    the timed-forward emulation's lookup table (seconds per bucket)."""
+    from r2d2_tpu.actor.policy import make_forward_fn
+    fwd = make_forward_fn(net)
+    h, w, s = net.obs_hw
+    hd = net.config.hidden_dim
+    table = {}
+    for b in sorted(set(int(x) for x in buckets)):
+        args = (params, np.zeros((b, h, w, s), np.float32),
+                np.zeros(b, np.int32), np.zeros((b, 2, hd), np.float32))
+        np.asarray(fwd(*args)[0])            # compile outside the timing
+        ts = []
+        for _ in range(max(repeats, 1)):
+            t0 = time.perf_counter()
+            np.asarray(fwd(*args)[0])
+            ts.append(time.perf_counter() - t0)
+        table[b] = float(np.median(ts))
+    return table
+
+
+def serve_fleet_probe(seconds: float, servers: int, clients: int,
+                      overrides: Optional[dict] = None,
+                      forward_table: Optional[dict] = None,
+                      max_batch: Optional[int] = None,
+                      queue_depth_bound: int = 0) -> dict:
+    """One serving-fleet cell: ``servers`` in-proc server loops behind
+    the client-side router, ``clients`` pipelined lanes stepping
+    synthetic frames as fast as replies come back. ``state_shards`` is
+    set to the client count so contiguous client ids spread EVENLY over
+    the servers (each lane its own shard group); per-server
+    ``max_batch`` defaults to the per-server lane share so a full
+    micro-batch dispatches without waiting out the deadline. With
+    ``forward_table`` the forward is the calibrated sleep stand-in (see
+    the section comment); without it the real forward runs (parity-true
+    but meaningless for N>1 scaling on one core).
+
+    The scaling cells pass an EXPLICIT ``max_batch`` = clients /
+    max-fleet-width so every arm forwards the same batch shape and the
+    arms differ only in how many of those equal batches run at once:
+    the single server drains the client tick as max-width sequential
+    dispatches, four servers overlap them exactly as N accelerator
+    hosts would. (Letting each arm batch its full per-server share
+    instead would fold the CPU calibration's strong batch sublinearity
+    — a host artifact; accelerators at serving batch sizes are
+    latency-bound — into the fleet curve.)"""
+    import jax
+
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.serve import (RemoteBatchedPolicy, ServerFleet,
+                                ServingStats)
+    shards = max(clients, servers)
+    mb = max_batch if max_batch is not None else max(
+        1, clients // servers)
+    ov = dict(overrides or {})
+    ov.pop("actor.inference", None)
+    ov.update({
+        "serve.servers": servers, "serve.max_servers": servers,
+        "serve.state_shards": shards, "serve.state_slots": 64 * shards,
+        "serve.max_batch": mb,
+        "serve.queue_depth_bound": queue_depth_bound,
+    })
+    cfg = _bench_config(ov)
+    net = NetworkApply(6, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    params = net.init(jax.random.PRNGKey(0))
+    hd = cfg.network.hidden_dim
+    fff = None
+    if forward_table is not None:
+        biggest = max(forward_table)
+
+        def fff(slot, _t=forward_table, _big=biggest):
+            def fwd(params, stacked, last_action, hidden):
+                b = int(stacked.shape[0])
+                time.sleep(_t.get(b, _t[_big]))
+                return (np.zeros(b, np.int64),
+                        np.zeros((b, 6), np.float32),
+                        np.zeros((b, 2, hd), np.float32))
+            return fwd
+    stats = ServingStats()
+    fleet = ServerFleet(cfg, net, params, stats=stats, client_timed=True,
+                        forward_fn_factory=fff)
+    try:
+        remote = RemoteBatchedPolicy(
+            fleet.connect(), net.action_dim, [0.05] * clients,
+            list(range(clients)), stats=stats,
+            timeout_s=cfg.serve.request_timeout_s)
+        rng = np.random.default_rng(0)
+        h, w = cfg.env.frame_height, cfg.env.frame_width
+        frames = rng.integers(0, 255, (64, h, w), np.uint8)
+        for i in range(clients):
+            remote.observe_reset_lane(i, frames[i % 64])
+        for _ in range(3):                       # warm the round trip
+            remote.act()
+        fleet.interval_block()                   # drop warm-up samples
+        ticks = 0
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            actions, _, _ = remote.act()
+            remote.observe(frames[(ticks + np.arange(clients)) % 64],
+                           actions)
+            ticks += 1
+        elapsed = time.time() - t0
+        block = fleet.interval_block() or {}
+        lat = block.get("latency") or {}
+        adm = block.get("admission") or {}
+        alat = adm.get("admitted_latency") or {}
+        cell = {
+            "servers": servers,
+            "clients": clients,
+            "max_batch": mb,
+            "queue_depth_bound": queue_depth_bound,
+            "emulated_forward": forward_table is not None,
+            "seconds": round(elapsed, 1),
+            "ticks": ticks,
+            # logical client steps/s — shed retries do NOT count, so
+            # this is goodput, the number the scaling gate reads
+            "requests_per_sec": round(ticks * clients / elapsed, 1),
+            "fill_mean": (block.get("batch") or {}).get("fill_mean"),
+            "latency_p50_ms": lat.get("p50_ms"),
+            "latency_p99_ms": lat.get("p99_ms"),
+            "admitted_p50_ms": alat.get("p50_ms"),
+            "admitted_p99_ms": alat.get("p99_ms"),
+            "shed": adm.get("shed", 0),
+            "shed_frac": adm.get("shed_frac", 0.0),
+            "client_shed_retries": remote.shed_retries,
+            "server_rows": len((block.get("servers") or {})
+                               .get("rows") or {}),
+        }
+        return cell
+    finally:
+        fleet.stop()
+
+
+def socket_rt_probe(seconds: float,
+                    overrides: Optional[dict] = None) -> dict:
+    """Socket-transport round-trip re-quote (TCP_NODELAY satellite):
+    one real-forward server behind the TCP loopback transport, ONE
+    blocking client — the per-request wire latency with Nagle disabled
+    on both sides, comparable against PERF.md's earlier socket quotes."""
+    import jax
+
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.serve import (InprocEndpoint, PolicyServer, RemotePolicy,
+                                ServingStats, SocketChannel,
+                                SocketServerTransport)
+    ov = dict(E2E_CPU_OVERRIDES)
+    ov.update(overrides or {})
+    ov.pop("actor.inference", None)
+    cfg = _bench_config(ov)
+    net = NetworkApply(6, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    params = net.init(jax.random.PRNGKey(0))
+    stats = ServingStats()
+    ep = InprocEndpoint()
+    srv = PolicyServer(cfg, net, params, endpoint=ep, stats=stats,
+                       client_timed=True).start()
+    transport = SocketServerTransport(ep.submit, cfg.serve.host, 0)
+    try:
+        remote = RemotePolicy(
+            SocketChannel(transport.host, transport.port),
+            net.action_dim, 0.05, stats=stats,
+            timeout_s=cfg.serve.request_timeout_s)
+        rng = np.random.default_rng(0)
+        h, w = cfg.env.frame_height, cfg.env.frame_width
+        frame = rng.integers(0, 255, (h, w), np.uint8)
+        remote.observe_reset(frame)
+        for _ in range(5):
+            remote.act()
+        lats = []
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            t1 = time.perf_counter()
+            action, _, _ = remote.act()
+            lats.append(time.perf_counter() - t1)
+            remote.observe(frame, action)
+        arr = np.asarray(lats) * 1e3
+        return {
+            "round_trips": len(lats),
+            "rt_p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "rt_p95_ms": round(float(np.percentile(arr, 95)), 3),
+            "rt_p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "tcp_nodelay": True,
+        }
+    finally:
+        transport.close()
+        srv.stop()
+
+
+def run_serve_fleet_ab(seconds: float, overrides: Optional[dict] = None,
+                       repeats: int = 2,
+                       servers_sweep: Tuple[int, ...] = (1, 2, 4),
+                       clients_sweep: Tuple[int, ...] = (8, 16)) -> dict:
+    """Serving-fleet scaling A/B (ISSUE 17 acceptance), one artifact:
+
+      * **scaling curve** — requests/s at 1/2/4 emulated server loops x
+        client widths, ABBA-interleaved ``repeats`` times with per-arm
+        medians; the gate is 4 servers >= 2.5x single-server goodput at
+        the widest EQUAL client count. ``max_batch`` is pinned to
+        clients / max-fleet-width in EVERY arm (equal batch shape;
+        serve_fleet_probe's docstring argues why), so the arms differ
+        only in how many of those batches forward concurrently. A
+        ``single_server_full_batch`` cell (1 server batching its whole
+        client share at once) rides along as the transparency baseline
+        for the CPU table's batch sublinearity.
+      * **brownout anatomy** — single server at 2x-overload (clients =
+        2x max_batch), bound off vs on: with ``queue_depth_bound`` the
+        overflow sheds (retry-after; clients back off and retry) while
+        ADMITTED p99 stays within the SLO (deadline + 2 service times);
+        unbounded, the same offered load queues and the client-visible
+        p99 inflates past it.
+      * **socket round trip** — the TCP_NODELAY re-quote cell.
+
+    The forward calibration table (real jitted forward, median per pow2
+    bucket, at the REFERENCE network shape so per-row compute dominates
+    dispatch overhead) ships in the artifact."""
+    base = dict(overrides or {})
+    cmax = max(clients_sweep)
+    # reference-shape network for calibration + scaling cells: on a tiny
+    # net the fixed dispatch overhead flattens fwd(C)/fwd(C/4) and the
+    # cell would measure overhead, not scaling headroom
+    import jax
+
+    from r2d2_tpu.models.network import NetworkApply
+    cal_cfg = _bench_config(base)
+    cal_net = NetworkApply(6, cal_cfg.network, cal_cfg.env.frame_stack,
+                           cal_cfg.env.frame_height,
+                           cal_cfg.env.frame_width)
+    cal_params = cal_net.init(jax.random.PRNGKey(0))
+    buckets = []
+    b = 1
+    while b <= cmax:
+        buckets.append(b)
+        b *= 2
+    table = _calibrate_forward_table(cal_cfg, cal_net, cal_params, buckets)
+    out = {
+        "repeats": max(repeats, 1),
+        "forward_table_ms": {str(k): round(v * 1e3, 3)
+                             for k, v in sorted(table.items())},
+        "emulation": "timed-forward (calibrated sleep; see PERF.md)",
+    }
+
+    width = max(servers_sweep)
+    cells = {}
+    for rep in range(max(repeats, 1)):
+        arms = list(servers_sweep)
+        if rep % 2:
+            arms = arms[::-1]      # ABBA: cancel monotonic host drift
+        for c in clients_sweep:
+            for s in arms:
+                if c < s or c % width:
+                    continue
+                cells.setdefault((s, c), []).append(serve_fleet_probe(
+                    seconds, s, c, overrides=base, forward_table=table,
+                    max_batch=max(1, c // width)))
+    out["scaling"] = [
+        {**runs[-1],
+         "requests_per_sec": float(np.median(
+             [r["requests_per_sec"] for r in runs])),
+         "requests_per_sec_cells": [r["requests_per_sec"] for r in runs]}
+        for (s, c), runs in sorted(cells.items())]
+
+    def med_rps(s, c):
+        runs = cells.get((s, c))
+        return (float(np.median([r["requests_per_sec"] for r in runs]))
+                if runs else None)
+
+    hi, lo = max(servers_sweep), min(servers_sweep)
+    if med_rps(lo, cmax):
+        out["fleet_scaling_ratio"] = round(
+            med_rps(hi, cmax) / med_rps(lo, cmax), 3)
+        out["fleet_scaling_servers"] = [lo, hi]
+        out["fleet_scaling_clients"] = cmax
+    # transparency baseline: one server batching its FULL client share
+    # (best single-server batch shape; folds the CPU table's batch
+    # sublinearity back in — see serve_fleet_probe's docstring)
+    out["single_server_full_batch"] = serve_fleet_probe(
+        seconds, 1, cmax, overrides=base, forward_table=table,
+        max_batch=cmax)
+
+    # brownout anatomy: ONE server, offered load 2x its micro-batch
+    # capacity; the bound is HALF a batch deep (the shed pass runs after
+    # each batch fill and rejects only the overflow past the bound, so a
+    # bound >= max_batch under exactly-2x load never triggers)
+    mb = cmax // 2
+    over = {k: v for k, v in base.items()}
+    unbounded = serve_fleet_probe(seconds, 1, cmax, overrides=over,
+                                  forward_table=table, max_batch=mb,
+                                  queue_depth_bound=0)
+    bounded = serve_fleet_probe(seconds, 1, cmax, overrides=over,
+                                forward_table=table, max_batch=mb,
+                                queue_depth_bound=max(1, mb // 2))
+    svc_ms = table[mb] * 1e3
+    slo_ms = _bench_config(base).serve.deadline_ms + 2.0 * svc_ms
+    out["brownout"] = {
+        "overload_factor": 2.0,
+        "max_batch": mb,
+        "service_ms": round(svc_ms, 3),
+        "slo_ms": round(slo_ms, 3),
+        "unbounded": unbounded,
+        "bounded": bounded,
+    }
+    out["brownout_shed_frac"] = bounded["shed_frac"]
+    if bounded.get("admitted_p99_ms") is not None:
+        out["brownout_admitted_p99_ms"] = bounded["admitted_p99_ms"]
+        out["brownout_ok"] = bool(
+            bounded["shed_frac"] > 0.0
+            and bounded["admitted_p99_ms"] <= slo_ms)
+        # regress-gated form of the brownout acceptance: emitted ONLY
+        # while the bounded arm actually sheds, so the metric VANISHES
+        # (a gate failure) if brownout stops triggering, and its value
+        # drops below 1.0 exactly when admitted p99 exceeds the SLO.
+        if bounded["shed_frac"] > 0.0:
+            out["brownout_slo_headroom_ratio"] = round(
+                slo_ms / bounded["admitted_p99_ms"], 3)
+
+    out["socket_rt"] = socket_rt_probe(min(seconds, 10.0), overrides=base)
+    return out
+
+
 def run_fleet_mh(seconds: float, envs_per_actor: int = 8,
                  dp: int = 2, fleet_on: bool = True,
                  overrides: Optional[dict] = None) -> dict:
@@ -1624,6 +1959,17 @@ def main(argv=None) -> int:
                         "on/off pair on the service-routed learner "
                         "(fleet.replay_shards=2, 2x-capacity spill); "
                         "one artifact (E2E_r17.json)")
+    p.add_argument("--serve-fleet-ab", type=int, default=0,
+                   help="1: run the e2e phase as the serving-fleet "
+                        "scaling A/B instead (ISSUE 17) — 1/2/4 emulated "
+                        "server loops x client widths on the client-side "
+                        "router (timed-forward emulation, calibrated per "
+                        "dispatch bucket; ABBA-interleaved, per-arm "
+                        "medians; 4-server >= 2.5x goodput gate), the "
+                        "2x-overload brownout pair (queue_depth_bound "
+                        "off/on; admitted p99 within SLO while shedding) "
+                        "and the TCP_NODELAY socket round-trip re-quote; "
+                        "one artifact (E2E_r19.json)")
     p.add_argument("--resources-ab", type=int, default=0,
                    help="1: run the e2e phase as a resource/compile/alerts "
                         "on/off A/B instead (telemetry.resources_enabled; "
@@ -1691,6 +2037,10 @@ def main(argv=None) -> int:
                 repeats=args.ab_repeats,
                 ingest_blocks=args.ingest_batch_blocks,
                 socket_window=args.socket_window)
+        elif args.serve_fleet_ab:
+            out["e2e_serve_fleet_ab"] = run_serve_fleet_ab(
+                args.e2e_seconds, overrides=overrides,
+                repeats=args.ab_repeats)
         elif args.elastic_ab:
             out["e2e_elastic_ab"] = run_elastic_ab(
                 args.e2e_seconds, overrides=overrides,

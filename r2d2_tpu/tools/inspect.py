@@ -322,6 +322,28 @@ def render_serving(sv: dict, quant: Optional[dict] = None) -> str:
              if clients.get(k)]
     if churn:
         lines.append("  leases: " + " ".join(churn))
+    adm = sv.get("admission")
+    if adm:
+        alat = adm.get("admitted_latency") or {}
+        bits = [f"  admission: shed={adm.get('shed', 0)} "
+                f"({100 * adm.get('shed_frac', 0.0):.1f}%) "
+                f"misrouted={adm.get('misrouted', 0)}"]
+        if alat.get("p99_ms") is not None:
+            bits.append(f"admitted p99={_fmt(alat['p99_ms'], 8).strip()}ms")
+        lines.append(" ".join(bits))
+    fleet = sv.get("servers")
+    if fleet:
+        lines.append(f"  fleet: {fleet.get('count', 0)} servers "
+                     f"map v{fleet.get('map_version', 0)}")
+        for slot, row in sorted((fleet.get("rows") or {}).items(),
+                                key=lambda kv: int(kv[0])):
+            lines.append(
+                f"    server {slot}: {row.get('requests', 0)} req "
+                f"fill={_fmt(row.get('fill_mean'), 6).strip()} "
+                f"p50={_fmt(row.get('latency_p50_ms'), 8).strip()} "
+                f"p99={_fmt(row.get('latency_p99_ms'), 8).strip()} "
+                f"shed={row.get('shed', 0)} "
+                f"shards={row.get('shards', 0)}")
     if quant:
         lines.append("  " + render_quant(quant))
     return "\n".join(lines)

@@ -867,3 +867,354 @@ def test_evaluate_as_a_service(tmp_path):
         cfg, ckpt, rounds=4, seed=0, serve=True, serve_clients=2)
     assert step == step2 == 7
     assert np.isfinite(mean_direct) and np.isfinite(mean_served)
+
+
+# ---------------------------------------------------------------------------
+# sharded serving fleet (ISSUE 17): shard routing, admission/brownout,
+# elastic grow/shrink, kill-one-of-N failover
+
+
+def fleet_cfg(**over):
+    base = {"serve.servers": 2, "serve.max_servers": 2,
+            "serve.state_shards": 8, "serve.state_slots": 512}
+    base.update(over)
+    return small_cfg(**base)
+
+
+def make_fleet(cfg=None, **fleet_kw):
+    from r2d2_tpu.serve import ServerFleet, ServingStats
+    cfg = cfg or fleet_cfg()
+    net, params = tiny_net(cfg)
+    stats = fleet_kw.pop("stats", None) or ServingStats()
+    fleet = ServerFleet(cfg, net, params, stats=stats, **fleet_kw)
+    return cfg, net, params, stats, fleet
+
+
+def test_collect_batch_drains_backlog_past_deadline():
+    """The deadline bounds WAITING, not backlog drain: a first request
+    that aged out while the server was mid-forward must still dispatch
+    with everything already queued, not as a batch of one (the
+    degenerate fill-1 regime the fleet bench exposed)."""
+    from r2d2_tpu.serve import collect_batch
+    inbox = queue.Queue()
+    for _ in range(3):
+        inbox.put(_pending())
+    stale = _pending(t_recv=time.monotonic() - 1.0)
+    batch = collect_batch(inbox, stale, max_batch=8, deadline_s=0.005)
+    assert len(batch) == 4
+
+
+def test_contiguous_partition():
+    from r2d2_tpu.serve import contiguous_partition
+    parts = contiguous_partition(8, [0, 2])
+    assert parts == {0: [0, 1, 2, 3], 2: [4, 5, 6, 7]}
+    # remainder shards go to the leading servers, coverage is exact
+    parts = contiguous_partition(10, [1, 3, 4])
+    got = sorted(s for shards in parts.values() for s in shards)
+    assert got == list(range(10))
+    assert [len(parts[s]) for s in (1, 3, 4)] == [4, 3, 3]
+    with pytest.raises(ValueError):
+        contiguous_partition(4, [])
+
+
+def test_shard_map_wire_versioning():
+    from r2d2_tpu.serve import ShardMap
+    m = ShardMap(4, [0, 0, 1, 1])
+    assert m.server_for(0) == 0 and m.server_for(2) == 1
+    assert m.server_for(6) == 1          # client_id % total_shards
+    wire = m.to_wire()
+    other = ShardMap(4, [0, 0, 0, 0])
+    other.version = 0
+    assert other.apply_wire(wire)
+    assert other.assignment() == m.assignment()
+    # stale or equal versions are ignored
+    assert not other.apply_wire(wire)
+    assert not other.apply_wire((0, (1, 1, 1, 1)))
+    v = m.update([1, 1, 0, 0])
+    assert v == m.version and m.server_for(0) == 1
+
+
+def test_state_cache_shard_handoff_roundtrip():
+    """detach_shard -> import_shard moves a client's recurrent state
+    bit-exactly; the donor then MISROUTES the moved client."""
+    from r2d2_tpu.serve.state_cache import MisroutedClient, StateCache
+    a = StateCache(64, 4, (24, 24), 2, 16, owned_shards=[0, 1, 2, 3],
+                   total_shards=8)
+    b = StateCache(64, 4, (24, 24), 2, 16, owned_shards=[4, 5, 6, 7],
+                   total_shards=8)
+    slot, fresh = a.lease(1)             # client 1 -> shard 1
+    assert fresh
+    a.hidden[slot] = 7.25
+    a.last_action[slot] = 3
+    state = a.detach_shard(1)
+    b.import_shard(state)
+    assert 1 not in a.owned_shards and 1 in b.owned_shards
+    with pytest.raises(MisroutedClient):
+        a.lease(1)
+    slot_b, fresh_b = b.lease(1)
+    assert not fresh_b                   # retained state, not a reset
+    assert float(b.hidden[slot_b].ravel()[0]) == 7.25
+    assert int(b.last_action[slot_b]) == 3
+
+
+def test_routing_channel_reroutes_on_misroute():
+    """A client holding a STALE map gets MISROUTED + the true map from
+    the wrong server and re-aims within the same call."""
+    from r2d2_tpu.serve import RemotePolicy, RoutingChannel, ShardMap
+    cfg, net, params, stats, fleet = make_fleet()
+    try:
+        stale = ShardMap(8, [1] * 8)     # everything -> server 1: wrong
+        stale.version = 0                # any fleet wire wins
+        chan = RoutingChannel(
+            {s: ep.connect() for s, ep in enumerate(fleet.endpoints)},
+            stale)
+        pol = RemotePolicy(chan, net.action_dim, 0.0, client_id=0,
+                           timeout_s=5.0)
+        rng = np.random.default_rng(0)
+        pol.observe_reset(rand_obs(rng, cfg))
+        action, q, _ = pol.act()
+        assert chan.reroutes >= 1
+        assert (chan.shard_map.assignment()
+                == fleet.shard_map.assignment())
+        assert np.isfinite(q).all()
+    finally:
+        fleet.stop()
+
+
+def test_fleet_parity_with_single_server():
+    """Served inference through a 2-server fleet is bit-identical to
+    the single-server path at equal seeds/eps: same per-client streams,
+    only the routing differs."""
+    from r2d2_tpu.serve import RemoteBatchedPolicy
+    cfg, net, params, stats, fleet = make_fleet()
+    single_cfg = small_cfg(**{"serve.state_shards": 8,
+                              "serve.state_slots": 512})
+    _, _, _, ep, srv = make_server(single_cfg)
+    try:
+        streams = {}
+        for tag, channel in (("fleet", fleet.connect()),
+                             ("single", ep.connect())):
+            pol = RemoteBatchedPolicy(channel, net.action_dim,
+                                      [0.0] * 4, [0, 1, 2, 3],
+                                      client_base=2, timeout_s=5.0)
+            rng = np.random.default_rng(7)
+            for i in range(4):
+                pol.observe_reset_lane(i, rand_obs(rng, cfg))
+            acts, qs = [], []
+            for _ in range(6):
+                a, q, _ = pol.act()
+                acts.append(a.copy())
+                qs.append(np.asarray(q).copy())
+                pol.observe(np.stack([rand_obs(rng, cfg)
+                                      for _ in range(4)]), a)
+            streams[tag] = (np.stack(acts), np.stack(qs))
+        np.testing.assert_array_equal(streams["fleet"][0],
+                                      streams["single"][0])
+        np.testing.assert_array_equal(streams["fleet"][1],
+                                      streams["single"][1])
+        block = fleet.interval_block()
+        rows = block["servers"]["rows"]
+        assert len(rows) == 2            # both servers took traffic
+        assert all(r["requests"] > 0 for r in rows.values())
+    finally:
+        fleet.stop()
+        srv.stop()
+
+
+def test_fleet_kill_failover_stream_parity():
+    """Kill one of two servers mid-stream: the survivor adopts the
+    orphaned shards, clients re-route on the bounced map, and the
+    action stream stays bit-identical to an undisturbed single-server
+    run of the same seeds."""
+    from r2d2_tpu.serve import RemoteBatchedPolicy
+    cfg, net, params, stats, fleet = make_fleet()
+    single_cfg = small_cfg(**{"serve.state_shards": 8,
+                              "serve.state_slots": 512})
+    _, _, _, ep, srv = make_server(single_cfg)
+    try:
+        def run(channel, fleet_to_kill=None):
+            pol = RemoteBatchedPolicy(channel, net.action_dim,
+                                      [0.0] * 4, [0, 1, 2, 3],
+                                      client_base=2, timeout_s=5.0)
+            rng = np.random.default_rng(11)
+            for i in range(4):
+                pol.observe_reset_lane(i, rand_obs(rng, cfg))
+            acts = []
+            for t in range(8):
+                if t == 4 and fleet_to_kill is not None:
+                    victim = max(fleet_to_kill.servers)
+                    fleet_to_kill.kill_server(victim)
+                    deadline = time.time() + 10.0
+                    while (fleet_to_kill.supervise() == 0
+                           and time.time() < deadline):
+                        time.sleep(0.02)
+                a, _, _ = pol.act()
+                acts.append(a.copy())
+                pol.observe(np.stack([rand_obs(rng, cfg)
+                                      for _ in range(4)]), a)
+            return np.stack(acts)
+
+        v0 = fleet.shard_map.version
+        fleet_stream = run(fleet.connect(), fleet_to_kill=fleet)
+        single_stream = run(ep.connect())
+        np.testing.assert_array_equal(fleet_stream, single_stream)
+        assert len(fleet.servers) == 1
+        survivor = next(iter(fleet.servers.values()))
+        assert sorted(survivor.cache.owned_shards) == list(range(8))
+        assert fleet.shard_map.version > v0
+    finally:
+        fleet.stop()
+        srv.stop()
+
+
+def test_fleet_grow_shrink_reslices():
+    """grow_server splits the shard range onto the joiner with a
+    lease handoff; shrink_server rehomes them back — clients keep
+    streaming across both re-slices."""
+    from r2d2_tpu.serve import RemoteBatchedPolicy
+    cfg, net, params, stats, fleet = make_fleet(
+        cfg=fleet_cfg(**{"serve.servers": 1, "serve.max_servers": 2}))
+    try:
+        pol = RemoteBatchedPolicy(fleet.connect(), net.action_dim,
+                                  [0.0] * 4, [0, 1, 2, 3],
+                                  timeout_s=5.0)
+        rng = np.random.default_rng(3)
+        for i in range(4):
+            pol.observe_reset_lane(i, rand_obs(rng, cfg))
+        pol.act()
+        slot = fleet.grow_server()
+        assert len(fleet.servers) == 2
+        per = [sorted(s.cache.owned_shards)
+               for s in fleet.servers.values()]
+        assert sorted(sum(per, [])) == list(range(8))
+        assert all(len(p) == 4 for p in per)
+        a_grow, _, _ = pol.act()         # streams through the re-slice
+        pol.observe(np.stack([rand_obs(rng, cfg) for _ in range(4)]),
+                    a_grow)
+        assert fleet.shrink_server(slot) == slot
+        assert len(fleet.servers) == 1
+        survivor = next(iter(fleet.servers.values()))
+        assert sorted(survivor.cache.owned_shards) == list(range(8))
+        a_shrink, _, _ = pol.act()
+        assert a_shrink.shape == (4,)
+    finally:
+        fleet.stop()
+
+
+def test_admission_shed_retry_and_stats():
+    """Overload a 1-wide fleet past its queue bound: the overflow is
+    shed with STATUS_RETRY, clients absorb the retries (no failures),
+    and the serving block's admission counters account for it."""
+    from r2d2_tpu.serve import RemoteBatchedPolicy
+    cfg = fleet_cfg(**{"serve.servers": 1, "serve.max_servers": 1,
+                       "serve.state_shards": 8, "serve.max_batch": 2,
+                       "serve.queue_depth_bound": 1,
+                       "serve.deadline_ms": 1.0})
+    cfg2, net, params, stats, fleet = make_fleet(cfg=cfg)
+    try:
+        pol = RemoteBatchedPolicy(fleet.connect(), net.action_dim,
+                                  [0.0] * 8, list(range(8)),
+                                  timeout_s=5.0)
+        rng = np.random.default_rng(5)
+        for i in range(8):
+            pol.observe_reset_lane(i, rand_obs(rng, cfg))
+        for t in range(6):               # 8 lanes vs batch 2, bound 1
+            a, _, _ = pol.act()
+            pol.observe(np.stack([rand_obs(rng, cfg)
+                                  for _ in range(8)]), a)
+        assert pol.shed_retries > 0
+        block = fleet.interval_block()
+        adm = block["admission"]
+        assert adm["shed"] > 0 and adm["shed_frac"] > 0
+        assert adm["admitted_latency"]["p99_ms"] is not None
+    finally:
+        fleet.stop()
+
+
+def test_serve_brownout_alert_fires_and_rearms():
+    from r2d2_tpu.telemetry.alerts import AlertEngine, default_rules
+    engine = AlertEngine(default_rules(Config().telemetry))
+
+    def rec(shed_frac):
+        serving = {"latency": {"p99_ms": 5.0},
+                   "admission": {"shed_frac": shed_frac}}
+        return {"t": 1.0, "buffer_speed": 100.0, "training_speed": 1.0,
+                "serving": serving}
+
+    out = engine.evaluate(rec(0.0))
+    assert not out["fired"]
+    out = engine.evaluate(rec(0.5))
+    assert [a["rule"] for a in out["fired"]] == ["serve_brownout"]
+    out = engine.evaluate(rec(0.6))
+    assert not out["fired"]              # level rule: edge only
+    out = engine.evaluate(rec(0.01))
+    assert "serve_brownout" not in out["active"]
+    out = engine.evaluate(rec(0.5))
+    assert [a["rule"] for a in out["fired"]] == ["serve_brownout"]
+
+
+def test_admission_block_gated_off_single_server():
+    """Kill switch: serve.servers=1 + queue_depth_bound=0 emits the
+    PR-16 serving schema exactly — no 'admission', no 'servers' key."""
+    from r2d2_tpu.serve import RemoteBatchedPolicy, ServingStats
+    stats = ServingStats()
+    cfg, net, params, ep, srv = make_server(stats=stats)
+    try:
+        pol = RemoteBatchedPolicy(ep.connect(), net.action_dim,
+                                  [0.0] * 2, [0, 1], timeout_s=5.0)
+        rng = np.random.default_rng(0)
+        for i in range(2):
+            pol.observe_reset_lane(i, rand_obs(rng, cfg))
+        pol.act()
+        block = stats.interval_block()
+        assert "admission" not in block
+        assert "servers" not in block
+    finally:
+        srv.stop()
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError, match="state_shards"):
+        fleet_cfg(**{"serve.servers": 9})
+    with pytest.raises(ValueError, match="max_servers"):
+        fleet_cfg(**{"serve.max_servers": 1})
+    with pytest.raises(ValueError, match="transport"):
+        fleet_cfg(**{"serve.transport": "shm"})
+    with pytest.raises(ValueError, match="queue_depth_bound"):
+        small_cfg(**{"serve.queue_depth_bound": -1})
+    cfg = fleet_cfg(**{"serve.queue_depth_bound": 16})
+    assert cfg.serve.servers == 2
+
+
+def test_membership_lease_server_roundtrip():
+    """The socket lease API (cli/join.py's dial): join/leave/info round
+    trips, handler errors surface as refusals, unknown ops list the
+    vocabulary."""
+    from r2d2_tpu.fleet import MembershipServer, lease_call
+    calls = []
+
+    def join(slot=None):
+        calls.append(("join", slot))
+        return {"slot": 3 if slot is None else int(slot),
+                "generation": 1, "lane_base": 0, "lanes": 4}
+
+    def leave(slot):
+        if int(slot) == 9:
+            raise RuntimeError("slot 9 is not ACTIVE")
+        return {"slot": int(slot)}
+
+    ms = MembershipServer({"join": join, "leave": leave,
+                           "info": lambda: {"actors": 2}})
+    try:
+        got = lease_call(ms.host, ms.port, "join")
+        assert got["slot"] == 3 and got["ok"]
+        got = lease_call(ms.host, ms.port, "join", slot=1)
+        assert got["slot"] == 1
+        assert lease_call(ms.host, ms.port, "info")["actors"] == 2
+        with pytest.raises(RuntimeError, match="not ACTIVE"):
+            lease_call(ms.host, ms.port, "leave", slot=9)
+        with pytest.raises(RuntimeError, match="join"):
+            lease_call(ms.host, ms.port, "nonsense")
+        assert calls[0] == ("join", None)
+    finally:
+        ms.close()
