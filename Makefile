@@ -111,6 +111,19 @@
 #                traceparse on the checked-in miniature trace, roofline
 #                report + analytic golden file, the costs gate, record
 #                schema stability under the kill switch).
+#   make recovery — the fast-tier crash-recovery suite
+#                (tests/test_recovery.py: snapshot round-trip bit-parity
+#                (service shards with/without spill, the plain in-mesh
+#                cut), the atomic manifest commit + torn-payload probe,
+#                SnapshotWriter latest-wins, producer reconnect +
+#                unacked-tail replay across a service bounce,
+#                eager-connect construction failures + the dial ladder,
+#                resume determinism on both learner paths, the
+#                supervisor's breaker/clean-exit/resume-chain policies,
+#                checkpoint retention GC, kill-switch record-schema
+#                stability + inert alert rules); the slow SIGKILL drills
+#                (tools/chaos.py --kill-learner / --kill-replay-service
+#                end-to-end) run with the full tier.
 #   make regress — the regression gate: tools/regress.py compares the
 #                tree's E2E_*/BENCH_* artifacts against BASELINE.json's
 #                'bench' snapshot (per-metric noise tolerances) AND the
@@ -127,7 +140,7 @@
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
 	replaydiag fleet serve quant elastic service-ingest costmodel \
-	regress costs roofline check-fast-markers
+	recovery regress costs roofline check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -184,6 +197,10 @@ costmodel: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+recovery: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_recovery.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
 regress:
 	JAX_PLATFORMS=cpu python -m r2d2_tpu.tools.regress \
 	    --baseline BASELINE.json --dir .
@@ -214,7 +231,8 @@ FAST_MARKER_CHECKS := \
 	tests/test_quant.py:not_slow:14:quant \
 	tests/test_elastic.py:not_slow:20:elastic \
 	tests/test_service_ingest.py:not_slow:20:service-ingest \
-	tests/test_costmodel.py:not_slow:10:cost-model
+	tests/test_costmodel.py:not_slow:10:cost-model \
+	tests/test_recovery.py:not_slow:18:recovery
 
 check-fast-markers:
 	@for spec in $(FAST_MARKER_CHECKS); do \

@@ -43,6 +43,18 @@ def main(argv=None) -> None:
         print(" | ".join(f"{k}={v}" for k, v in record.items() if v is not None),
               flush=True)
 
+    if cfg.runtime.auto_resume:
+        # learner supervision (ISSUE 18): run train() as a supervised
+        # child process — a crash relaunches from the newest checkpoint
+        # (plus the replay snapshot under runtime.snapshot_interval);
+        # SIGTERM/SIGINT forward to the child for a clean preemption
+        # stop. Raises for multi-process multihost jobs (the cluster
+        # scheduler supervises those).
+        from r2d2_tpu.runtime.supervisor import supervise_train
+        supervise_train(cfg, actor_mode=actor_mode or "process",
+                        max_steps=max_steps, max_seconds=max_seconds)
+        return
+
     if cfg.mesh.multihost and cfg.mesh.num_processes > 1:
         # multi-controller pod: run this same CLI on every host with its
         # own --mesh.process_id; the lockstep loop keeps dispatch cadences

@@ -810,6 +810,18 @@ class TelemetryConfig:
     # queue before ever becoming samplable (raise
     # fleet.ingest_batch_blocks or slow collection).
     alerts_ingest_backlog: float = 64.0
+    # -- crash-recovery plane (ISSUE 18; the record's 'recovery' block) --
+    # Age (seconds) of the newest durable replay snapshot
+    # (recovery.snapshot.age_s) at/above which snapshot_stale fires —
+    # the writer has stopped committing cuts, so a crash now loses more
+    # than one runtime.snapshot_interval of experience. Inactive on
+    # records without a recovery block (snapshot_interval = 0).
+    alerts_snapshot_stale_s: float = 600.0
+    # Supervisor relaunches of the learner (recovery.supervisor.restarts,
+    # cumulative within the supervised run) at/above which recovery_loop
+    # fires — the learner is crash-looping through auto-resume instead
+    # of making progress (the breaker parks it one rung later).
+    alerts_recovery_loop: float = 2.0
 
 
 @dataclass(frozen=True)
@@ -893,6 +905,35 @@ class RuntimeConfig:
     # (per-slot heartbeat ages, queue/ring occupancy, limiter state)
     # instead of starving silently. 0 disables.
     ingest_stall_timeout_s: float = 300.0
+    # -- crash-recovery plane (ISSUE 18) --
+    # Learner steps between durable replay snapshots: at each interval
+    # boundary the learner captures a consistent cut of the replay plane
+    # (every shard's ReplayState + ring accounting + spill pages + rr
+    # cursors) at the commit boundary between train dispatches, and a
+    # background writer serializes it to {save_dir}/replay_player{p}.npz
+    # with an atomic tmp+rename manifest (replay/snapshot.py). 0 = off
+    # (no snapshot files, no 'recovery' record block — records stay
+    # byte-identical to the pre-PR18 schema).
+    snapshot_interval: int = 0
+    # Restore replay contents on resume: when runtime.resume is set and a
+    # replay snapshot manifest exists next to the checkpoint, the learner
+    # reloads every shard's ring/tree/stamps/spill bit-exactly before
+    # training continues. Off restores params/opt-state only (the
+    # pre-PR18 resume).
+    restore_replay: bool = True
+    # Supervisor rung (runtime/supervisor.py, wired in cli/train.py): run
+    # training in a supervised child process; on learner death (or
+    # SIGKILL preemption of the child) the supervisor relaunches it with
+    # runtime.resume pointed at the newest checkpoint + replay snapshot.
+    # The relaunch ladder reuses the PR-3 worker-health knobs above
+    # (restart_backoff_*, max_restarts_per_window, restart_window_s) as
+    # the crash-loop breaker.
+    auto_resume: bool = False
+    # Checkpoint retention: keep only the newest K checkpoint dirs per
+    # player (plus their .config.json sidecars and any per-checkpoint
+    # snapshot sets) after each save — disk growth was unbounded before.
+    # 0 = keep everything.
+    keep_checkpoints: int = 0
 
 
 @dataclass(frozen=True)
@@ -1395,6 +1436,32 @@ class Config:
             raise ValueError("runtime.max_restarts_per_window must be >= 0")
         if self.runtime.profile_at_step < 0:
             raise ValueError("runtime.profile_at_step must be >= 0")
+        if self.runtime.snapshot_interval < 0:
+            raise ValueError(
+                f"runtime.snapshot_interval "
+                f"({self.runtime.snapshot_interval}) must be >= 0 "
+                "(learner steps between replay snapshots; 0 disables)")
+        if self.runtime.keep_checkpoints < 0:
+            raise ValueError(
+                f"runtime.keep_checkpoints "
+                f"({self.runtime.keep_checkpoints}) must be >= 0 "
+                "(newest checkpoints retained; 0 keeps everything)")
+        if (self.runtime.snapshot_interval
+                and self.replay.placement == "host"):
+            raise ValueError(
+                "runtime.snapshot_interval requires the device replay "
+                "(replay.placement='device'): the host-replay numpy twin "
+                "has no snapshot plane yet — set snapshot_interval=0 or "
+                "switch placement")
+        if self.telemetry.alerts_snapshot_stale_s <= 0:
+            raise ValueError(
+                f"telemetry.alerts_snapshot_stale_s "
+                f"({self.telemetry.alerts_snapshot_stale_s}) must be > 0")
+        if self.telemetry.alerts_recovery_loop < 1:
+            raise ValueError(
+                f"telemetry.alerts_recovery_loop "
+                f"({self.telemetry.alerts_recovery_loop}) must be >= 1 "
+                "(supervisor relaunches before the alert fires)")
         if self.telemetry.ring_size < 16:
             raise ValueError(
                 f"telemetry.ring_size ({self.telemetry.ring_size}) must be "

@@ -685,6 +685,20 @@ class ReplayService:
             self._prefetch_thread.join(timeout=2.0)
             self._prefetch_thread = None
 
+    # -- crash-recovery plane (ISSUE 18): durable snapshot delegation --
+
+    def snapshot_state(self, step: int, extra: Optional[dict] = None) -> dict:
+        """Consistent host-side cut of every shard (replay/snapshot.py)
+        — taken under the service lock at a commit boundary."""
+        from r2d2_tpu.replay.snapshot import capture_service
+        return capture_service(self, step, extra)
+
+    def restore_state(self, snap: dict) -> None:
+        """Load a captured cut back into this (freshly-built) service —
+        bit-parity with the captured one."""
+        from r2d2_tpu.replay.snapshot import restore_service
+        restore_service(self, snap)
+
     # -- accountant facade (the Learner's ring contract) --
 
     @property
@@ -941,44 +955,119 @@ class RemoteReplayProducer:
     ``add_blocks`` / ``add_stacked`` are the ISSUE-16 windowed rung: one
     ``addw`` frame per stacked group, up to ``window`` unacked frames in
     flight, cumulative acks reaped at the window bound (back-pressure)
-    and on :meth:`flush`. Lazily (re)dials like
-    serve/transport.SocketChannel."""
+    and on :meth:`flush`.
+
+    Crash-recovery rung (ISSUE 18): the producer DIALS AT CONSTRUCTION
+    (a dead address raises there, not at the first add a thousand steps
+    later) with a bounded connect retry on the PR-3 backoff ladder
+    (``min(base * 2^(attempt-1), max)``) so a producer rank may start
+    before the service finishes binding. Each in-flight entry retains
+    its serialized frame, so when the service socket dies mid-window the
+    producer redials on the same ladder and REPLAYS the unacked tail in
+    seq order — frames the dead service committed get re-acked
+    cumulatively (server-side commits are ring overwrites, so a
+    duplicate from a lost ack is benign), frames it never saw are
+    simply delivered to the successor. A service bounce therefore costs
+    the producer a counted reconnect, never a crash; what IS lost is
+    whatever the service committed after its last snapshot — bounded by
+    the snapshot interval, measured by the kill drill."""
 
     def __init__(self, host: str, port: int, dial_timeout: float = 2.0,
-                 window: int = 1):
+                 window: int = 1, connect_retries: int = 0,
+                 backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0,
+                 eager_connect: bool = True):
         self._addr = (host, port)
         self._dial_timeout = dial_timeout
         self.window = max(int(window), 1)
+        self.connect_retries = max(int(connect_retries), 0)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
         self._sock = None
         self._lock = threading.Lock()
         self._seq = 0
-        self._inflight: "deque[Tuple[int, int]]" = deque()
+        # (seq, n_blocks, frame) — frame retained for tail replay; None
+        # for flush probes (resync points are connection-local, dropped
+        # at reconnect instead of replayed)
+        self._inflight: "deque[Tuple[int, int, Optional[tuple]]]" = deque()
         self.frames_sent = 0
         self.blocks_acked = 0
+        self.reconnects = 0
+        self.blocks_resent = 0
         from r2d2_tpu.serve.transport import recv_frame, send_frame
         self._recv_frame, self._send_frame = recv_frame, send_frame
+        if eager_connect:
+            self._ensure()
+
+    def _dial(self):
+        """One connect attempt per ladder rung; the terminal failure
+        re-raises the last refusal (ECONNREFUSED and friends) so a
+        misaddressed producer fails with the real error."""
+        import socket
+        attempt = 0
+        while True:
+            try:
+                s = socket.create_connection(self._addr,
+                                             timeout=self._dial_timeout)
+                break
+            except OSError:
+                attempt += 1
+                if attempt > self.connect_retries:
+                    raise
+                time.sleep(min(self.backoff_base_s * (2 ** (attempt - 1)),
+                               self.backoff_max_s))
+        # Windowed frames interleave large data writes one way with
+        # small cumulative acks the other; Nagle holding an ack
+        # behind the peer's delayed ACK stalls the pipeline ~40 ms
+        # per occurrence. Frames are whole sendall() calls, so
+        # nothing is gained by coalescing.
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(self._dial_timeout)
+        return s
 
     def _ensure(self):
-        import socket
         if self._sock is None:
-            s = socket.create_connection(self._addr,
-                                         timeout=self._dial_timeout)
-            # Windowed frames interleave large data writes one way with
-            # small cumulative acks the other; Nagle holding an ack
-            # behind the peer's delayed ACK stalls the pipeline ~40 ms
-            # per occurrence. Frames are whole sendall() calls, so
-            # nothing is gained by coalescing.
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            s.settimeout(self._dial_timeout)
-            self._sock = s
+            self._sock = self._dial()
         return self._sock
+
+    def _drop_socket(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _recover(self, timeout: float):
+        """Redial on the ladder and replay the unacked tail in seq
+        order. Flush probes are dropped from the window first: the old
+        connection's resync points have no meaning to the successor,
+        and an unreplayed probe would pin the window open forever."""
+        self._drop_socket()
+        sock = self._ensure()
+        sock.settimeout(timeout)
+        self.reconnects += 1
+        self._inflight = deque(e for e in self._inflight
+                               if e[2] is not None)
+        for _seq, k, frame in list(self._inflight):
+            self._send_frame(sock, frame, self._lock)
+            self.blocks_resent += k
+        return sock
 
     def add_block(self, block: Block, timeout: float = 5.0) -> int:
         fields = _block_fields(block)
-        sock = self._ensure()
-        sock.settimeout(timeout)
-        self._send_frame(sock, ("add", fields), self._lock)
-        kind, shard = self._recv_frame(sock)
+        frame = ("add", fields)
+        try:
+            sock = self._ensure()
+            sock.settimeout(timeout)
+            self._send_frame(sock, frame, self._lock)
+            kind, shard = self._recv_frame(sock)
+        except (ConnectionError, EOFError, OSError):
+            # lockstep rung: nothing windowed is outstanding (any addw
+            # tail replays first), so retry this one frame once
+            sock = self._recover(timeout)
+            self._send_frame(sock, frame, self._lock)
+            kind, shard = self._recv_frame(sock)
         if kind != "ack":
             raise ConnectionError(f"unexpected reply kind {kind!r}")
         return int(shard)
@@ -1004,49 +1093,74 @@ class RemoteReplayProducer:
         self._send_windowed(_block_fields(stacked), k, timeout)
 
     def _send_windowed(self, fields, k: int, timeout: float) -> None:
-        sock = self._ensure()
-        sock.settimeout(timeout)
         self._seq += 1
-        self._send_frame(
-            sock, ("addw", self._seq, len(self._inflight), k, fields),
-            self._lock)
-        self._inflight.append((self._seq, k))
+        frame = ("addw", self._seq, len(self._inflight), k, fields)
+        self._inflight.append((self._seq, k, frame))
         self.frames_sent += 1
+        try:
+            sock = self._ensure()
+            sock.settimeout(timeout)
+            self._send_frame(sock, frame, self._lock)
+        except (ConnectionError, EOFError, OSError):
+            sock = self._recover(timeout)   # replays the tail incl. this
         while len(self._inflight) >= self.window:
-            self._await_ack(sock)
+            self._await_ack(sock, timeout)
 
-    def _await_ack(self, sock) -> None:
+    def _await_ack(self, sock, timeout: float = 5.0) -> None:
         """Reap one cumulative ack: pops every in-flight frame ≤ the
         acked seq (a dropped ack is covered by the next). On a recv
         timeout a flush probe is sent once — the server always acks
         flushes, so a window stalled behind a dropped final ack
-        self-heals instead of deadlocking."""
+        self-heals instead of deadlocking. A dead socket recovers via
+        tail replay and the reap resumes on the new connection."""
         import socket as _socket
+        if self._sock is not None:
+            # a _recover inside an earlier reap replaced the socket; the
+            # caller's loop still holds the corpse — prefer the live one
+            sock = self._sock
         try:
-            frame = self._recv_frame(sock)
-        except _socket.timeout:
+            try:
+                frame = self._recv_frame(sock)
+            except _socket.timeout:
+                self._seq += 1
+                self._send_frame(sock, ("flushw", self._seq), self._lock)
+                self._inflight.append((self._seq, 0, None))
+                frame = self._recv_frame(sock)
+        except (ConnectionError, EOFError, OSError):
+            sock = self._recover(timeout)
+            if not self._inflight:
+                return
             self._seq += 1
-            self._send_frame(sock, ("flushw", self._seq), self._lock)
-            self._inflight.append((self._seq, 0))
+            probe = ("flushw", self._seq)
+            self._send_frame(sock, probe, self._lock)
+            self._inflight.append((self._seq, 0, None))
             frame = self._recv_frame(sock)
         kind, seq, _k = frame
         if kind != "ackw":
             raise ConnectionError(f"unexpected reply kind {kind!r}")
         while self._inflight and self._inflight[0][0] <= seq:
-            _, nblocks = self._inflight.popleft()
+            _, nblocks, _frame = self._inflight.popleft()
             self.blocks_acked += nblocks
 
     def flush(self, timeout: float = 5.0) -> int:
         """Drain the in-flight window: one always-acked flush frame,
         then reap until empty. Returns cumulative blocks acked."""
-        if self._sock is not None:
-            sock = self._sock
-            sock.settimeout(timeout)
-            self._seq += 1
-            self._send_frame(sock, ("flushw", self._seq), self._lock)
-            self._inflight.append((self._seq, 0))
+        if self._sock is not None or self._inflight:
+            try:
+                sock = self._ensure()
+                sock.settimeout(timeout)
+                self._seq += 1
+                self._send_frame(sock, ("flushw", self._seq), self._lock)
+                self._inflight.append((self._seq, 0, None))
+            except (ConnectionError, EOFError, OSError):
+                sock = self._recover(timeout)
+                if self._inflight:
+                    self._seq += 1
+                    self._send_frame(sock, ("flushw", self._seq),
+                                     self._lock)
+                    self._inflight.append((self._seq, 0, None))
             while self._inflight:
-                self._await_ack(sock)
+                self._await_ack(sock, timeout)
         return self.blocks_acked
 
     @property
@@ -1054,12 +1168,7 @@ class RemoteReplayProducer:
         return len(self._inflight)
 
     def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        self._drop_socket()
         self._inflight.clear()
 
 

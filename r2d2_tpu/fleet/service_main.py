@@ -1,0 +1,215 @@
+"""Standalone ReplayService host (ISSUE 18, rung b).
+
+PR 15 disaggregated replay into :class:`ReplayService` and PR 16 gave it
+the windowed socket rung — but the service always lived INSIDE the
+learner process, so "the replay service died" and "the learner died"
+were the same failure. This module hosts the service + its socket
+listener in a process of its own, which is what makes the restart drill
+meaningful: kill THIS process mid-ingest and the producers' reconnect +
+tail-replay (RemoteReplayProducer) plus the snapshot restore here must
+put the fleet back together with at most one snapshot interval of loss.
+
+Lifecycle:
+
+  * start: build the service exactly the way the Learner does (equal
+    device-ring slices per shard off ``ReplaySpec.from_config``), then
+    — under ``runtime.resume``-style semantics — reload the durable
+    shard snapshot (``replay/snapshot.py``) if one exists, so a
+    restarted service comes back with its experience, not empty rings;
+  * announce: re-register the listener's address with the fleet lease
+    board (``announce_replay``, best-effort) so producers discovering
+    through ``info`` dial the survivor;
+  * run: periodic snapshots every ``runtime.snapshot_interval``
+    COMMITTED BLOCKS (this process has no train-step clock; adds are
+    its commit boundary), written through the same async
+    :class:`SnapshotWriter` the learner uses;
+  * stop (SIGTERM/SIGINT): final synchronous snapshot, close.
+
+The pid is published to ``{save_dir}/replay_service.pid`` (the
+--kill-replay-service drill's target).
+"""
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+def _pid_path(save_dir: str) -> str:
+    return os.path.join(save_dir or ".", "replay_service.pid")
+
+
+class ReplayServiceHost:
+    """One standalone service incarnation: service + socket listener +
+    snapshot plane. ``player_idx`` namespaces the snapshot files, so a
+    multiplayer deployment runs one host per player stack."""
+
+    def __init__(self, cfg, player_idx: int = 0,
+                 host: Optional[str] = None, port: Optional[int] = None):
+        import dataclasses
+
+        from r2d2_tpu.fleet.replay_service import (ReplayService,
+                                                   ReplayServiceServer)
+        from r2d2_tpu.replay.structs import ReplaySpec
+        if cfg.fleet.replay_shards < 1:
+            raise ValueError(
+                "ReplayServiceHost requires fleet.replay_shards >= 1")
+        self.cfg = cfg
+        self.player_idx = player_idx
+        spec = ReplaySpec.from_config(cfg)
+        shard_spec = dataclasses.replace(
+            spec, num_blocks=spec.num_blocks // cfg.fleet.replay_shards,
+            replay_diag=False)
+        self.service = ReplayService(
+            shard_spec, cfg.fleet.replay_shards,
+            spill_blocks=cfg.fleet.spill_blocks,
+            route=cfg.fleet.replay_route,
+            promote_per_sample=cfg.fleet.spill_promote_per_sample,
+            ingest_batch_blocks=cfg.fleet.ingest_batch_blocks,
+            spill_prefetch=cfg.fleet.spill_prefetch)
+        self.restored_blocks = 0
+        self._snap_writer = None
+        self._snap_adds = 0
+        save_dir = cfg.runtime.save_dir or "."
+        if cfg.runtime.snapshot_interval > 0:
+            from r2d2_tpu.replay.snapshot import (SnapshotWriter,
+                                                  load_snapshot)
+            self._snap_writer = SnapshotWriter(save_dir, player_idx)
+            snap = load_snapshot(save_dir, player_idx)
+            if snap is not None and snap.get("kind") == "service":
+                self.service.restore_state(snap)
+                self.restored_blocks = self.service.total_adds
+                self._snap_adds = self.service.total_adds
+                log.warning(
+                    "replay service restored %d committed blocks from "
+                    "the step-%s snapshot", self.restored_blocks,
+                    snap.get("step"))
+        self.server = ReplayServiceServer(
+            self.service,
+            cfg.fleet.service_host if host is None else host,
+            cfg.fleet.service_port if port is None else port)
+        self.announced = self._announce()
+
+    def _announce(self) -> bool:
+        """Re-register with the fleet lease board (best-effort: the
+        board lives in the orchestrator, which may itself be mid-restart
+        — producers then fall back to their configured address and the
+        reconnect ladder)."""
+        cfg = self.cfg
+        if cfg.fleet.lease_transport != "socket":
+            return False
+        try:
+            from r2d2_tpu.fleet.membership import lease_call
+            lease_call(cfg.fleet.lease_host, cfg.fleet.lease_port,
+                       "announce_replay", timeout_s=2.0,
+                       host=self.server.host, port=self.server.port,
+                       shards=cfg.fleet.replay_shards,
+                       step=self.service.total_adds)
+            return True
+        except (OSError, RuntimeError) as e:
+            log.info("replay service lease announcement skipped (%s)", e)
+            return False
+
+    def maybe_snapshot(self) -> bool:
+        """Async snapshot when ``snapshot_interval`` blocks committed
+        since the last one; returns True when one was submitted."""
+        if self._snap_writer is None:
+            return False
+        interval = self.cfg.runtime.snapshot_interval
+        adds = self.service.total_adds
+        if adds - self._snap_adds < interval:
+            return False
+        self._snap_writer.submit(
+            self.service.snapshot_state(adds))
+        self._snap_adds = adds
+        return True
+
+    def run(self, max_seconds: Optional[float] = None,
+            stop: Optional[threading.Event] = None,
+            poll_s: float = 0.1) -> None:
+        """Serve until stopped/deadline: the listener threads do the
+        ingest work; this loop only drives the snapshot cadence."""
+        stop = stop or threading.Event()
+        deadline = time.time() + max_seconds if max_seconds else None
+        while not stop.is_set():
+            if deadline is not None and time.time() >= deadline:
+                break
+            self.maybe_snapshot()
+            time.sleep(poll_s)
+
+    def close(self) -> None:
+        """Final synchronous snapshot (the process is exiting — nothing
+        to protect from the write), then tear down."""
+        if self._snap_writer is not None:
+            try:
+                self._snap_writer.write_now(
+                    self.service.snapshot_state(self.service.total_adds))
+            finally:
+                self._snap_writer.stop()
+        self.server.close()
+        self.service.close()
+
+
+def run_replay_service(cfg, player_idx: int = 0,
+                       max_seconds: Optional[float] = None) -> None:
+    """Blocking entry: host the service, snapshot on cadence, write the
+    final snapshot on SIGTERM/SIGINT or deadline."""
+    host = ReplayServiceHost(cfg, player_idx)
+    save_dir = cfg.runtime.save_dir or "."
+    os.makedirs(save_dir, exist_ok=True)
+    pid_file = _pid_path(save_dir)
+    with open(pid_file, "w") as f:
+        f.write(str(os.getpid()))
+    stop = threading.Event()
+    prev = {}
+    if threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            stop.set()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(sig, _on_signal)
+            except (ValueError, OSError):
+                pass
+    print(f"replay service: {host.server.host}:{host.server.port} "
+          f"({cfg.fleet.replay_shards} shard(s), restored "
+          f"{host.restored_blocks} block(s))", flush=True)
+    try:
+        host.run(max_seconds=max_seconds, stop=stop)
+    finally:
+        host.close()
+        try:
+            os.remove(pid_file)
+        except OSError:
+            pass
+        for sig, handler in prev.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+
+
+def main(argv=None) -> None:
+    import sys
+
+    from r2d2_tpu.config import Config, parse_overrides
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    player_idx, max_seconds, rest = 0, None, []
+    for arg in argv:
+        if arg.startswith("--player="):
+            player_idx = int(arg.split("=", 1)[1])
+        elif arg.startswith("--max-seconds="):
+            max_seconds = float(arg.split("=", 1)[1])
+        else:
+            rest.append(arg)
+    cfg = parse_overrides(Config(), rest)
+    run_replay_service(cfg, player_idx, max_seconds=max_seconds)
+
+
+if __name__ == "__main__":
+    main()

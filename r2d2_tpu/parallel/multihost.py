@@ -766,6 +766,51 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
     # duplicate.
     observed_wiring = [None] * n_local
 
+    # Crash-recovery rank-0 twin (ISSUE 18): the single-host learner's
+    # durable replay snapshot plane, mirrored into the lockstep loop.
+    # Active on the shapes where rank 0 addresses the WHOLE ring (one
+    # controller, dp=1, device placement — the single-controller pod and
+    # the loop's test reality); wider pods log the gap once and rely on
+    # checkpoint resume alone (ROADMAP 4b: dp-sharded snapshot cuts).
+    # The ring twin is a host RingAccountant advanced per ingested block
+    # — the same mirror discipline as the single-host Learner's.
+    snap_writer = None
+    snap_ring = None
+    capture_plain = None
+    if cfg.runtime.snapshot_interval > 0 and rank == 0 and not host_mode:
+        import logging
+        if nprocs > 1 or dp > 1:
+            logging.getLogger(__name__).warning(
+                "runtime.snapshot_interval=%d: the rank-0 replay "
+                "snapshot twin needs a rank-0-addressable ring "
+                "(nprocs=1, dp=1; got nprocs=%d dp=%d) — replay "
+                "snapshots are skipped, checkpoint resume still works",
+                cfg.runtime.snapshot_interval, nprocs, dp)
+        else:
+            from r2d2_tpu.replay.snapshot import (SnapshotWriter,
+                                                  capture_plain,
+                                                  load_snapshot,
+                                                  restore_plain)
+            from r2d2_tpu.replay.structs import RingAccountant
+            snap_ring = RingAccountant(spec.num_blocks)
+            snap_writer = SnapshotWriter(cfg.runtime.save_dir or ".", pid)
+            if cfg.runtime.resume and cfg.runtime.restore_replay:
+                snap = load_snapshot(cfg.runtime.save_dir or ".", pid)
+                if snap is not None and snap.get("kind") == "plain":
+                    rs0 = jax.tree_util.tree_map(lambda x: x[0], rs)
+                    restored0 = restore_plain(spec, rs0, snap_ring, snap)
+                    # re-pin the restored plain cut under the dp axis on
+                    # the sharded state's own placement
+                    rs = jax.tree_util.tree_map(
+                        lambda r0, full: jax.device_put(
+                            np.asarray(jax.device_get(r0))[None],
+                            full.sharding),
+                        restored0, rs)
+                    logging.getLogger(__name__).warning(
+                        "rank-0 twin restored %d replay block(s) from "
+                        "the step-%s snapshot", snap_ring.total_adds,
+                        snap.get("step"))
+
     if actor_mode == "process":
         def spawn_actor(i: int):
             # player_idx=pid / actor_idx=gidx reproduces the thread path's
@@ -1144,6 +1189,12 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                     # only real ingests count — the pre-ready no-op spin
                     # iterations would otherwise dominate the histogram
                     tele.observe("ingest/commit", t_coll)
+                    if snap_ring is not None:
+                        # ring twin: same accounting replay_add applied
+                        # in-graph, kept host-side for the snapshot cut
+                        snap_ring.advance(
+                            int(np.sum(np.asarray(block.learning_steps))),
+                            int(np.asarray(block.weight_version)))
             if debug:
                 print(f"[mh rank={rank} it={it}] step={step_count} "
                       f"block={block is not None} {info}", flush=True)
@@ -1236,6 +1287,21 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                         resumed_env + info["env_steps"],
                         config_json=cfg.to_json())
                     last_ckpt_step = step_count
+                    if rt.keep_checkpoints > 0:
+                        # retention GC twin (ISSUE 18): same rank-0
+                        # dedup rule as the other side effects
+                        from r2d2_tpu.runtime.checkpoint import \
+                            prune_checkpoints
+                        prune_checkpoints(rt.save_dir, cfg.env.game_name,
+                                          pid, rt.keep_checkpoints)
+                if snap_writer is not None and boundary(
+                        rt.snapshot_interval):
+                    # async durable replay snapshot off the train path —
+                    # capture (device→host) here at the commit boundary,
+                    # serialization rides the writer thread
+                    rs0 = jax.tree_util.tree_map(lambda x: x[0], rs)
+                    snap_writer.submit(capture_plain(
+                        spec, rs0, snap_ring, step_count))
             else:
                 time.sleep(0.01)
 
@@ -1350,12 +1416,24 @@ def train_multihost(cfg: Config, *, max_training_steps: Optional[int] = None,
                 ts.opt_state, ts.target_params, step_count,
                 resumed_env + info["env_steps"],
                 config_json=cfg.to_json())
+            if rt.keep_checkpoints > 0:
+                from r2d2_tpu.runtime.checkpoint import prune_checkpoints
+                prune_checkpoints(rt.save_dir, cfg.env.game_name, pid,
+                                  rt.keep_checkpoints)
+        if snap_writer is not None:
+            # final synchronous snapshot (Learner.save_final's contract):
+            # the stop point's replay contents, not the last interval's
+            rs0 = jax.tree_util.tree_map(lambda x: x[0], rs)
+            snap_writer.write_now(capture_plain(
+                spec, rs0, snap_ring, step_count))
         if halt_error:
             # deferred nan_policy=halt (see flush_losses): every rank left
             # the loop via the stop consensus; now fail loudly on rank 0
             raise halt_error[0]
     finally:
         stop.set()
+        if snap_writer is not None:
+            snap_writer.stop()
         for sig, handler in prev_handlers.items():
             try:
                 signal.signal(sig, handler)

@@ -1,0 +1,559 @@
+"""Durable replay snapshots (ISSUE 18 tentpole, plane a).
+
+The prioritized recurrent replay is the expensive state in R2D2 — params
+re-materialize from any checkpoint in seconds, but the ring took millions
+of env-steps to fill, and before this plane a learner or ReplayService
+crash lost every shard's storage rows, sum-tree priorities, stamps, and
+spill pages. This module serializes the FULL replay plane to disk and
+restores it bit-exactly:
+
+  * per shard: every live ``ReplayState`` leaf (storage rings, sum-tree,
+    ring pointer, staleness/lane stamps, and — when replay_diag is on —
+    the sample-count ring and eviction accumulators), the
+    ``RingAccountant`` host mirror, the spill tier's pages in LRU order
+    with their stored priorities (the lazy-deletion heap is rebuilt from
+    the per-page priorities, which ``demote``/``write_back`` keep as the
+    single source of truth), and the ``_resident``/``_demote_ids``
+    demotion shadow;
+  * service-level: the round-robin add/sample cursors and the route, so
+    a restored service routes the NEXT block exactly where the dead one
+    would have;
+  * caller extras (the learner rides its service sample key along), so
+    resume-determinism holds through the sampling RNG.
+
+Consistency cut: capture runs under the service lock at a commit
+boundary (between learner dispatches — the same quiescent point
+``replay_add_many`` groups commit at), so a snapshot never splits a
+grouped add. None leaves are captured as ABSENT and restored as None —
+the kill-switch pytree contract (a restored state compiles the same
+programs as the pre-crash one, byte for byte).
+
+Disk format: one ``.npz`` payload + one ``.json`` manifest per player,
+each written tmp + ``os.replace``; the MANIFEST rename is the commit
+point (a loader that finds a manifest whose payload byte-size matches is
+looking at a complete snapshot — a crash mid-write leaves the previous
+pair intact). :class:`SnapshotWriter` does the serialization and IO on a
+background thread so the train path pays only the host capture
+(device_get of the shard states), never the disk.
+
+These page files are also the ROADMAP item-4b substrate: a disk tier
+below host RAM demotes/promotes through exactly this per-page layout.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+SNAPSHOT_VERSION = 1
+
+# ReplaySpec fields a snapshot must agree on to be loadable: everything
+# that shapes the state arrays or the sampling programs.
+_SPEC_FIELDS = ("num_blocks", "seqs_per_block", "block_length", "burn_in",
+                "learning", "forward", "frame_stack", "frame_height",
+                "frame_width", "hidden_dim", "batch_size", "prio_exponent",
+                "is_exponent", "pallas_gather", "exact_gather",
+                "replay_diag")
+
+
+def snapshot_paths(save_dir: str, player_idx: int):
+    """(payload, manifest) paths for one player's rolling snapshot."""
+    base = os.path.join(save_dir, f"replay_player{player_idx}")
+    return base + ".npz", base + ".json"
+
+
+def _spec_fingerprint(spec) -> dict:
+    return {f: getattr(spec, f) for f in _SPEC_FIELDS}
+
+
+def _block_fields_np(block) -> dict:
+    """Block -> {field: numpy} (None fields omitted — the same record
+    the socket frames carry)."""
+    return {name: np.asarray(getattr(block, name))
+            for name in block.__dataclass_fields__
+            if getattr(block, name) is not None}
+
+
+def _state_to_host(state) -> dict:
+    """ReplayState -> {leaf: numpy} for the present (non-None) leaves."""
+    import jax
+    out = {}
+    for name in state.__dataclass_fields__:
+        leaf = getattr(state, name)
+        if leaf is not None:
+            out[name] = np.asarray(jax.device_get(leaf))
+    return out
+
+
+def _put_like(template_leaf, arr: np.ndarray):
+    """Re-pin one restored leaf exactly where the freshly-initialized
+    template leaf lives (same device/sharding — the replay_add_many
+    pinning discipline: donated programs require operands on the layout
+    they were compiled for)."""
+    import jax
+    try:
+        return jax.device_put(arr, template_leaf.sharding)
+    except (AttributeError, ValueError):
+        return jax.device_put(arr)
+
+
+def _restore_state(template, leaves: dict):
+    """Rebuild a ReplayState from captured leaves onto ``template``'s
+    placement. The captured leaf set must equal the template's present
+    leaf set — a replay_diag (or exact_gather) mismatch means the
+    snapshot belongs to a differently-compiled program."""
+    present = {name for name in template.__dataclass_fields__
+               if getattr(template, name) is not None}
+    if present != set(leaves):
+        raise ValueError(
+            "replay snapshot leaf set "
+            f"{sorted(leaves)} != expected {sorted(present)} — the "
+            "snapshot was taken under a different replay_diag/gather "
+            "configuration; re-run with matching telemetry knobs or "
+            "drop the snapshot")
+    return template.replace(**{
+        name: _put_like(getattr(template, name), leaves[name])
+        for name in present})
+
+
+# ---------------------------------------------------------------------------
+# Capture: live objects -> one pure-host snapshot dict.
+
+
+def _capture_ring(ring) -> dict:
+    return {
+        "ptr": int(ring.ptr),
+        "total_adds": int(ring.total_adds),
+        "buffer_steps": int(ring.buffer_steps),
+        "slot_steps": [int(s) for s in ring.slot_steps],
+        "slot_versions": [int(v) for v in ring.slot_versions],
+    }
+
+
+def _capture_shard(shard) -> dict:
+    spill = shard.spill
+    pages = [(int(pid), _block_fields_np(block), int(learning), int(wv))
+             for pid, (block, learning, wv) in spill._pages.items()]
+    resident = [(slot, _block_fields_np(blk), int(learning), int(wv))
+                for slot, page in enumerate(shard._resident)
+                if page is not None
+                for blk, learning, wv in [page]]
+    return {
+        "state": _state_to_host(shard.state),
+        "ring": _capture_ring(shard.ring),
+        "spill": {
+            "next_id": int(spill._next_id),
+            "demotions": int(spill.demotions),
+            "promotions": int(spill.promotions),
+            "evictions": int(spill.evictions),
+            "writebacks": int(spill.writebacks),
+            # pages ride in OrderedDict (= LRU) order; per-page priority
+            # is re-derived into _prio and the heap at restore
+            "pages": pages,
+        },
+        "resident": resident,
+        "demote_ids": [(-1 if d is None else int(d))
+                       for d in shard._demote_ids],
+    }
+
+
+def capture_service(service, step: int, extra: Optional[dict] = None) -> dict:
+    """Consistent cut of a full ReplayService under its lock (call at a
+    commit boundary — between learner dispatches). ``extra`` carries
+    caller state that must ride the snapshot (the learner's service
+    sample key); values must be JSON-serializable."""
+    with service._lock:
+        return {
+            "version": SNAPSHOT_VERSION,
+            "kind": "service",
+            "step": int(step),
+            "spec": _spec_fingerprint(service.spec),
+            "route": service.route,
+            "rr_add": int(service._rr_add),
+            "rr_sample": int(service._rr_sample),
+            "extra": dict(extra or {}),
+            "shards": [_capture_shard(s) for s in service.shards],
+        }
+
+
+def capture_plain(spec, state, ring, step: int,
+                  extra: Optional[dict] = None) -> dict:
+    """Consistent cut of the legacy in-mesh device replay (one
+    ReplayState + its RingAccountant mirror — the replay_shards=0
+    learner). Caller quiesces (the learner's step loop is
+    single-threaded between dispatches)."""
+    return {
+        "version": SNAPSHOT_VERSION,
+        "kind": "plain",
+        "step": int(step),
+        "spec": _spec_fingerprint(spec),
+        "extra": dict(extra or {}),
+        "shards": [{
+            "state": _state_to_host(state),
+            "ring": _capture_ring(ring),
+        }],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Restore: snapshot dict -> live objects (bit-parity with the capture).
+
+
+def _restore_ring(ring, cap: dict) -> None:
+    ring.ptr = int(cap["ptr"])
+    ring.total_adds = int(cap["total_adds"])
+    ring.buffer_steps = int(cap["buffer_steps"])
+    ring.slot_steps = [int(s) for s in cap["slot_steps"]]
+    ring.slot_versions = [int(v) for v in cap["slot_versions"]]
+
+
+def _restore_spill(spill, cap: dict, block_cls) -> None:
+    import heapq
+    spill._pages = OrderedDict()
+    spill._prio = {}
+    spill._heap = []
+    for pid, fields, learning, wv in cap["pages"]:
+        block = block_cls(**fields)
+        spill._pages[int(pid)] = (block, int(learning), int(wv))
+        prio = float(np.max(np.asarray(block.priority)))
+        spill._prio[int(pid)] = prio
+        spill._heap.append((-prio, int(pid)))
+    heapq.heapify(spill._heap)
+    spill._next_id = int(cap["next_id"])
+    spill.demotions = int(cap["demotions"])
+    spill.promotions = int(cap["promotions"])
+    spill.evictions = int(cap["evictions"])
+    spill.writebacks = int(cap["writebacks"])
+
+
+def _check_spec(snap: dict, spec) -> None:
+    got, want = snap["spec"], _spec_fingerprint(spec)
+    if got != want:
+        diff = {k: (got.get(k), want[k]) for k in want
+                if got.get(k) != want[k]}
+        raise ValueError(
+            f"replay snapshot spec mismatch {diff} (snapshot, current) — "
+            "the snapshot belongs to a different replay geometry")
+
+
+def restore_service(service, snap: dict) -> None:
+    """Load a captured cut back into a freshly-constructed ReplayService
+    (same config): shard states re-pinned onto their template placement,
+    accountants/spill/cursors overwritten in place."""
+    from r2d2_tpu.replay.structs import Block
+    if snap.get("kind") != "service":
+        raise ValueError(f"snapshot kind {snap.get('kind')!r} is not a "
+                         "service snapshot")
+    _check_spec(snap, service.spec)
+    if len(snap["shards"]) != service.num_shards:
+        raise ValueError(
+            f"snapshot has {len(snap['shards'])} shards, service has "
+            f"{service.num_shards} — shard count must match to restore")
+    if snap["route"] != service.route:
+        raise ValueError(
+            f"snapshot route {snap['route']!r} != service route "
+            f"{service.route!r}")
+    with service._lock:
+        for shard, cap in zip(service.shards, snap["shards"]):
+            shard.state = _restore_state(shard.state, cap["state"])
+            _restore_ring(shard.ring, cap["ring"])
+            _restore_spill(shard.spill, cap["spill"], Block)
+            shard._resident = [None] * shard.spec.num_blocks
+            for slot, fields, learning, wv in cap["resident"]:
+                shard._resident[int(slot)] = (
+                    Block(**fields), int(learning), int(wv))
+            shard._demote_ids = [(None if d < 0 else int(d))
+                                 for d in cap["demote_ids"]]
+        service._rr_add = int(snap["rr_add"])
+        service._rr_sample = int(snap["rr_sample"])
+
+
+def restore_plain(spec, state, ring, snap: dict):
+    """Load a plain (in-mesh) cut: returns the restored ReplayState
+    (pinned like ``state``) and overwrites ``ring`` in place."""
+    if snap.get("kind") != "plain":
+        raise ValueError(f"snapshot kind {snap.get('kind')!r} is not a "
+                         "plain replay snapshot")
+    _check_spec(snap, spec)
+    cap = snap["shards"][0]
+    _restore_ring(ring, cap["ring"])
+    return _restore_state(state, cap["state"])
+
+
+# ---------------------------------------------------------------------------
+# Disk format: flatten the snapshot dict into one npz payload plus a
+# JSON manifest; manifest rename is the commit point.
+
+
+def _flatten_payload(snap: dict) -> dict:
+    """Everything array-shaped goes into the npz; scalars/structure stay
+    in the manifest."""
+    arrays = {}
+    for j, shard in enumerate(snap["shards"]):
+        p = f"s{j}."
+        for name, arr in shard["state"].items():
+            arrays[p + "state." + name] = arr
+        arrays[p + "ring.slot_steps"] = np.asarray(
+            shard["ring"]["slot_steps"], np.int64)
+        arrays[p + "ring.slot_versions"] = np.asarray(
+            shard["ring"]["slot_versions"], np.int64)
+        if "spill" in shard:
+            pages = shard["spill"]["pages"]
+            arrays[p + "spill.ids"] = np.asarray(
+                [pid for pid, _, _, _ in pages], np.int64)
+            arrays[p + "spill.learning"] = np.asarray(
+                [lg for _, _, lg, _ in pages], np.int64)
+            arrays[p + "spill.wv"] = np.asarray(
+                [wv for _, _, _, wv in pages], np.int64)
+            for field in (pages[0][1] if pages else {}):
+                arrays[p + "spill.f." + field] = np.stack(
+                    [fields[field] for _, fields, _, _ in pages])
+            res = shard["resident"]
+            arrays[p + "res.slots"] = np.asarray(
+                [slot for slot, _, _, _ in res], np.int64)
+            arrays[p + "res.learning"] = np.asarray(
+                [lg for _, _, lg, _ in res], np.int64)
+            arrays[p + "res.wv"] = np.asarray(
+                [wv for _, _, _, wv in res], np.int64)
+            for field in (res[0][1] if res else {}):
+                arrays[p + "res.f." + field] = np.stack(
+                    [fields[field] for _, fields, _, _ in res])
+            arrays[p + "demote_ids"] = np.asarray(
+                shard["demote_ids"], np.int64)
+    return arrays
+
+
+def _manifest_meta(snap: dict, payload_name: str, payload_bytes: int,
+                   duration_s: float) -> dict:
+    meta = {
+        "version": snap["version"],
+        "kind": snap["kind"],
+        "step": snap["step"],
+        "spec": snap["spec"],
+        "extra": snap["extra"],
+        "payload": payload_name,
+        "payload_bytes": payload_bytes,
+        "written_at": time.time(),
+        "write_s": round(duration_s, 6),
+        "total_adds": sum(s["ring"]["total_adds"] for s in snap["shards"]),
+        "shards": [],
+    }
+    if snap["kind"] == "service":
+        meta.update(route=snap["route"], rr_add=snap["rr_add"],
+                    rr_sample=snap["rr_sample"])
+    for shard in snap["shards"]:
+        entry = {
+            "state_leaves": sorted(shard["state"]),
+            "ring": {k: shard["ring"][k]
+                     for k in ("ptr", "total_adds", "buffer_steps")},
+        }
+        if "spill" in shard:
+            entry["spill"] = {k: shard["spill"][k]
+                              for k in ("next_id", "demotions",
+                                        "promotions", "evictions",
+                                        "writebacks")}
+            entry["spill"]["occupancy"] = len(shard["spill"]["pages"])
+        meta["shards"].append(entry)
+    return meta
+
+
+def write_snapshot(snap: dict, save_dir: str, player_idx: int) -> dict:
+    """Persist one snapshot atomically (payload first, then the manifest
+    — its rename commits). Returns the manifest dict (the recovery
+    telemetry's source: bytes, duration, step, written_at)."""
+    os.makedirs(save_dir, exist_ok=True)
+    payload_path, manifest_path = snapshot_paths(save_dir, player_idx)
+    t0 = time.perf_counter()
+    arrays = _flatten_payload(snap)
+    tmp = payload_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, payload_path)
+    payload_bytes = os.path.getsize(payload_path)
+    meta = _manifest_meta(snap, os.path.basename(payload_path),
+                          payload_bytes, time.perf_counter() - t0)
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, manifest_path)
+    return meta
+
+
+def _unstack_pages(data, prefix: str, ids_key: str):
+    ids = data[prefix + ids_key]
+    n = ids.shape[0]
+    learning = data[prefix + "learning"]
+    wv = data[prefix + "wv"]
+    fields = {k[len(prefix) + 2:]: data[k] for k in data.files
+              if k.startswith(prefix + "f.")}
+    return [(int(ids[i]), {f: arr[i] for f, arr in fields.items()},
+             int(learning[i]), int(wv[i])) for i in range(n)]
+
+
+def load_snapshot(save_dir: str, player_idx: int) -> Optional[dict]:
+    """Read a committed snapshot back into the capture dict shape; None
+    when no (complete) snapshot exists. A manifest whose payload is
+    missing or size-mismatched is treated as absent (the previous pair
+    was already replaced — nothing consistent remains)."""
+    payload_path, manifest_path = snapshot_paths(save_dir, player_idx)
+    if not os.path.exists(manifest_path):
+        return None
+    with open(manifest_path) as f:
+        meta = json.load(f)
+    if meta.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(
+            f"replay snapshot version {meta.get('version')} != "
+            f"{SNAPSHOT_VERSION} at {manifest_path}")
+    if (not os.path.exists(payload_path)
+            or os.path.getsize(payload_path) != meta["payload_bytes"]):
+        return None
+    snap = {
+        "version": meta["version"],
+        "kind": meta["kind"],
+        "step": meta["step"],
+        "spec": meta["spec"],
+        "extra": meta.get("extra", {}),
+        "shards": [],
+    }
+    if meta["kind"] == "service":
+        snap.update(route=meta["route"], rr_add=meta["rr_add"],
+                    rr_sample=meta["rr_sample"])
+    with np.load(payload_path) as data:
+        for j, entry in enumerate(meta["shards"]):
+            p = f"s{j}."
+            shard = {
+                "state": {name: data[p + "state." + name]
+                          for name in entry["state_leaves"]},
+                "ring": {
+                    **entry["ring"],
+                    "slot_steps": data[p + "ring.slot_steps"].tolist(),
+                    "slot_versions":
+                        data[p + "ring.slot_versions"].tolist(),
+                },
+            }
+            if "spill" in entry:
+                shard["spill"] = {
+                    **{k: entry["spill"][k]
+                       for k in ("next_id", "demotions", "promotions",
+                                 "evictions", "writebacks")},
+                    "pages": _unstack_pages(data, p + "spill.", "ids"),
+                }
+                shard["resident"] = _unstack_pages(data, p + "res.",
+                                                   "slots")
+                shard["demote_ids"] = data[p + "demote_ids"].tolist()
+            snap["shards"].append(shard)
+    return snap
+
+
+def read_manifest(save_dir: str, player_idx: int) -> Optional[dict]:
+    """Manifest alone (no payload load) — the cheap existence/telemetry
+    probe."""
+    payload_path, manifest_path = snapshot_paths(save_dir, player_idx)
+    if not os.path.exists(manifest_path):
+        return None
+    try:
+        with open(manifest_path) as f:
+            meta = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
+    if (not os.path.exists(payload_path)
+            or os.path.getsize(payload_path) != meta.get("payload_bytes")):
+        return None
+    return meta
+
+
+# ---------------------------------------------------------------------------
+# Background writer: the train path hands over a captured cut; the
+# serialization and disk IO happen off-thread. Latest-wins: a submit
+# while a write is in flight replaces any queued cut (snapshots are
+# rolling — only the newest matters).
+
+
+class SnapshotWriter:
+    def __init__(self, save_dir: str, player_idx: int):
+        self.save_dir = save_dir
+        self.player_idx = player_idx
+        self._pending: Optional[dict] = None
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        # telemetry (read by the recovery block): guarded by _cond
+        self.count = 0
+        self.dropped = 0            # cuts replaced before they wrote
+        self.last_meta: Optional[dict] = None
+
+    def submit(self, snap: dict) -> None:
+        """Queue one captured cut for writing (latest wins); lazy-starts
+        the writer thread. Re-raises a prior write failure here — a
+        snapshot plane that cannot write must fail the run loudly, not
+        pretend durability."""
+        with self._cond:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            if self._pending is not None:
+                self.dropped += 1
+            self._pending = snap
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name=f"replay-snapshot-p{self.player_idx}")
+                self._thread.start()
+            self._cond.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stop:
+                    self._cond.wait(timeout=0.25)
+                if self._pending is None and self._stop:
+                    return
+                snap, self._pending = self._pending, None
+            try:
+                meta = write_snapshot(snap, self.save_dir,
+                                      self.player_idx)
+            except BaseException as e:   # surfaced at the next submit
+                with self._cond:
+                    self._error = e
+                continue
+            with self._cond:
+                self.count += 1
+                self.last_meta = meta
+
+    def write_now(self, snap: dict) -> dict:
+        """Synchronous write (the final-checkpoint path: the process is
+        about to exit, so there is no train path to protect). Drains any
+        pending async cut first by replacing it — this cut is newer."""
+        with self._cond:
+            if self._pending is not None:
+                self._pending = None
+                self.dropped += 1
+        meta = write_snapshot(snap, self.save_dir, self.player_idx)
+        with self._cond:
+            self.count += 1
+            self.last_meta = meta
+        return meta
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until no cut is pending (test/shutdown hook)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cond:
+                if self._pending is None:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        self.drain(join_timeout)
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=join_timeout)
+            self._thread = None

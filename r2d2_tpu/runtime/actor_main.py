@@ -74,14 +74,21 @@ def actor_process_main(cfg_dict: dict, player_idx: int, actor_idx: int,
             version, assign = serve_spec["assign"]
             smap = ShardMap(serve_spec["total_shards"], assign)
             smap.version = int(version)
+            # eager dial on the bounded ladder (ISSUE 18): a server that
+            # is still binding is retried with backoff; a misaddressed
+            # one raises HERE with the real ECONNREFUSED instead of a
+            # timeout storm at the first request
             serve_channel = RoutingChannel(
-                {slot: SocketChannel(host, port)
+                {slot: SocketChannel(host, port, connect_retries=5,
+                                     eager_connect=True)
                  for slot, (host, port) in serve_spec["servers"].items()},
                 smap)
         else:
             from r2d2_tpu.serve import SocketChannel
             serve_channel = SocketChannel(serve_spec["host"],
-                                          serve_spec["port"])
+                                          serve_spec["port"],
+                                          connect_retries=5,
+                                          eager_connect=True)
     else:
         params = net.init(jax.random.PRNGKey(cfg.runtime.seed))
         # quantized inference (ISSUE 14): the published tree is the

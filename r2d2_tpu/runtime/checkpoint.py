@@ -212,3 +212,36 @@ def list_checkpoints(save_dir: str, game: str, player: int
         if m:
             out.append((int(m.group(1)), os.path.join(save_dir, name)))
     return sorted(out)
+
+
+def latest_checkpoint(save_dir: str, game: str, player: int
+                      ) -> Optional[str]:
+    """Path of the newest checkpoint, or None — the supervisor's resume
+    target (runtime/supervisor.py picks up from here after a crash)."""
+    ckpts = list_checkpoints(save_dir, game, player)
+    return ckpts[-1][1] if ckpts else None
+
+
+def prune_checkpoints(save_dir: str, game: str, player: int,
+                      keep: int) -> List[str]:
+    """Retention GC (ISSUE 18 satellite): delete all but the newest
+    ``keep`` checkpoint directories for one player, each with its
+    ``.config.json`` sidecar. Runs after every save — before this, disk
+    growth was unbounded (every orbax dir holds the full param + opt
+    tree). ``keep <= 0`` keeps everything. Returns the pruned paths.
+
+    The rolling replay snapshot (replay/snapshot.py) is NOT pruned: it
+    is one overwritten-in-place pair per player, not a per-checkpoint
+    set, and the newest checkpoint resumes from it."""
+    import shutil
+    if keep <= 0:
+        return []
+    pruned = []
+    for _idx, path in list_checkpoints(save_dir, game, player)[:-keep]:
+        shutil.rmtree(path, ignore_errors=True)
+        try:
+            os.remove(path + ".config.json")
+        except OSError:
+            pass
+        pruned.append(path)
+    return pruned

@@ -346,6 +346,68 @@ class Learner:
         self._staged_lock = threading.Lock()
         self._pause_started: Optional[float] = None
 
+        # -- crash-recovery plane (ISSUE 18) --
+        # runtime.snapshot_interval > 0: a background SnapshotWriter
+        # persists a consistent cut of the replay plane (service shards
+        # or the in-mesh state) at interval boundaries; on resume with
+        # runtime.restore_replay the newest committed cut is loaded back
+        # bit-exactly BEFORE training continues.
+        self._snap_writer = None
+        self._restores = 0
+        self._restored_blocks = 0
+        self._snap_capture_s = 0.0
+        # adds committed at the last snapshot — lost_blocks_est is the
+        # gauge of what a crash RIGHT NOW would cost (bounded by the
+        # snapshot interval; the kill drill measures it for real)
+        self._snap_adds = 0
+        if cfg.runtime.snapshot_interval > 0 and not self.host_mode:
+            from r2d2_tpu.replay.snapshot import SnapshotWriter
+            self._snap_writer = SnapshotWriter(cfg.runtime.save_dir,
+                                               player_idx)
+        if (cfg.runtime.resume and cfg.runtime.restore_replay
+                and not self.host_mode):
+            self._restore_replay_snapshot()
+
+    def _restore_replay_snapshot(self) -> None:
+        """Resume plane c (ISSUE 18): reload the newest committed replay
+        snapshot next to the checkpoint — every shard's ring/tree/stamps
+        /spill pages plus the service sample key, so the restored
+        learner's next sample (and next-step loss) equals the
+        uninterrupted twin's. Silently a no-op when no snapshot exists
+        (a pre-PR18 resume restores params/opt-state only)."""
+        from r2d2_tpu.replay.snapshot import load_snapshot, restore_plain
+        snap = load_snapshot(self.cfg.runtime.save_dir, self.player_idx)
+        if snap is None:
+            return
+        if self.service is not None:
+            self.service.restore_state(snap)
+            key = snap["extra"].get("service_key")
+            if key is not None:
+                self._service_key = jax.device_put(
+                    np.asarray(key, np.uint32))
+        else:
+            self.replay_state = restore_plain(
+                self.spec, self.replay_state, self.ring, snap)
+            if self.mesh is not None:
+                self._next_shard = int(
+                    snap["extra"].get("next_shard", 0))
+            key = snap["extra"].get("train_key")
+            if key is not None:
+                cur = self.train_state.key
+                self.train_state = self.train_state.replace(
+                    key=jax.device_put(np.asarray(key, np.uint32),
+                                       cur.sharding))
+        self._restores = 1
+        self._restored_blocks = sum(s["ring"]["total_adds"]
+                                    for s in snap["shards"])
+        self._snap_adds = self.ring.total_adds
+        env_steps = snap["extra"].get("env_steps")
+        if env_steps is not None:
+            # the checkpoint's env_steps counter stopped at its save;
+            # the snapshot's cut is newer (or equal) — adopt the later
+            self.env_steps = max(self.env_steps, int(env_steps))
+        self.metrics.set_buffer_size(self.ring.buffer_steps)
+
     @property
     def tele(self):
         """The process Telemetry, read through metrics DYNAMICALLY: the
@@ -769,6 +831,10 @@ class Learner:
 
     def stop_background(self, join_timeout: float = 10.0) -> None:
         stuck = []
+        if self._snap_writer is not None:
+            # drain + stop the snapshot writer first: a queued cut still
+            # writing must land (it is newer than anything on disk)
+            self._snap_writer.stop(join_timeout)
         if self._stager is not None:
             # drain the staging queue so a stager parked in a full-queue
             # put can observe the stop event; staged-but-uncommitted
@@ -1019,7 +1085,86 @@ class Learner:
             tele.observe("weights/publish", time.time() - t0)
         if rt.save_interval and step // rt.save_interval > prev // rt.save_interval:
             self.save(step // rt.save_interval)
+        if (self._snap_writer is not None and rt.snapshot_interval
+                and step // rt.snapshot_interval
+                    > prev // rt.snapshot_interval):
+            self.snapshot_replay()
         return m
+
+    def _capture_replay(self) -> dict:
+        """Consistent cut at the commit boundary between dispatches (the
+        caller's position in the step loop IS the quiescent point; the
+        service capture additionally holds the service lock against
+        socket producers and stager threads)."""
+        step = self._host_step
+        if self.service is not None:
+            extra = {
+                "service_key": np.asarray(
+                    jax.device_get(self._service_key)).tolist(),
+                "env_steps": int(self.env_steps),
+            }
+            return self.service.snapshot_state(step, extra)
+        from r2d2_tpu.replay.snapshot import capture_plain
+        # the fused step folds its sample key off train_state.key, which
+        # the checkpoint does NOT carry (resume_training_state keeps the
+        # reference's no-RNG contract) — the snapshot carries it instead,
+        # so a restored learner replays the exact sample stream its
+        # uninterrupted twin draws (same contract as service_key above)
+        extra = {
+            "env_steps": int(self.env_steps),
+            "train_key": np.asarray(
+                jax.device_get(self.train_state.key)).tolist(),
+        }
+        if self.mesh is not None:
+            extra["next_shard"] = int(self._next_shard)
+        return capture_plain(self.spec, self.replay_state, self.ring,
+                             step, extra)
+
+    def snapshot_replay(self) -> None:
+        """Capture + hand off one durable replay snapshot (ISSUE 18).
+        The train path pays only the host capture (device_get of the
+        ring state); serialization and the atomic tmp+rename write run
+        on the writer thread."""
+        if self._snap_writer is None:
+            return
+        t0 = time.time()
+        snap = self._capture_replay()
+        self._snap_capture_s = time.time() - t0
+        self.tele.observe("recovery/snapshot_capture",
+                          self._snap_capture_s)
+        self._snap_writer.submit(snap)
+        self._snap_adds = self.ring.total_adds
+
+    def recovery_block(self) -> Optional[dict]:
+        """The periodic record's ``recovery`` block (attached by the
+        orchestrator only when the plane is on, so recovery-off runs
+        keep a byte-identical schema). ``lost_blocks_est`` is the adds
+        committed since the last snapshot — exactly the experience a
+        crash at this instant would cost."""
+        if self._snap_writer is None:
+            return None
+        import os as _os
+        w = self._snap_writer
+        meta = w.last_meta
+        snap = {
+            "count": w.count,
+            "dropped": w.dropped,
+            "age_s": (round(time.time() - meta["written_at"], 3)
+                      if meta else None),
+            "bytes": meta["payload_bytes"] if meta else None,
+            "write_s": meta["write_s"] if meta else None,
+            "capture_s": round(self._snap_capture_s, 6),
+            "step": meta["step"] if meta else None,
+        }
+        return {
+            "snapshot": snap,
+            "restores": self._restores,
+            "restored_blocks": self._restored_blocks,
+            "lost_blocks_est": max(
+                0, self.ring.total_adds - self._snap_adds),
+            "supervisor": {"restarts": int(_os.environ.get(
+                "R2D2_SUPERVISOR_RESTARTS", "0"))},
+        }
 
     def flush_metrics(self) -> None:
         """Convert accumulated device losses to host floats (ONE sync for the
@@ -1077,10 +1222,18 @@ class Learner:
     def save(self, index: int) -> str:
         ts = self.train_state
         self._last_saved_step = self._host_step
-        return save_checkpoint(
+        path = save_checkpoint(
             self.cfg.runtime.save_dir, self.cfg.env.game_name, index,
             self.player_idx, ts.params, ts.opt_state, ts.target_params,
             int(ts.step), self.env_steps, config_json=self.cfg.to_json())
+        if self.cfg.runtime.keep_checkpoints > 0:
+            # retention GC (ISSUE 18 satellite): prune after every save
+            # so disk growth is bounded at keep_checkpoints orbax dirs
+            from r2d2_tpu.runtime.checkpoint import prune_checkpoints
+            prune_checkpoints(self.cfg.runtime.save_dir,
+                              self.cfg.env.game_name, self.player_idx,
+                              self.cfg.runtime.keep_checkpoints)
+        return path
 
     def save_final(self) -> Optional[str]:
         """Preemption-safe final checkpoint: write one last save on a clean
@@ -1089,11 +1242,18 @@ class Learner:
         the current step is already covered by a save (stopping exactly on
         a boundary must not write the same state twice). The index lands
         one past the current periodic slot so it sorts as the newest
-        checkpoint for resume."""
+        checkpoint for resume. With the recovery plane on, a final replay
+        snapshot is written SYNCHRONOUSLY alongside (the process is about
+        to exit — a SIGTERM-preempted run resumes with zero replay
+        loss)."""
         rt = self.cfg.runtime
         if not rt.save_interval or self._host_step <= self._last_saved_step:
             return None
-        return self.save(self._host_step // rt.save_interval + 1)
+        path = self.save(self._host_step // rt.save_interval + 1)
+        if self._snap_writer is not None:
+            self._snap_writer.write_now(self._capture_replay())
+            self._snap_adds = self.ring.total_adds
+        return path
 
     def run(self, queue, should_stop: Callable[[], bool],
             max_steps: Optional[int] = None) -> int:

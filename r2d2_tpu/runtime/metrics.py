@@ -138,6 +138,14 @@ class TrainMetrics:
         # the PR14 schema.
         self._replay_service_fn = None
 
+        # crash-recovery plane (ISSUE 18): a recovery-block provider
+        # (Learner.recovery_block — snapshot age/bytes/durations, restore
+        # counts, estimated lost blocks, supervisor restarts) attached by
+        # the orchestrating loop when runtime.snapshot_interval > 0 —
+        # unattached (every run with the plane off) the record is
+        # byte-identical to the PR17 schema.
+        self._recovery_fn = None
+
         # system-health pillar (ISSUE 7): a resources-block provider
         # (ResourceMonitor.block) and the alert engine, both attached by
         # the orchestrating loop. None = the blocks are OMITTED and the
@@ -265,6 +273,16 @@ class TrainMetrics:
         counters inside "spill". Called once per log(); None returns
         omit the block (consumers key on its presence)."""
         self._replay_service_fn = provider
+
+    def set_recovery(self, provider) -> None:
+        """Attach the recovery-block provider (ISSUE 18): a callable
+        returning the crash-recovery telemetry dict — latest replay
+        snapshot (age/bytes/capture+write durations/step), restore
+        counts + restored blocks, the estimated at-risk block count
+        (adds since the last snapshot), supervisor restart count.
+        Called once per log(); None returns omit the block (consumers
+        key on its presence)."""
+        self._recovery_fn = provider
 
     def set_resources(self, provider) -> None:
         """Attach the resources-block provider (ISSUE 7): a callable
@@ -429,6 +447,14 @@ class TrainMetrics:
             rs = self._replay_service_fn()
             if rs is not None:
                 record["replay_service"] = rs
+        if self._recovery_fn is not None:
+            # crash-recovery block (ISSUE 18): snapshot age / restore
+            # counts / at-risk blocks / supervisor restarts. Before the
+            # sentinel pass so the snapshot_stale / recovery_loop rules
+            # see their own interval.
+            recovery = self._recovery_fn()
+            if recovery is not None:
+                record["recovery"] = recovery
         if self._resources_fn is not None:
             # machine-side block (ISSUE 7): devices/host/buffer footprints
             # + the compile sub-block. Before the sentinel, which reads it.

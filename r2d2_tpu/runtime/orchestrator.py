@@ -127,6 +127,16 @@ class PlayerStack:
         # stay byte-identical to the PR14 schema
         if cfg.fleet.active and cfg.telemetry.enabled:
             self.metrics.set_replay_service(self._replay_service_block)
+        # crash-recovery plane (ISSUE 18): the record's recovery block
+        # (snapshot age/bytes/durations, restore counts, at-risk blocks,
+        # supervisor restarts) — attached only when the snapshot plane
+        # is on, so plane-off records stay byte-identical to PR17
+        if cfg.telemetry.enabled and cfg.runtime.snapshot_interval > 0:
+            self.metrics.set_recovery(self.learner.recovery_block)
+        # last replay-service re-announcement (ISSUE 18): a restarted
+        # standalone service posts its address here through the lease
+        # board; 'info' callers (joining producers) dial the survivor
+        self._replay_announce = None
         self.publisher = None
         self.store = None
         self.queue: Optional[BlockQueue] = None
@@ -852,9 +862,20 @@ class PlayerStack:
             return {"slot": self.shrink_serve_server(slot),
                     "servers": sorted(self.serve_fleet.servers)}
 
+        def _announce_replay(host, port, shards=None, step=None):
+            # ISSUE 18: a (re)started ReplayService re-registers its
+            # address after restoring from snapshot — producers that
+            # lost their socket rediscover the survivor via 'info'
+            self._replay_announce = {"host": str(host), "port": int(port),
+                                     "shards": shards, "step": step,
+                                     "t": time.time()}
+            return {"ok": True}
+
         def _info():
             info = {"membership": self.membership.snapshot(),
                     "actor_mode": self._actor_mode}
+            if self._replay_announce is not None:
+                info["replay_service"] = self._replay_announce
             if self.serve_fleet is not None:
                 info["serving"] = {
                     "servers": sorted(self.serve_fleet.servers),
@@ -869,7 +890,8 @@ class PlayerStack:
 
         self._lease_server = MembershipServer(
             {"join": _join, "leave": _leave, "grow_serve": _grow_serve,
-             "shrink_serve": _shrink_serve, "info": _info},
+             "shrink_serve": _shrink_serve, "info": _info,
+             "announce_replay": _announce_replay},
             host=self.cfg.fleet.lease_host,
             port=self.cfg.fleet.lease_port)
         import logging

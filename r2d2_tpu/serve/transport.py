@@ -335,19 +335,45 @@ class SocketChannel:
     ``req_id`` (a stash absorbs out-of-order completions when lanes are
     pipelined). Every socket failure surfaces as ``ServeTimeout`` so the
     caller's one retry/backoff path covers dead server, mid-restart, and
-    plain slowness alike."""
+    plain slowness alike.
 
-    def __init__(self, host: str, port: int, dial_timeout: float = 2.0):
+    ISSUE 18: every dial climbs a bounded backoff ladder
+    (``connect_retries`` attempts at ``min(base * 2^(n-1), max)``
+    spacing) so a client rank may start before its server finishes
+    binding; the terminal failure re-raises the real refusal.
+    ``eager_connect=True`` dials AT CONSTRUCTION — a misaddressed
+    client (RemotePolicy's channel) fails where it is built, not at the
+    first request a thousand steps later."""
+
+    def __init__(self, host: str, port: int, dial_timeout: float = 2.0,
+                 connect_retries: int = 0, backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0, eager_connect: bool = False):
         self._addr = (host, port)
         self._dial_timeout = dial_timeout
+        self.connect_retries = max(int(connect_retries), 0)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._stash: Dict[int, Reply] = {}
+        if eager_connect:
+            self._ensure()
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:
-            s = socket.create_connection(self._addr,
-                                         timeout=self._dial_timeout)
+            attempt = 0
+            while True:
+                try:
+                    s = socket.create_connection(
+                        self._addr, timeout=self._dial_timeout)
+                    break
+                except OSError:
+                    attempt += 1
+                    if attempt > self.connect_retries:
+                        raise
+                    time.sleep(min(
+                        self.backoff_base_s * (2 ** (attempt - 1)),
+                        self.backoff_max_s))
             s.settimeout(self._dial_timeout)
             # disable Nagle on the client side too: a reply ACK riding a
             # delayed timer stalls the next pipelined send (the replay

@@ -253,6 +253,21 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("ingest_backlog", "threshold",
                   ("replay_service", "ingest", "backlog"),
                   tcfg.alerts_ingest_backlog, "warn"),
+        # crash-recovery rules (ISSUE 18; the recovery block — inactive
+        # on records without it, i.e. every run with
+        # runtime.snapshot_interval == 0):
+        # the newest durable replay snapshot is older than the ceiling —
+        # a crash now would lose more experience than the plane promises
+        # (the writer thread wedged, or the interval is mis-sized)
+        AlertRule("snapshot_stale", "threshold",
+                  ("recovery", "snapshot", "age_s"),
+                  tcfg.alerts_snapshot_stale_s, "warn"),
+        # the supervisor has relaunched the learner repeatedly — a
+        # crash LOOP, not a one-off preemption; the breaker is about to
+        # (or did) give up, and every lap replays the snapshot window
+        AlertRule("recovery_loop", "threshold",
+                  ("recovery", "supervisor", "restarts"),
+                  tcfg.alerts_recovery_loop, "crit"),
     )
 
 

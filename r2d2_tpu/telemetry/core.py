@@ -46,6 +46,8 @@ STAGES = (
     "serve/batch_wait",           # serving: oldest request's fill wait
     "serve/forward",              # serving: jitted micro-batch forward
     "serve/reply",                # serving: state scatter + reply send
+    "recovery/snapshot_capture",  # replay snapshot host cut (train path
+                                  # cost; the write runs off-thread)
 )
 STAGE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(STAGES)}
 

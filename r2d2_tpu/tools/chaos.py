@@ -763,6 +763,415 @@ def run_churn_drill(seconds: float = 45.0, num_actors: int = 4,
     return report
 
 
+# ---------------------------------------------------------------------------
+# Crash-recovery kill drills (ISSUE 18): SIGKILL the learner / the
+# standalone replay service mid-run and assert the recovery plane puts
+# the run back together.
+
+
+def _read_jsonl(path: str) -> list:
+    """Best-effort metrics reader: skips partial trailing lines (a
+    writer mid-append) and anything unparseable."""
+    import json
+    import os
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows
+
+
+def _read_pid(path: str):
+    import os
+    try:
+        with open(path) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError, OSError):
+        return None
+    return pid
+
+
+def run_kill_learner_drill(seconds: float = 150.0,
+                           config_overrides: dict = None) -> dict:
+    """Learner kill drill (ISSUE 18 tentpole d): train on the fake env
+    under ``runtime.auto_resume`` with the snapshot plane on, SIGKILL
+    the training child mid-run (via ``{save_dir}/learner.pid``), and
+    assert the supervisor relaunched it, that training resumed PAST the
+    kill point from the newest checkpoint, that the replay buffer came
+    back from the durable snapshot (``recovery.restores``), that the
+    restored contents cover everything durable at the kill (loss ≤ one
+    snapshot interval of commits), and that the restart did not set off
+    an actor crash storm (no breaker trips, no parked slots, exactly
+    one supervisor restart)."""
+    import os
+    import signal
+    import tempfile
+    import threading
+
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.replay.snapshot import read_manifest
+    from r2d2_tpu.runtime.checkpoint import latest_checkpoint
+    from r2d2_tpu.runtime.supervisor import _pid_path, supervise_train
+
+    save_dir = tempfile.mkdtemp(prefix="r2d2_kill_learner_")
+    overrides = {
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "actor.num_actors": 2,
+        "telemetry.enabled": True,
+        "runtime.save_dir": save_dir,
+        "runtime.save_interval": 25,
+        "runtime.snapshot_interval": 25,
+        "runtime.auto_resume": True,
+        "runtime.log_interval": 1.0,
+        "runtime.steps_per_dispatch": 1,
+        # a tight ladder so the relaunch is fast, with a window wide
+        # enough that the drill's single kill can never trip the breaker
+        "runtime.restart_backoff_base_s": 0.2,
+        "runtime.restart_backoff_max_s": 1.0,
+        "runtime.max_restarts_per_window": 3,
+        "runtime.restart_window_s": 600.0,
+    }
+    overrides.update(config_overrides or {})
+    cfg = Config().replace(**overrides)
+    game = cfg.env.game_name
+
+    pid_file = _pid_path(save_dir)
+    metrics_path = os.path.join(save_dir, "metrics_player0.jsonl")
+    holder = {"restarts": None, "error": None}
+
+    def _run():
+        try:
+            # thread-mode actors: they die WITH the killed child, so the
+            # SIGKILL cannot orphan an actor fleet
+            holder["restarts"] = supervise_train(
+                cfg, actor_mode="thread", max_seconds=seconds * 2 + 120)
+        except Exception as e:   # breaker trip surfaces in the verdict
+            holder["error"] = repr(e)
+
+    sup = threading.Thread(target=_run, name="drill-supervisor", daemon=True)
+    t0 = time.time()
+    sup.start()
+
+    def _wait(pred, timeout):
+        deadline = time.time() + timeout
+        while time.time() < deadline and sup.is_alive():
+            if pred():
+                return True
+            time.sleep(0.2)
+        return pred()
+
+    pid0 = steps_at_kill = adds_at_kill = None
+    killed = False
+    ready = _wait(
+        lambda: (_read_pid(pid_file) is not None
+                 and latest_checkpoint(save_dir, game, 0) is not None
+                 and (read_manifest(save_dir, 0) or {}).get("total_adds", 0) > 0
+                 and (_read_jsonl(metrics_path) or [{}])[-1]
+                     .get("training_steps", 0) > 0),
+        timeout=seconds)
+    if ready:
+        pid0 = _read_pid(pid_file)
+        rows = _read_jsonl(metrics_path)
+        rows_at_kill = len(rows)
+        steps_at_kill = rows[-1].get("training_steps", 0)
+        adds_at_kill = read_manifest(save_dir, 0)["total_adds"]
+        os.kill(pid0, signal.SIGKILL)
+        killed = True
+
+        def _recovered():
+            pid = _read_pid(pid_file)
+            if pid is None or pid == pid0:
+                return False
+            fresh = _read_jsonl(metrics_path)[rows_at_kill:]
+            return any(((r.get("recovery") or {}).get("restores") or 0) >= 1
+                       for r in fresh) and any(
+                r.get("training_steps", 0) > steps_at_kill for r in fresh)
+        _wait(_recovered, timeout=seconds)
+
+    # clean stop: SIGTERM the CURRENT child — its clean-stop path exits 0
+    # and the supervisor breaks without relaunching
+    for _ in range(3):
+        if not sup.is_alive():
+            break
+        pid = _read_pid(pid_file)
+        if pid is not None:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                pass
+        sup.join(timeout=30.0)
+    sup.join(timeout=30.0)
+
+    rows = _read_jsonl(metrics_path)
+    post = rows[rows_at_kill:] if killed else []
+    recovery_rows = [r.get("recovery") for r in post if r.get("recovery")]
+    restored_blocks = max(
+        (r.get("restored_blocks") or 0 for r in recovery_rows), default=0)
+    restarts_seen = max(
+        ((r.get("supervisor") or {}).get("restarts") or 0
+         for r in recovery_rows), default=0)
+    final = rows[-1] if rows else {}
+    final_steps = final.get("training_steps", 0)
+
+    report = {
+        "metric": "kill_learner_drill",
+        "duration_s": round(time.time() - t0, 1),
+        "save_dir": save_dir,
+        "killed_pid": pid0,
+        "steps_at_kill": steps_at_kill,
+        "snapshot_adds_at_kill": adds_at_kill,
+        "restored_blocks": restored_blocks,
+        "supervisor_restarts": holder["restarts"],
+        "supervisor_error": holder["error"],
+        "training_steps": final_steps,
+        "records": rows[-3:],
+    }
+    report["verdict"] = {
+        "killed": killed,
+        "relaunched": restarts_seen >= 1,
+        "resumed_training": (killed and steps_at_kill is not None
+                             and final_steps > steps_at_kill),
+        "replay_restored": any(
+            (r.get("restores") or 0) >= 1 for r in recovery_rows),
+        # everything durable at the kill came back: the loss is bounded
+        # by the commits since the last snapshot — one interval at most
+        "bounded_loss": (killed and restored_blocks >= (adds_at_kill or 0)
+                         and (adds_at_kill or 0) > 0),
+        "no_crash_storm": (holder["error"] is None
+                           and restarts_seen == 1
+                           and final.get("actor_breaker_trips", 0) == 0
+                           and final.get("actor_parked_slots", 0) == 0),
+    }
+    return report
+
+
+def _service_child(cfg_dict: dict) -> None:
+    """Spawn target for one standalone replay-service incarnation
+    (module-level: the ``spawn`` start method pickles by reference)."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.fleet.service_main import run_replay_service
+    run_replay_service(Config.from_dict(cfg_dict), 0)
+
+
+def _synth_blocks(cfg, n: int, seed: int = 0) -> list:
+    """A pool of well-formed fake-env blocks for the service drill —
+    the same LocalBuffer path the actors use, so the wire shapes match
+    the service's spec exactly."""
+    import numpy as np
+
+    from r2d2_tpu.actor.local_buffer import LocalBuffer
+    from r2d2_tpu.replay.structs import ReplaySpec
+
+    spec = ReplaySpec.from_config(cfg)
+    action_dim = 4
+    rng = np.random.default_rng(seed)
+    buf = LocalBuffer(spec, action_dim, gamma=0.99)
+    buf.reset(np.zeros((spec.frame_height, spec.frame_width), np.uint8))
+    blocks = []
+    t = 0
+    for _ in range(n):
+        for i in range(spec.block_length):
+            obs = np.full((spec.frame_height, spec.frame_width),
+                          (t + i) % 250, np.uint8)
+            q = rng.normal(size=action_dim).astype(np.float32)
+            hidden = rng.normal(size=(2, spec.hidden_dim)).astype(np.float32)
+            buf.add((t + i) % action_dim, float((t + i) % 3), obs, q, hidden)
+        t += spec.block_length
+        blocks.append(buf.finish(
+            last_qval=rng.normal(size=action_dim).astype(np.float32)))
+    return blocks
+
+
+def run_kill_replay_service_drill(seconds: float = 120.0,
+                                  config_overrides: dict = None) -> dict:
+    """Replay-service kill drill (ISSUE 18 tentpole d): host the
+    standalone service (fleet/service_main.py) in its own process,
+    stream blocks at it through a windowed RemoteReplayProducer,
+    SIGKILL the service mid-ingest, restart it, and assert:
+
+      * the producer SURVIVED the dead socket — reconnect ladder +
+        unacked-tail replay, no exception, every sent block acked;
+      * the restarted service RESTORED the durable snapshot (committed
+        blocks are monotone across the kill);
+      * the loss is BOUNDED: at most one snapshot interval of commits
+        (plus the in-flight window) went down with the process."""
+    import multiprocessing as mp
+    import os
+    import socket as socket_mod
+    import tempfile
+    import threading
+
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.fleet.replay_service import RemoteReplayProducer
+    from r2d2_tpu.replay.snapshot import read_manifest
+
+    save_dir = tempfile.mkdtemp(prefix="r2d2_kill_service_")
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    interval = 8
+    overrides = {
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "fleet.replay_shards": 2,
+        "fleet.service_host": "127.0.0.1",
+        "fleet.service_port": port,
+        "runtime.save_dir": save_dir,
+        "runtime.snapshot_interval": interval,
+    }
+    overrides.update(config_overrides or {})
+    cfg = Config().replace(**overrides)
+    cfg_dict = cfg.to_dict()
+    interval = cfg.runtime.snapshot_interval
+
+    ctx = mp.get_context("spawn")
+    t0 = time.time()
+    child = ctx.Process(target=_service_child, args=(cfg_dict,),
+                        name="replay-service-0")
+    child.start()
+    pool = _synth_blocks(cfg, 12)
+    group = 2
+    window = 4
+    # the eager dial + _recover both ride this ladder: wide enough to
+    # cover a full spawn+jax import of the replacement service
+    producer = RemoteReplayProducer(
+        "127.0.0.1", port, window=window, connect_retries=120,
+        backoff_base_s=0.1, backoff_max_s=1.0, eager_connect=True)
+
+    state = {"sent": 0, "error": None}
+    stop_send = threading.Event()
+
+    def _sender():
+        i = 0
+        try:
+            while not stop_send.is_set():
+                producer.add_blocks(
+                    [pool[(i + j) % len(pool)] for j in range(group)])
+                state["sent"] += group
+                i += group
+                time.sleep(0.02)
+        except Exception as e:
+            state["error"] = repr(e)
+
+    sender = threading.Thread(target=_sender, name="drill-producer",
+                              daemon=True)
+    sender.start()
+
+    def _wait(pred, timeout):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.1)
+        return pred()
+
+    killed = restarted = False
+    adds_at_kill = sent_at_kill = None
+    child2 = None
+    final_manifest = None
+    try:
+        # phase 1: ingest until the service committed + snapshotted
+        ready = _wait(
+            lambda: ((read_manifest(save_dir, 0) or {})
+                     .get("total_adds", 0) >= interval
+                     and state["sent"] >= 2 * interval
+                     and state["error"] is None),
+            timeout=seconds)
+        if ready:
+            manifest = read_manifest(save_dir, 0)
+            adds_at_kill = manifest["total_adds"]
+            sent_at_kill = state["sent"]
+            child.kill()                      # SIGKILL, mid-ingest
+            child.join(timeout=30.0)
+            killed = True
+            # phase 2: restart; the producer's ladder rides the outage
+            child2 = ctx.Process(target=_service_child, args=(cfg_dict,),
+                                 name="replay-service-1")
+            child2.start()
+            restarted = _wait(
+                lambda: (state["sent"] > sent_at_kill + 2 * interval
+                         and state["error"] is None),
+                timeout=seconds)
+    finally:
+        stop_send.set()
+        sender.join(timeout=60.0)
+        try:
+            if state["error"] is None:
+                producer.flush()
+        except OSError as e:
+            state["error"] = repr(e)
+        producer.close()
+        # clean stop: SIGTERM → final synchronous snapshot on close()
+        for c in (child, child2):
+            if c is not None and c.is_alive():
+                c.terminate()
+                c.join(timeout=60.0)
+                if c.is_alive():
+                    c.kill()
+                    c.join(timeout=10.0)
+        final_manifest = read_manifest(save_dir, 0)
+
+    final_adds = (final_manifest or {}).get("total_adds", 0)
+    # duplicates from the ack-replay tail COUNT as adds (idempotent
+    # overwrite), so sent - adds can go negative; clamp
+    lost_est = max(0, state["sent"] - final_adds)
+    report = {
+        "metric": "kill_replay_service_drill",
+        "duration_s": round(time.time() - t0, 1),
+        "save_dir": save_dir,
+        "blocks_sent": state["sent"],
+        "blocks_acked": producer.blocks_acked,
+        "blocks_resent": producer.blocks_resent,
+        "reconnects": producer.reconnects,
+        "producer_error": state["error"],
+        "snapshot_adds_at_kill": adds_at_kill,
+        "final_total_adds": final_adds,
+        "lost_blocks_est": lost_est,
+        "loss_bound": (interval + window * group) if killed else None,
+    }
+    report["verdict"] = {
+        "killed": killed,
+        "producer_survived": (killed and state["error"] is None
+                              and producer.reconnects >= 1),
+        "all_sent_acked": (state["sent"] > 0
+                           and producer.blocks_acked == state["sent"]),
+        "service_restored": (restarted
+                             and final_adds >= (adds_at_kill or 0)
+                             and (adds_at_kill or 0) > 0),
+        "bounded_loss": (killed
+                         and lost_est <= interval + window * group),
+    }
+    return report
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -785,6 +1194,19 @@ def main(argv=None) -> int:
                         "drill: survivors adopt the victim's cache "
                         "shards, clients re-route, the learner never "
                         "stalls")
+    p.add_argument("--kill-learner", action="store_true",
+                   help="run the ISSUE-18 learner kill drill: SIGKILL "
+                        "the training child mid-run under "
+                        "runtime.auto_resume, assert the supervisor "
+                        "relaunched it past the kill point with the "
+                        "replay snapshot restored (loss ≤ one snapshot "
+                        "interval) and no actor crash storm")
+    p.add_argument("--kill-replay-service", action="store_true",
+                   help="run the ISSUE-18 replay-service kill drill: "
+                        "SIGKILL the standalone service mid-ingest, "
+                        "restart it, assert producer reconnect + "
+                        "unacked-tail replay and a bounded-loss "
+                        "snapshot restore")
     p.add_argument("--servers", type=int, default=2,
                    help="--serve-fleet: fleet width before the kill")
     p.add_argument("--outage-seconds", type=float, default=6.0,
@@ -799,7 +1221,13 @@ def main(argv=None) -> int:
             overrides[k] = json.loads(v)
         except (json.JSONDecodeError, ValueError):
             overrides[k] = v
-    if args.churn:
+    if args.kill_learner:
+        out = run_kill_learner_drill(max(args.seconds, 120.0),
+                                     config_overrides=overrides)
+    elif args.kill_replay_service:
+        out = run_kill_replay_service_drill(max(args.seconds, 90.0),
+                                            config_overrides=overrides)
+    elif args.churn:
         out = run_churn_drill(args.seconds, config_overrides=overrides)
     elif args.serve_fleet:
         out = run_serve_fleet_chaos(args.seconds, args.servers, overrides)

@@ -295,6 +295,12 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         else:
             replay_service.update(
                 {k: v for k, v in fb.items() if v is not None})
+    # crash-recovery evidence (ISSUE 18): the newest recovery block —
+    # its snapshot counters are cumulative, so last-wins is exact; None
+    # on every run with the snapshot plane off (the key-absence
+    # contract, like serving/quant/replay_service)
+    recovery = next((r["recovery"] for r in reversed(records)
+                     if r.get("recovery")), None)
     # system-health evidence (ISSUE 7): the newest resources block plus
     # the run's alert tally — proof the pillar actually flowed (or, with
     # the kill switch off, that the records carried neither key)
@@ -333,6 +339,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "serving": serving,
         "quant": quant,
         "replay_service": replay_service,
+        "recovery": recovery,
         "resources": resources,
         "alerts_present": alerts_present,
         "alerts_fired": alerts_fired,
@@ -517,6 +524,66 @@ def run_resources_ab(seconds: float, envs_per_actor: int, num_actors: int,
         c.get("resources") for c in cells["resources_off"])
     out["alerts_block_off"] = any(
         c.get("alerts_present") for c in cells["resources_off"])
+    return out
+
+
+def run_recovery_ab(seconds: float, envs_per_actor: int, num_actors: int,
+                    overrides: Optional[dict] = None,
+                    repeats: int = 2,
+                    snapshot_interval: int = 200) -> dict:
+    """Crash-recovery plane overhead A/B (ISSUE 18 acceptance): the SAME
+    e2e system with ``runtime.snapshot_interval`` on vs off, in one
+    artifact. Budget under test: the durable replay snapshot path —
+    per-interval device→host ring capture, the async SnapshotWriter's
+    npz serialization + atomic tmp/rename commit, and the recovery
+    telemetry block — costs < 2% on BOTH env-steps/s and learner
+    updates/s (the capture is the only on-path piece; the write rides a
+    background thread). Cells run INTERLEAVED off/on ``repeats`` times
+    with per-arm medians, like the resources A/B. The ON cells carry
+    the ``recovery`` block (snapshot count/bytes/write_s) as evidence
+    snapshots actually flowed; the OFF cells prove the records carried
+    no ``recovery`` key at all (the kill-switch schema contract)."""
+    cells = {"recovery_off": [], "recovery_on": []}
+    for _ in range(max(repeats, 1)):
+        for label, interval in (("recovery_off", 0),
+                                ("recovery_on", snapshot_interval)):
+            ov = dict(overrides or {})
+            ov["runtime.snapshot_interval"] = interval
+            cells[label].append(run_e2e(seconds, envs_per_actor,
+                                        num_actors, overrides=ov))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"recovery_off": cells["recovery_off"][-1],
+           "recovery_on": cells["recovery_on"][-1],
+           "repeats": max(repeats, 1),
+           "snapshot_interval": snapshot_interval,
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("recovery_off", "env_steps_per_sec") > 0:
+        ratio = (med("recovery_on", "env_steps_per_sec")
+                 / med("recovery_off", "env_steps_per_sec"))
+        out["env_steps_ratio"] = round(ratio, 3)
+        out["overhead_pct"] = round((1.0 - ratio) * 100.0, 2)
+    if med("recovery_off", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio"] = round(
+            med("recovery_on", "learner_steps_per_sec")
+            / med("recovery_off", "learner_steps_per_sec"), 3)
+    on_cells = cells["recovery_on"]
+    out["recovery_block_on"] = any(c.get("recovery") for c in on_cells)
+    rb = next((c["recovery"] for c in reversed(on_cells)
+               if c.get("recovery")), None)
+    if rb:
+        out["snapshots_written"] = (rb.get("snapshot") or {}).get("count")
+        out["snapshot_bytes"] = (rb.get("snapshot") or {}).get("bytes")
+        out["snapshot_write_s"] = (rb.get("snapshot") or {}).get("write_s")
+    out["recovery_block_off"] = any(
+        c.get("recovery") for c in cells["recovery_off"])
     return out
 
 
@@ -1970,6 +2037,20 @@ def main(argv=None) -> int:
                         "off/on; admitted p99 within SLO while shedding) "
                         "and the TCP_NODELAY socket round-trip re-quote; "
                         "one artifact (E2E_r19.json)")
+    p.add_argument("--recovery-ab", type=int, default=0,
+                   help="run the crash-recovery overhead A/B instead "
+                        "(ISSUE 18): runtime.snapshot_interval on vs "
+                        "off on the same e2e system — the durable "
+                        "replay snapshot plane must cost < 2%% on both "
+                        "env-steps/s and learner updates/s, the ON "
+                        "cells must carry the recovery block, the OFF "
+                        "cells must not")
+    p.add_argument("--snapshot-interval", type=int, default=200,
+                   help="--recovery-ab: the ON arm's snapshot cadence "
+                        "in learner steps (default models the ~30s "
+                        "loss window the kill drills assert; the write "
+                        "duty cycle, not the on-path capture, is the "
+                        "cost, so overhead scales ~1/interval)")
     p.add_argument("--resources-ab", type=int, default=0,
                    help="1: run the e2e phase as a resource/compile/alerts "
                         "on/off A/B instead (telemetry.resources_enabled; "
@@ -2058,6 +2139,11 @@ def main(argv=None) -> int:
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,
                 overrides=overrides, repeats=args.ab_repeats,
                 sharded_dp=args.sharded_dp)
+        elif args.recovery_ab:
+            out["recovery_ab"] = run_recovery_ab(
+                args.e2e_seconds, args.envs_per_actor, args.num_actors,
+                overrides=overrides, repeats=args.ab_repeats,
+                snapshot_interval=args.snapshot_interval)
         elif args.resources_ab:
             out["e2e_resources_ab"] = run_resources_ab(
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,

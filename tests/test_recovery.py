@@ -1,0 +1,620 @@
+"""Crash-recovery plane tests (ISSUE 18): durable replay snapshot
+bit-parity (service shards with/without spill, the plain in-mesh cut),
+the atomic manifest commit + corruption probe, the async SnapshotWriter's
+latest-wins contract, producer reconnect + unacked-tail replay across a
+service bounce (cumulative-ack idempotence), eager-connect construction
+failures + the bounded dial ladder, resume determinism (the restored
+learner's next-step loss equals the uninterrupted twin's, on BOTH the
+plain and service paths), the learner supervisor's crash-loop breaker /
+clean-exit / resume-chain policies (fake process, no spawn cost),
+checkpoint retention GC, and the kill-switch schema contract (no
+``recovery`` record block, no snapshot files, inert alert rules when
+``runtime.snapshot_interval == 0``). Slow tier: the two SIGKILL drills
+from tools/chaos.py end-to-end.
+"""
+
+import json
+import os
+import socket as socket_mod
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_elastic import assert_trees_equal
+from tests.test_replay import _fill_blocks, make_spec
+from tests.test_runtime import tiny_config
+from tests.test_service_ingest import _ring_equal, _spill_equal, _svc_cfg
+
+import jax
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.fleet.replay_service import (RemoteReplayProducer,
+                                           ReplayService,
+                                           ReplayServiceServer)
+from r2d2_tpu.replay import replay_add, replay_init
+from r2d2_tpu.replay.snapshot import (SnapshotWriter, capture_plain,
+                                      load_snapshot, read_manifest,
+                                      restore_plain, snapshot_paths,
+                                      write_snapshot)
+from r2d2_tpu.replay.structs import RingAccountant
+
+
+def _recovery_cfg(tmp_path, **extra):
+    """tiny_config shrunk to the 12x12/hidden-8 geometry _fill_blocks
+    synthesizes, with the snapshot plane armed (manual cadence: the
+    interval is large so tests drive snapshot_replay() explicitly)."""
+    base = {
+        "env.frame_height": 12, "env.frame_width": 12,
+        "network.hidden_dim": 8,
+        "runtime.snapshot_interval": 100_000,
+        "runtime.save_interval": 0,
+    }
+    base.update(extra)
+    return tiny_config(tmp_path, **base)
+
+
+def _make_net(cfg):
+    from r2d2_tpu.models.network import NetworkApply
+    return NetworkApply(4, cfg.network, cfg.env.frame_stack,
+                        cfg.env.frame_height, cfg.env.frame_width)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot round-trip: bit-parity restore.
+
+
+@pytest.mark.parametrize("spill", [0, 3])
+@pytest.mark.parametrize("route", ["round_robin", "lane"])
+def test_service_snapshot_roundtrip_bit_parity(rng, tmp_path, route, spill):
+    """Capture → disk → restore of a wrapped, spilled service is
+    BIT-identical: every shard's ReplayState (tree, rings, stamps),
+    ring accountant, spill pages + priority heap, residency table and
+    the route cursors — and a same-key sample from the restored service
+    returns the identical batch from the identical shard."""
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 14, rng)     # wraps each 4-slot shard
+    svc = ReplayService(spec, 2, spill_blocks=spill, route=route)
+    try:
+        for blk in blocks:
+            svc.add_block(blk)
+        snap = svc.snapshot_state(14, extra={"marker": 7})
+        meta = write_snapshot(snap, str(tmp_path), 0)
+        assert meta["kind"] == "service"
+        assert meta["total_adds"] == svc.total_adds == 14
+        loaded = load_snapshot(str(tmp_path), 0)
+        assert loaded is not None
+        assert loaded["extra"]["marker"] == 7
+
+        svc2 = ReplayService(spec, 2, spill_blocks=spill, route=route)
+        try:
+            svc2.restore_state(loaded)
+            assert svc2.total_adds == svc.total_adds
+            assert svc2.buffer_steps == svc.buffer_steps
+            for got, exp in zip(svc2.shards, svc.shards):
+                assert_trees_equal(got.state, exp.state)
+                _ring_equal(got, exp)
+                _spill_equal(got, exp)
+            # behavioral parity: the restored route cursors + trees draw
+            # the SAME batch from the SAME shard under the same key
+            key = jax.random.PRNGKey(3)
+            batch, shard, adds = svc.sample(key)
+            batch2, shard2, adds2 = svc2.sample(key)
+            assert shard == shard2 and adds == adds2
+            assert_trees_equal(batch, batch2)
+        finally:
+            svc2.close()
+    finally:
+        svc.close()
+
+
+def test_plain_snapshot_roundtrip_bit_parity(rng, tmp_path):
+    """The replay_shards=0 learner's cut: one ReplayState + its
+    RingAccountant mirror survive the disk round-trip bit-exactly,
+    restored onto a freshly-initialized state/ring pair."""
+    spec = make_spec(num_blocks=3)
+    state = replay_init(spec)
+    ring = RingAccountant(spec.num_blocks)
+    for blk in _fill_blocks(spec, 5, rng):   # wraps the 3-slot ring
+        state = replay_add(spec, state, blk)
+        ring.advance(int(np.asarray(blk.learning_steps).sum()))
+    snap = capture_plain(spec, state, ring, step=42,
+                         extra={"env_steps": 99})
+    write_snapshot(snap, str(tmp_path), 1)
+    loaded = load_snapshot(str(tmp_path), 1)
+    assert loaded is not None and loaded["kind"] == "plain"
+    assert loaded["step"] == 42 and loaded["extra"]["env_steps"] == 99
+
+    ring2 = RingAccountant(spec.num_blocks)
+    state2 = restore_plain(spec, replay_init(spec), ring2, loaded)
+    assert_trees_equal(state2, state)
+    assert ring2.ptr == ring.ptr
+    assert ring2.total_adds == ring.total_adds == 5
+    assert ring2.buffer_steps == ring.buffer_steps
+    assert ring2.slot_steps == ring.slot_steps
+    assert ring2.slot_versions == ring.slot_versions
+
+
+def test_snapshot_spec_mismatch_refused(rng, tmp_path):
+    """A snapshot from a different replay geometry is refused loudly —
+    restoring it bitwise into mismatched rings would corrupt sampling."""
+    spec = make_spec(num_blocks=3)
+    state = replay_init(spec)
+    ring = RingAccountant(spec.num_blocks)
+    snap = capture_plain(spec, state, ring, step=0)
+    other = make_spec(num_blocks=3, batch_size=8)
+    with pytest.raises(ValueError, match="spec mismatch"):
+        restore_plain(other, replay_init(other),
+                      RingAccountant(other.num_blocks), snap)
+
+
+def test_manifest_commit_atomic_and_corruption_probe(rng, tmp_path):
+    """The manifest rename is the commit point: a committed snapshot
+    leaves no .tmp litter, read_manifest() is the cheap probe (kind /
+    step / total_adds / payload size), and a payload whose size no
+    longer matches the manifest (torn write, partial copy) makes
+    load_snapshot return None instead of restoring garbage."""
+    spec = make_spec(num_blocks=3)
+    state = replay_init(spec)
+    ring = RingAccountant(spec.num_blocks)
+    for blk in _fill_blocks(spec, 2, rng):
+        state = replay_add(spec, state, blk)
+        ring.advance(int(np.asarray(blk.learning_steps).sum()))
+    write_snapshot(capture_plain(spec, state, ring, 7), str(tmp_path), 0)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    man = read_manifest(str(tmp_path), 0)
+    assert man is not None
+    assert man["kind"] == "plain" and man["step"] == 7
+    assert man["total_adds"] == 2 and man["payload_bytes"] > 0
+    assert read_manifest(str(tmp_path), 3) is None   # absent player
+
+    payload, _manifest = snapshot_paths(str(tmp_path), 0)
+    with open(payload, "rb") as f:
+        data = f.read()
+    with open(payload, "wb") as f:
+        f.write(data[: len(data) // 2])              # torn payload
+    assert load_snapshot(str(tmp_path), 0) is None
+
+
+def test_snapshot_writer_async_latest_wins(rng, tmp_path):
+    """The writer never queues more than one pending cut (latest wins,
+    replaced cuts counted as dropped), every submitted cut is accounted
+    as written-or-dropped after drain, and write_now is synchronous."""
+    spec = make_spec(num_blocks=3)
+    state = replay_init(spec)
+    ring = RingAccountant(spec.num_blocks)
+    w = SnapshotWriter(str(tmp_path), 0)
+    n = 6
+    for step in range(n):
+        w.submit(capture_plain(spec, state, ring, step))
+    assert w.drain(10.0)
+    deadline = time.monotonic() + 10.0
+    while w.count + w.dropped < n and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert w.count + w.dropped == n and w.count >= 1
+    meta = w.write_now(capture_plain(spec, state, ring, 99))
+    assert meta["step"] == 99
+    assert read_manifest(str(tmp_path), 0)["step"] == 99
+    assert w.last_meta["step"] == 99
+    w.stop()
+    w.stop()                                         # idempotent
+
+
+# ---------------------------------------------------------------------------
+# Service bounce: producer reconnect + unacked-tail replay.
+
+
+def test_service_bounce_mid_window_ack_replay_idempotent(rng):
+    """Kill the service with a window frame unacked (every data ack
+    dropped), restore a successor FROM ITS SNAPSHOT on the same port:
+    the producer redials on the ladder, replays the unacked tail in seq
+    order, and every block sent is eventually acked. The replayed frame
+    the dead service already committed lands again as a benign ring
+    overwrite (counted adds, never a crash): restored 2 + replayed 2 +
+    new 2 = 6 committed adds for 4 producer-sent blocks."""
+    spec = make_spec(num_blocks=4)
+    blocks = _fill_blocks(spec, 4, rng)
+    svc1 = ReplayService(spec, 2, ingest_batch_blocks=2)
+    server1 = ReplayServiceServer(svc1, drop_ack_every=1)
+    port = server1.port
+    producer = RemoteReplayProducer(
+        server1.host, port, window=4, connect_retries=60,
+        backoff_base_s=0.05, backoff_max_s=0.25)
+    svc2 = server2 = None
+    try:
+        producer.add_blocks(blocks[:2])          # committed; ack dropped
+        deadline = time.monotonic() + 5.0
+        while svc1.total_adds < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert svc1.total_adds == 2 and producer.inflight == 1
+        snap = svc1.snapshot_state(2)
+        server1.close()                          # SIGKILL stand-in
+        svc1.close()
+
+        svc2 = ReplayService(spec, 2, ingest_batch_blocks=2)
+        svc2.restore_state(snap)
+        server2 = ReplayServiceServer(svc2, "127.0.0.1", port)
+        producer.add_blocks(blocks[2:])
+        acked = producer.flush()
+        assert acked == 4 and producer.inflight == 0
+        assert producer.reconnects >= 1
+        assert producer.blocks_resent >= 2       # the unacked tail
+        assert svc2.total_adds == 6
+        assert server2.blocks_received == 4
+    finally:
+        producer.close()
+        server1.close()
+        if server2 is not None:
+            server2.close()
+        if svc2 is not None:
+            svc2.close()
+
+
+def _dead_port() -> int:
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_eager_connect_raises_at_construction():
+    """A misaddressed producer/policy channel fails where it is BUILT —
+    today a dead replay-service address surfaced only at the first add,
+    a thousand steps into multihost bring-up."""
+    port = _dead_port()
+    with pytest.raises(OSError):
+        RemoteReplayProducer("127.0.0.1", port, dial_timeout=0.5)
+    from r2d2_tpu.serve.transport import SocketChannel
+    with pytest.raises(OSError):
+        SocketChannel("127.0.0.1", port, dial_timeout=0.5,
+                      eager_connect=True)
+    # eager_connect=False keeps the legacy lazy dial (no raise here)
+    SocketChannel("127.0.0.1", port, dial_timeout=0.5)
+
+
+def test_connect_retry_ladder_covers_late_binding_server():
+    """Order-insensitive bring-up: a producer constructed BEFORE its
+    server binds rides the bounded backoff ladder to a live connection
+    instead of dying on the first refusal."""
+    port = _dead_port()
+    accepted = threading.Event()
+
+    def _bind_late():
+        time.sleep(0.3)
+        srv = socket_mod.create_server(("127.0.0.1", port))
+        conn, _ = srv.accept()
+        accepted.set()
+        conn.close()
+        srv.close()
+
+    t = threading.Thread(target=_bind_late, daemon=True)
+    t.start()
+    producer = RemoteReplayProducer(
+        "127.0.0.1", port, dial_timeout=0.5, connect_retries=20,
+        backoff_base_s=0.05, backoff_max_s=0.2)
+    try:
+        assert accepted.wait(5.0)
+    finally:
+        producer.close()
+        t.join(5.0)
+
+
+# ---------------------------------------------------------------------------
+# Learner snapshot cycle + resume determinism.
+
+
+def test_learner_plain_resume_determinism(rng, tmp_path):
+    """checkpoint + replay snapshot → a restored plain-path learner is
+    the uninterrupted twin: bit-identical replay state/ring, the carried
+    train key (which resume_training_state deliberately does NOT
+    checkpoint) round-trips through the snapshot, and the next step's
+    loss matches exactly."""
+    from r2d2_tpu.runtime.learner_loop import Learner
+    cfg = _recovery_cfg(tmp_path)
+    net = _make_net(cfg)
+    lr = Learner(cfg, net, 0)
+    try:
+        for blk in _fill_blocks(lr.spec, 6, rng):
+            lr.ingest(blk)
+        assert lr.ready
+        lr.step()
+        ckpt = lr.save(1)
+        lr.snapshot_replay()
+        assert lr._snap_writer.drain(10.0)
+        deadline = time.monotonic() + 10.0
+        while lr._snap_writer.count < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        man = read_manifest(str(tmp_path), 0)
+        assert man["total_adds"] == lr.ring.total_adds == 6
+        ref_state = jax.device_get(lr.replay_state)
+        twin_loss = np.asarray(jax.device_get(lr.step()["loss"]))
+
+        resumed = Learner(cfg.replace(**{"runtime.resume": ckpt}), net, 0)
+        try:
+            assert resumed._restores == 1
+            assert resumed._restored_blocks == 6
+            assert resumed.ring.total_adds == 6
+            assert resumed.ring.ptr == lr.ring.ptr
+            assert_trees_equal(jax.device_get(resumed.replay_state),
+                               ref_state)
+            rec = resumed.recovery_block()
+            assert rec["restores"] == 1 and rec["restored_blocks"] == 6
+            got = np.asarray(jax.device_get(resumed.step()["loss"]))
+            np.testing.assert_array_equal(twin_loss, got)
+        finally:
+            resumed.stop_background()
+    finally:
+        lr.stop_background()
+
+
+def test_learner_service_resume_determinism(rng, tmp_path):
+    """Same contract on the service path: the snapshot carries every
+    shard + the service sample key, so the restored learner draws the
+    same batch and lands the same next-step loss as the twin."""
+    from r2d2_tpu.runtime.learner_loop import Learner
+    cfg = _svc_cfg(tmp_path, **{"runtime.snapshot_interval": 100_000})
+    net = _make_net(cfg)
+    lr = Learner(cfg, net, 0)
+    try:
+        from r2d2_tpu.replay.structs import ReplaySpec
+        for blk in _fill_blocks(ReplaySpec.from_config(cfg), 4, rng):
+            lr.ingest(blk)
+        assert lr.ready
+        lr.step()
+        ckpt = lr.save(1)
+        lr.snapshot_replay()
+        assert lr._snap_writer.drain(10.0)
+        deadline = time.monotonic() + 10.0
+        while lr._snap_writer.count < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        twin_loss = np.asarray(jax.device_get(lr.step()["loss"]))
+
+        resumed = Learner(cfg.replace(**{"runtime.resume": ckpt}), net, 0)
+        try:
+            assert resumed._restores == 1
+            assert resumed.service.total_adds == 4
+            got = np.asarray(jax.device_get(resumed.step()["loss"]))
+            np.testing.assert_array_equal(twin_loss, got)
+        finally:
+            resumed.stop_background()
+    finally:
+        lr.stop_background()
+
+
+def test_learner_no_snapshot_resume_is_checkpoint_only(rng, tmp_path):
+    """Resume with no snapshot on disk stays the pre-PR18 behavior: a
+    silent params/opt-state-only restore, empty replay, no restores
+    counted — an old checkpoint dir must keep working."""
+    from r2d2_tpu.runtime.learner_loop import Learner
+    cfg = _recovery_cfg(tmp_path)
+    net = _make_net(cfg)
+    lr = Learner(cfg, net, 0)
+    try:
+        ckpt = lr.save(1)
+    finally:
+        lr.stop_background()
+    resumed = Learner(cfg.replace(**{"runtime.resume": ckpt}), net, 0)
+    try:
+        assert resumed._restores == 0
+        assert resumed.ring.total_adds == 0
+    finally:
+        resumed.stop_background()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor policies (fake child process — no spawn cost).
+
+
+class _FakeProc:
+    def __init__(self, exitcodes, calls, target=None, args=(), name=""):
+        self.exitcode = exitcodes.pop(0) if exitcodes else 0
+        self.pid = 4242
+        calls.append(args)
+
+    def start(self):
+        pass
+
+    def is_alive(self):
+        return False
+
+    def join(self, timeout=None):
+        pass
+
+    def terminate(self):
+        pass
+
+    def kill(self):
+        pass
+
+
+class _FakeCtx:
+    def __init__(self, exitcodes, calls):
+        self._exitcodes, self._calls = exitcodes, calls
+
+    def Process(self, target=None, args=(), name=""):
+        return _FakeProc(self._exitcodes, self._calls,
+                         target=target, args=args, name=name)
+
+
+def _sup_cfg(tmp_path, **extra):
+    base = {
+        "env.game_name": "Fake",
+        "runtime.save_dir": str(tmp_path),
+        "runtime.restart_backoff_base_s": 0.01,
+        "runtime.restart_backoff_max_s": 0.02,
+        "runtime.max_restarts_per_window": 2,
+        "runtime.restart_window_s": 600.0,
+    }
+    base.update(extra)
+    return Config().replace(**base)
+
+
+def _patch_ctx(monkeypatch, exitcodes):
+    import multiprocessing
+    calls = []
+    ctx = _FakeCtx(list(exitcodes), calls)
+    monkeypatch.setattr(multiprocessing, "get_context",
+                        lambda method=None: ctx)
+    return calls
+
+
+def test_supervisor_clean_exit_no_relaunch(tmp_path, monkeypatch):
+    """Exit code 0 = the run completed; the supervisor must NOT relaunch
+    (a clean stop is not a crash)."""
+    from r2d2_tpu.runtime.supervisor import supervise_train
+    calls = _patch_ctx(monkeypatch, [0])
+    assert supervise_train(_sup_cfg(tmp_path)) == 0
+    assert len(calls) == 1
+    assert calls[0][0]["runtime"]["resume"] == ""
+
+
+def test_supervisor_resume_chain(tmp_path, monkeypatch):
+    """A crashed child is relaunched FROM THE NEWEST CHECKPOINT: the
+    second incarnation's config carries runtime.resume pointed at it
+    (and pretrain cleared), and the restart ordinal is threaded
+    through."""
+    from r2d2_tpu.runtime.supervisor import supervise_train
+    os.makedirs(tmp_path / "Fake7_player0")
+    calls = _patch_ctx(monkeypatch, [1, 0])
+    assert supervise_train(_sup_cfg(tmp_path)) == 1
+    assert len(calls) == 2
+    assert calls[0][0]["runtime"]["resume"] == ""
+    assert calls[1][0]["runtime"]["resume"].endswith("Fake7_player0")
+    assert calls[1][0]["runtime"]["pretrain"] == ""
+    assert calls[1][4] == 1                       # restart ordinal
+
+
+def test_supervisor_crash_loop_breaker(tmp_path, monkeypatch):
+    """max_restarts_per_window failures inside the window park the slot:
+    the supervisor raises ONE loud error instead of relaunching a doomed
+    run forever (the actor fleet's WorkerHealth policy, reused)."""
+    from r2d2_tpu.runtime.supervisor import supervise_train
+    calls = _patch_ctx(monkeypatch, [1, 1, 1, 1, 1])
+    with pytest.raises(RuntimeError, match="crash-loop breaker"):
+        supervise_train(_sup_cfg(tmp_path))
+    assert len(calls) == 3                        # 2 relaunches, then trip
+
+
+def test_supervisor_refuses_multihost(tmp_path, monkeypatch):
+    from r2d2_tpu.runtime.supervisor import supervise_train
+    _patch_ctx(monkeypatch, [0])
+    cfg = _sup_cfg(tmp_path, **{"mesh.multihost": True,
+                                "mesh.num_processes": 2})
+    with pytest.raises(NotImplementedError, match="auto_resume"):
+        supervise_train(cfg)
+
+
+# ---------------------------------------------------------------------------
+# Retention GC.
+
+
+def test_prune_checkpoints_retention(tmp_path):
+    """keep=K deletes all but the newest K checkpoint dirs + their
+    .config.json sidecars; keep<=0 keeps everything; the rolling replay
+    snapshot pair is never touched."""
+    from r2d2_tpu.runtime.checkpoint import (latest_checkpoint,
+                                             prune_checkpoints)
+    for i in (1, 2, 3, 10):
+        d = tmp_path / f"Fake{i}_player0"
+        os.makedirs(d)
+        with open(str(d) + ".config.json", "w") as f:
+            f.write("{}")
+    os.makedirs(tmp_path / "Fake9_player1")       # other player: untouched
+    for name in ("replay_player0.npz", "replay_player0.json"):
+        with open(tmp_path / name, "w") as f:
+            f.write("x")
+
+    assert prune_checkpoints(str(tmp_path), "Fake", 0, 0) == []
+    pruned = prune_checkpoints(str(tmp_path), "Fake", 0, 2)
+    assert [os.path.basename(p) for p in pruned] == [
+        "Fake1_player0", "Fake2_player0"]
+    left = sorted(p for p in os.listdir(tmp_path) if "player0" in p
+                  and not p.endswith((".npz", ".json")))
+    assert left == ["Fake10_player0", "Fake3_player0"]
+    assert not os.path.exists(tmp_path / "Fake1_player0.config.json")
+    assert os.path.exists(tmp_path / "Fake10_player0.config.json")
+    assert os.path.exists(tmp_path / "Fake9_player1")
+    assert os.path.exists(tmp_path / "replay_player0.npz")
+    assert latest_checkpoint(str(tmp_path), "Fake", 0).endswith(
+        "Fake10_player0")
+
+
+# ---------------------------------------------------------------------------
+# Kill-switch contract: plane off = byte-identical records, inert rules.
+
+
+def test_record_schema_stable_with_plane_off(rng, tmp_path):
+    """runtime.snapshot_interval=0: no SnapshotWriter, no snapshot files,
+    recovery_block() is None and the periodic record carries NO
+    'recovery' key — the schema is byte-identical to pre-PR18 runs."""
+    from r2d2_tpu.runtime.learner_loop import Learner
+    cfg = _recovery_cfg(tmp_path, **{"runtime.snapshot_interval": 0})
+    net = _make_net(cfg)
+    lr = Learner(cfg, net, 0)
+    try:
+        assert lr._snap_writer is None
+        assert lr.recovery_block() is None
+        for blk in _fill_blocks(lr.spec, 6, rng):
+            lr.ingest(blk)
+        lr.step()
+        lr.metrics.set_recovery(lr.recovery_block)
+        rec = lr.metrics.log(1.0)
+        assert "recovery" not in rec
+        assert json.dumps(rec)                    # still serializable
+        assert read_manifest(str(tmp_path), 0) is None
+    finally:
+        lr.stop_background()
+
+
+def test_recovery_alert_rules_inert_without_block():
+    """snapshot_stale / recovery_loop evaluate to 'no data' on records
+    without the recovery block (plane off) and fire on real breaches."""
+    from r2d2_tpu.telemetry.alerts import AlertEngine, default_rules
+    tcfg = Config().telemetry
+    eng = AlertEngine(default_rules(tcfg))
+    out = eng.evaluate({"training_steps": 5})
+    assert "snapshot_stale" not in eng.active
+    assert "recovery_loop" not in eng.active
+    assert not any(a["rule"] in ("snapshot_stale", "recovery_loop")
+                   for a in out["fired"])
+    out = eng.evaluate({
+        "training_steps": 6,
+        "recovery": {"snapshot": {"age_s": tcfg.alerts_snapshot_stale_s + 1},
+                     "supervisor": {"restarts": 3}},
+    })
+    fired = {a["rule"] for a in out["fired"]}
+    assert {"snapshot_stale", "recovery_loop"} <= fired
+
+
+def test_snapshot_interval_rejects_host_placement(tmp_path):
+    with pytest.raises(ValueError, match="snapshot_interval"):
+        Config().replace(**{"replay.placement": "host",
+                            "runtime.snapshot_interval": 10})
+
+
+# ---------------------------------------------------------------------------
+# Kill drills (slow tier): SIGKILL mid-run, assert auto-recovery.
+
+
+@pytest.mark.slow
+def test_kill_learner_drill_end_to_end():
+    """SIGKILL the supervised learner child mid-run: the supervisor
+    relaunches from the newest checkpoint + replay snapshot, training
+    resumes past the kill point, loss is bounded by the snapshot
+    interval, and the actor fleet neither breaker-trips nor parks."""
+    from r2d2_tpu.tools.chaos import run_kill_learner_drill
+    report = run_kill_learner_drill(seconds=240.0)
+    assert all(report["verdict"].values()), report
+
+
+@pytest.mark.slow
+def test_kill_replay_service_drill_end_to_end():
+    """SIGKILL the standalone replay service mid-ingest: the producer
+    reconnects and replays its unacked tail into the restarted service,
+    which restores from its last snapshot — every sent block acked,
+    committed-block loss bounded by the snapshot interval + window."""
+    from r2d2_tpu.tools.chaos import run_kill_replay_service_drill
+    report = run_kill_replay_service_drill(seconds=180.0)
+    assert all(report["verdict"].values()), report
