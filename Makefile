@@ -124,6 +124,18 @@
 #                stability + inert alert rules); the slow SIGKILL drills
 #                (tools/chaos.py --kill-learner / --kill-replay-service
 #                end-to-end) run with the full tier.
+#   make tracing — the fast-tier cross-plane tracing suite
+#                (tests/test_tracing.py: hop-stamp propagation through
+#                the in-proc/shm/socket serve rungs, the experience
+#                lineage stamp through ring wrap + spill
+#                demote/promote + snapshot restore, the trace record
+#                block, kill-switch byte-identity of records and wire
+#                frames, record-schema stability).
+#   make tower — the fast-tier control-tower slice of the same file
+#                (tests/test_tracing.py -m tower: the TowerCollector
+#                join over synthesized plane streams, the derived
+#                cross-plane signals, the four tower rules, clock-
+#                anchor alignment, the offline-replay CLI).
 #   make regress — the regression gate: tools/regress.py compares the
 #                tree's E2E_*/BENCH_* artifacts against BASELINE.json's
 #                'bench' snapshot (per-metric noise tolerances) AND the
@@ -140,7 +152,7 @@
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
 	replaydiag fleet serve quant elastic service-ingest costmodel \
-	recovery regress costs roofline check-fast-markers
+	recovery tracing tower regress costs roofline check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -201,6 +213,14 @@ recovery: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_recovery.py -q \
 	    -m 'not slow' -p no:cacheprovider
 
+tracing: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
+tower: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q \
+	    -m 'tower and not slow' -p no:cacheprovider
+
 regress:
 	JAX_PLATFORMS=cpu python -m r2d2_tpu.tools.regress \
 	    --baseline BASELINE.json --dir .
@@ -232,7 +252,9 @@ FAST_MARKER_CHECKS := \
 	tests/test_elastic.py:not_slow:20:elastic \
 	tests/test_service_ingest.py:not_slow:20:service-ingest \
 	tests/test_costmodel.py:not_slow:10:cost-model \
-	tests/test_recovery.py:not_slow:18:recovery
+	tests/test_recovery.py:not_slow:18:recovery \
+	tests/test_tracing.py:not_slow:16:tracing \
+	tests/test_tracing.py:tower_and_not_slow:5:tower
 
 check-fast-markers:
 	@for spec in $(FAST_MARKER_CHECKS); do \

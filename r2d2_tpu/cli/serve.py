@@ -91,6 +91,12 @@ def main(argv=None) -> int:
                                  cfg.telemetry.quant_probe_interval)
 
     stats = ServingStats()
+    tracing = cfg.telemetry.enabled and cfg.telemetry.tracing_enabled
+    if tracing:
+        # distributed tracing (ISSUE 19): traced requests' per-hop
+        # stamps fold into the serving block's trace sub-block
+        from r2d2_tpu.telemetry.tracing import ServeTrace
+        stats.trace = ServeTrace()
     telemetry = Telemetry.from_config(cfg, name="serve")
     fleet = None
     endpoint = None
@@ -130,7 +136,8 @@ def main(argv=None) -> int:
             shm_t = ShmServeTransport(
                 endpoint.submit, (cfg.env.frame_height, cfg.env.frame_width),
                 action_dim, cfg.network.hidden_dim,
-                request_slots=cfg.serve.request_ring_slots)
+                request_slots=cfg.serve.request_ring_slots,
+                tracing=tracing)
             transports.append(shm_t)
             print(f"shm request ring: {shm_t.request_ring.name}", flush=True)
 
@@ -140,6 +147,14 @@ def main(argv=None) -> int:
     engine = AlertEngine(
         default_rules(cfg.telemetry),
         jsonl_path=os.path.join(args.save_dir or ".", "serve_alerts.jsonl"))
+    # process identity + clock anchor (ISSUE 19 satellite): stamped ONCE
+    # at announcement (the listener going live IS this plane's lease
+    # moment) and carried on every periodic row, so the tower join and
+    # the Perfetto merge align this stream without a shared mono clock
+    from r2d2_tpu.telemetry.tracing import proc_header
+    proc = proc_header("serve")
+    telemetry.start_drain(
+        os.path.join(args.save_dir or ".", "spans_serve.jsonl"))
 
     server = None
     if fleet is None:
@@ -187,7 +202,7 @@ def main(argv=None) -> int:
                 last_log = now
                 block = _serving_block()
                 record = {"t": round(now - t0, 1),
-                          "batches": _batches()}
+                          "batches": _batches(), "proc": proc}
                 if block is not None:   # the TrainMetrics omission contract
                     record["serving"] = block
                 if quant_stats is not None:
@@ -207,7 +222,7 @@ def main(argv=None) -> int:
         # final record so short runs still leave evidence
         block = _serving_block()
         record = {"t": round(time.time() - t0, 1),
-                  "batches": final_batches, "final": True}
+                  "batches": final_batches, "final": True, "proc": proc}
         if block is not None:
             record["serving"] = block
         if quant_stats is not None:

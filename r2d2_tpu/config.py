@@ -822,6 +822,47 @@ class TelemetryConfig:
     # fires — the learner is crash-looping through auto-resume instead
     # of making progress (the breaker parks it one rung later).
     alerts_recovery_loop: float = 2.0
+    # -- cross-plane distributed tracing (ISSUE 19; telemetry/tracing.py) --
+    # Kill switch for causal trace propagation on BOTH data paths:
+    # serving requests carry a trace dict (per-hop wall stamps client ->
+    # router -> server micro-batch -> reply; two gated fields on the shm
+    # request layout) and every Nth experience block carries the
+    # Block.trace_ms lineage stamp from emission through ingest / spill /
+    # sample to train consumption — the record's 'trace' block with the
+    # end-to-end env-step->gradient latency histogram. Default OFF: the
+    # stamp is a trailing pytree leaf and two wire fields, and the
+    # kill-switch contract (records, wire frames, and block schemas
+    # byte-identical when off) means an opt-in plane, like
+    # snapshot_interval and spill_prefetch before it.
+    tracing_enabled: bool = False
+    # Every Nth emitted block gets a lineage stamp / every Nth serve
+    # exchange gets a trace dict (1 = trace everything; the benched <= 2%
+    # overhead budget holds at the default).
+    trace_sample_every: int = 16
+    # Control-tower collector sub-switch (telemetry/tower.py +
+    # tools/tower.py): gates the process-identity header + clock anchor
+    # on the serve-fleet / ReplayService periodic rows the tower join
+    # and the cross-process Perfetto merge align on. Pull-based (the
+    # tower tails files) — on by default; rows gain only the '_proc'
+    # header key.
+    tower_enabled: bool = True
+    # -- per-tier replay telemetry (ISSUE 19 satellite; ROADMAP 4d) --
+    # Adds promotion-latency + bytes-per-tier sub-blocks to the record's
+    # replay_service.spill block. Off => the block is byte-identical to
+    # the PR-18 schema.
+    replay_tiers_enabled: bool = False
+    # Spill promotion latency p95 (replay_service.spill.
+    # promotion_latency.p95_ms — time-in-tier of pages promoted this
+    # interval) at/above which spill_promotion_latency fires: demoted
+    # experience is sitting so long in the host tier that it returns
+    # stale (grow promote_per_sample / spill_prefetch, or shrink the
+    # tier).
+    alerts_spill_promotion_ms: float = 60_000.0
+    # Tower alert rule: e2e_experience_latency p50 (the record trace
+    # block's env-step->gradient latency) above this multiple of its own
+    # rolling median fires e2e_latency_growth — experience is aging
+    # somewhere between emission and the gradient.
+    alerts_e2e_latency_growth: float = 4.0
 
 
 @dataclass(frozen=True)
@@ -1462,6 +1503,20 @@ class Config:
                 f"telemetry.alerts_recovery_loop "
                 f"({self.telemetry.alerts_recovery_loop}) must be >= 1 "
                 "(supervisor relaunches before the alert fires)")
+        if self.telemetry.trace_sample_every < 1:
+            raise ValueError(
+                f"telemetry.trace_sample_every "
+                f"({self.telemetry.trace_sample_every}) must be >= 1 "
+                "(1 = trace every block/exchange)")
+        if self.telemetry.alerts_spill_promotion_ms <= 0:
+            raise ValueError(
+                f"telemetry.alerts_spill_promotion_ms "
+                f"({self.telemetry.alerts_spill_promotion_ms}) must be > 0")
+        if self.telemetry.alerts_e2e_latency_growth <= 1:
+            raise ValueError(
+                f"telemetry.alerts_e2e_latency_growth "
+                f"({self.telemetry.alerts_e2e_latency_growth}) must be > 1 "
+                "(a multiple of the p50's rolling median)")
         if self.telemetry.ring_size < 16:
             raise ValueError(
                 f"telemetry.ring_size ({self.telemetry.ring_size}) must be "

@@ -126,6 +126,15 @@ class SpillTier:
         self.evictions = 0
         self.writebacks = 0
         self._interval = [0, 0, 0]   # demotions, promotions, evictions
+        # per-tier telemetry (ISSUE 19 satellite, ROADMAP 4d): demotion
+        # timestamp per resident page (monotonic) so a promotion's
+        # time-in-tier lands in the shared 64-bucket latency histogram;
+        # page_bytes is measured from the first demoted page (fixed
+        # record shapes — every page weighs the same)
+        self._demoted_at: Dict[int, float] = {}
+        from r2d2_tpu.telemetry.histogram import NBUCKETS
+        self._promo_lat = np.zeros(NBUCKETS, np.int64)
+        self.page_bytes = 0
 
     @property
     def occupancy(self) -> int:
@@ -147,9 +156,14 @@ class SpillTier:
         heapq.heappush(self._heap, (-prio, pid))
         self.demotions += 1
         self._interval[0] += 1
+        self._demoted_at[pid] = time.monotonic()
+        if not self.page_bytes:
+            self.page_bytes = sum(
+                np.asarray(v).nbytes for v in _block_fields(block).values())
         if len(self._pages) > self.capacity:
             old_id, _ = self._pages.popitem(last=False)
             self._prio.pop(old_id, None)
+            self._demoted_at.pop(old_id, None)
             self.evictions += 1
             self._interval[2] += 1
         return pid
@@ -161,6 +175,7 @@ class SpillTier:
             return None
         pid, page = self._pages.popitem(last=False)
         self._prio.pop(pid, None)
+        self._note_promo(pid)
         self.promotions += 1
         self._interval[1] += 1
         return page
@@ -176,10 +191,25 @@ class SpillTier:
                 continue            # evicted, promoted, or re-prioritized
             page = self._pages.pop(pid)
             self._prio.pop(pid, None)
+            self._note_promo(pid)
             self.promotions += 1
             self._interval[1] += 1
             return page
         return None
+
+    def _note_promo(self, pid: int) -> None:
+        t = self._demoted_at.pop(pid, None)
+        if t is not None:
+            from r2d2_tpu.telemetry.histogram import bucket_index
+            self._promo_lat[bucket_index(time.monotonic() - t)] += 1
+
+    def take_promotion_latency(self) -> Optional[dict]:
+        """Interval time-in-tier summary for promoted pages (reset on
+        read); None when nothing was promoted this interval."""
+        from r2d2_tpu.telemetry.histogram import summarize
+        s = summarize(self._promo_lat)
+        self._promo_lat[:] = 0
+        return s
 
     def write_back(self, page_id: int, seq: int, abs_td: float) -> bool:
         """Write one sequence's new |TD| priority into a spilled page
@@ -252,14 +282,27 @@ class ReplayShard:
         from r2d2_tpu.replay.device_replay import replay_add
         learning = int(np.asarray(block.learning_steps).sum())
         wv = int(np.asarray(block.weight_version))
+        trace = block.trace_ms
+        if trace is not None:
+            trace = int(np.asarray(trace))
         slot = self.ring.ptr
         if self._retain:
             block = _host_block(block)
             old = self._resident[slot]
             if old is not None and self.ring.slot_steps[slot] > 0:
                 self._demote_ids[slot] = self.spill.demote(*old)
-        self.state = replay_add(self.spec, self.state, block)
-        self.ring.advance(learning, wv)
+        # The device programs (and their AOT add_many avals) never see
+        # the lineage leaf — it lives in the ring accountant's host
+        # mirrors; the _resident page keeps the stamped block so spill
+        # demote/promote and snapshots carry lineage for free.
+        dev = block if trace is None else block.replace(trace_ms=None)
+        self.state = replay_add(self.spec, self.state, dev)
+        if trace is None:
+            self.ring.advance(learning, wv)
+        else:
+            from r2d2_tpu.telemetry.tracing import now_ms
+            self.ring.advance(learning, wv, trace_ms=trace,
+                              ingest_ms=(now_ms() if trace >= 0 else -1))
         if self._retain:
             self._resident[slot] = (block, learning, wv)
         return slot
@@ -296,10 +339,18 @@ class ReplayShard:
             t0 = time.perf_counter()
             if self._retain:
                 chunk = [_host_block(b) for b in chunk]
-            metas = [(int(np.asarray(b.learning_steps).sum()),
-                      int(np.asarray(b.weight_version))) for b in chunk]
+            metas = []
+            dev_chunk = []
+            for b in chunk:
+                t = b.trace_ms
+                t = int(np.asarray(t)) if t is not None else None
+                metas.append((int(np.asarray(b.learning_steps).sum()),
+                              int(np.asarray(b.weight_version)), t))
+                # strip the lineage leaf before stacking: the AOT
+                # add_many avals are built traceless (see add())
+                dev_chunk.append(b if t is None else b.replace(trace_ms=None))
             stacked = jax.tree_util.tree_map(
-                lambda *xs: np.stack([np.asarray(x) for x in xs]), *chunk)
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *dev_chunk)
             t1 = time.perf_counter()
             slots = [(self.ring.ptr + j) % n for j in range(k)]
             if self._retain:
@@ -308,10 +359,15 @@ class ReplayShard:
                     if old is not None and self.ring.slot_steps[slot] > 0:
                         self._demote_ids[slot] = self.spill.demote(*old)
             self.state = get_exe(k)(self.state, stacked)
-            for learning, wv in metas:
-                self.ring.advance(learning, wv)
+            for learning, wv, t in metas:
+                if t is None:
+                    self.ring.advance(learning, wv)
+                else:
+                    from r2d2_tpu.telemetry.tracing import now_ms
+                    self.ring.advance(learning, wv, trace_ms=t,
+                                      ingest_ms=(now_ms() if t >= 0 else -1))
             if self._retain:
-                for slot, blk, (learning, wv) in zip(slots, chunk, metas):
+                for slot, blk, (learning, wv, _t) in zip(slots, chunk, metas):
                     self._resident[slot] = (blk, learning, wv)
             t2 = time.perf_counter()
             stage_s += t1 - t0
@@ -368,7 +424,8 @@ class ReplayService:
                  spill_blocks: int = 0, route: str = "round_robin",
                  promote_per_sample: int = 1,
                  ingest_batch_blocks: int = 1,
-                 spill_prefetch: bool = False):
+                 spill_prefetch: bool = False,
+                 tier_stats: bool = False):
         if num_shards < 1:
             raise ValueError(f"num_shards ({num_shards}) must be >= 1")
         if route not in _ROUTES:
@@ -378,6 +435,9 @@ class ReplayService:
         self.route = route
         self.promote_per_sample = promote_per_sample
         self.spill_prefetch = bool(spill_prefetch)
+        # per-tier telemetry (ISSUE 19 satellite, ROADMAP 4d): gated so
+        # legacy `replay_service` record blocks stay byte-identical
+        self.tier_stats = bool(tier_stats)
         self.ingest_k = max(int(ingest_batch_blocks), 1)
         self.shards = [ReplayShard(spec, s, spill_blocks=spill_blocks)
                        for s in range(num_shards)]
@@ -552,6 +612,24 @@ class ReplayService:
                         shard.ring.total_adds)
         raise RuntimeError("ReplayService.sample on an empty service — "
                            "gate on all_shards_nonempty first")
+
+    def trace_lookup(self, shard: int, idxes) -> List[Tuple[int, int]]:
+        """Lineage stamps for one sampled batch (ISSUE 19): the (emit_ms,
+        ingest_ms) pair of every traced row's ring slot. Rows whose slot
+        was never stamped (untraced run, stamp overwritten, promoted
+        page) are simply absent — the trace is a sampled signal, not an
+        accounting invariant."""
+        sh = self.shards[shard]
+        spb = self.spec.seqs_per_block
+        out: List[Tuple[int, int]] = []
+        with self._lock:
+            ring = sh.ring
+            for idx in np.asarray(idxes).reshape(-1):
+                slot = int(idx) // spb
+                if 0 <= slot < ring.num_blocks and ring.slot_trace[slot] >= 0:
+                    out.append((int(ring.slot_trace[slot]),
+                                int(ring.slot_ingest_ms[slot])))
+        return out
 
     def _update_one(self, sh: ReplayShard, idxes, td_errors,
                     adds_snapshot: Optional[int]) -> None:
@@ -775,6 +853,28 @@ class ReplayService:
             self._prefetch_iv = 0
             spill["spilled_writebacks"] = self.spilled_writebacks
             spill["stale_rows_dropped"] = self.stale_rows_dropped
+        if self.tier_stats:
+            # ROADMAP 4(d): promotion latency (interval time-in-tier of
+            # promoted pages) + bytes resident per tier
+            lats = [s.spill.take_promotion_latency() for s in self.shards]
+            lats = [l for l in lats if l is not None]
+            merged = None
+            if lats:
+                merged = {
+                    "count": sum(l["count"] for l in lats),
+                    "p50_ms": round(float(np.median(
+                        [l["p50_ms"] for l in lats])), 3),
+                    "p95_ms": round(max(l["p95_ms"] for l in lats), 3),
+                    "p99_ms": round(max(l["p99_ms"] for l in lats), 3),
+                }
+            spill["promotion_latency"] = merged
+            page_b = next((s.spill.page_bytes for s in self.shards
+                           if s.spill.page_bytes), 0)
+            spill["tiers"] = {
+                "device_bytes": self.device_bytes,
+                "spill_bytes": occ * page_b,
+                "spill_page_bytes": page_b,
+            }
         out = {
             "shards": {
                 "n": self.num_shards,
@@ -833,12 +933,17 @@ class ReplayServiceServer:
     semantics."""
 
     def __init__(self, service: ReplayService, host: str = "127.0.0.1",
-                 port: int = 0, drop_ack_every: int = 0):
+                 port: int = 0, drop_ack_every: int = 0, telemetry=None):
         import socket
 
         from r2d2_tpu.serve.transport import recv_frame, send_frame
+        from r2d2_tpu.telemetry.core import NULL_TELEMETRY
         self._recv_frame, self._send_frame = recv_frame, send_frame
         self.service = service
+        # ISSUE 19: a standalone service host passes its process-local
+        # Telemetry so ingest commits land as spans on the service
+        # process's track in the cross-process Perfetto merge
+        self.telemetry = telemetry or NULL_TELEMETRY
         self.drop_ack_every = int(drop_ack_every)
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.25)
@@ -909,7 +1014,12 @@ class ReplayServiceServer:
                     blocks = [Block(**{name: np.asarray(v[i])
                                        for name, v in fields.items()})
                               for i in range(k)]
+                    t0 = time.time() if self.telemetry.spans.enabled \
+                        else 0.0
                     self.service.add_blocks(blocks)
+                    if t0:
+                        self.telemetry.record_span(
+                            "ingest/commit", t0, time.time(), {"k": k})
                     self._note_frame(k, inflight)
                     if not self._drop_this_ack():
                         self._send_frame(conn, ("ackw", seq, k), lock)

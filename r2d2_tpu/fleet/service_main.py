@@ -60,6 +60,13 @@ class ReplayServiceHost:
                 "ReplayServiceHost requires fleet.replay_shards >= 1")
         self.cfg = cfg
         self.player_idx = player_idx
+        # process identity + clock anchor (ISSUE 19): stamped at
+        # construction, refined at lease announcement (the board echoes
+        # its wall clock, giving a skew estimate good to ±RTT/2)
+        from r2d2_tpu.telemetry.core import Telemetry
+        from r2d2_tpu.telemetry.tracing import proc_header
+        self.proc = proc_header("replay_service")
+        self.telemetry = Telemetry.from_config(cfg, name="replay_service")
         spec = ReplaySpec.from_config(cfg)
         shard_spec = dataclasses.replace(
             spec, num_blocks=spec.num_blocks // cfg.fleet.replay_shards,
@@ -70,7 +77,9 @@ class ReplayServiceHost:
             route=cfg.fleet.replay_route,
             promote_per_sample=cfg.fleet.spill_promote_per_sample,
             ingest_batch_blocks=cfg.fleet.ingest_batch_blocks,
-            spill_prefetch=cfg.fleet.spill_prefetch)
+            spill_prefetch=cfg.fleet.spill_prefetch,
+            tier_stats=(cfg.telemetry.enabled
+                        and cfg.telemetry.replay_tiers_enabled))
         self.restored_blocks = 0
         self._snap_writer = None
         self._snap_adds = 0
@@ -91,7 +100,8 @@ class ReplayServiceHost:
         self.server = ReplayServiceServer(
             self.service,
             cfg.fleet.service_host if host is None else host,
-            cfg.fleet.service_port if port is None else port)
+            cfg.fleet.service_port if port is None else port,
+            telemetry=self.telemetry)
         self.announced = self._announce()
 
     def _announce(self) -> bool:
@@ -104,11 +114,22 @@ class ReplayServiceHost:
             return False
         try:
             from r2d2_tpu.fleet.membership import lease_call
-            lease_call(cfg.fleet.lease_host, cfg.fleet.lease_port,
-                       "announce_replay", timeout_s=2.0,
-                       host=self.server.host, port=self.server.port,
-                       shards=cfg.fleet.replay_shards,
-                       step=self.service.total_adds)
+            anchor_wall = time.time()
+            reply = lease_call(
+                cfg.fleet.lease_host, cfg.fleet.lease_port,
+                "announce_replay", timeout_s=2.0,
+                host=self.server.host, port=self.server.port,
+                shards=cfg.fleet.replay_shards,
+                step=self.service.total_adds,
+                anchor_wall=anchor_wall)
+            # ISSUE 19: re-anchor at the announcement instant and keep
+            # the board's echo as the skew estimate (±RTT/2) — what the
+            # tower join and the Perfetto merge align this plane on
+            from r2d2_tpu.telemetry.tracing import proc_header
+            self.proc = proc_header("replay_service")
+            if reply.get("board_wall") is not None:
+                self.proc["clock_anchor"]["offset_est"] = round(
+                    anchor_wall - float(reply["board_wall"]), 6)
             return True
         except (OSError, RuntimeError) as e:
             log.info("replay service lease announcement skipped (%s)", e)
@@ -123,8 +144,11 @@ class ReplayServiceHost:
         adds = self.service.total_adds
         if adds - self._snap_adds < interval:
             return False
+        t0 = time.time()
         self._snap_writer.submit(
             self.service.snapshot_state(adds))
+        self.telemetry.record_span("recovery/snapshot_capture", t0,
+                                   time.time(), {"adds": adds})
         self._snap_adds = adds
         return True
 
@@ -132,14 +156,46 @@ class ReplayServiceHost:
             stop: Optional[threading.Event] = None,
             poll_s: float = 0.1) -> None:
         """Serve until stopped/deadline: the listener threads do the
-        ingest work; this loop only drives the snapshot cadence."""
+        ingest work; this loop drives the snapshot cadence and the
+        periodic metrics rows (ISSUE 19: one
+        ``service_metrics_p{player}.jsonl`` row per log interval, led by
+        the process-identity header — the tower join's and the offline
+        sentinel's view of this plane)."""
+        import json
         stop = stop or threading.Event()
         deadline = time.time() + max_seconds if max_seconds else None
-        while not stop.is_set():
-            if deadline is not None and time.time() >= deadline:
-                break
-            self.maybe_snapshot()
-            time.sleep(poll_s)
+        save_dir = self.cfg.runtime.save_dir or "."
+        metrics_path = os.path.join(
+            save_dir, f"service_metrics_p{self.player_idx}.jsonl")
+        os.makedirs(save_dir, exist_ok=True)
+        open(metrics_path, "w").close()
+        self.telemetry.start_drain(
+            os.path.join(save_dir, "spans_replay_service.jsonl"))
+        t0 = time.time()
+        last_log = t0
+
+        def write_row(final: bool = False) -> None:
+            row = {"t": round(time.time() - t0, 1), "proc": self.proc,
+                   "replay_service": {
+                       **self.service.interval_block(),
+                       "socket": self.server.interval_stats()}}
+            if final:
+                row["final"] = True
+            with open(metrics_path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+
+        try:
+            while not stop.is_set():
+                now = time.time()
+                if deadline is not None and now >= deadline:
+                    break
+                self.maybe_snapshot()
+                if now - last_log >= self.cfg.runtime.log_interval:
+                    last_log = now
+                    write_row()
+                time.sleep(poll_s)
+        finally:
+            write_row(final=True)   # short runs still leave evidence
 
     def close(self) -> None:
         """Final synchronous snapshot (the process is exiting — nothing
@@ -152,6 +208,7 @@ class ReplayServiceHost:
                 self._snap_writer.stop()
         self.server.close()
         self.service.close()
+        self.telemetry.close()
 
 
 def run_replay_service(cfg, player_idx: int = 0,

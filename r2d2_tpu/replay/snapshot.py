@@ -125,13 +125,22 @@ def _restore_state(template, leaves: dict):
 
 
 def _capture_ring(ring) -> dict:
-    return {
+    cap = {
         "ptr": int(ring.ptr),
         "total_adds": int(ring.total_adds),
         "buffer_steps": int(ring.buffer_steps),
         "slot_steps": [int(s) for s in ring.slot_steps],
         "slot_versions": [int(v) for v in ring.slot_versions],
     }
+    # Lineage mirrors (ISSUE 19) ride only when something is traced —
+    # an untraced run's snapshot stays byte-identical to the PR-18
+    # format, and a legacy snapshot restores as all-untraced below.
+    trace = getattr(ring, "slot_trace", None)
+    ingest = getattr(ring, "slot_ingest_ms", None)
+    if trace is not None and any(t >= 0 for t in trace):
+        cap["slot_trace"] = [int(t) for t in trace]
+        cap["slot_ingest"] = [int(t) for t in ingest]
+    return cap
 
 
 def _capture_shard(shard) -> dict:
@@ -209,6 +218,9 @@ def _restore_ring(ring, cap: dict) -> None:
     ring.buffer_steps = int(cap["buffer_steps"])
     ring.slot_steps = [int(s) for s in cap["slot_steps"]]
     ring.slot_versions = [int(v) for v in cap["slot_versions"]]
+    n = len(ring.slot_steps)
+    ring.slot_trace = [int(t) for t in cap.get("slot_trace", [-1] * n)]
+    ring.slot_ingest_ms = [int(t) for t in cap.get("slot_ingest", [-1] * n)]
 
 
 def _restore_spill(spill, cap: dict, block_cls) -> None:
@@ -289,6 +301,19 @@ def restore_plain(spec, state, ring, snap: dict):
 # JSON manifest; manifest rename is the commit point.
 
 
+def _common_fields(pages) -> list:
+    """Block fields present on EVERY page, in first-page order. Pages
+    can disagree on optional trailing leaves (a legacy-restored page has
+    no trace_ms while post-restore pages do) — only the common set
+    stacks; a dropped optional leaf restores as None/untraced."""
+    if not pages:
+        return []
+    common = set(pages[0][1])
+    for _, fields, _, _ in pages[1:]:
+        common &= set(fields)
+    return [f for f in pages[0][1] if f in common]
+
+
 def _flatten_payload(snap: dict) -> dict:
     """Everything array-shaped goes into the npz; scalars/structure stay
     in the manifest."""
@@ -301,6 +326,11 @@ def _flatten_payload(snap: dict) -> dict:
             shard["ring"]["slot_steps"], np.int64)
         arrays[p + "ring.slot_versions"] = np.asarray(
             shard["ring"]["slot_versions"], np.int64)
+        if "slot_trace" in shard["ring"]:
+            arrays[p + "ring.slot_trace"] = np.asarray(
+                shard["ring"]["slot_trace"], np.int64)
+            arrays[p + "ring.slot_ingest"] = np.asarray(
+                shard["ring"]["slot_ingest"], np.int64)
         if "spill" in shard:
             pages = shard["spill"]["pages"]
             arrays[p + "spill.ids"] = np.asarray(
@@ -309,7 +339,7 @@ def _flatten_payload(snap: dict) -> dict:
                 [lg for _, _, lg, _ in pages], np.int64)
             arrays[p + "spill.wv"] = np.asarray(
                 [wv for _, _, _, wv in pages], np.int64)
-            for field in (pages[0][1] if pages else {}):
+            for field in _common_fields(pages):
                 arrays[p + "spill.f." + field] = np.stack(
                     [fields[field] for _, fields, _, _ in pages])
             res = shard["resident"]
@@ -319,7 +349,7 @@ def _flatten_payload(snap: dict) -> dict:
                 [lg for _, _, lg, _ in res], np.int64)
             arrays[p + "res.wv"] = np.asarray(
                 [wv for _, _, _, wv in res], np.int64)
-            for field in (res[0][1] if res else {}):
+            for field in _common_fields(res):
                 arrays[p + "res.f." + field] = np.stack(
                     [fields[field] for _, fields, _, _ in res])
             arrays[p + "demote_ids"] = np.asarray(
@@ -435,6 +465,11 @@ def load_snapshot(save_dir: str, player_idx: int) -> Optional[dict]:
                         data[p + "ring.slot_versions"].tolist(),
                 },
             }
+            if p + "ring.slot_trace" in data.files:
+                shard["ring"]["slot_trace"] = \
+                    data[p + "ring.slot_trace"].tolist()
+                shard["ring"]["slot_ingest"] = \
+                    data[p + "ring.slot_ingest"].tolist()
             if "spill" in entry:
                 shard["spill"] = {
                     **{k: entry["spill"][k]

@@ -210,6 +210,16 @@ class Block(struct.PyTreeNode):
     # records without the field load as lane -1 = unknown.
     lane: jnp.ndarray = struct.field(
         default_factory=lambda: np.full((), -1, np.int32))  # () int32
+    # Lineage trace stamp (ISSUE 19): wall-clock emission time in ms mod
+    # 2^31 on the SAMPLED fraction of blocks a tracing run stamps
+    # (telemetry.tracing_enabled + trace_sample_every). None-default —
+    # NOT default_factory — so the leaf is absent from untraced blocks:
+    # addw socket frames (the omit-None _block_fields contract), block
+    # snapshots, and every compiled add program stay byte-identical with
+    # tracing off, and pre-PR19 block records load as "untraced". The
+    # replay service strips the leaf before device commit and carries
+    # the stamp in the ring accountant's host mirrors instead.
+    trace_ms: jnp.ndarray = None  # () int32, -1 = untraced
 
 
 class ReplayState(struct.PyTreeNode):
@@ -309,14 +319,23 @@ class RingAccountant:
         # -1 = empty or unstamped) — the host mirror behind the learner's
         # replay-occupancy age percentiles (ISSUE 5)
         self.slot_versions = [-1] * num_blocks
+        # lineage trace mirrors (ISSUE 19): the landed block's emission
+        # stamp (Block.trace_ms, stripped before device commit) and the
+        # wall-ms it was committed — both -1 for untraced slots, so an
+        # untraced run's accounting is unchanged beyond two idle lists.
+        self.slot_trace = [-1] * num_blocks
+        self.slot_ingest_ms = [-1] * num_blocks
 
-    def advance(self, learning_steps: int, weight_version: int = -1) -> int:
+    def advance(self, learning_steps: int, weight_version: int = -1,
+                trace_ms: int = -1, ingest_ms: int = -1) -> int:
         """Account one block write: returns the slot it lands in and rolls
         the pointer, replacing the overwritten slot's step count."""
         slot = self.ptr
         self.buffer_steps += learning_steps - self.slot_steps[slot]
         self.slot_steps[slot] = learning_steps
         self.slot_versions[slot] = int(weight_version)
+        self.slot_trace[slot] = int(trace_ms)
+        self.slot_ingest_ms[slot] = int(ingest_ms)
         self.ptr = (slot + 1) % self.num_blocks
         self.total_adds += 1
         return slot

@@ -82,7 +82,11 @@ def make_actor_policy(cfg: Config, net, params, actor_idx: int, seed: int,
                   max_retry_s=cfg.serve.max_retry_s,
                   should_stop=should_stop,
                   backoff_base_s=cfg.runtime.restart_backoff_base_s,
-                  backoff_max_s=cfg.runtime.restart_backoff_max_s)
+                  backoff_max_s=cfg.runtime.restart_backoff_max_s,
+                  trace_every=(cfg.telemetry.trace_sample_every
+                               if (cfg.telemetry.enabled
+                                   and cfg.telemetry.tracing_enabled)
+                               else 0))
     # quantized inference (ISSUE 14): local policies run the quantized
     # forward whenever the config knob says so (the knob lives in
     # NetworkConfig, so the policies see it through net); the accuracy
@@ -155,6 +159,23 @@ def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
     the composition's unknown bucket rather than being fabricated into
     the worker's first lane."""
     wrapped = sink
+    if cfg.telemetry.tracing_enabled:
+        # Lineage stamp (ISSUE 19), innermost: EVERY block of a traced
+        # run carries the trace_ms leaf (uniform pytrees — stacked
+        # groups and the producer pump tree_map over mixed blocks), but
+        # only every Nth gets a real emission stamp; the rest stay
+        # UNTRACED(-1). Off => the leaf is never attached and blocks
+        # are byte-identical to the untraced schema.
+        from r2d2_tpu.telemetry.tracing import UNTRACED, now_ms
+        _every = max(int(cfg.telemetry.trace_sample_every), 1)
+        _emit_count = [0]
+
+        def sink_with_trace(block, _wrapped=wrapped):
+            _emit_count[0] += 1
+            stamp = now_ms() if _emit_count[0] % _every == 0 else UNTRACED
+            return _wrapped(block.replace(
+                trace_ms=np.asarray(stamp, np.int32)))
+        wrapped = sink_with_trace
     if lane_base is not None:
         def sink_with_lane(block, _wrapped=wrapped, _base=int(lane_base)):
             rel = int(np.asarray(block.lane))

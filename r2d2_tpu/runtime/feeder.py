@@ -479,11 +479,11 @@ class BlockQueue:
 
     def __init__(self, maxsize: int = 64, use_mp: bool = True,
                  ctx: Optional[mp.context.BaseContext] = None,
-                 shm_spec=None):
+                 shm_spec=None, tracing: bool = False):
         if use_mp and shm_spec is not None:
             try:
                 from r2d2_tpu.runtime.shm_feeder import ShmBlockRing
-                self._q = ShmBlockRing(shm_spec, maxsize)
+                self._q = ShmBlockRing(shm_spec, maxsize, tracing=tracing)
                 return
             except (ImportError, OSError, subprocess.CalledProcessError) as e:
                 import logging

@@ -90,6 +90,7 @@ class Learner:
         # instead of fusing sample+train over one in-mesh ring, which is
         # what lets producers/consumers/storage stop sharing a program.
         self.service = None
+        self._exp_trace = None
         if cfg.fleet.replay_shards >= 1 and not self.host_mode:
             import dataclasses
 
@@ -108,7 +109,16 @@ class Learner:
                 route=cfg.fleet.replay_route,
                 promote_per_sample=cfg.fleet.spill_promote_per_sample,
                 ingest_batch_blocks=cfg.fleet.ingest_batch_blocks,
-                spill_prefetch=cfg.fleet.spill_prefetch)
+                spill_prefetch=cfg.fleet.spill_prefetch,
+                tier_stats=(cfg.telemetry.enabled
+                            and cfg.telemetry.replay_tiers_enabled))
+            # experience lineage (ISSUE 19): sampled-batch stamps looked
+            # up from the service's ring mirrors feed the record's
+            # 'trace' block (env-step->gradient latency)
+            if cfg.telemetry.enabled and cfg.telemetry.tracing_enabled:
+                from r2d2_tpu.telemetry.tracing import ExperienceTrace
+                self._exp_trace = ExperienceTrace(
+                    cfg.telemetry.trace_sample_every)
             # service-mode sample staging (ISSUE 16): the PR-2 stager
             # treatment for the consumer side — a prefetch thread draws
             # the next per-shard batch while the train dispatch runs,
@@ -226,6 +236,9 @@ class Learner:
 
         self.metrics = metrics or TrainMetrics(player_idx, cfg.runtime.save_dir,
                                                resume=bool(cfg.runtime.resume))
+        if self._exp_trace is not None:
+            # experience lineage (ISSUE 19): the record's 'trace' block
+            self.metrics.set_tracing(self._exp_trace.interval_block)
         self.publish: Optional[Callable] = None   # wired by orchestrator
 
         # Ring accounting: ONE RingAccountant per replay (VERDICT r2 weak
@@ -431,6 +444,13 @@ class Learner:
             # advance inside the service
             self.service.add_block(block)
         else:
+            # strip the lineage leaf before the jitted add (the in-mesh
+            # programs are compiled traceless — the service path's AOT
+            # discipline); the stamp lands in the accountant mirror
+            trace = block.trace_ms
+            if trace is not None:
+                trace = int(np.asarray(trace))
+                block = block.replace(trace_ms=None)
             if self.mesh is not None:
                 self.replay_state = self._sharded_add(
                     self.replay_state, block, self._next_shard)
@@ -438,8 +458,14 @@ class Learner:
             else:
                 self.replay_state = replay_add(
                     self.spec, self.replay_state, block)
-            self.ring.advance(learning,
-                              int(np.asarray(block.weight_version)))
+            wv = int(np.asarray(block.weight_version))
+            if trace is None:
+                self.ring.advance(learning, wv)
+            else:
+                from r2d2_tpu.telemetry.tracing import now_ms
+                self.ring.advance(learning, wv, trace_ms=trace,
+                                  ingest_ms=(now_ms() if trace >= 0
+                                             else -1))
         self.env_steps += learning
         ret = float(np.asarray(block.sum_reward))
         self.metrics.on_block(learning, None if np.isnan(ret) else ret)
@@ -589,8 +615,14 @@ class Learner:
                 self.replay_state = replay_add_many(
                     self.spec, self.replay_state, staged)
         total = 0
-        for learning, ret, wv in metas:
-            self.ring.advance(learning, wv)
+        for learning, ret, wv, trace in metas:
+            if trace is None:
+                self.ring.advance(learning, wv)
+            else:
+                from r2d2_tpu.telemetry.tracing import now_ms
+                self.ring.advance(learning, wv, trace_ms=trace,
+                                  ingest_ms=(now_ms() if trace >= 0
+                                             else -1))
             self.metrics.on_block(learning, ret)
             total += learning
         self.env_steps += total
@@ -710,6 +742,13 @@ class Learner:
                         # odd size (qsize-less backend): compile HERE
                         # (stager thread), never at commit
                         self._add_many_cache[k] = self._compile_add_many(k)
+                    trace = stacked.trace_ms
+                    if trace is not None:
+                        # strip before staging — the AOT add_many avals
+                        # are traceless (the per-block path's discipline);
+                        # stamps mirror into the accountant at commit
+                        trace = np.asarray(trace, np.int64)
+                        stacked = stacked.replace(trace_ms=None)
                     learning = np.asarray(stacked.learning_steps)\
                         .sum(axis=1).astype(np.int64)
                     rets = np.asarray(stacked.sum_reward, np.float32)
@@ -717,7 +756,8 @@ class Learner:
                     metas = [
                         (int(learning[i]),
                          None if np.isnan(rets[i]) else float(rets[i]),
-                         int(wvs[i]))
+                         int(wvs[i]),
+                         int(trace[i]) if trace is not None else None)
                         for i in range(k)]
                     with self._staged_lock:
                         self._staged_env_steps += int(learning.sum())
@@ -941,8 +981,14 @@ class Learner:
                     self._service_key, key = jax.random.split(
                         self._service_key)
                     t0 = time.time()
-                    staged = self.service.sample(key)
+                    batch, shard, snapshot = self.service.sample(key)
                     self.tele.observe("learner/sample", time.time() - t0)
+                    token = None
+                    if self._exp_trace is not None:
+                        token = self._exp_trace.on_sample(
+                            self.service.trace_lookup(
+                                shard, np.asarray(batch.idxes)))
+                    staged = (batch, shard, snapshot, token)
                     while not self._svc_stop.is_set():
                         try:
                             self._svc_prefetch_q.put(staged, timeout=0.5)
@@ -992,7 +1038,7 @@ class Learner:
             self._start_service_stager()
         while True:
             try:
-                batch, shard, snapshot = self._svc_prefetch_q.get(
+                batch, shard, snapshot, token = self._svc_prefetch_q.get(
                     timeout=2.0)
                 break
             except queue_mod.Empty:
@@ -1004,6 +1050,8 @@ class Learner:
                     raise RuntimeError(
                         "service stager threads exited without error")
         self.train_state, m = self._step_fn(self.train_state, batch)
+        if self._exp_trace is not None:
+            self._exp_trace.on_train(token)
         try:
             self._svc_writeback_q.put_nowait(
                 (shard, batch.idxes, m.pop("priorities"), snapshot))
@@ -1030,7 +1078,13 @@ class Learner:
         t0 = time.time()
         batch, shard, snapshot = self.service.sample(key)
         self.tele.observe("learner/sample", time.time() - t0)
+        token = None
+        if self._exp_trace is not None:
+            token = self._exp_trace.on_sample(
+                self.service.trace_lookup(shard, np.asarray(batch.idxes)))
         self.train_state, m = self._step_fn(self.train_state, batch)
+        if self._exp_trace is not None:
+            self._exp_trace.on_train(token)
         t0 = time.time()
         # the snapshot arms the staleness guard: with socket producers
         # feeding the service concurrently, an add landing mid-step must

@@ -146,6 +146,14 @@ class TrainMetrics:
         # byte-identical to the PR17 schema.
         self._recovery_fn = None
 
+        # cross-plane tracing (ISSUE 19): a trace-block provider
+        # (ExperienceTrace.interval_block — the end-to-end env-step ->
+        # gradient latency histogram with its per-hop breakdown)
+        # attached by the learner when telemetry.tracing_enabled —
+        # unattached (the kill switch, every legacy run) the record is
+        # byte-identical to the PR18 schema.
+        self._tracing_fn = None
+
         # system-health pillar (ISSUE 7): a resources-block provider
         # (ResourceMonitor.block) and the alert engine, both attached by
         # the orchestrating loop. None = the blocks are OMITTED and the
@@ -283,6 +291,16 @@ class TrainMetrics:
         Called once per log(); None returns omit the block (consumers
         key on its presence)."""
         self._recovery_fn = provider
+
+    def set_tracing(self, provider) -> None:
+        """Attach the trace-block provider (ISSUE 19): a callable
+        returning ``ExperienceTrace.interval_block()`` — the sampled
+        row count, the e2e_experience_latency histogram summary
+        (env-step emission -> gradient consumption), and its per-hop
+        breakdown (emit_to_ingest / ingest_to_sample / sample_to_train).
+        Called once per log(); None returns omit the block (consumers
+        key on its presence)."""
+        self._tracing_fn = provider
 
     def set_resources(self, provider) -> None:
         """Attach the resources-block provider (ISSUE 7): a callable
@@ -455,6 +473,15 @@ class TrainMetrics:
             recovery = self._recovery_fn()
             if recovery is not None:
                 record["recovery"] = recovery
+        if self._tracing_fn is not None:
+            # cross-plane trace block (ISSUE 19): env-step -> gradient
+            # latency with per-hop breakdown. Before the sentinel pass
+            # so the e2e_latency_growth rule sees its own interval; an
+            # interval that traced nothing returns None and the key is
+            # omitted.
+            trace = self._tracing_fn()
+            if trace is not None:
+                record["trace"] = trace
         if self._resources_fn is not None:
             # machine-side block (ISSUE 7): devices/host/buffer footprints
             # + the compile sub-block. Before the sentinel, which reads it.

@@ -173,6 +173,9 @@ class PlayerStack:
         if cfg.actor.inference == "server":
             from r2d2_tpu.serve import InprocEndpoint, ServingStats
             self.serve_stats = ServingStats()
+            if cfg.telemetry.enabled and cfg.telemetry.tracing_enabled:
+                from r2d2_tpu.telemetry.tracing import ServeTrace
+                self.serve_stats.trace = ServeTrace()
             self.serve_endpoint = InprocEndpoint()
             self.metrics.set_serving(self._serving_block)
         # quantized inference plane (ISSUE 14): the publish-time
@@ -544,7 +547,9 @@ class PlayerStack:
             lambda: self.publisher.publish_count
         self.queue = BlockQueue(
             use_mp=True, ctx=self._ctx,
-            shm_spec=self.learner.spec if cfg.runtime.shm_transport else None)
+            shm_spec=self.learner.spec if cfg.runtime.shm_transport else None,
+            tracing=(cfg.telemetry.enabled
+                     and cfg.telemetry.tracing_enabled))
         self._stop = stop_event
         self._actor_mode = "process"
         if self.serve_endpoint is not None:
@@ -629,7 +634,9 @@ class PlayerStack:
                     self.serve_endpoint.submit,
                     (cfg.env.frame_height, cfg.env.frame_width),
                     self.net.action_dim, cfg.network.hidden_dim,
-                    request_slots=cfg.serve.request_ring_slots)
+                    request_slots=cfg.serve.request_ring_slots,
+                    tracing=(cfg.telemetry.enabled
+                             and cfg.telemetry.tracing_enabled))
                 self._serve_spec = {
                     "transport": "shm",
                     "request_ring": self._serve_transport.request_ring,
@@ -862,14 +869,22 @@ class PlayerStack:
             return {"slot": self.shrink_serve_server(slot),
                     "servers": sorted(self.serve_fleet.servers)}
 
-        def _announce_replay(host, port, shards=None, step=None):
+        def _announce_replay(host, port, shards=None, step=None,
+                             anchor_wall=None):
             # ISSUE 18: a (re)started ReplayService re-registers its
             # address after restoring from snapshot — producers that
-            # lost their socket rediscover the survivor via 'info'
+            # lost their socket rediscover the survivor via 'info'.
+            # ISSUE 19: the announcement is also the clock-anchor
+            # exchange — the board echoes ITS wall clock at receipt, so
+            # the announcer can estimate its skew against the learner
+            # plane (offset ≈ anchor_wall - board_wall, good to ±RTT/2)
+            # without any shared monotonic clock.
             self._replay_announce = {"host": str(host), "port": int(port),
                                      "shards": shards, "step": step,
                                      "t": time.time()}
-            return {"ok": True}
+            if anchor_wall is not None:
+                self._replay_announce["anchor_wall"] = float(anchor_wall)
+            return {"ok": True, "board_wall": time.time()}
 
         def _info():
             info = {"membership": self.membership.snapshot(),

@@ -28,10 +28,19 @@ import numpy as np
 from r2d2_tpu.replay.structs import Block, ReplaySpec, empty_block_np
 
 
-def block_layout(spec: ReplaySpec) -> List[Tuple[str, tuple, np.dtype]]:
+def block_layout(spec: ReplaySpec,
+                 tracing: bool = False) -> List[Tuple[str, tuple, np.dtype]]:
     """(field, shape, dtype) in serialization order — derived from the one
-    authoritative record definition (empty_block_np) so it cannot drift."""
-    return [(k, v.shape, v.dtype) for k, v in empty_block_np(spec).items()]
+    authoritative record definition (empty_block_np) so it cannot drift.
+
+    ``tracing`` (ISSUE 19) appends the lineage stamp field at the END, so
+    a traced run's emission stamps survive the process boundary; off (the
+    default), slot bytes are exactly the untraced layout — the ring a
+    kill-switched run maps is byte-identical."""
+    fields = [(k, v.shape, v.dtype) for k, v in empty_block_np(spec).items()]
+    if tracing:
+        fields.append(("trace_ms", (), np.dtype(np.int32)))
+    return fields
 
 
 @dataclass
@@ -52,12 +61,14 @@ class ShmBlockRing:
     """
 
     def __init__(self, spec: ReplaySpec, maxsize: int = 64,
+                 tracing: bool = False,
                  _attach_name: Optional[str] = None):
         self.spec = spec
         self.capacity = maxsize
+        self.tracing = bool(tracing)
         self._fields: List[_Field] = []
         off = 0
-        for name, shape, dtype in block_layout(spec):
+        for name, shape, dtype in block_layout(spec, tracing=self.tracing):
             nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
             self._fields.append(_Field(name, shape, dtype, off, nbytes))
             off += nbytes
@@ -79,10 +90,13 @@ class ShmBlockRing:
 
     def __getstate__(self):
         return {"spec": self.spec, "capacity": self.capacity,
-                "name": self.name}
+                "tracing": self.tracing, "name": self.name}
 
     def __setstate__(self, state):
+        # .get: pre-tracing pickles (rings serialized before ISSUE 19)
+        # attach with the untraced layout they were created with
         self.__init__(state["spec"], state["capacity"],
+                      tracing=state.get("tracing", False),
                       _attach_name=state["name"])
 
     @property
@@ -126,7 +140,10 @@ class ShmBlockRing:
             time.sleep(0.001)
         slot = self._slot_view(lib, pos)
         for f in self._fields:
-            src = np.ascontiguousarray(getattr(block, f.name), f.dtype)
+            val = getattr(block, f.name)
+            if val is None:        # unstamped block on a traced ring
+                val = -1
+            src = np.ascontiguousarray(val, f.dtype)
             slot[f.offset:f.offset + f.nbytes] = src.view(np.uint8).reshape(-1)
         lib.ring_commit_push(self._base, pos)
 

@@ -118,8 +118,14 @@ class _RemoteBase:
     def __init__(self, channel, action_dim: int, *, stats=None,
                  timeout_s: float = 5.0, max_retry_s: float = 60.0,
                  backoff_base_s: float = 0.25, backoff_max_s: float = 5.0,
-                 should_stop: Optional[Callable[[], bool]] = None):
+                 should_stop: Optional[Callable[[], bool]] = None,
+                 trace_every: int = 0):
         self.channel = channel
+        # Distributed tracing (ISSUE 19): every Nth exchange attaches a
+        # trace dict to its requests (0 = never — the default keeps
+        # request objects and wire frames byte-identical to untraced).
+        self._trace_every = max(int(trace_every), 0)
+        self._exchanges = 0
         self.action_dim = int(action_dim)
         self.stats = stats
         self.timeout_s = timeout_s
@@ -152,12 +158,28 @@ class _RemoteBase:
         for lane in lanes:
             lane.begin_op()        # one logical op per lane per exchange
         reqs = {lane.client_id: lane.build(kind) for lane in lanes}
+        traced = (self._trace_every
+                  and self._exchanges % self._trace_every == 0)
+        self._exchanges += 1
+        if traced:
+            from r2d2_tpu.telemetry.tracing import new_request_trace
+            for req in reqs.values():
+                req.trace = new_request_trace(req.req_id)
         out: dict = {}
         while True:
             pending_lanes = [lane for lane in lanes
                              if lane.client_id not in out]
             if not pending_lanes:
                 break
+            if traced:
+                # the route hop ends here: submit->send is the client's
+                # own build/queue time (retries re-stamp, so a resent
+                # request's transit hop starts at ITS send)
+                now_wall = time.time()
+                for lane in pending_lanes:
+                    tr = getattr(reqs[lane.client_id], "trace", None)
+                    if tr is not None:
+                        tr["t_send_wall"] = now_wall
             got = self.channel.request_many(
                 [reqs[lane.client_id] for lane in pending_lanes],
                 timeout=self.timeout_s)

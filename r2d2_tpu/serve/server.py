@@ -133,6 +133,10 @@ class ServingStats:
         self._shed = 0
         self._misrouted = 0
         self._adm_lat = np.zeros(NBUCKETS, np.int64)
+        # -- distributed tracing (ISSUE 19) -- a ServeTrace is attached
+        # when telemetry.tracing_enabled; the ``trace`` sub-block exists
+        # only then (same presence gating as ``admission``).
+        self.trace = None
 
     # -- feed points --
 
@@ -256,6 +260,10 @@ class ServingStats:
                     "misrouted": self._misrouted,
                     "admitted_latency": adm,
                 }
+            if self.trace is not None:
+                tr = self.trace.interval_block()
+                if tr is not None:
+                    block["trace"] = tr
             self._lat[:] = 0
             self._fill[:] = 0
             self._fill_sum = 0
@@ -608,6 +616,21 @@ class PolicyServer:
             st.active_clients = self.cache.active_clients
         if not live:
             return
+        # distributed tracing (ISSUE 19): close each traced request's
+        # route/transit hops and record its micro-batch fill wait (the
+        # server's own monotonic clock — exact); the batch's forward and
+        # reply hops follow below iff any request was traced
+        traced_any = False
+        trace_sinks = [st.trace for st in self._each_stats()
+                       if st.trace is not None]
+        if trace_sinks:
+            for req, _cb, _slot in live:
+                tr = getattr(req, "trace", None)
+                if tr is not None:
+                    traced_any = True
+                    qw = max(now - req.t_recv, 0.0)
+                    for sink in trace_sinks:
+                        sink.on_request(tr, qw)
         fill = len(live)
         stacked, last_action, hidden = self.cache.gather(
             [slot for _, _, slot in live])
@@ -640,6 +663,12 @@ class PolicyServer:
         h = np.asarray(h)
         t1 = time.perf_counter()
         tele.observe("serve/forward", t1 - t0)
+        if tele.spans.enabled:
+            # the serving plane's track in the cross-process Perfetto
+            # merge (ISSUE 19): one span per dispatched micro-batch
+            wall = time.time()
+            tele.record_span("serve/forward", wall - (t1 - t0), wall,
+                             {"fill": fill})
         reply_t = time.monotonic()
         for i, (req, cb, slot) in enumerate(live):
             if req.kind == KIND_STEP:
@@ -658,7 +687,14 @@ class PolicyServer:
                     # the brownout contract's p99: server-side
                     # receive→reply of ADMITTED requests only
                     st.on_admitted_latency(lat)
-        tele.observe("serve/reply", time.perf_counter() - t1)
+        reply_s = time.perf_counter() - t1
+        tele.observe("serve/reply", reply_s)
+        if tele.spans.enabled:
+            wall = time.time()
+            tele.record_span("serve/reply", wall - reply_s, wall)
+        if traced_any:
+            for sink in trace_sinks:
+                sink.on_batch(t1 - t0, reply_s)
         for st in self._each_stats():
             st.on_replies(fill)
             st.on_batch(

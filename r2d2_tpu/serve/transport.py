@@ -135,6 +135,9 @@ class InprocEndpoint:
 
     def submit(self, req: Request, reply_cb: Callable[[Reply], None]) -> None:
         req.t_recv = time.monotonic()
+        trace = getattr(req, "trace", None)
+        if trace is not None:
+            trace["t_recv_wall"] = time.time()
         self.inbox.put((req, reply_cb))
 
     def submit_many(self, items) -> None:
@@ -144,8 +147,14 @@ class InprocEndpoint:
         interleaved with its own wakeups (measured as several ms of
         arrival spread per tick on a contended host)."""
         now = time.monotonic()
+        wall = None
         for req, _cb in items:
             req.t_recv = now
+            trace = getattr(req, "trace", None)
+            if trace is not None:
+                if wall is None:
+                    wall = time.time()
+                trace["t_recv_wall"] = wall
         with self.inbox.mutex:
             self.inbox.queue.extend(items)
             self.inbox.not_empty.notify()
@@ -448,20 +457,31 @@ class SocketChannel:
 # fixed-layout request/reply records.
 
 
-def request_layout(h: int, w: int) -> List[Tuple[str, tuple, np.dtype]]:
+def request_layout(h: int, w: int,
+                   tracing: bool = False) -> List[Tuple[str, tuple, np.dtype]]:
     """(field, shape, dtype) of one request slot — the serve twin of
     shm_feeder.block_layout, derived once so client and server views of
-    the ring cannot drift (both sides build it from the same config)."""
-    return [("client_id", (), np.dtype(np.int64)),
-            ("req_id", (), np.dtype(np.int64)),
-            ("kind", (), np.dtype(np.int64)),
-            ("op_seq", (), np.dtype(np.int64)),
-            ("action", (), np.dtype(np.int64)),
-            ("flags", (), np.dtype(np.int64)),     # bit0 reset, bit1 observe
-            ("t_submit", (), np.dtype(np.float64)),
-            ("reply_to", (_REPLY_NAME_BYTES,), np.dtype(np.uint8)),
-            ("reset_obs", (h, w), np.dtype(np.uint8)),
-            ("obs", (h, w), np.dtype(np.uint8))]
+    the ring cannot drift (both sides build it from the same config).
+
+    ``tracing`` (ISSUE 19) appends the two wall-stamp fields a traced
+    request's hop decomposition needs; 0.0 = this request untraced. Off,
+    the layout — and thus the ring's slot bytes — is exactly the PR-18
+    one. Clients never choose: the ring handle they attach to pickles
+    its layout, so the server's knob decides for every process."""
+    fields = [("client_id", (), np.dtype(np.int64)),
+              ("req_id", (), np.dtype(np.int64)),
+              ("kind", (), np.dtype(np.int64)),
+              ("op_seq", (), np.dtype(np.int64)),
+              ("action", (), np.dtype(np.int64)),
+              ("flags", (), np.dtype(np.int64)),   # bit0 reset, bit1 observe
+              ("t_submit", (), np.dtype(np.float64)),
+              ("reply_to", (_REPLY_NAME_BYTES,), np.dtype(np.uint8)),
+              ("reset_obs", (h, w), np.dtype(np.uint8)),
+              ("obs", (h, w), np.dtype(np.uint8))]
+    if tracing:
+        fields.extend([("t_submit_wall", (), np.dtype(np.float64)),
+                       ("t_send_wall", (), np.dtype(np.float64))])
+    return fields
 
 
 def reply_layout(action_dim: int,
@@ -618,9 +638,11 @@ class ShmServeTransport:
 
     def __init__(self, submit: Callable[[Request, Callable], None],
                  frame_hw: Tuple[int, int], action_dim: int,
-                 hidden_dim: int, request_slots: int = 256):
+                 hidden_dim: int, request_slots: int = 256,
+                 tracing: bool = False):
         h, w = frame_hw
-        self.request_ring = ShmRecordRing(request_layout(h, w),
+        self.request_ring = ShmRecordRing(request_layout(h, w,
+                                                         tracing=tracing),
                                           maxsize=request_slots)
         self._reply_layout = reply_layout(action_dim, hidden_dim)
         self._submit = submit
@@ -678,6 +700,12 @@ class ShmServeTransport:
                 reset_obs=rec["reset_obs"] if flags & 1 else None,
                 obs=rec["obs"] if flags & 2 else None,
                 reply_to=_decode_name(rec["reply_to"]))
+            if "t_submit_wall" in rec and float(rec["t_submit_wall"]) > 0:
+                trace = {"id": req.req_id,
+                         "t_submit_wall": float(rec["t_submit_wall"])}
+                if float(rec["t_send_wall"]) > 0:
+                    trace["t_send_wall"] = float(rec["t_send_wall"])
+                req.trace = trace
             self._submit(req, self._reply_cb_for(req.reply_to))
 
     def close(self) -> None:
@@ -702,28 +730,43 @@ class ShmServeChannel:
                                          maxsize=reply_slots)
         self._name_field = _encode_name(self._reply_ring.name)
         self._stash: Dict[int, Reply] = {}
+        # layout self-negotiation: the attached ring carries the server's
+        # request_layout (pickled with the handle), so a traced server
+        # teaches every client to fill the wall-stamp fields
+        self._traced_ring = any(name == "t_submit_wall"
+                                for name, _, _ in self._req_ring.layout)
 
     def _push(self, req: Request) -> None:
-        h, w = self._req_ring.layout[-1][1]
+        h, w = next(shape for name, shape, _ in self._req_ring.layout
+                    if name == "obs")
         zeros = None
         flags = (1 if req.reset_obs is not None else 0) | \
                 (2 if req.obs is not None else 0)
         if req.reset_obs is None or req.obs is None:
             zeros = np.zeros((h, w), np.uint8)
+        record = {
+            "client_id": np.int64(req.client_id),
+            "req_id": np.int64(req.req_id),
+            "kind": np.int64(req.kind),
+            "op_seq": np.int64(req.op_seq),
+            "action": np.int64(req.action),
+            "flags": np.int64(flags),
+            "t_submit": np.float64(req.t_submit),
+            "reply_to": self._name_field,
+            "reset_obs": (req.reset_obs if req.reset_obs is not None
+                          else zeros),
+            "obs": req.obs if req.obs is not None else zeros,
+        }
+        if self._traced_ring:
+            # the server's layout says tracing is on: the wall stamps
+            # ride the ring (0.0 = this particular request untraced)
+            trace = getattr(req, "trace", None) or {}
+            record["t_submit_wall"] = np.float64(
+                trace.get("t_submit_wall", 0.0))
+            record["t_send_wall"] = np.float64(
+                trace.get("t_send_wall", 0.0))
         try:
-            self._req_ring.put({
-                "client_id": np.int64(req.client_id),
-                "req_id": np.int64(req.req_id),
-                "kind": np.int64(req.kind),
-                "op_seq": np.int64(req.op_seq),
-                "action": np.int64(req.action),
-                "flags": np.int64(flags),
-                "t_submit": np.float64(req.t_submit),
-                "reply_to": self._name_field,
-                "reset_obs": (req.reset_obs if req.reset_obs is not None
-                              else zeros),
-                "obs": req.obs if req.obs is not None else zeros,
-            }, timeout=1.0)
+            self._req_ring.put(record, timeout=1.0)
         except queue.Full:
             raise ServeTimeout("request ring full") from None
 

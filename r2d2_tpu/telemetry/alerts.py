@@ -253,6 +253,26 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("ingest_backlog", "threshold",
                   ("replay_service", "ingest", "backlog"),
                   tcfg.alerts_ingest_backlog, "warn"),
+        # per-tier replay telemetry (ISSUE 19 satellite, ROADMAP 4d; the
+        # spill.promotion_latency sub-block — present only with
+        # telemetry.replay_tiers_enabled): pages promoted this interval
+        # sat demoted longer than the ceiling before coming back — the
+        # spill tier is a parking lot, not a cache (experience ages out
+        # of relevance before it becomes samplable again)
+        AlertRule("spill_promotion_latency", "threshold",
+                  ("replay_service", "spill", "promotion_latency",
+                   "p95_ms"),
+                  tcfg.alerts_spill_promotion_ms, "warn"),
+        # cross-plane tracing (ISSUE 19; the trace block — inactive on
+        # records without it, i.e. every run with tracing_enabled off):
+        # the end-to-end env-step -> gradient latency grew past a
+        # multiple of its own recent median — experience is aging
+        # somewhere between emission and consumption (ingest backlog,
+        # spill churn, or a starved sampler; the per-hop breakdown in
+        # the same block says which)
+        AlertRule("e2e_latency_growth", "growth",
+                  ("trace", "e2e_experience_latency", "p95_ms"),
+                  tcfg.alerts_e2e_latency_growth, "warn", window=w),
         # crash-recovery rules (ISSUE 18; the recovery block — inactive
         # on records without it, i.e. every run with
         # runtime.snapshot_interval == 0):

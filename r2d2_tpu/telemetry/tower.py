@@ -1,0 +1,386 @@
+"""Fleet control tower (ISSUE 19 tentpole, part b).
+
+Every plane of the disaggregated stack already narrates itself into a
+per-process JSONL stream: the learner's ``metrics_player{p}.jsonl``, the
+serving fleet's ``serve_metrics.jsonl``, a standalone ReplayService's
+``service_metrics_p{p}.jsonl``, the multihost ranks'
+``telemetry_host{r}.jsonl``, and the per-stream alert logs. Until now
+NOTHING read them together — a brownout on the serving plane and an
+ingest backlog on the replay plane looked like two unrelated warnings in
+two files, when together they are one story (compute contention). The
+tower is the reader: it tails every stream, joins the newest rows into
+ONE fleet-wide record, derives the cross-plane signals no single stream
+can see, and runs its own alert pass over the joined record (the same
+declarative :class:`~r2d2_tpu.telemetry.alerts.AlertEngine` the per-run
+sentinel uses — tower rules are data too).
+
+Joined-record shape::
+
+    {"t_wall": ..., "planes": {
+         "learner":        [newest record per player],
+         "serve":          newest fleet row or None,
+         "replay_service": [newest row per standalone service host],
+         "hosts":          [newest row per multihost rank]},
+     "events": [newest alert firings across every alerts stream],
+     "clock":  {"anchors": {plane: {...}}, "offsets": {plane: s}},
+     "derived": {...}, "alerts": {"active": [...], "fired": [...]}}
+
+Clock alignment generalizes the PR-11/12 ``clock_anchor``: serve and
+replay-service processes stamp a wall/mono anchor pair at lease
+announcement (``proc_header``); a standalone ReplayService additionally
+exchanges anchors with the lease board at ``announce_replay`` (the board
+echoes its wall clock, giving ``offset_est`` good to half the
+announcement RTT), so the tower — and the Perfetto merge in
+``tools/inspect.py --export-trace`` — can place every plane's events on
+the learner's clock without assuming a shared monotonic clock.
+
+Gated by ``telemetry.tower_enabled``; the tower is PULL-based (a reader
+process beside the run — ``tools/tower.py``), so the switch gates the
+reader, and the producing planes are byte-identical either way.
+"""
+
+import glob
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from r2d2_tpu.telemetry.alerts import AlertEngine, AlertRule, record_value
+
+# Streams the tower joins, as (plane, glob) pairs. Multi-match globs
+# (players, service hosts, ranks) contribute one row per file.
+STREAM_GLOBS = (
+    ("learner", "metrics_player*.jsonl"),
+    ("serve", "serve_metrics.jsonl"),
+    ("replay_service", "service_metrics_p*.jsonl"),
+    ("hosts", "telemetry_host*.jsonl"),
+)
+ALERT_GLOBS = ("alerts_player*.jsonl", "serve_alerts.jsonl",
+               "alerts_host*.jsonl")
+
+
+def tower_rules(cfg) -> Tuple[AlertRule, ...]:
+    """The tower's cross-plane rule set — evaluated against the JOINED
+    record, so the paths walk ``derived``, where the cross-plane
+    signals live. Parameterized by the same ``telemetry.alerts_*``
+    knobs as the per-run sentinel (one knob vocabulary, two scopes)."""
+    t = cfg.telemetry
+    return (
+        # the acceptance signal: end-to-end env-step -> gradient p95
+        # growing past a multiple of its own recent median (the rolling
+        # window lives in the engine, so offline replay and live tailing
+        # share warm-up semantics with every other growth rule)
+        AlertRule("tower_e2e_latency_growth", "growth",
+                  ("derived", "e2e_p95_ms"),
+                  t.alerts_e2e_latency_growth, "warn",
+                  window=t.alerts_window),
+        # the canonical cross-plane correlation: the serving fleet shed
+        # requests in an interval where the replay plane's ingest ran a
+        # backlog — two planes contending for the same resource budget;
+        # either alone is a plane-local warning, together they are a
+        # provisioning signal (1.0 = both observed this join)
+        AlertRule("tower_shed_while_backlog", "threshold",
+                  ("derived", "shed_while_backlog"), 1.0, "crit"),
+        # per-tier replay health surfaced fleet-wide (ROADMAP 4d): the
+        # same bound as the in-run spill_promotion_latency rule, read
+        # from whichever plane hosts the service (learner-internal or
+        # standalone)
+        AlertRule("tower_spill_promotion_latency", "threshold",
+                  ("derived", "spill_promotion_p95_ms"),
+                  t.alerts_spill_promotion_ms, "warn"),
+        # a plane stopped reporting: its newest row aged past the
+        # ceiling while other planes kept writing (file-mtime based, so
+        # a crashed serve fleet is visible even though its stream simply
+        # ends) — live mode only; offline replay carries no ages
+        AlertRule("tower_plane_silent", "threshold",
+                  ("derived", "stalest_plane_age_s"),
+                  t.alerts_missing_rank_age_s, "crit"),
+    )
+
+
+def _read_last_row(path: str) -> Optional[dict]:
+    from r2d2_tpu.telemetry.fleet import read_last_jsonl_row
+    return read_last_jsonl_row(path)
+
+
+class TowerCollector:
+    """One tower instance per run directory. ``snapshot()`` joins the
+    newest row of every stream (live mode); ``replay()`` walks the full
+    histories index-aligned (every plane logs on the same
+    ``runtime.log_interval`` cadence, so row *i* of each stream covers
+    the same interval up to one period of skew — the offline join the
+    post-mortem CLI uses). Both feed ``evaluate()``."""
+
+    def __init__(self, run_dir: str, cfg=None,
+                 jsonl_path: Optional[str] = None):
+        if cfg is None:
+            from r2d2_tpu.config import Config
+            cfg = Config()
+        self.run_dir = run_dir
+        self.cfg = cfg
+        self.engine = AlertEngine(tower_rules(cfg), jsonl_path=jsonl_path)
+        self._events_seen: Dict[str, int] = {}
+
+    # -- stream discovery / reading --
+
+    def _paths(self, pattern: str) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.run_dir, pattern)))
+
+    def _plane_rows(self) -> Tuple[Dict[str, object], Dict[str, float]]:
+        """Newest row per stream, plus per-plane staleness (seconds
+        since the newest contributing file was written)."""
+        planes: Dict[str, object] = {}
+        ages: Dict[str, float] = {}
+        now = time.time()
+        for plane, pattern in STREAM_GLOBS:
+            rows, age = [], None
+            for path in self._paths(pattern):
+                row = _read_last_row(path)
+                if row is None:
+                    continue
+                rows.append(row)
+                try:
+                    a = now - os.path.getmtime(path)
+                except OSError:
+                    continue
+                age = a if age is None else min(age, a)
+            if plane == "serve":
+                planes[plane] = rows[0] if rows else None
+            else:
+                planes[plane] = rows
+            if age is not None:
+                ages[plane] = round(age, 1)
+        return planes, ages
+
+    def _new_events(self, limit: int = 32) -> List[dict]:
+        """Alert firings appended to ANY alerts stream since the last
+        call — the joined record's supervisor/recovery/brownout event
+        feed (each row tagged with its source stream)."""
+        from r2d2_tpu.tools.logparse import parse_jsonl
+        events: List[dict] = []
+        for pattern in ALERT_GLOBS:
+            for path in self._paths(pattern):
+                try:
+                    rows = parse_jsonl(path)
+                except FileNotFoundError:
+                    continue
+                seen = self._events_seen.get(path, 0)
+                if len(rows) < seen:      # truncation: fresh run
+                    seen = 0
+                for row in rows[seen:]:
+                    events.append({"stream": os.path.basename(path), **row})
+                self._events_seen[path] = len(rows)
+        return events[-limit:]
+
+    # -- the join --
+
+    @staticmethod
+    def derive(planes: Dict[str, object],
+               ages: Optional[Dict[str, float]] = None) -> dict:
+        """The cross-plane signals — everything here reads >= 1 plane
+        and exists nowhere else. Static so offline replay (which joins
+        historical rows, not files) shares the exact derivation."""
+        derived: dict = {}
+        learners = planes.get("learner") or []
+        lead = learners[0] if learners else {}
+
+        # end-to-end experience latency (the tracing tentpole's record
+        # block) — surfaced fleet-wide for the growth rule
+        e2e = record_value(lead, ("trace", "e2e_experience_latency",
+                                  "p95_ms"))
+        if e2e is not None:
+            derived["e2e_p95_ms"] = e2e
+
+        # the replay plane's view: prefer the standalone service hosts'
+        # rows, fall back to the learner-internal service block
+        svc_rows = list(planes.get("replay_service") or [])
+        if not svc_rows and lead.get("replay_service") is not None:
+            svc_rows = [lead]
+        backlog = max((record_value(r, ("replay_service", "ingest",
+                                        "backlog")) or 0.0
+                       for r in svc_rows), default=0.0)
+        promo = [v for r in svc_rows
+                 if (v := record_value(r, ("replay_service", "spill",
+                                           "promotion_latency",
+                                           "p95_ms"))) is not None]
+        if promo:
+            derived["spill_promotion_p95_ms"] = max(promo)
+
+        # the serving plane's view: the standalone fleet row, else the
+        # learner-internal serving block
+        serve_row = planes.get("serve") or lead
+        shed = record_value(serve_row, ("serving", "admission", "shed"))
+
+        # shed-while-backlog: BOTH planes degraded in the joined
+        # interval (the correlation no single stream carries)
+        if shed is not None or backlog:
+            derived["ingest_backlog"] = backlog
+            derived["serve_shed"] = shed or 0.0
+            derived["shed_while_backlog"] = float(
+                bool(shed) and bool(backlog))
+
+        if ages:
+            derived["plane_ages_s"] = dict(ages)
+            derived["stalest_plane_age_s"] = max(ages.values())
+        return derived
+
+    @staticmethod
+    def clock(planes: Dict[str, object]) -> dict:
+        """Per-plane clock anchors (+ the announce-time offset estimate
+        where a plane exchanged one) pulled from the proc headers."""
+        anchors: Dict[str, dict] = {}
+        offsets: Dict[str, float] = {}
+        serve_row = planes.get("serve")
+        rows = [("serve", serve_row)] if serve_row else []
+        rows += [(f"replay_service/{i}", r)
+                 for i, r in enumerate(planes.get("replay_service") or [])]
+        for name, row in rows:
+            proc = (row or {}).get("proc") or {}
+            anchor = proc.get("clock_anchor")
+            if anchor:
+                anchors[name] = anchor
+                if anchor.get("offset_est") is not None:
+                    offsets[name] = anchor["offset_est"]
+        for row in planes.get("hosts") or []:
+            a = row.get("clock_anchor")
+            if a and row.get("rank") is not None:
+                anchors[f"host{row['rank']}"] = a
+        return {"anchors": anchors, "offsets": offsets}
+
+    def join(self, planes: Dict[str, object],
+             ages: Optional[Dict[str, float]] = None,
+             events: Optional[List[dict]] = None) -> dict:
+        record = {"t_wall": round(time.time(), 3), "planes": planes,
+                  "derived": self.derive(planes, ages),
+                  "clock": self.clock(planes)}
+        if events:
+            record["events"] = events
+        return record
+
+    # -- entry points --
+
+    def snapshot(self, evaluate: bool = True) -> dict:
+        """Live mode: join the newest rows + fresh events, evaluate the
+        tower rules, return the joined record (``alerts`` included)."""
+        planes, ages = self._plane_rows()
+        record = self.join(planes, ages, self._new_events())
+        if evaluate:
+            record["alerts"] = self.engine.evaluate(record)
+        return record
+
+    def replay(self) -> List[dict]:
+        """Offline mode: walk the full stream histories index-aligned
+        and evaluate every joined record in order — the post-mortem the
+        sentinel CLI performs per-stream, performed fleet-wide. Returns
+        the joined records (each carrying its ``alerts`` block)."""
+        from r2d2_tpu.tools.logparse import parse_jsonl
+        histories: Dict[str, List[List[dict]]] = {}
+        for plane, pattern in STREAM_GLOBS:
+            streams = []
+            for path in self._paths(pattern):
+                try:
+                    streams.append(parse_jsonl(path))
+                except FileNotFoundError:
+                    continue
+            histories[plane] = streams
+        depth = max((len(s) for streams in histories.values()
+                     for s in streams), default=0)
+        out = []
+        for i in range(depth):
+            planes: Dict[str, object] = {}
+            for plane, streams in histories.items():
+                # index-aligned join; a shorter stream holds its last
+                # row (the plane stopped logging — its final state)
+                rows = [s[min(i, len(s) - 1)] for s in streams if s]
+                planes[plane] = ((rows[0] if rows else None)
+                                 if plane == "serve" else rows)
+            record = self.join(planes)
+            record["alerts"] = self.engine.evaluate(record)
+            out.append(record)
+        return out
+
+
+def render_tower(record: dict) -> str:
+    """One dashboard frame over the joined record — every plane one
+    line, then the derived signals and the tower's own alert state."""
+    lines = []
+    planes = record.get("planes") or {}
+    learners = planes.get("learner") or []
+    for i, row in enumerate(learners):
+        bits = [f"learner[{i}]: t={row.get('t', 0):.0f}s "
+                f"env_steps={row.get('env_steps', 0)} "
+                f"train={row.get('training_steps', 0)}"]
+        if row.get("buffer_speed") is not None:
+            bits.append(f"{row['buffer_speed']:.0f} steps/s")
+        tr = row.get("trace") or {}
+        e2e = (tr.get("e2e_experience_latency") or {})
+        if e2e.get("p95_ms") is not None:
+            bits.append(f"e2e p95={e2e['p95_ms']:.0f}ms")
+        rec = row.get("recovery") or {}
+        if (rec.get("supervisor") or {}).get("restarts"):
+            bits.append(f"restarts={rec['supervisor']['restarts']}")
+        lines.append(" ".join(bits))
+    serve = planes.get("serve")
+    if serve:
+        sv = serve.get("serving") or {}
+        adm = sv.get("admission") or {}
+        bits = [f"serve: t={serve.get('t', 0):.0f}s "
+                f"batches={serve.get('batches', 0)} "
+                f"req={sv.get('requests', 0)}"]
+        if (sv.get("latency") or {}).get("p99_ms") is not None:
+            bits.append(f"p99={sv['latency']['p99_ms']:.1f}ms")
+        if adm.get("shed"):
+            bits.append(f"SHED={adm['shed']}")
+        tr = sv.get("trace") or {}
+        if tr.get("requests"):
+            bits.append(f"traced={tr['requests']}")
+        lines.append(" ".join(bits))
+    for i, row in enumerate(planes.get("replay_service") or []):
+        rs = row.get("replay_service") or {}
+        sh = rs.get("shards") or {}
+        sp = rs.get("spill") or {}
+        bits = [f"replay[{i}]: t={row.get('t', 0):.0f}s "
+                f"shards={sh.get('n', '?')} "
+                f"fill={sh.get('fill_min', 0):.2f}"
+                f"-{sh.get('fill_max', 0):.2f}"]
+        if (rs.get("ingest") or {}).get("backlog"):
+            bits.append(f"BACKLOG={rs['ingest']['backlog']}")
+        if sp.get("occupancy"):
+            bits.append(f"spill={sp['occupancy']}/{sp.get('capacity')}")
+        pl = sp.get("promotion_latency") or {}
+        if pl.get("p95_ms") is not None:
+            bits.append(f"promo p95={pl['p95_ms']:.0f}ms")
+        lines.append(" ".join(bits))
+    hosts = planes.get("hosts") or []
+    if hosts:
+        lines.append(f"hosts: {len(hosts)} rank row(s)")
+    if not lines:
+        lines.append("(no plane streams found)")
+    derived = record.get("derived") or {}
+    bits = []
+    for key in ("e2e_p95_ms", "ingest_backlog", "serve_shed",
+                "spill_promotion_p95_ms", "stalest_plane_age_s"):
+        if derived.get(key) is not None:
+            bits.append(f"{key}={derived[key]:.4g}")
+    if derived.get("shed_while_backlog"):
+        bits.append("SHED-WHILE-BACKLOG")
+    if bits:
+        lines.append("derived: " + " ".join(bits))
+    offsets = (record.get("clock") or {}).get("offsets") or {}
+    if offsets:
+        lines.append("clock offsets: " + " ".join(
+            f"{k}={v * 1e3:+.1f}ms" for k, v in sorted(offsets.items())))
+    ab = record.get("alerts")
+    if ab is not None:
+        active = ab.get("active") or []
+        lines.append("tower alerts: "
+                     + (" ".join(active) if active else "none active"))
+        for a in ab.get("fired") or []:
+            lines.append(f"  -> FIRED {a.get('severity', '?').upper()} "
+                         f"{a.get('rule')}"
+                         + (f" value={a['value']:.4g}"
+                            if a.get("value") is not None else ""))
+    for ev in (record.get("events") or [])[-4:]:
+        lines.append(f"  event[{ev.get('stream')}] "
+                     f"{ev.get('severity', '?')} {ev.get('rule')}")
+    return "\n".join(lines)

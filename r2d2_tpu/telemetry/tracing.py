@@ -1,0 +1,235 @@
+"""Cross-plane distributed tracing (ISSUE 19 tentpole, part a).
+
+Two causal paths get per-hop wall-clock stamps, both behind the
+``telemetry.tracing_enabled`` kill switch (off => records, wire frames,
+and block schemas byte-identical to the pre-tracing system):
+
+  * **Serving requests** — every Nth exchange
+    (``telemetry.trace_sample_every``) attaches a ``trace`` dict to its
+    ``Request`` objects: ``{"id", "t_submit_wall", "t_send_wall",
+    "t_recv_wall"}``. The dict rides the pickle rungs for free (plain
+    dataclasses pickle their ``__dict__``, so an absent attribute keeps
+    untraced frames byte-identical) and two gated i64/f64 fields on the
+    shm request layout (serve/transport.py ``request_layout``). The
+    server decomposes the round trip into transit / queue_wait /
+    forward / reply hops (``ServeTrace``, folded into the ``serving``
+    record block as a ``trace`` sub-block).
+
+  * **Experience blocks** — every Nth emitted block carries
+    ``Block.trace_ms``, a trailing None-default leaf (the PR-5
+    ``weight_version`` / PR-10 ``lane`` treatment: absent => old blocks
+    and untraced runs load unchanged; present => it rides ``addw``
+    socket frames via the omit-None ``_block_fields`` contract). The
+    replay service strips the leaf before any device commit (the AOT
+    ``replay_add_many`` avals never see it) and mirrors it into the
+    ring accountant's host-side slot arrays, through spill
+    demote/promote and snapshot capture/restore. At sample time the
+    learner looks the stamps back up by slot and feeds
+    ``ExperienceTrace`` — the periodic record's ``trace`` block with
+    the end-to-end **env-step -> gradient** latency histogram and its
+    per-hop breakdown (emit->ingest, ingest->sample, sample->train).
+
+Timestamps are wall-clock **milliseconds mod 2^31** stored as int32
+(fits the Block's int32 stamp convention; -1 = untraced, matching the
+lane / weight_version sentinel). Hop latencies difference mod 2^31, so
+the ~24-day wrap cannot produce negative hops.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from r2d2_tpu.telemetry.histogram import NBUCKETS, bucket_index, summarize
+
+# Untraced sentinel for int32 stamp fields (slot mirrors, shm fields,
+# Block.trace_ms when a run traces only a sampled fraction).
+UNTRACED = -1
+_WRAP = 2 ** 31
+
+
+def now_ms() -> int:
+    """Wall-clock milliseconds mod 2^31 (int32-safe; see module doc)."""
+    return int(time.time() * 1e3) % _WRAP
+
+
+def hop_ms(start_ms: int, end_ms: int) -> Optional[float]:
+    """Latency between two mod-2^31 stamps; None when either side is
+    untraced. The mod-difference keeps a wrap mid-hop non-negative."""
+    if start_ms < 0 or end_ms < 0:
+        return None
+    return float((end_ms - start_ms) % _WRAP)
+
+
+def new_request_trace(req_id: int) -> dict:
+    """The serving-side trace payload attached to a sampled Request."""
+    return {"id": int(req_id), "t_submit_wall": time.time()}
+
+
+class _Hist:
+    """One hop's thread-safe 64-bucket log histogram (ms-domain values
+    observed as seconds into the shared layout, so ``summarize`` reports
+    the usual p50/p95/p99 in ms)."""
+
+    __slots__ = ("_lock", "counts")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = np.zeros(NBUCKETS, np.int64)
+
+    def observe_ms(self, ms: float) -> None:
+        i = bucket_index(ms / 1e3)
+        with self._lock:
+            self.counts[i] += 1
+
+    def take(self) -> np.ndarray:
+        with self._lock:
+            out = self.counts.copy()
+            self.counts[:] = 0
+        return out
+
+
+# Experience-path hops, in pipeline order. ``e2e`` is emit->train — the
+# acceptance criterion's env-step->gradient latency.
+EXPERIENCE_HOPS = ("emit_to_ingest", "ingest_to_sample", "sample_to_train")
+# Serving-path hops: client submit->send (client-side routing/queueing),
+# send->server receive (wire transit), receive->dispatch (micro-batch
+# fill wait), the jitted forward, and the reply scatter+send.
+SERVE_HOPS = ("route", "transit", "queue_wait", "forward", "reply")
+
+
+class ExperienceTrace:
+    """Learner-side aggregator for the experience lineage path. Fed at
+    sample time with the (emit_ms, ingest_ms) pairs the service looked
+    up for the drawn batch, and at train-consumption time with the
+    sample tokens; consumed once per record by ``interval_block``."""
+
+    def __init__(self, sample_every: int = 1):
+        self.sample_every = max(int(sample_every), 1)
+        self._hops = {name: _Hist() for name in EXPERIENCE_HOPS}
+        self._e2e = _Hist()
+        self._lock = threading.Lock()
+        self._sampled = 0
+
+    def on_sample(self, pairs: Sequence[Tuple[int, int]]
+                  ) -> Optional[List[int]]:
+        """Record emit->ingest and ingest->sample for every traced row
+        of one sampled batch; returns the emit stamps as the token the
+        train-consumption hook closes out (None when nothing was
+        traced, so untraced batches cost one truthiness check)."""
+        if not pairs:
+            return None
+        sample_ms = now_ms()
+        emits: List[int] = []
+        for emit_ms, ingest_ms in pairs:
+            d = hop_ms(emit_ms, ingest_ms)
+            if d is not None:
+                self._hops["emit_to_ingest"].observe_ms(d)
+            d = hop_ms(ingest_ms, sample_ms)
+            if d is not None:
+                self._hops["ingest_to_sample"].observe_ms(d)
+            if emit_ms >= 0:
+                emits.append(int(emit_ms))
+        with self._lock:
+            self._sampled += len(pairs)
+        return [sample_ms] + emits if emits else None
+
+    def on_train(self, token: Optional[List[int]]) -> None:
+        """Close out one batch's traced rows at train consumption:
+        sample->train for the batch, emit->train (e2e) per row."""
+        if not token:
+            return
+        train_ms = now_ms()
+        sample_ms, emits = token[0], token[1:]
+        d = hop_ms(sample_ms, train_ms)
+        if d is not None:
+            self._hops["sample_to_train"].observe_ms(d)
+        for emit_ms in emits:
+            d = hop_ms(emit_ms, train_ms)
+            if d is not None:
+                self._e2e.observe_ms(d)
+
+    def interval_block(self) -> Optional[dict]:
+        """The periodic record's ``trace`` block; consumes the interval
+        (the TrainMetrics provider contract). None when the interval
+        traced nothing — the key is then omitted."""
+        e2e = summarize(self._e2e.take())
+        hops = {}
+        for name in EXPERIENCE_HOPS:
+            s = summarize(self._hops[name].take())
+            if s is not None:
+                hops[name] = s
+        with self._lock:
+            sampled = self._sampled
+            self._sampled = 0
+        if e2e is None and not hops and sampled == 0:
+            return None
+        block: dict = {"sampled": sampled}
+        if e2e is not None:
+            block["e2e_experience_latency"] = e2e
+        if hops:
+            block["hops"] = hops
+        return block
+
+
+class ServeTrace:
+    """Server-side aggregator for the serving request path. Attached to
+    ``ServingStats`` (``stats.trace``) when tracing is on; the serving
+    record block then carries a ``trace`` sub-block — absent it, the
+    block is byte-identical to the untraced schema."""
+
+    def __init__(self):
+        self._hops = {name: _Hist() for name in SERVE_HOPS}
+        self._lock = threading.Lock()
+        self._requests = 0
+
+    def on_request(self, trace: dict, queue_wait_s: float) -> None:
+        """Per traced request at dispatch: client-side route hop
+        (submit->send), wire transit (send->receive), and the
+        micro-batch fill wait (receive->dispatch, measured on the
+        server's monotonic clock — exact, no cross-process skew)."""
+        t_submit = trace.get("t_submit_wall")
+        t_send = trace.get("t_send_wall")
+        t_recv = trace.get("t_recv_wall")
+        if t_submit is not None and t_send is not None:
+            self._hops["route"].observe_ms(max(t_send - t_submit, 0.0) * 1e3)
+        start = t_send if t_send is not None else t_submit
+        if start is not None and t_recv is not None:
+            self._hops["transit"].observe_ms(max(t_recv - start, 0.0) * 1e3)
+        self._hops["queue_wait"].observe_ms(max(queue_wait_s, 0.0) * 1e3)
+        with self._lock:
+            self._requests += 1
+
+    def on_batch(self, forward_s: float, reply_s: float) -> None:
+        """Per dispatched batch containing >= 1 traced request."""
+        self._hops["forward"].observe_ms(max(forward_s, 0.0) * 1e3)
+        self._hops["reply"].observe_ms(max(reply_s, 0.0) * 1e3)
+
+    def interval_block(self) -> Optional[dict]:
+        hops = {}
+        for name in SERVE_HOPS:
+            s = summarize(self._hops[name].take())
+            if s is not None:
+                hops[name] = s
+        with self._lock:
+            requests = self._requests
+            self._requests = 0
+        if not hops and requests == 0:
+            return None
+        return {"requests": requests, "hops": hops}
+
+
+def proc_header(plane: str, lease: Optional[int] = None) -> dict:
+    """Process-identity header + clock anchor for a per-process metrics
+    row (ISSUE 19 satellite: cli/serve.py / fleet/service_main.py rows).
+    The wall/mono pair is the PR-11 ``clock_anchor`` generalized to
+    non-rank processes: the tower join and the Perfetto merge align
+    streams on it without assuming a shared monotonic clock."""
+    import os
+    head = {"plane": plane, "pid": os.getpid(),
+            "clock_anchor": {"wall": time.time(),
+                             "mono": time.monotonic()}}
+    if lease is not None:
+        head["lease"] = int(lease)
+    return head

@@ -34,13 +34,16 @@ Phases:
      groups acting into local replay shards alongside the dp-sharded
      learner step — with per-arm medians and the env/learner scaling
      ratios in one artifact (``E2E_r12.json``).
-  5. **Telemetry / learning / resources A/Bs** (``--telemetry-ab`` /
-     ``--learning-ab`` / ``--resources-ab``): the same e2e system with the
-     respective kill switch on vs off — the < 2% overhead budgets for the
-     PR-4 stage telemetry, the PR-5 fused learning diagnostics
-     (histograms, staleness, ΔQ cadence), and the PR-7 machine-side
-     pillar (memory sampling, RSS/CPU gauges, compile/retrace capture,
-     the per-record alert pass).
+  5. **Telemetry / learning / resources / tracing A/Bs**
+     (``--telemetry-ab`` / ``--learning-ab`` / ``--resources-ab`` /
+     ``--tracing-ab``): the same e2e system with the respective kill
+     switch on vs off — the < 2% overhead budgets for the PR-4 stage
+     telemetry, the PR-5 fused learning diagnostics (histograms,
+     staleness, ΔQ cadence), the PR-7 machine-side pillar (memory
+     sampling, RSS/CPU gauges, compile/retrace capture, the per-record
+     alert pass), and the PR-19 cross-plane experience lineage (sampled
+     ``Block.trace_ms`` stamps, ring mirrors, the env-step→gradient
+     latency block).
   6. **Fleet A/B** (``--fleet-ab``): the lockstep multihost trainer (one
      controller over an emulated dp mesh) with ``telemetry.fleet_enabled``
      on vs off — the widened psum gauges, per-iteration lockstep timing,
@@ -295,6 +298,24 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         else:
             replay_service.update(
                 {k: v for k, v in fb.items() if v is not None})
+    # experience-lineage evidence (ISSUE 19): sampled COUNTS accumulate
+    # across records (each interval_block consumes its interval, so
+    # last-wins would erase the run's tally); the latency histograms
+    # take the newest non-null summary. None on every run with
+    # tracing_enabled off (the key-absence contract).
+    trace = None
+    for r in records:
+        tb = r.get("trace")
+        if not tb:
+            continue
+        if trace is None:
+            trace = dict(tb)
+            continue
+        for k, v in tb.items():
+            if k == "sampled":
+                trace[k] = (trace.get(k) or 0) + (v or 0)
+            elif v is not None:
+                trace[k] = v
     # crash-recovery evidence (ISSUE 18): the newest recovery block —
     # its snapshot counters are cumulative, so last-wins is exact; None
     # on every run with the snapshot plane off (the key-absence
@@ -338,6 +359,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "anakin": anakin,
         "serving": serving,
         "quant": quant,
+        "trace": trace,
         "replay_service": replay_service,
         "recovery": recovery,
         "resources": resources,
@@ -584,6 +606,82 @@ def run_recovery_ab(seconds: float, envs_per_actor: int, num_actors: int,
         out["snapshot_write_s"] = (rb.get("snapshot") or {}).get("write_s")
     out["recovery_block_off"] = any(
         c.get("recovery") for c in cells["recovery_off"])
+    return out
+
+
+def run_tracing_ab(seconds: float, envs_per_actor: int, num_actors: int,
+                   overrides: Optional[dict] = None,
+                   repeats: int = 2) -> dict:
+    """Cross-plane tracing overhead A/B (ISSUE 19 acceptance): the SAME
+    e2e system with ``telemetry.tracing_enabled`` on vs off, in one
+    artifact. Budget under test: the lineage path — the per-emission
+    sampled stamp on ``Block.trace_ms``, the strip-before-device-commit
+    + ring-mirror bookkeeping inside the ingest path, the sample-time
+    slot lookup, and the per-record ``trace`` block assembly — costs
+    <= 2%% on BOTH env-steps/s and learner updates/s. Cells run
+    ABBA-interleaved ``repeats`` times with per-arm medians (the
+    serve/fleet-AB noise treatment; single cells swing ±10%% on the
+    2-core host). The ON cells carry the ``trace`` block (sampled rows,
+    the env-step->gradient e2e histogram, per-hop breakdown) as
+    end-to-end evidence; the OFF cells prove the records carried no
+    ``trace`` key at all (the kill-switch schema contract)."""
+    cells = {"tracing_off": [], "tracing_on": []}
+    for rep in range(max(repeats, 1)):
+        order = (("tracing_off", False), ("tracing_on", True))
+        if rep % 2:
+            order = order[::-1]    # ABBA: cancel monotonic host drift
+        for label, on in order:
+            ov = dict(overrides or {})
+            ov["telemetry.tracing_enabled"] = on
+            # trace a denser fraction than the production default so the
+            # short window accumulates real histograms — stamping MORE
+            # blocks bounds the per-emission overhead from above
+            ov.setdefault("telemetry.trace_sample_every", 4)
+            # lineage lives on the replay-service path (the ring-mirror
+            # bookkeeping under test); BOTH arms run it so the A/B
+            # isolates tracing, not the service plane itself
+            ov.setdefault("fleet.replay_shards", 1)
+            cells[label].append(run_e2e(seconds, envs_per_actor,
+                                        num_actors, overrides=ov))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"tracing_off": cells["tracing_off"][-1],
+           "tracing_on": cells["tracing_on"][-1],
+           "repeats": max(repeats, 1),
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("tracing_off", "env_steps_per_sec") > 0:
+        ratio = (med("tracing_on", "env_steps_per_sec")
+                 / med("tracing_off", "env_steps_per_sec"))
+        out["env_steps_ratio"] = round(ratio, 3)
+        out["overhead_pct"] = round((1.0 - ratio) * 100.0, 2)
+    if med("tracing_off", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio"] = round(
+            med("tracing_on", "learner_steps_per_sec")
+            / med("tracing_off", "learner_steps_per_sec"), 3)
+    # evidence: merge the ON cells' trace blocks (counts sum, hop
+    # summaries newest-non-null — the run_e2e merge semantics again)
+    tb = {}
+    for c in cells["tracing_on"]:
+        for k, v in (c.get("trace") or {}).items():
+            if k == "sampled":
+                tb[k] = (tb.get(k) or 0) + (v or 0)
+            elif v is not None:
+                tb[k] = v
+    out["trace_block_on"] = bool(tb)
+    out["traced_rows_on"] = tb.get("sampled")
+    e2e = tb.get("e2e_experience_latency") or {}
+    out["e2e_latency_p50_ms"] = e2e.get("p50_ms")
+    out["e2e_latency_p95_ms"] = e2e.get("p95_ms")
+    out["hops_on"] = sorted((tb.get("hops") or {}).keys())
+    out["trace_block_off"] = any(
+        c.get("trace") for c in cells["tracing_off"])
     return out
 
 
@@ -2051,6 +2149,16 @@ def main(argv=None) -> int:
                         "loss window the kill drills assert; the write "
                         "duty cycle, not the on-path capture, is the "
                         "cost, so overhead scales ~1/interval)")
+    p.add_argument("--tracing-ab", type=int, default=0,
+                   help="1: run the e2e phase as the cross-plane tracing "
+                        "on/off A/B instead (ISSUE 19: "
+                        "telemetry.tracing_enabled; budget <= 2%% on "
+                        "env-steps/s AND learner updates/s; ABBA-"
+                        "interleaved repeats with per-arm medians; the "
+                        "ON cells carry the 'trace' block — sampled "
+                        "rows, the env-step->gradient e2e latency "
+                        "histogram, per-hop breakdown — as end-to-end "
+                        "evidence; one artifact, E2E_r21.json)")
     p.add_argument("--resources-ab", type=int, default=0,
                    help="1: run the e2e phase as a resource/compile/alerts "
                         "on/off A/B instead (telemetry.resources_enabled; "
@@ -2144,6 +2252,10 @@ def main(argv=None) -> int:
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,
                 overrides=overrides, repeats=args.ab_repeats,
                 snapshot_interval=args.snapshot_interval)
+        elif args.tracing_ab:
+            out["e2e_tracing_ab"] = run_tracing_ab(
+                args.e2e_seconds, args.envs_per_actor, args.num_actors,
+                overrides=overrides, repeats=args.ab_repeats)
         elif args.resources_ab:
             out["e2e_resources_ab"] = run_resources_ab(
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,

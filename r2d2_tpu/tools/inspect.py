@@ -25,7 +25,13 @@ run it in a second terminal against a live soak. Export mode
 (``--export-trace out.json``) merges every spans file into ONE
 Chrome-trace JSON (each process a pid row, each thread a tid track) that
 loads in Perfetto / chrome://tracing, viewable alongside the xprof
-capture ``runtime.profile_at_step`` or SIGUSR2 triggered.
+capture ``runtime.profile_at_step`` or SIGUSR2 triggered. The merge
+spans every PLANE of a disaggregated run (ISSUE 19): learner + actor
+spans, the policy server's ``spans_serve.jsonl``, and a standalone
+ReplayService's ``spans_replay_service.jsonl`` land on one timeline,
+aligned per the clock anchors their processes stamped at lease
+announcement (``plane_clock_offsets``; cross-host rank spans keep the
+PR-12 host-anchor shift).
 
     python -m r2d2_tpu.tools.inspect --dir models               # once
     python -m r2d2_tpu.tools.inspect --dir models --follow      # live
@@ -123,6 +129,10 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None,
         # the dtype + live agreement gauge get their own line
         lines.append("")
         lines.append(render_quant(qb))
+    tb = record.get("trace")
+    if tb:
+        lines.append("")
+        lines.append(render_trace(tb))
     rb = record.get("resources")
     if rb:
         lines.append("")
@@ -514,6 +524,30 @@ def render_learning(lb: dict) -> str:
     return "\n".join(lines)
 
 
+def render_trace(tb: dict) -> str:
+    """The cross-plane tracing panel (ISSUE 19): the end-to-end
+    env-step -> gradient latency of the interval's lineage-stamped
+    blocks, broken down per pipeline hop — the record's ``trace``
+    block."""
+    e2e = tb.get("e2e_experience_latency") or {}
+    head = f"trace: {tb.get('sampled', 0)} sampled row(s)"
+    if e2e.get("p50_ms") is not None:
+        head += (f"  e2e env-step->gradient ms: p50={e2e['p50_ms']:.0f} "
+                 f"p95={e2e['p95_ms']:.0f} p99={e2e['p99_ms']:.0f}")
+    lines = [head]
+    hops = tb.get("hops") or {}
+    if hops:
+        bits = []
+        for name in ("emit_to_ingest", "ingest_to_sample",
+                     "sample_to_train"):
+            h = hops.get(name)
+            if h and h.get("p50_ms") is not None:
+                bits.append(f"{name}={h['p50_ms']:.0f}ms")
+        if bits:
+            lines.append("  hops p50: " + " ".join(bits))
+    return "\n".join(lines)
+
+
 def render_resources(rb: dict) -> str:
     """The machine-side panel (ISSUE 7): per-device HBM + headroom, host
     RSS/CPU, the buffer-attribution table, and the compile/retrace
@@ -659,6 +693,27 @@ def fleet_clock_offsets(run_dir: str):
     return offsets, actors_per_rank
 
 
+def plane_clock_offsets(run_dir: str) -> dict:
+    """Per-PLANE clock offsets (ISSUE 19), generalizing the per-rank
+    anchors: serve / replay-service processes stamp a ``proc`` header
+    (plane, pid, wall/mono anchor) on their periodic rows, and a
+    standalone ReplayService exchanges anchors with the lease board at
+    announcement — its ``offset_est`` (seconds its wall clock runs
+    AHEAD of the learner plane's, good to ±RTT/2) is what aligns its
+    spans here. Planes without an exchange anchor at 0 (same-host wall
+    clocks). Returns ``{spans-file basename: offset_seconds}``."""
+    offsets = {}
+    for name, pattern in (("spans_serve.jsonl", "serve_metrics.jsonl"),
+                          ("spans_replay_service.jsonl",
+                           "service_metrics_p*.jsonl")):
+        for path in glob.glob(os.path.join(run_dir, pattern)):
+            row = read_last_jsonl_row(path)
+            anchor = ((row or {}).get("proc") or {}).get("clock_anchor")
+            if anchor is not None:
+                offsets[name] = float(anchor.get("offset_est") or 0.0)
+    return offsets
+
+
 def _span_file_rank(path: str, actors_per_rank) -> Optional[int]:
     """Which rank produced a spans file: host files carry it in the
     name; actor files carry the GLOBAL worker index, which maps back via
@@ -683,6 +738,7 @@ def export_chrome_trace(run_dir: str, out_path: str) -> int:
     per process."""
     from r2d2_tpu.telemetry import chrome_trace_events
     offsets, actors_per_rank = fleet_clock_offsets(run_dir)
+    plane_offsets = plane_clock_offsets(run_dir)
     events = []
     n = 0
     for pid_index, path in enumerate(
@@ -691,6 +747,9 @@ def export_chrome_trace(run_dir: str, out_path: str) -> int:
         n += len(spans)
         rank = _span_file_rank(path, actors_per_rank)
         shift = offsets.get(rank, 0.0) if rank is not None else 0.0
+        # ISSUE 19: serve / replay-service plane spans align on the
+        # anchor their process exchanged at lease announcement
+        shift += plane_offsets.get(os.path.basename(path), 0.0)
         if shift:
             spans = [{**ev, "ts": ev["ts"] - shift} for ev in spans]
         pid = (spans[0].get("pid") if spans else None) or \

@@ -30,6 +30,14 @@ rank's ``alerts_host{r}.jsonl``) — no re-evaluation, just the firing
 log with the same crit exit code, for triaging a rank whose metrics
 stream rotated away.
 
+Per-process plane streams (ISSUE 19): ``--stream PATH`` points the
+engine at an arbitrary metrics-format JSONL — the serving fleet's
+``serve_metrics.jsonl`` or a standalone ReplayService's
+``service_metrics_p{p}.jsonl`` — replayed or tailed (``--follow``)
+exactly like the player stream; their ``serving`` / ``replay_service``
+blocks sit at the same record paths, so the plane rules evaluate
+unchanged.
+
     python -m r2d2_tpu.tools.sentinel --dir models                # replay
     python -m r2d2_tpu.tools.sentinel --dir models --follow       # live
     python -m r2d2_tpu.tools.sentinel --dir models --host-rank 1
@@ -169,6 +177,15 @@ def main(argv=None) -> int:
                    help="evaluate a rank's telemetry_host{R}.jsonl host-row "
                         "stream instead of the player metrics stream "
                         "(replay and --follow both work)")
+    p.add_argument("--stream", default="",
+                   help="replay/tail an ARBITRARY metrics-format JSONL "
+                        "through the engine instead of the player stream "
+                        "— the per-process rows the serve fleet "
+                        "(serve_metrics.jsonl) and a standalone "
+                        "ReplayService (service_metrics_p{p}.jsonl) "
+                        "write (ISSUE 19); their blocks sit at the same "
+                        "record paths, so the serving / replay_service "
+                        "rules evaluate unchanged")
     p.add_argument("--alerts-stream", default="",
                    help="replay/tail an existing alerts JSONL "
                         "(alerts_player{p}.jsonl or alerts_host{r}.jsonl) "
@@ -200,7 +217,9 @@ def main(argv=None) -> int:
         return replay_alerts_stream(args.alerts_stream, args.follow,
                                     args.interval)
 
-    if args.host_rank is not None:
+    if args.stream:
+        path = args.stream
+    elif args.host_rank is not None:
         path = os.path.join(args.dir,
                             f"telemetry_host{args.host_rank}.jsonl")
     else:

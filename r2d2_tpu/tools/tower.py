@@ -1,0 +1,122 @@
+"""Fleet control tower CLI: the cross-plane dashboard + offline replay
+over a run directory's per-process streams (ISSUE 19).
+
+The per-plane tools each watch ONE stream — ``tools/inspect.py`` the
+learner record, ``tools/sentinel.py`` whichever JSONL it is pointed at.
+This tool watches the FLEET: it joins the newest row of every stream the
+run directory carries (learner records, serving-fleet rows, standalone
+ReplayService rows, multihost host rows, every alerts log) into one
+joined record (:class:`~r2d2_tpu.telemetry.tower.TowerCollector`),
+derives the cross-plane signals (end-to-end experience latency, the
+shed-while-backlog correlation, spill promotion latency, plane
+staleness) and runs the tower rule set over each join.
+
+Modes, on the sentinel pattern:
+
+  * **offline replay** (default): walk the full stream histories
+    index-aligned (every plane logs on the same ``runtime.log_interval``
+    cadence), evaluate every joined record, print the firings. Exit
+    code 1 when any ``crit`` tower rule fired — a soak wrapper gates on
+    it exactly like the per-stream sentinel.
+  * **live watch** (``--follow``): redraw one dashboard frame per poll
+    over the newest join, the ``tools/inspect.py --follow`` treatment
+    widened to every plane.
+
+Honors the ``telemetry.tower_enabled`` kill switch (exit 0, no reads,
+when off — override per ``--override telemetry.tower_enabled=true``).
+Firings can append to a JSONL via ``--out`` for the paper trail.
+
+    python -m r2d2_tpu.tools.tower --dir models              # replay
+    python -m r2d2_tpu.tools.tower --dir models --follow     # live
+    python -m r2d2_tpu.tools.tower --rules                   # rule table
+"""
+
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.telemetry.tower import (TowerCollector, render_tower,
+                                          tower_rules)
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default="models",
+                   help="the run's save_dir (all plane streams live "
+                        "there)")
+    p.add_argument("--follow", action="store_true",
+                   help="live dashboard: redraw one joined frame per "
+                        "poll instead of replaying the histories")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="poll cadence in follow mode")
+    p.add_argument("--out", default="",
+                   help="also append tower firings to this JSONL "
+                        "(existing history is kept)")
+    p.add_argument("--rules", action="store_true",
+                   help="print the effective tower rule table and exit")
+    p.add_argument("--override", action="append", default=[],
+                   help="dotted config override key=value (repeatable), "
+                        "e.g. telemetry.alerts_e2e_latency_growth=2")
+    args = p.parse_args(argv)
+
+    overrides = {}
+    for ov in args.override:
+        k, _, v = ov.partition("=")
+        try:
+            overrides[k] = json.loads(v)
+        except (json.JSONDecodeError, ValueError):
+            overrides[k] = v
+    cfg = Config().replace(**overrides)
+
+    if args.rules:
+        print(f"{'rule':<32}{'kind':<11}{'severity':<9}{'bound':>10}  path")
+        for r in tower_rules(cfg):
+            print(f"{r.name:<32}{r.kind:<11}{r.severity:<9}"
+                  f"{r.bound:>10}  {'.'.join(r.path)}")
+        return 0
+
+    if not (cfg.telemetry.enabled and cfg.telemetry.tower_enabled):
+        print("tower disabled (telemetry.tower_enabled=false)")
+        return 0
+
+    collector = TowerCollector(args.dir, cfg,
+                               jsonl_path=args.out or None)
+
+    if not args.follow:
+        records = collector.replay()
+        if not records:
+            print(f"no plane streams under {args.dir!r}", file=sys.stderr)
+            return 2
+        fired = crit = 0
+        for i, rec in enumerate(records):
+            for a in rec["alerts"]["fired"]:
+                fired += 1
+                if a.get("severity") == "crit":
+                    crit += 1
+                print(f"join#{i:>4} "
+                      f"{a.get('severity', '?'):>4} {a['rule']}"
+                      + (f" value={a['value']:.4g}"
+                         if a.get("value") is not None else "")
+                      + (f" baseline={a['baseline']:.4g}"
+                         if a.get("baseline") is not None else ""))
+        print(f"-- {len(records)} joined record(s), {fired} tower "
+              f"alert(s) ({crit} crit)")
+        print(render_tower(records[-1]))
+        return 1 if crit else 0
+
+    while True:
+        record = collector.snapshot()
+        frame = render_tower(record)
+        if sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+        print(f"== control tower: {args.dir} "
+              f"(t_wall={record['t_wall']:.0f}) ==")
+        print(frame, flush=True)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

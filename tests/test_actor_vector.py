@@ -276,6 +276,11 @@ def test_vector_loop_n1_matches_scalar_loop_blocks():
                     "num_sequences"}
     for a, b in zip(scalar_blocks, blocks):
         for f in dataclasses.fields(a):
+            if getattr(a, f.name) is None or getattr(b, f.name) is None:
+                # trailing-defaulted leaves (trace_ms): absent on both
+                # streams in an untraced run — that IS the parity
+                assert getattr(a, f.name) is getattr(b, f.name), f.name
+                continue
             x = np.asarray(getattr(a, f.name))
             y = np.asarray(getattr(b, f.name))
             if f.name in exact_fields:

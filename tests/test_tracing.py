@@ -1,0 +1,653 @@
+"""Cross-plane distributed tracing + control tower (ISSUE 19): hop-stamp
+propagation on the serving path (in-proc / shm / socket), experience
+lineage from emission through ring wrap, spill demote/promote and
+snapshot restore, the record's ``trace`` block + kill-switch schema
+identity, the per-tier replay telemetry (ROADMAP 4d), and the tower's
+cross-plane join, derived signals, rule set, CLI, and Perfetto merge."""
+
+import json
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config
+from tests.test_replay import _fill_blocks, make_spec
+from tests.test_serve import (_native_available, rand_obs, small_cfg,
+                              tiny_net)
+from tests.test_telemetry import PR23_RECORD_KEYS
+
+
+def _stamp(block, ms):
+    return block.replace(trace_ms=np.asarray(ms, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# primitives: stamps, hops, interval aggregators
+
+
+def test_now_ms_hop_ms_wrap_and_untraced():
+    from r2d2_tpu.telemetry.tracing import UNTRACED, hop_ms, now_ms
+    t = now_ms()
+    assert 0 <= t < 2 ** 31
+    assert hop_ms(100, 350) == 250.0
+    # a wrap mid-hop stays non-negative (mod-2^31 difference)
+    assert hop_ms(2 ** 31 - 5, 3) == 8.0
+    assert hop_ms(UNTRACED, t) is None
+    assert hop_ms(t, UNTRACED) is None
+
+
+def test_request_trace_and_proc_header_shape():
+    from r2d2_tpu.telemetry.tracing import new_request_trace, proc_header
+    tr = new_request_trace(42)
+    assert tr["id"] == 42 and tr["t_submit_wall"] > 0
+    head = proc_header("serve")
+    assert head["plane"] == "serve" and head["pid"] == os.getpid()
+    assert {"wall", "mono"} <= set(head["clock_anchor"])
+    assert "lease" not in head
+    assert proc_header("replay_service", lease=7)["lease"] == 7
+
+
+def test_experience_trace_interval_semantics():
+    from r2d2_tpu.telemetry.tracing import (EXPERIENCE_HOPS,
+                                            ExperienceTrace, now_ms)
+    tr = ExperienceTrace()
+    assert tr.on_sample([]) is None
+    assert tr.interval_block() is None          # empty interval: no block
+    emit = now_ms() - 120
+    token = tr.on_sample([(emit, emit + 40), (emit, emit + 60)])
+    assert token is not None and token[1:] == [emit, emit]
+    tr.on_train(token)
+    tr.on_train(None)                           # untraced batch: no-op
+    block = tr.interval_block()
+    assert block["sampled"] == 2
+    e2e = block["e2e_experience_latency"]
+    assert e2e["count"] == 2 and e2e["p95_ms"] > 0
+    assert set(block["hops"]) == set(EXPERIENCE_HOPS)
+    # the block CONSUMES the interval (TrainMetrics provider contract)
+    assert tr.interval_block() is None
+
+
+def test_serve_trace_interval_semantics():
+    from r2d2_tpu.telemetry.tracing import SERVE_HOPS, ServeTrace
+    tr = ServeTrace()
+    assert tr.interval_block() is None
+    tr.on_request({"t_submit_wall": 5.0, "t_send_wall": 5.001,
+                   "t_recv_wall": 5.004}, queue_wait_s=0.002)
+    tr.on_batch(forward_s=0.003, reply_s=0.001)
+    block = tr.interval_block()
+    assert block["requests"] == 1
+    assert set(block["hops"]) == set(SERVE_HOPS)
+    assert block["hops"]["transit"]["count"] == 1
+    assert tr.interval_block() is None
+
+
+# ---------------------------------------------------------------------------
+# experience lineage: the Block leaf, ring mirrors, spill, snapshots
+
+
+def test_block_trace_leaf_absent_by_default(rng):
+    import jax
+    blk = _fill_blocks(make_spec(), 1, rng)[0]
+    base = jax.tree_util.tree_leaves(blk)
+    stamped = _stamp(blk, 1234)
+    assert len(jax.tree_util.tree_leaves(stamped)) == len(base) + 1
+    # stripping restores the EXACT untraced structure (wire identity)
+    stripped = stamped.replace(trace_ms=None)
+    assert (jax.tree_util.tree_structure(stripped)
+            == jax.tree_util.tree_structure(blk))
+
+
+def test_wire_frame_fields_omit_untraced(rng):
+    from r2d2_tpu.fleet.replay_service import _block_fields
+    blk = _fill_blocks(make_spec(), 1, rng)[0]
+    assert "trace_ms" not in _block_fields(blk)
+    fields = _block_fields(_stamp(blk, 77))
+    assert int(fields["trace_ms"]) == 77
+
+
+def test_shard_add_strips_stamp_and_mirrors_it(rng):
+    import jax
+    from r2d2_tpu.fleet.replay_service import ReplayShard
+    spec = make_spec()
+    blk = _fill_blocks(spec, 1, rng)[0]
+    traced, plain = ReplayShard(spec, 0), ReplayShard(spec, 0)
+    slot = traced.add(_stamp(blk, 9001))
+    plain.add(blk)
+    # device state is BIT-IDENTICAL to the untraced add: the stamp
+    # never reaches the jitted ring
+    for a, b in zip(jax.tree_util.tree_leaves(traced.state),
+                    jax.tree_util.tree_leaves(plain.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert traced.ring.slot_trace[slot] == 9001
+    assert traced.ring.slot_ingest_ms[slot] >= 0
+    assert plain.ring.slot_trace[slot] == -1
+    assert plain.ring.slot_ingest_ms[slot] == -1
+
+
+def test_lineage_through_ring_wrap(rng):
+    from r2d2_tpu.fleet.replay_service import ReplayShard
+    spec = make_spec()
+    shard = ReplayShard(spec, 0)
+    blk = _fill_blocks(spec, 1, rng)[0]
+    for i in range(2 * spec.num_blocks):
+        shard.add(_stamp(blk, 3000 + i))
+    # the ring wrapped once: every slot mirrors its SECOND occupant
+    assert (set(shard.ring.slot_trace)
+            == {3000 + i for i in range(spec.num_blocks, 2 * spec.num_blocks)})
+
+
+def test_trace_lookup_filters_untraced_rows(rng):
+    import jax
+    from r2d2_tpu.fleet.replay_service import ReplayService
+    spec = make_spec()
+    svc = ReplayService(spec, 1)
+    for i, blk in enumerate(_fill_blocks(spec, spec.num_blocks, rng)):
+        svc.add_block(_stamp(blk, 100 + i) if i % 2 == 0 else blk)
+    batch, shard, _snap = svc.sample(jax.random.PRNGKey(0))
+    pairs = svc.trace_lookup(shard, np.asarray(batch.idxes))
+    even = {100 + i for i in range(0, spec.num_blocks, 2)}
+    assert pairs, "a full ring with half its slots traced must yield pairs"
+    for emit, ingest in pairs:
+        assert emit in even and ingest >= 0
+
+
+def test_lineage_rides_spill_demote_promote(rng):
+    from r2d2_tpu.fleet.replay_service import ReplayShard
+    spec = make_spec()
+    shard = ReplayShard(spec, 0, spill_blocks=4)
+    blk = _fill_blocks(spec, 1, rng)[0]
+    for i in range(spec.num_blocks):
+        shard.add(_stamp(blk, 500 + i))
+    for i in range(2):                     # overwrites demote slots 0, 1
+        shard.add(_stamp(blk, 600 + i))
+    assert shard.spill.occupancy == 2
+    assert 500 not in shard.ring.slot_trace
+    assert shard.promote(2) == 2
+    # the promoted pages re-enter the ring carrying their ORIGINAL emit
+    # stamp (the retained block rides demote -> promote intact)
+    assert {500, 501} <= set(shard.ring.slot_trace)
+
+
+def test_lineage_survives_snapshot_restore(rng):
+    from r2d2_tpu.fleet.replay_service import ReplayService
+    spec = make_spec()
+    svc = ReplayService(spec, 2)
+    for i, blk in enumerate(_fill_blocks(spec, 6, rng)):
+        svc.add_block(_stamp(blk, 800 + i))
+    snap = svc.snapshot_state(step=3)
+    restored = ReplayService(spec, 2)
+    restored.restore_state(snap)
+    for a, b in zip(svc.shards, restored.shards):
+        assert list(a.ring.slot_trace) == list(b.ring.slot_trace)
+        assert list(a.ring.slot_ingest_ms) == list(b.ring.slot_ingest_ms)
+
+
+def test_experience_trace_end_to_end_via_service(rng):
+    import jax
+    from r2d2_tpu.telemetry.tracing import (EXPERIENCE_HOPS,
+                                            ExperienceTrace, now_ms)
+    from r2d2_tpu.fleet.replay_service import ReplayService
+    spec = make_spec()
+    svc = ReplayService(spec, 1)
+    emit = now_ms()
+    for blk in _fill_blocks(spec, spec.num_blocks, rng):
+        svc.add_block(_stamp(blk, emit))
+    batch, shard, _ = svc.sample(jax.random.PRNGKey(1))
+    pairs = svc.trace_lookup(shard, np.asarray(batch.idxes))
+    assert len(pairs) == spec.batch_size   # fully traced run: every row
+    tr = ExperienceTrace()
+    token = tr.on_sample(pairs)
+    tr.on_train(token)
+    block = tr.interval_block()
+    assert block["sampled"] == spec.batch_size
+    assert block["e2e_experience_latency"]["count"] == spec.batch_size
+    assert set(block["hops"]) == set(EXPERIENCE_HOPS)
+
+
+# ---------------------------------------------------------------------------
+# record schema, config knobs, in-run rules, per-tier telemetry
+
+
+def test_record_trace_block_provider_contract(tmp_path):
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+    m = TrainMetrics(0, str(tmp_path))
+    payload = {"sampled": 3,
+               "e2e_experience_latency": {"count": 3, "p50_ms": 40.0,
+                                          "p95_ms": 90.0, "p99_ms": 95.0}}
+    m.set_tracing(lambda: payload)
+    record = m.log(1.0)
+    assert record["trace"] == payload
+    m.set_tracing(lambda: None)            # quiet interval: key omitted
+    assert "trace" not in m.log(1.0)
+
+
+def test_record_schema_identical_with_tracing_off(tmp_path):
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+    record = TrainMetrics(0, str(tmp_path)).log(1.0)
+    assert PR23_RECORD_KEYS <= set(record)
+    assert "trace" not in record
+
+
+def test_config_tracing_knobs_and_validation():
+    t = Config().telemetry
+    assert t.tracing_enabled is False      # kill switch default: OFF
+    assert t.trace_sample_every == 16
+    assert t.tower_enabled is True
+    assert t.alerts_spill_promotion_ms == 60_000.0
+    assert t.alerts_e2e_latency_growth == 4.0
+    cfg = Config().replace(**{"telemetry.tracing_enabled": True,
+                              "telemetry.trace_sample_every": 4})
+    assert cfg.telemetry.tracing_enabled
+    assert cfg.telemetry.trace_sample_every == 4
+    with pytest.raises(ValueError):
+        Config().replace(**{"telemetry.trace_sample_every": 0})
+    with pytest.raises(ValueError):
+        Config().replace(**{"telemetry.alerts_e2e_latency_growth": 1.0})
+
+
+def test_in_run_tracing_alert_rules():
+    from r2d2_tpu.telemetry.alerts import AlertEngine, default_rules
+    cfg = Config().replace(**{"telemetry.alerts_window": 2})
+    engine = AlertEngine(default_rules(cfg.telemetry))
+
+    def rec(e2e, promo):
+        return {"trace": {"e2e_experience_latency": {"p95_ms": e2e}},
+                "replay_service": {"spill": {"promotion_latency":
+                                             {"p95_ms": promo}}}}
+
+    fired = engine.evaluate(rec(100.0, 70_000.0))["fired"]
+    assert any(a["rule"] == "spill_promotion_latency"
+               and a["severity"] == "warn" for a in fired)
+    engine.evaluate(rec(100.0, 1.0))       # fills the growth window
+    fired = engine.evaluate(rec(1000.0, 1.0))["fired"]
+    assert any(a["rule"] == "e2e_latency_growth" for a in fired)
+
+
+def test_tier_stats_interval_block_gated(rng):
+    from r2d2_tpu.fleet.replay_service import ReplayService
+    spec = make_spec()
+    svc = ReplayService(spec, 1, spill_blocks=4, tier_stats=True)
+    for blk in _fill_blocks(spec, spec.num_blocks + 2, rng):
+        svc.add_block(blk)
+    assert svc.shards[0].promote(1) == 1
+    spill = svc.interval_block()["spill"]
+    promo = spill["promotion_latency"]
+    assert promo is not None and promo["count"] >= 1
+    assert promo["p95_ms"] >= 0
+    tiers = spill["tiers"]
+    assert tiers["device_bytes"] > 0 and tiers["spill_page_bytes"] > 0
+    assert tiers["spill_bytes"] == (spill["occupancy"]
+                                    * tiers["spill_page_bytes"])
+    # gated OFF (the default): the PR-15 spill block is byte-identical
+    legacy = ReplayService(spec, 1, spill_blocks=4)
+    for blk in _fill_blocks(spec, 2, rng):
+        legacy.add_block(blk)
+    legacy_spill = legacy.interval_block()["spill"]
+    assert "promotion_latency" not in legacy_spill
+    assert "tiers" not in legacy_spill
+
+
+# ---------------------------------------------------------------------------
+# serving-path hop propagation: in-proc, wire identity, socket, shm
+
+
+def _traced_server(cfg=None):
+    from r2d2_tpu.serve import InprocEndpoint, PolicyServer
+    from r2d2_tpu.serve.server import ServingStats
+    from r2d2_tpu.telemetry.tracing import ServeTrace
+    cfg = cfg or small_cfg()
+    net, params = tiny_net(cfg)
+    stats = ServingStats()
+    stats.trace = ServeTrace()
+    ep = InprocEndpoint()
+    srv = PolicyServer(cfg, net, params, endpoint=ep, stats=stats).start()
+    return cfg, net, ep, srv, stats
+
+
+def test_inproc_traced_exchange_records_hops():
+    from r2d2_tpu.serve import RemotePolicy
+    from r2d2_tpu.telemetry.tracing import SERVE_HOPS
+    cfg, net, ep, srv, stats = _traced_server()
+    try:
+        remote = RemotePolicy(ep.connect(), net.action_dim, 0.0, seed=0,
+                              trace_every=1)
+        rng = np.random.default_rng(3)
+        remote.observe_reset(rand_obs(rng, cfg))
+        for _ in range(3):
+            remote.act()
+        block = stats.interval_block()
+        trace = block["trace"]
+        assert trace["requests"] >= 3
+        hops = trace["hops"]
+        assert set(hops) <= set(SERVE_HOPS)
+        # client stamps (submit/send), endpoint stamps receive, server
+        # stamps the batch: the full decomposition on one process
+        assert {"route", "transit", "queue_wait",
+                "forward", "reply"} <= set(hops)
+    finally:
+        srv.stop()
+
+
+def test_untraced_requests_and_layout_byte_identical():
+    from r2d2_tpu.serve import RemotePolicy, Request
+    from r2d2_tpu.serve.transport import request_layout
+    from r2d2_tpu.telemetry.tracing import new_request_trace
+    base = pickle.dumps(Request(client_id=1, req_id=2))
+    assert pickle.dumps(Request(client_id=1, req_id=2)) == base
+    traced = Request(client_id=1, req_id=2)
+    traced.trace = new_request_trace(2)
+    assert pickle.dumps(traced) != base    # the trace rides __dict__
+    # the shm slot layout only grows stamp fields when ASKED, at the END
+    plain = request_layout(8, 8)
+    assert plain == request_layout(8, 8, tracing=False)
+    grown = request_layout(8, 8, tracing=True)
+    assert grown[:len(plain)] == plain
+    assert [f[0] for f in grown[len(plain):]] == ["t_submit_wall",
+                                                  "t_send_wall"]
+    # client gating: trace_every=0 (the default) never attaches
+    cfg, net, ep, srv, _stats = _traced_server()
+    captured = []
+    orig, orig_many = ep.submit, ep.submit_many
+    ep.submit = lambda req, cb: (captured.append(req), orig(req, cb))[1]
+    ep.submit_many = lambda items: (
+        captured.extend(req for req, _cb in items), orig_many(items))[1]
+    try:
+        rng = np.random.default_rng(4)
+        remote = RemotePolicy(ep.connect(), net.action_dim, 0.0, seed=0)
+        remote.observe_reset(rand_obs(rng, cfg))
+        remote.act()
+        assert captured and all(not hasattr(r, "trace") for r in captured)
+        traced_remote = RemotePolicy(ep.connect(), net.action_dim, 0.0,
+                                     seed=0, client_id=1, trace_every=1)
+        traced_remote.observe_reset(rand_obs(rng, cfg))
+        traced_remote.act()
+        stamps = [r.trace for r in captured if hasattr(r, "trace")]
+        assert stamps and {"t_submit_wall", "t_send_wall",
+                           "t_recv_wall"} <= set(stamps[0])
+    finally:
+        srv.stop()
+
+
+def test_socket_transport_carries_trace():
+    from r2d2_tpu.serve import (InprocEndpoint, PolicyServer, RemotePolicy,
+                                SocketChannel, SocketServerTransport)
+    from r2d2_tpu.serve.server import ServingStats
+    from r2d2_tpu.telemetry.tracing import ServeTrace
+    cfg = small_cfg()
+    net, params = tiny_net(cfg)
+    stats = ServingStats()
+    stats.trace = ServeTrace()
+    ep = InprocEndpoint()
+    transport = SocketServerTransport(ep.submit, "127.0.0.1", 0)
+    srv = PolicyServer(cfg, net, params, endpoint=ep, stats=stats).start()
+    try:
+        channel = SocketChannel(transport.host, transport.port)
+        remote = RemotePolicy(channel, net.action_dim, 0.0, seed=0,
+                              trace_every=1)
+        rng = np.random.default_rng(5)
+        remote.observe_reset(rand_obs(rng, cfg))
+        remote.act()
+        remote.act()
+        trace = stats.interval_block()["trace"]
+        assert trace["requests"] >= 2
+        # transit = client send stamp -> server-side receive stamp,
+        # measured ACROSS the socket hop
+        assert trace["hops"]["transit"]["count"] >= 2
+        remote.close()
+    finally:
+        srv.stop()
+        transport.close()
+
+
+def test_shm_transport_carries_trace():
+    if not _native_available():
+        pytest.skip("native shm ring toolchain unavailable")
+    from r2d2_tpu.serve import (InprocEndpoint, PolicyServer, RemotePolicy,
+                                ShmServeChannel, ShmServeTransport)
+    from r2d2_tpu.serve.server import ServingStats
+    from r2d2_tpu.telemetry.tracing import ServeTrace
+    cfg = small_cfg()
+    net, params = tiny_net(cfg)
+    stats = ServingStats()
+    stats.trace = ServeTrace()
+    ep = InprocEndpoint()
+    transport = ShmServeTransport(
+        ep.submit, (cfg.env.frame_height, cfg.env.frame_width),
+        net.action_dim, cfg.network.hidden_dim, request_slots=16,
+        tracing=True)
+    srv = PolicyServer(cfg, net, params, endpoint=ep, stats=stats).start()
+    try:
+        channel = ShmServeChannel(transport.request_ring, net.action_dim,
+                                  cfg.network.hidden_dim, reply_slots=4)
+        remote = RemotePolicy(channel, net.action_dim, 0.0, seed=0,
+                              client_id=3, trace_every=1)
+        rng = np.random.default_rng(6)
+        remote.observe_reset(rand_obs(rng, cfg))
+        remote.act()
+        trace = stats.interval_block()["trace"]
+        assert trace["requests"] >= 1
+        assert trace["hops"]["transit"]["count"] >= 1
+        remote.close()
+    finally:
+        srv.stop()
+        transport.close()
+
+
+def test_shm_block_ring_traced_layout_roundtrip():
+    if not _native_available():
+        pytest.skip("native shm ring toolchain unavailable")
+    from r2d2_tpu.runtime.shm_feeder import ShmBlockRing, block_layout
+    from r2d2_tpu.telemetry.tracing import UNTRACED
+    spec = make_spec()
+    # kill switch: the traced layout only differs by the trailing field
+    plain = block_layout(spec)
+    traced = block_layout(spec, tracing=True)
+    assert traced[:-1] == plain
+    assert traced[-1][0] == "trace_ms"
+    rng = np.random.default_rng(11)
+    a, b, c = _fill_blocks(spec, 3, rng)
+    ring = ShmBlockRing(spec, maxsize=8, tracing=True)
+    try:
+        ring.put(_stamp(a, 4321), timeout=1.0)
+        ring.put(b, timeout=1.0)                 # unstamped on a traced ring
+        ring.put(_stamp(c, UNTRACED), timeout=1.0)
+        # per-block pop carries the stamp (and -1 for the unstamped put)
+        got = ring.get_nowait()
+        assert int(np.asarray(got.trace_ms)) == 4321
+        # the stager's path: one stacked drain, stamps ride the K axis
+        stacked, k = ring.drain_stacked(4)
+        assert k == 2
+        assert np.asarray(stacked.trace_ms).tolist() == [-1, UNTRACED]
+        # pickled handles re-attach with the traced layout
+        clone = pickle.loads(pickle.dumps(ring))
+        assert clone.tracing and clone.slot_bytes == ring.slot_bytes
+    finally:
+        ring.close()
+    off = ShmBlockRing(spec, maxsize=8)
+    try:
+        assert off.slot_bytes < ring.slot_bytes  # no hidden traced bytes
+        off.put(a, timeout=1.0)
+        assert off.get_nowait().trace_ms is None
+    finally:
+        off.close()
+
+
+# ---------------------------------------------------------------------------
+# the control tower: join, derived signals, rules, CLI, Perfetto merge
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def _learner_row(t=10.0, e2e_p95=250.0):
+    return {"t": t, "env_steps": 1000, "training_steps": 50,
+            "trace": {"sampled": 4,
+                      "e2e_experience_latency": {"count": 4, "p50_ms": 120.0,
+                                                 "p95_ms": e2e_p95,
+                                                 "p99_ms": 300.0}}}
+
+
+def _serve_row(t=10.0, shed=3, offset=None):
+    anchor = {"wall": 100.0, "mono": 2.0}
+    if offset is not None:
+        anchor["offset_est"] = offset
+    return {"t": t, "batches": 5,
+            "proc": {"plane": "serve", "pid": 11, "clock_anchor": anchor},
+            "serving": {"requests": 40, "admission": {"shed": shed}}}
+
+
+def _service_row(t=10.0, backlog=6, promo_p95=70_000.0, offset=0.25):
+    return {"t": t,
+            "proc": {"plane": "replay_service", "pid": 12,
+                     "clock_anchor": {"wall": 100.0, "mono": 0.5,
+                                      "offset_est": offset}},
+            "replay_service": {
+                "shards": {"n": 1, "fill_min": 0.5, "fill_max": 0.5},
+                "ingest": {"backlog": backlog},
+                "spill": {"occupancy": 2, "capacity": 4,
+                          "promotion_latency": {"count": 2, "p50_ms": 100.0,
+                                                "p95_ms": promo_p95,
+                                                "p99_ms": promo_p95}}}}
+
+
+@pytest.mark.tower
+def test_tower_rules_table():
+    from r2d2_tpu.telemetry.tower import tower_rules
+    from r2d2_tpu.tools.tower import main
+    rules = {r.name: r for r in tower_rules(Config())}
+    assert set(rules) == {"tower_e2e_latency_growth",
+                          "tower_shed_while_backlog",
+                          "tower_spill_promotion_latency",
+                          "tower_plane_silent"}
+    for r in rules.values():
+        assert r.path[0] == "derived"      # tower rules read the JOIN
+    assert rules["tower_shed_while_backlog"].severity == "crit"
+    assert rules["tower_plane_silent"].severity == "crit"
+    assert rules["tower_e2e_latency_growth"].bound == 4.0
+    assert rules["tower_spill_promotion_latency"].bound == 60_000.0
+    assert main(["--rules"]) == 0
+
+
+@pytest.mark.tower
+def test_tower_derive_and_clock_are_cross_plane():
+    from r2d2_tpu.telemetry.tower import TowerCollector
+    planes = {"learner": [_learner_row()], "serve": _serve_row(offset=-0.5),
+              "replay_service": [_service_row()], "hosts": []}
+    derived = TowerCollector.derive(planes, {"learner": 1.0, "serve": 200.0})
+    assert derived["e2e_p95_ms"] == 250.0
+    assert derived["spill_promotion_p95_ms"] == 70_000.0
+    assert derived["ingest_backlog"] == 6
+    assert derived["serve_shed"] == 3
+    assert derived["shed_while_backlog"] == 1.0
+    assert derived["stalest_plane_age_s"] == 200.0
+    clock = TowerCollector.clock(planes)
+    assert clock["offsets"] == {"serve": -0.5, "replay_service/0": 0.25}
+    assert {"serve", "replay_service/0"} <= set(clock["anchors"])
+    # one healthy plane missing its counterpart: no correlation signal
+    healthy = TowerCollector.derive({"learner": [_learner_row()],
+                                     "serve": None,
+                                     "replay_service": [], "hosts": []})
+    assert "shed_while_backlog" not in healthy
+
+
+@pytest.mark.tower
+def test_tower_snapshot_joins_streams_and_fires(tmp_path):
+    from r2d2_tpu.telemetry.tower import TowerCollector, render_tower
+    _write_jsonl(tmp_path / "metrics_player0.jsonl", [_learner_row()])
+    _write_jsonl(tmp_path / "serve_metrics.jsonl", [_serve_row()])
+    _write_jsonl(tmp_path / "service_metrics_p0.jsonl", [_service_row()])
+    collector = TowerCollector(str(tmp_path), Config())
+    record = collector.snapshot()
+    assert record["planes"]["learner"][0]["env_steps"] == 1000
+    assert record["planes"]["serve"]["batches"] == 5
+    fired = {a["rule"]: a for a in record["alerts"]["fired"]}
+    assert fired["tower_shed_while_backlog"]["severity"] == "crit"
+    assert fired["tower_spill_promotion_latency"]["severity"] == "warn"
+    assert record["clock"]["offsets"]["replay_service/0"] == 0.25
+    frame = render_tower(record)
+    assert "SHED-WHILE-BACKLOG" in frame and "clock offsets" in frame
+
+
+@pytest.mark.tower
+def test_tower_replay_index_aligns_unequal_streams(tmp_path):
+    from r2d2_tpu.telemetry.tower import TowerCollector
+    _write_jsonl(tmp_path / "metrics_player0.jsonl",
+                 [_learner_row(t=10.0 * (i + 1)) for i in range(3)])
+    _write_jsonl(tmp_path / "serve_metrics.jsonl",
+                 [_serve_row(t=10.0), _serve_row(t=20.0, shed=9)])
+    records = TowerCollector(str(tmp_path), Config()).replay()
+    assert len(records) == 3               # depth = the longest stream
+    # the shorter serve stream HOLDS its final row (its last state)
+    assert records[2]["planes"]["serve"]["serving"]["admission"]["shed"] == 9
+    assert all("alerts" in r for r in records)
+    assert records[0]["planes"]["learner"][0]["t"] == 10.0
+    assert records[2]["planes"]["learner"][0]["t"] == 30.0
+
+
+@pytest.mark.tower
+def test_tower_cli_exit_codes_and_kill_switch(tmp_path, capsys):
+    from r2d2_tpu.tools.tower import main
+    _write_jsonl(tmp_path / "metrics_player0.jsonl", [_learner_row()])
+    _write_jsonl(tmp_path / "serve_metrics.jsonl", [_serve_row()])
+    _write_jsonl(tmp_path / "service_metrics_p0.jsonl", [_service_row()])
+    # crit fired (shed-while-backlog) -> exit 1, firings printed
+    assert main(["--dir", str(tmp_path)]) == 1
+    assert "tower_shed_while_backlog" in capsys.readouterr().out
+    # kill switch: no reads, exit 0
+    assert main(["--dir", str(tmp_path), "--override",
+                 "telemetry.tower_enabled=false"]) == 0
+    assert "tower disabled" in capsys.readouterr().out
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["--dir", str(empty)]) == 2
+
+
+@pytest.mark.tower
+def test_export_trace_merges_planes_on_anchored_clocks(tmp_path):
+    from r2d2_tpu.tools.inspect import (export_chrome_trace,
+                                        plane_clock_offsets)
+    span = {"name": "work", "ts": 100.0, "dur": 0.5, "tid": "main"}
+    _write_jsonl(tmp_path / "spans_player0.jsonl",
+                 [{**span, "pid": "player0"}])
+    _write_jsonl(tmp_path / "spans_serve.jsonl", [{**span, "pid": "serve"}])
+    _write_jsonl(tmp_path / "spans_replay_service.jsonl",
+                 [{**span, "pid": "replay_service"}])
+    _write_jsonl(tmp_path / "serve_metrics.jsonl", [_serve_row(offset=0.5)])
+    _write_jsonl(tmp_path / "service_metrics_p0.jsonl",
+                 [_service_row(offset=-0.25)])
+    assert plane_clock_offsets(str(tmp_path)) == {
+        "spans_serve.jsonl": 0.5, "spans_replay_service.jsonl": -0.25}
+    out = tmp_path / "trace.json"
+    assert export_chrome_trace(str(tmp_path), str(out)) == 3
+    events = json.loads(out.read_text())["traceEvents"]
+    name_of = {ev["pid"]: ev["args"]["name"] for ev in events
+               if ev["ph"] == "M" and ev["name"] == "process_name"}
+    # ONE timeline spanning >= 3 processes (the acceptance criterion)
+    assert {"player0", "serve", "replay_service"} <= set(name_of.values())
+    ts = {name_of[ev["pid"]]: ev["ts"] for ev in events if ev["ph"] == "X"}
+    assert ts["player0"] == pytest.approx(100.0 * 1e6)
+    # each plane's spans shift onto the learner clock by its offset_est
+    assert ts["serve"] == pytest.approx((100.0 - 0.5) * 1e6)
+    assert ts["replay_service"] == pytest.approx((100.0 + 0.25) * 1e6)
+
+
+@pytest.mark.tower
+def test_sentinel_stream_replays_plane_rows(tmp_path, capsys):
+    from r2d2_tpu.tools.sentinel import main
+    path = tmp_path / "service_metrics_p0.jsonl"
+    _write_jsonl(path, [
+        {"t": 5.0 * (i + 1),
+         "replay_service": {"spill": {"promotion_latency":
+                                      {"count": 1, "p50_ms": 1.0,
+                                       "p95_ms": 70_000.0,
+                                       "p99_ms": 70_000.0}}}}
+        for i in range(2)])
+    assert main(["--stream", str(path)]) == 0     # warn fired, no crit
+    assert "spill_promotion_latency" in capsys.readouterr().out
+    assert main(["--stream", str(tmp_path / "missing.jsonl")]) == 2
