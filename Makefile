@@ -136,6 +136,17 @@
 #                join over synthesized plane streams, the derived
 #                cross-plane signals, the four tower rules, clock-
 #                anchor alignment, the offline-replay CLI).
+#   make quality — the fast-tier policy-quality suite
+#                (tests/test_quality.py: the Q-calibration join vs a
+#                per-row python reference, QualityStats interval/eval
+#                aggregation, shadow scoring that never mutates live
+#                serving state, the gated canary promotion round-trip
+#                (stage/refuse/promote/rollback + restart persistence),
+#                kill-switch record-schema stability, pre-PR20 config
+#                round-trips, the three quality alert rules + their
+#                tower twins); the promotion drill itself
+#                (tools/chaos.py --promotion) rides the e2e bench's
+#                --promotion-ab evidence cell.
 #   make regress — the regression gate: tools/regress.py compares the
 #                tree's E2E_*/BENCH_* artifacts against BASELINE.json's
 #                'bench' snapshot (per-metric noise tolerances) AND the
@@ -152,7 +163,8 @@
 
 .PHONY: t1 chaos telemetry learning anakin anakin-sharded sentinel \
 	replaydiag fleet serve quant elastic service-ingest costmodel \
-	recovery tracing tower regress costs roofline check-fast-markers
+	recovery tracing tower quality regress costs roofline \
+	check-fast-markers
 
 t1: check-fast-markers
 	bash scripts/t1.sh
@@ -221,6 +233,10 @@ tower: check-fast-markers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q \
 	    -m 'tower and not slow' -p no:cacheprovider
 
+quality: check-fast-markers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_quality.py -q \
+	    -m 'not slow' -p no:cacheprovider
+
 regress:
 	JAX_PLATFORMS=cpu python -m r2d2_tpu.tools.regress \
 	    --baseline BASELINE.json --dir .
@@ -254,7 +270,8 @@ FAST_MARKER_CHECKS := \
 	tests/test_costmodel.py:not_slow:10:cost-model \
 	tests/test_recovery.py:not_slow:18:recovery \
 	tests/test_tracing.py:not_slow:16:tracing \
-	tests/test_tracing.py:tower_and_not_slow:5:tower
+	tests/test_tracing.py:tower_and_not_slow:5:tower \
+	tests/test_quality.py:not_slow:14:quality
 
 check-fast-markers:
 	@for spec in $(FAST_MARKER_CHECKS); do \

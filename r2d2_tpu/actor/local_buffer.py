@@ -36,11 +36,15 @@ from r2d2_tpu.replay.structs import Block, ReplaySpec, empty_block_np
 
 class LocalBuffer:
     def __init__(self, spec: ReplaySpec, action_dim: int, gamma: float,
-                 priority_eta: float = 0.9):
+                 priority_eta: float = 0.9, quality_feed=None):
         self.spec = spec
         self.action_dim = action_dim
         self.gamma = gamma
         self.eta = priority_eta
+        # optional Q-calibration tap (ISSUE 20): called with the block's
+        # (size+1, A) decision-time Q-values and raw per-step rewards —
+        # the only place both exist together before shapes are fixed
+        self.quality_feed = quality_feed
         self.size = 0
 
     def __len__(self) -> int:
@@ -97,6 +101,13 @@ class LocalBuffer:
         rewards = np.asarray(self.rewards, np.float64)
         returns = n_step_return(rewards, self.gamma, spec.forward)
         actions = np.asarray(self.actions, np.int32)
+
+        if self.quality_feed is not None:
+            # telemetry must never kill an actor
+            try:
+                self.quality_feed(qval_arr, rewards)
+            except Exception:
+                pass
 
         burn_in = np.array(
             [min(s * spec.learning + self.curr_burn_in, spec.burn_in)

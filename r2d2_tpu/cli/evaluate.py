@@ -43,16 +43,43 @@ def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
                         env_sink: Optional[callable] = None,
                         serve: bool = False, serve_clients: int = 4
                         ) -> Tuple[float, int, int]:
-    """Returns (mean_return, training_steps, env_steps). ``env_sink``
-    receives the created env handle so a supervising caller can close it if
-    this evaluator is abandoned mid-rollout (--play straggler handling).
+    """Returns (mean_return, training_steps, env_steps) — the pooled view
+    of :func:`evaluate_scenarios` (kept for callers that predate the
+    per-scenario schema)."""
+    res = evaluate_scenarios(cfg, ckpt_path, rounds, testing=testing,
+                             is_host=is_host, port=port, seed=seed,
+                             env_sink=env_sink, serve=serve,
+                             serve_clients=serve_clients)
+    return res["mean_return"], res["step"], res["env_steps"]
+
+
+def evaluate_scenarios(cfg, ckpt_path: str, rounds: int, *,
+                       scenarios: Optional[List[str]] = None,
+                       testing: bool = False, is_host: bool = False,
+                       port: int = 5060, seed: int = 0,
+                       env_sink: Optional[callable] = None,
+                       serve: bool = False, serve_clients: int = 4) -> dict:
+    """Per-scenario evaluation of one checkpoint (ISSUE 20 satellite;
+    ROADMAP item 5's scenario-coverage axis shares this schema). Returns
+
+        {"scenarios": [{"scenario", "episodes", "mean_return",
+                        "min_return", "max_return"}, ...],
+         "mean_return": <episode-pooled>, "step": ..., "env_steps": ...}
+
+    ``scenarios`` names the env kinds (game names) to roll out, each for
+    ``rounds`` episodes against the same restored params; default is the
+    checkpoint's own env kind — one row. ``env_sink`` receives every
+    created env handle so a supervising caller can close it if this
+    evaluator is abandoned mid-rollout (--play straggler handling).
 
     ``serve=True`` (ISSUE 13): evaluation-as-a-service — the checkpoint's
-    params load into ONE in-proc PolicyServer and ``serve_clients``
-    concurrent evaluator threads (each with its own env + thin
-    RemotePolicy at the same test ε) split the rounds, so every policy
-    forward of the evaluation rides the micro-batcher. Greedy-ish math
-    is identical (shared forward factory, client-side ε draws)."""
+    params load into ONE in-proc PolicyServer per scenario and
+    ``serve_clients`` concurrent evaluator threads (each with its own env
+    + thin RemotePolicy at the same test ε) split the rounds, so every
+    policy forward of the evaluation rides the micro-batcher. Greedy-ish
+    math is identical (shared forward factory, client-side ε draws)."""
+    import dataclasses
+
     import jax
 
     from r2d2_tpu.actor.policy import ActorPolicy
@@ -68,33 +95,51 @@ def evaluate_checkpoint(cfg, ckpt_path: str, rounds: int, *,
     # test_epsilon, multiplayer wiring, save_dir — stay with the CLI config
     stored = load_checkpoint_config(ckpt_path)
     if stored is not None:
-        import dataclasses
         cfg = dataclasses.replace(cfg, env=stored.env, network=stored.network,
                                   sequence=stored.sequence)
-    probe_env = create_env(cfg.env, clip_rewards=False, testing=testing,
-                           is_host=is_host, port=port, seed=seed)
-    if env_sink is not None:
-        env_sink(probe_env)
-    net = NetworkApply(probe_env.action_space.n, cfg.network,
-                       cfg.env.frame_stack, cfg.env.frame_height,
-                       cfg.env.frame_width)
-    template = net.init(jax.random.PRNGKey(0))
-    restored = restore_checkpoint(ckpt_path)
-    params = jax.tree_util.tree_map(
-        lambda t, p: np.asarray(p, np.asarray(t).dtype),
-        template, restored["params"])
-    if serve:
-        returns = _serve_rollouts(cfg, net, params, probe_env, rounds,
-                                  max(serve_clients, 1), testing, seed,
-                                  env_sink)
-    else:
-        policy = ActorPolicy(net, params, cfg.runtime.test_epsilon,
-                             seed=seed)
-        returns = [rollout_episode(probe_env, policy)
-                   for _ in range(rounds)]
-    probe_env.close()
-    return (float(np.mean(returns)), int(restored.get("step", 0)),
-            int(restored.get("env_steps", 0)))
+    names = list(scenarios) if scenarios else [cfg.env.game_name]
+    rows: List[dict] = []
+    pooled: List[float] = []
+    net = params = restored = None
+    for si, name in enumerate(names):
+        scfg = (cfg if name == cfg.env.game_name else dataclasses.replace(
+            cfg, env=dataclasses.replace(cfg.env, game_name=name)))
+        env = create_env(scfg.env, clip_rewards=False, testing=testing,
+                         is_host=is_host and si == 0, port=port,
+                         seed=seed + 1000 * si)
+        if env_sink is not None:
+            env_sink(env)
+        if net is None:
+            # restore ONCE against the first scenario's action space (all
+            # scenarios share the checkpoint's head — a scenario with a
+            # different action_dim cannot be scored by these params)
+            net = NetworkApply(env.action_space.n, cfg.network,
+                               cfg.env.frame_stack, cfg.env.frame_height,
+                               cfg.env.frame_width)
+            template = net.init(jax.random.PRNGKey(0))
+            restored = restore_checkpoint(ckpt_path)
+            params = jax.tree_util.tree_map(
+                lambda t, p: np.asarray(p, np.asarray(t).dtype),
+                template, restored["params"])
+        if serve:
+            returns = _serve_rollouts(scfg, net, params, env, rounds,
+                                      max(serve_clients, 1), testing,
+                                      seed + 1000 * si, env_sink)
+        else:
+            policy = ActorPolicy(net, params, cfg.runtime.test_epsilon,
+                                 seed=seed + 1000 * si)
+            returns = [rollout_episode(env, policy)
+                       for _ in range(rounds)]
+        env.close()
+        pooled.extend(returns)
+        rows.append({"scenario": name, "episodes": len(returns),
+                     "mean_return": float(np.mean(returns)),
+                     "min_return": float(np.min(returns)),
+                     "max_return": float(np.max(returns))})
+    return {"scenarios": rows,
+            "mean_return": float(np.mean(pooled)),
+            "step": int(restored.get("step", 0)),
+            "env_steps": int(restored.get("env_steps", 0))}
 
 
 def _serve_rollouts(cfg, net, params, first_env, rounds: int, clients: int,
@@ -158,7 +203,8 @@ def _serve_rollouts(cfg, net, params, first_env, rounds: int, clients: int,
     return returns
 
 
-def _sweep_worker(cfg_dict: dict, ckpt: str, rounds: int, seed: int):
+def _sweep_worker(cfg_dict: dict, ckpt: str, rounds: int, seed: int,
+                  scenarios: Optional[List[str]] = None):
     """Checkpoint-sweep worker, run in a spawned CPU-pinned process (the
     reference's multiprocessing.Pool analog, test.py:23). Module-level so
     it pickles under the spawn start method; the platform pin must run
@@ -171,8 +217,8 @@ def _sweep_worker(cfg_dict: dict, ckpt: str, rounds: int, seed: int):
     from r2d2_tpu.utils import pin_platform
     pin_platform()
     from r2d2_tpu.config import Config
-    return evaluate_checkpoint(Config.from_dict(cfg_dict), ckpt, rounds,
-                               seed=seed)
+    return evaluate_scenarios(Config.from_dict(cfg_dict), ckpt, rounds,
+                              seed=seed, scenarios=scenarios)
 
 
 def main(argv=None) -> None:
@@ -197,6 +243,10 @@ def main(argv=None) -> None:
     p.add_argument("--serve-clients", type=int, default=4,
                    help="--serve: concurrent evaluator clients per "
                         "checkpoint")
+    p.add_argument("--scenarios", default=None,
+                   help="comma-separated env kinds (game names) to roll "
+                        "each checkpoint through — one return row per "
+                        "scenario (default: the checkpoint's own env)")
     p.add_argument("--straggler-window", type=float, default=60.0,
                    help="--play: seconds a peer evaluator may keep running "
                         "after the first one finishes before being "
@@ -332,10 +382,12 @@ def main(argv=None) -> None:
     # GIL-bound (round-3 review) — while separate processes parallelize
     # the whole rollout like the reference does. --workers 1 runs
     # in-process (no spawn/jax-import cost for small sweeps).
+    scenarios = (args.scenarios.split(",") if args.scenarios else None)
     if args.serve or args.workers <= 1 or len(ckpts) == 1:
-        results = [evaluate_checkpoint(cfg, c, args.rounds, seed=i,
-                                       serve=args.serve,
-                                       serve_clients=args.serve_clients)
+        results = [evaluate_scenarios(cfg, c, args.rounds, seed=i,
+                                      scenarios=scenarios,
+                                      serve=args.serve,
+                                      serve_clients=args.serve_clients)
                    for i, c in ckpts]
     else:
         import multiprocessing as mp
@@ -347,12 +399,22 @@ def main(argv=None) -> None:
                 mp_context=mp.get_context("spawn")) as pool:
             results = list(pool.map(
                 _sweep_worker, repeat(cfg_dict), [c for _, c in ckpts],
-                repeat(args.rounds), [i for i, _ in ckpts]))
+                repeat(args.rounds), [i for i, _ in ckpts],
+                repeat(scenarios)))
     rows = []
-    for (idx, _), (mean_ret, step, env_steps) in zip(ckpts, results):
-        rows.append((idx, step, env_steps, mean_ret))
+    for (idx, _), res in zip(ckpts, results):
+        step, env_steps = res["step"], res["env_steps"]
+        rows.append((idx, step, env_steps, res["mean_return"]))
+        # per-env-kind return rows (ISSUE 20 satellite), the pooled
+        # mean last for the curve
+        for sc in res["scenarios"]:
+            print(f"checkpoint {idx}: scenario={sc['scenario']} "
+                  f"episodes={sc['episodes']} "
+                  f"mean_return={sc['mean_return']:.2f} "
+                  f"[{sc['min_return']:.2f}, {sc['max_return']:.2f}]",
+                  flush=True)
         print(f"checkpoint {idx}: step={step} env_steps={env_steps} "
-              f"mean_return={mean_ret:.2f}", flush=True)
+              f"mean_return={res['mean_return']:.2f}", flush=True)
 
     import matplotlib
     matplotlib.use("Agg")

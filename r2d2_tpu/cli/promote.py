@@ -1,0 +1,186 @@
+"""Gated checkpoint promotion + one-command rollback (ISSUE 20;
+ROADMAP item 2d).
+
+Three modes over the ``fleet/promotion.py`` plane:
+
+  * **gate evaluation** (default): score a CANDIDATE checkpoint against
+    the LIVE one offline — per-scenario eval returns through the
+    ``cli/evaluate.py`` machinery — and apply the configured promotion
+    gates (``fleet.promotion_*``). Prints one JSON verdict; exit 0 means
+    every gate cleared (the candidate may be staged/published), exit 1
+    means refused. Shadow-divergence evidence, when available (a running
+    fleet's quality stream), is supplied via ``--shadow-divergence`` /
+    ``--shadow-requests``; without it the shadow gate fails CLOSED
+    unless ``--no-shadow-gate`` waives it (an offline gate check has no
+    mirror to sample).
+
+        python -m r2d2_tpu.cli.promote --candidate models/Fake7_player0 \\
+            --live models/Fake6_player0 --rounds 5 --no-shadow-gate
+
+  * **--rollback**: re-publish the bundle retained under
+    ``{save_dir}/promotion/`` by the last ``stage()`` — the one-command
+    rollback. The restored tree is the staged-time snapshot,
+    bit-identical by construction.
+
+  * **--status**: print the persisted promotion state (or, with
+    ``--port``, the RUNNING supervisor's live promotion block via the
+    fleet lease API).
+"""
+
+import argparse
+import json
+import sys
+
+
+def _offline_gates(args, cfg) -> int:
+    """Evaluate candidate vs live and apply the gates (no running fleet
+    required — the ledger path for a live run feeds decide() instead)."""
+    from r2d2_tpu.cli.evaluate import evaluate_scenarios
+    from r2d2_tpu.fleet.promotion import PromotionManager
+
+    scenarios = (args.scenarios.split(",") if args.scenarios else None)
+    cand = evaluate_scenarios(cfg, args.candidate, args.rounds,
+                              scenarios=scenarios, seed=cfg.runtime.seed,
+                              serve=args.serve,
+                              serve_clients=args.serve_clients)
+    live = None
+    if args.live:
+        live = evaluate_scenarios(cfg, args.live, args.rounds,
+                                  scenarios=scenarios,
+                                  seed=cfg.runtime.seed, serve=args.serve,
+                                  serve_clients=args.serve_clients)
+
+    class _NullStore:
+        publish_count = 0
+
+        def current(self, reader_id=None):
+            return None
+
+    mgr = PromotionManager(cfg.fleet, _NullStore())
+    if args.no_shadow_gate:
+        # offline check: no mirror exists to sample — synthesize a
+        # passing shadow observation so only eval+calibration gate
+        shadow_div, shadow_reqs = 0.0, cfg.fleet.promotion_min_shadow
+    else:
+        shadow_div, shadow_reqs = args.shadow_divergence, \
+            args.shadow_requests
+    ok, gates = mgr.decide(
+        candidate_return=cand["mean_return"],
+        live_return=(live["mean_return"] if live is not None
+                     else args.live_return),
+        calibration_gap=args.calibration_gap,
+        shadow_divergence=shadow_div,
+        shadow_requests=shadow_reqs)
+    report = {
+        "verdict": "promote" if ok else "refuse",
+        "gates": gates,
+        "candidate": {"checkpoint": args.candidate,
+                      "step": cand["step"],
+                      "scenarios": cand["scenarios"]},
+    }
+    if live is not None:
+        report["live"] = {"checkpoint": args.live, "step": live["step"],
+                          "scenarios": live["scenarios"]}
+    print(json.dumps(report, indent=2), flush=True)
+    return 0 if ok else 1
+
+
+def _rollback(args, cfg) -> int:
+    from r2d2_tpu.fleet.promotion import PromotionManager
+    from r2d2_tpu.runtime.weights import InProcWeightStore
+
+    # the manager's persisted previous.pkl IS the bundle; publishing it
+    # into a fresh store exercises the exact rollback code path (a
+    # RUNNING run rolls back through its own manager instead —
+    # PlayerStack.promotion.rollback() — and every consumer re-adopts)
+    store = InProcWeightStore(None)
+    mgr = PromotionManager(cfg.fleet, store,
+                           save_dir=cfg.runtime.save_dir or ".")
+    try:
+        stamp = mgr.rollback()
+    except RuntimeError as e:
+        print(f"rollback failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps({"rolled_back_to_stamp": stamp,
+                      "state": mgr.state}), flush=True)
+    return 0
+
+
+def _status(args, cfg) -> int:
+    if args.port is not None:
+        from r2d2_tpu.fleet.membership import lease_call
+        try:
+            reply = lease_call(args.host, args.port, "info",
+                               timeout_s=args.timeout)
+        except (RuntimeError, ConnectionError, OSError) as e:
+            print(f"status failed: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(reply.get("promotion",
+                                   {"state": "unknown"})), flush=True)
+        return 0
+    import os
+    path = os.path.join(cfg.runtime.save_dir or ".", "promotion",
+                        "state.json")
+    try:
+        with open(path) as f:
+            print(json.dumps(json.load(f)), flush=True)
+    except OSError:
+        print(json.dumps({"state": "idle", "note": f"no {path}"}),
+              flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    from r2d2_tpu.utils import pin_platform
+    pin_platform()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--candidate", default=None,
+                   help="candidate checkpoint path to gate")
+    p.add_argument("--live", default=None,
+                   help="live checkpoint path to compare against")
+    p.add_argument("--live-return", type=float, default=None,
+                   help="known live mean return (instead of --live)")
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--scenarios", default=None,
+                   help="comma-separated env kinds (evaluate.py schema)")
+    p.add_argument("--serve", action="store_true",
+                   help="evaluate through an in-proc policy server")
+    p.add_argument("--serve-clients", type=int, default=4)
+    p.add_argument("--calibration-gap", type=float, default=None,
+                   help="observed calibration gap_mean (quality stream); "
+                        "omitted => the calibration gate passes open")
+    p.add_argument("--shadow-divergence", type=float, default=None,
+                   help="observed shadow divergence (quality stream)")
+    p.add_argument("--shadow-requests", type=int, default=0,
+                   help="shadow requests the divergence is over")
+    p.add_argument("--no-shadow-gate", action="store_true",
+                   help="waive the shadow gate (offline checks have no "
+                        "mirror to sample)")
+    p.add_argument("--rollback", action="store_true",
+                   help="re-publish the retained previous bundle from "
+                        "{save_dir}/promotion/")
+    p.add_argument("--status", action="store_true",
+                   help="print the persisted (or --port: live) promotion "
+                        "state")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="--status: fleet lease API host")
+    p.add_argument("--port", type=int, default=None,
+                   help="--status: fleet lease API port (live block)")
+    p.add_argument("--timeout", type=float, default=30.0)
+    args, config_overrides = p.parse_known_args(argv)
+
+    from r2d2_tpu.config import Config, parse_overrides
+    cfg = parse_overrides(Config(), config_overrides)
+
+    if args.rollback:
+        return _rollback(args, cfg)
+    if args.status:
+        return _status(args, cfg)
+    if not args.candidate:
+        p.error("--candidate is required (or use --rollback / --status)")
+    return _offline_gates(args, cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -398,6 +398,12 @@ class ServeConfig:
     # Pre-compile every pow2 dispatch bucket at server start (the ingest
     # stager's AOT recipe — a lazy mid-run compile parks every client).
     warmup: bool = True
+    # Shadow mirroring (ISSUE 20): fraction of live OK step replies the
+    # client-side router copies to a candidate server for divergence
+    # scoring (fleet/promotion.py ShadowScorer — mirrored replies are
+    # never returned to clients). 0 (default) = no mirror sink is ever
+    # attached; the routing path is byte-identical to PR-17.
+    shadow_sample_rate: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -505,6 +511,25 @@ class FleetConfig:
     lease_transport: str = ""
     lease_host: str = "127.0.0.1"
     lease_port: int = 0             # 0 = ephemeral
+    # -- gated canary promotion (ISSUE 20; fleet/promotion.py) --
+    # Eval-return gate: a candidate promotes only if its per-scenario
+    # mean return >= the live policy's minus this tolerance (absolute,
+    # in return units — returns are env-scale, not normalized).
+    promotion_return_tolerance: float = 0.05
+    # Calibration gate: |mean (predicted max-Q − realized n-step
+    # return)| of the candidate's stream must stay under this bound
+    # (fail-open when no calibration stream exists — process fleets).
+    promotion_calibration_bound: float = 10.0
+    # Shadow gate: greedy-disagreement fraction on mirrored traffic
+    # must stay under this bound, measured over at least
+    # promotion_min_shadow scored requests (fail-closed below the
+    # minimum — a promotion must earn its evidence).
+    promotion_divergence_bound: float = 0.25
+    promotion_min_shadow: int = 32
+    # Fraction of fan-out consumers a staged candidate canary-publishes
+    # to (leaf-relay granularity; 0 disables the canary slice — the
+    # candidate proves itself on shadow + eval alone).
+    promotion_canary_frac: float = 0.25
 
     def resolved_max_slots(self, num_actors: int) -> int:
         return self.max_slots if self.max_slots > 0 else num_actors
@@ -863,6 +888,32 @@ class TelemetryConfig:
     # rolling median fires e2e_latency_growth — experience is aging
     # somewhere between emission and the gradient.
     alerts_e2e_latency_growth: float = 4.0
+    # -- policy-quality pillar (ISSUE 20; telemetry/quality.py) --
+    # Master switch: continuous eval + Q-calibration + the record's
+    # 'quality' block + the quality_player{p}.jsonl ledger stream. Off
+    # (default) => nothing is constructed and records are byte-identical
+    # to the PR-19 schema (the kill-switch contract).
+    quality_enabled: bool = False
+    # Background evaluator cadence / work: seconds between checkpoint
+    # polls, eval episodes per scenario, served eval clients (the eval
+    # rollouts ride cli/evaluate's --serve machinery when serving is on).
+    quality_eval_interval_s: float = 60.0
+    quality_eval_rounds: int = 2
+    quality_eval_clients: int = 2
+    # Every Nth finished actor block feeds the Q-calibration join
+    # (1 = every block; the tap is one convolution per 400-step block).
+    quality_calib_sample_every: int = 1
+    # quality_regression: eval mean_return dropping below this fraction
+    # of its own rolling median fires (drop rule — return scales are
+    # env-relative, so the rule is too).
+    alerts_quality_regression: float = 0.5
+    # canary_divergence: shadow greedy-disagreement fraction at/above
+    # this fires (crit — the candidate disagrees with live on mirrored
+    # traffic beyond the promotion gate's own bound).
+    alerts_canary_divergence: float = 0.25
+    # promotion_stall: a canary staged longer than this many seconds
+    # without a promote/refuse/rollback verdict fires.
+    alerts_promotion_stall_s: float = 600.0
 
 
 @dataclass(frozen=True)
@@ -1623,6 +1674,54 @@ class Config:
             raise ValueError(
                 f"telemetry.alerts_missing_rank_age_s "
                 f"({self.telemetry.alerts_missing_rank_age_s}) must be > 0")
+        if not 0 <= self.serve.shadow_sample_rate <= 1:
+            raise ValueError(
+                f"serve.shadow_sample_rate ({self.serve.shadow_sample_rate}) "
+                "must be in [0, 1]")
+        if self.telemetry.quality_eval_interval_s <= 0:
+            raise ValueError(
+                f"telemetry.quality_eval_interval_s "
+                f"({self.telemetry.quality_eval_interval_s}) must be > 0")
+        if self.telemetry.quality_eval_rounds < 1:
+            raise ValueError(
+                f"telemetry.quality_eval_rounds "
+                f"({self.telemetry.quality_eval_rounds}) must be >= 1")
+        if self.telemetry.quality_eval_clients < 1:
+            raise ValueError(
+                f"telemetry.quality_eval_clients "
+                f"({self.telemetry.quality_eval_clients}) must be >= 1")
+        if self.telemetry.quality_calib_sample_every < 1:
+            raise ValueError(
+                f"telemetry.quality_calib_sample_every "
+                f"({self.telemetry.quality_calib_sample_every}) must be "
+                ">= 1")
+        if not 0 < self.telemetry.alerts_quality_regression < 1:
+            raise ValueError(
+                f"telemetry.alerts_quality_regression "
+                f"({self.telemetry.alerts_quality_regression}) must be in "
+                "(0, 1) (a fraction of the rolling-median eval return)")
+        if not 0 < self.telemetry.alerts_canary_divergence <= 1:
+            raise ValueError(
+                f"telemetry.alerts_canary_divergence "
+                f"({self.telemetry.alerts_canary_divergence}) must be in "
+                "(0, 1] (a greedy-disagreement fraction)")
+        if self.telemetry.alerts_promotion_stall_s <= 0:
+            raise ValueError(
+                f"telemetry.alerts_promotion_stall_s "
+                f"({self.telemetry.alerts_promotion_stall_s}) must be > 0")
+        if not 0 <= self.fleet.promotion_canary_frac <= 1:
+            raise ValueError(
+                f"fleet.promotion_canary_frac "
+                f"({self.fleet.promotion_canary_frac}) must be in [0, 1]")
+        if not 0 <= self.fleet.promotion_divergence_bound <= 1:
+            raise ValueError(
+                f"fleet.promotion_divergence_bound "
+                f"({self.fleet.promotion_divergence_bound}) must be in "
+                "[0, 1] (a greedy-disagreement fraction)")
+        if self.fleet.promotion_min_shadow < 0:
+            raise ValueError(
+                f"fleet.promotion_min_shadow "
+                f"({self.fleet.promotion_min_shadow}) must be >= 0")
         if self.multiplayer.enabled and self.actor.envs_per_actor > 1:
             raise ValueError(
                 "actor.envs_per_actor > 1 is not supported with multiplayer "

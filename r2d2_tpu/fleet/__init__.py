@@ -20,6 +20,10 @@ and churn independently of one another:
 """
 
 from r2d2_tpu.fleet.fanout import FanoutTree, ShmFanout
+from r2d2_tpu.fleet.promotion import (STATE_CANARY, STATE_IDLE,
+                                      STATE_PROMOTED, STATE_REFUSED,
+                                      STATE_ROLLED_BACK, PromotionManager,
+                                      ShadowScorer)
 from r2d2_tpu.fleet.membership import (SLOT_ACTIVE, SLOT_FREE, SLOT_PARKED,
                                        FleetMembership, MembershipServer,
                                        SlotLease, lease_call)
@@ -32,6 +36,9 @@ __all__ = [
     "ReplayService", "ReplayShard", "SpillTier",
     "ReplayServiceServer", "RemoteReplayProducer", "ReplayProducerPump",
     "FanoutTree", "ShmFanout",
+    "PromotionManager", "ShadowScorer",
+    "STATE_IDLE", "STATE_CANARY", "STATE_PROMOTED", "STATE_REFUSED",
+    "STATE_ROLLED_BACK",
     "FleetMembership", "SlotLease", "MembershipServer", "lease_call",
     "SLOT_FREE", "SLOT_ACTIVE", "SLOT_PARKED",
 ]

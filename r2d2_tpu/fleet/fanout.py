@@ -113,6 +113,18 @@ class _Relay:
                 self._readers[reader_id] = self._version
             return self._tree
 
+    def adopt(self, tree, version: int) -> None:
+        """Directly install (tree, version) on this relay, bypassing the
+        upstream (the canary-promotion path, ISSUE 20): every consumer's
+        seen-version resets so all of them re-poll the adopted tree. The
+        override STICKS until the upstream actually re-publishes (pump
+        only overwrites on fresh upstream data) or the tree's
+        ``clear_canary`` re-adopts the root's bundle."""
+        with self._lock:
+            self._tree = tree
+            self._version = int(version)
+            self._readers.clear()
+
     def reader_version(self, reader_id) -> int:
         with self._lock:
             return self._readers.get(reader_id, 0)
@@ -158,6 +170,9 @@ class FanoutTree:
         # tiers is now root-ward first; leaves last (possibly empty —
         # degree >= n_consumers means consumers read the root directly)
         self.relays = [r for tier in self.tiers for r in tier]
+        # canary slice (ISSUE 20): leaf relays currently serving a
+        # candidate bundle instead of the root's (see canary_publish)
+        self._canaried: List[_Relay] = []
         # initial propagation: relays adopt the store's construction
         # publication (tier order is root-ward, so one pass reaches the
         # leaves) — a consumer spawned before the first training publish
@@ -202,19 +217,68 @@ class FanoutTree:
             for relay in tier:
                 relay.pump()
 
+    def canary_publish(self, tree, version: int,
+                       frac: float = 0.25) -> List[int]:
+        """Serve a CANDIDATE bundle to a slice of the fleet (ISSUE 20):
+        adopt (tree, version) on enough leaf relays — taken from the
+        high-slot end, the most-exploratory end of the ε ladder — to
+        cover at least ``ceil(frac * n_consumers)`` consumers. Slice
+        granularity is the leaf relay (all of a canaried relay's
+        consumers get the candidate). Returns the covered consumer
+        slots — empty when the tree has no relays (degree >=
+        n_consumers: consumers read the root directly, which only a
+        root publish may touch) or ``frac <= 0``."""
+        if not self.tiers or frac <= 0:
+            return []
+        want = max(1, math.ceil(float(frac) * self.n_consumers))
+        leaf_tier = self.tiers[-1]
+        tree = jax.device_get(tree)
+        covered: List[int] = []
+        canaried: List[_Relay] = []
+        for j in range(len(leaf_tier) - 1, -1, -1):
+            canaried.append(leaf_tier[j])
+            covered.extend(c for c in range(self.n_consumers)
+                           if c // self.degree == j)
+            if len(covered) >= want:
+                break
+        for relay in canaried:
+            relay.adopt(tree, version)
+        self._canaried = canaried
+        return sorted(covered)
+
+    def clear_canary(self) -> None:
+        """Return every canaried relay to the ROOT's current bundle
+        (explicit re-adoption: after a refused canary the root never
+        re-published, so an upstream pump would return None forever and
+        the candidate would stick)."""
+        if not self._canaried:
+            return
+        current = self.store.current()
+        version = int(self.store.publish_count)
+        for relay in self._canaried:
+            relay.adopt(current, version)
+        self._canaried = []
+
     def stats(self) -> Optional[dict]:
         """The record's ``fanout`` sub-block: topology + the max relay
         lag in publications (root publish count − slowest relay's
-        adopted count) — the ``fanout_lag`` alert's signal."""
+        adopted count) — the ``fanout_lag`` alert's signal. A live
+        canary's relays carry the CANDIDATE stamp (> root), clamped out
+        of the lag so a canary never reads as negative lag."""
         root = int(self.store.publish_count)
-        lags = [root - r.version for r in self.relays]
-        return {
+        lags = [max(root - r.version, 0) for r in self.relays]
+        out = {
             "degree": self.degree,
             "depth": self.depth,
             "relays": len(self.relays),
             "consumers": self.n_consumers,
             "max_lag": (max(lags) if lags else 0),
         }
+        if self._canaried:
+            # present only while a canary is live, so promotion-less
+            # runs' records stay byte-identical to the PR-19 schema
+            out["canary_relays"] = len(self._canaried)
+        return out
 
 
 def _make_version(parent: _Relay) -> Callable[[], int]:

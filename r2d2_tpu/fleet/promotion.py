@@ -1,0 +1,398 @@
+"""Gated canary promotion + shadow scoring (ISSUE 20; ROADMAP item 2d).
+
+The deployment lifecycle over the existing distribution plumbing — no new
+transport, no new weight format:
+
+  * ``ShadowScorer`` — the serve router's mirror sink
+    (``RoutingChannel.set_mirror``): a sampled fraction of live requests
+    is COPIED to a candidate server's own channel and the candidate's
+    replies are scored for greedy-agreement / max-|ΔQ| divergence against
+    the live replies. Mirroring only enqueues (bounded queue, drops
+    counted); a worker thread pays the candidate's latency, so the live
+    path sees O(sample-decision) overhead and candidate replies are never
+    returned to clients. The candidate server owns its own state cache —
+    live client state is untouched by construction.
+  * ``PromotionManager`` — the state machine: ``stage()`` retains the
+    currently-published bundle (root store value + stamp, persisted under
+    ``{save_dir}/promotion/`` so rollback survives the process) and
+    canary-publishes the candidate to a slice of the fan-out tree's leaf
+    relays (PR-14); ``decide()`` applies the configurable gates (eval
+    return ≥ live − tolerance, calibration drift and shadow divergence
+    bounded, minimum shadow sample count); ``promote()`` is ONE root
+    publish — the same path a training publish takes, so every consumer
+    and serving slot adopts through unchanged plumbing; ``rollback()``
+    re-publishes the retained previous bundle bit-identically.
+
+Candidates arrive PREPARED (the PR-13 publish preparer has already built
+the stamped quant bundle when quantization is on) — promotion moves
+bundles, it never rebuilds them.
+"""
+
+import dataclasses
+import json
+import os
+import pickle
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+STATE_IDLE = "idle"
+STATE_CANARY = "canary"
+STATE_PROMOTED = "promoted"
+STATE_REFUSED = "refused"
+STATE_ROLLED_BACK = "rolled_back"
+
+
+def _greedy(reply) -> Optional[int]:
+    """Greedy action under a reply: argmax of the carried q-vector when
+    present (exploration-free — two policies with different ε must not
+    read as divergence), the sampled action otherwise."""
+    q = getattr(reply, "q", None)
+    if q is not None:
+        return int(np.argmax(np.asarray(q)))
+    a = int(getattr(reply, "action", -1))
+    return a if a >= 0 else None
+
+
+class ShadowScorer:
+    """Mirror sink for ``RoutingChannel.set_mirror``: samples live
+    (request, reply) pairs into a bounded queue; ``process_pending()``
+    (the worker loop, or tests/drills directly) replays request COPIES
+    against the candidate channel and feeds greedy-agreement + max-|ΔQ|
+    into ``QualityStats.on_shadow``. Only OK step replies score; the
+    live ``reqs``/``replies`` objects are never written to."""
+
+    def __init__(self, candidate_channel, stats=None, *,
+                 sample_rate: float = 1.0, max_queue: int = 512,
+                 timeout_s: float = 2.0, seed: int = 0):
+        import random
+        from r2d2_tpu.serve.transport import KIND_STEP, STATUS_OK
+        self._kind_step = KIND_STEP
+        self._status_ok = STATUS_OK
+        self.candidate = candidate_channel
+        self.stats = stats
+        self.sample_rate = float(sample_rate)
+        self.timeout_s = float(timeout_s)
+        self._rng = random.Random(seed)
+        self._q: deque = deque(maxlen=int(max_queue))
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # counters (read by tests/the drill; stats carries the record)
+        self.mirrored = 0
+        self.scored = 0
+        self.agreed = 0
+        self.dropped = 0
+        self.errors = 0
+
+    # -- live-path side (must be cheap and exception-free) --
+
+    def mirror(self, reqs: Sequence, replies: Dict[int, object]) -> None:
+        pairs = []
+        for r in reqs:
+            if r.kind != self._kind_step:
+                continue
+            live = replies.get(r.req_id)
+            if live is None or live.status != self._status_ok:
+                continue
+            if self._rng.random() >= self.sample_rate:
+                continue
+            pairs.append((r, live))
+        if not pairs:
+            return
+        with self._lock:
+            before = len(self._q)
+            self._q.extend(pairs)
+            lost = before + len(pairs) - len(self._q)
+        if lost > 0:
+            self.dropped += lost
+            if self.stats is not None:
+                self.stats.on_shadow(0, 0, dropped=lost)
+        self.mirrored += len(pairs)
+        self._wake.set()
+
+    # -- candidate side --
+
+    def process_pending(self) -> int:
+        """Drain the queue against the candidate; returns pairs scored."""
+        with self._lock:
+            pairs = list(self._q)
+            self._q.clear()
+        if not pairs:
+            return 0
+        copies = [dataclasses.replace(r, reply_to="") for r, _live in pairs]
+        try:
+            cand = self.candidate.request_many(copies,
+                                               timeout=self.timeout_s)
+        except Exception:
+            self.errors += 1
+            return 0
+        scored = agreed = 0
+        dq_max = None
+        for (req, live), copy in zip(pairs, copies):
+            rep = cand.get(copy.req_id)
+            if rep is None or rep.status != self._status_ok:
+                continue
+            g_live, g_cand = _greedy(live), _greedy(rep)
+            if g_live is None or g_cand is None:
+                continue
+            scored += 1
+            agreed += int(g_live == g_cand)
+            if live.q is not None and rep.q is not None:
+                dq = float(np.max(np.abs(
+                    np.asarray(live.q, np.float32)
+                    - np.asarray(rep.q, np.float32))))
+                dq_max = dq if dq_max is None else max(dq_max, dq)
+        if scored:
+            self.scored += scored
+            self.agreed += agreed
+            if self.stats is not None:
+                self.stats.on_shadow(scored, agreed, dq_max=dq_max)
+        return scored
+
+    def divergence(self) -> Optional[float]:
+        """Cumulative greedy-disagreement fraction (None before any
+        score) — the gate input when no QualityStats is attached."""
+        return (1.0 - self.agreed / self.scored) if self.scored else None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(0.1)
+            self._wake.clear()
+            try:
+                self.process_pending()
+            except Exception:
+                self.errors += 1
+
+    def start(self) -> "ShadowScorer":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="shadow-scorer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+class PromotionManager:
+    """idle → canary → promoted | refused (+ rollback) over the root
+    weight store and the optional fan-out tree. Thread-safe; ``block()``
+    is the record's ``promotion`` sub-block (``age_s`` is non-None only
+    while a canary is in flight — the ``promotion_stall`` rule's path)."""
+
+    def __init__(self, fleet_cfg, store, *, fanout=None, stats=None,
+                 save_dir: Optional[str] = None,
+                 clock: Callable[[], float] = time.time):
+        self.cfg = fleet_cfg
+        self.store = store
+        self.fanout = fanout
+        self.stats = stats
+        self.clock = clock
+        self._lock = threading.Lock()
+        self.state = STATE_IDLE
+        self.promotions = 0
+        self.rollbacks = 0
+        self.refusals = 0
+        self.root_publishes = 0      # drill: promote == ONE root publish
+        self._candidate = None
+        self._candidate_stamp: Optional[int] = None
+        self._retained = None        # (tree, stamp) of the pre-stage bundle
+        self._staged_at: Optional[float] = None
+        self._last_gates: Optional[dict] = None
+        self._dir = (os.path.join(save_dir, "promotion")
+                     if save_dir else None)
+        if stats is not None:
+            stats.set_promotion(self.block)
+        if self._dir is not None:
+            self._load_persisted()
+
+    # -- persistence (one staged generation survives the process) --
+
+    def _load_persisted(self) -> None:
+        try:
+            with open(os.path.join(self._dir, "state.json")) as f:
+                st = json.load(f)
+            self.state = st.get("state", STATE_IDLE)
+            self.promotions = int(st.get("promotions", 0))
+            self.rollbacks = int(st.get("rollbacks", 0))
+            self.refusals = int(st.get("refusals", 0))
+            self._candidate_stamp = st.get("candidate_stamp")
+            self._staged_at = st.get("staged_at")
+            with open(os.path.join(self._dir, "previous.pkl"), "rb") as f:
+                prev = pickle.load(f)
+            self._retained = (prev["tree"], int(prev["stamp"]))
+        except (OSError, ValueError, KeyError, pickle.PickleError):
+            pass                     # fresh dir / partial write: start idle
+
+    def _persist(self) -> None:
+        if self._dir is None:
+            return
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            if self._retained is not None:
+                tmp = os.path.join(self._dir, ".previous.pkl.tmp")
+                with open(tmp, "wb") as f:
+                    pickle.dump({"tree": self._retained[0],
+                                 "stamp": self._retained[1]}, f)
+                os.replace(tmp, os.path.join(self._dir, "previous.pkl"))
+            tmp = os.path.join(self._dir, ".state.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"state": self.state,
+                           "candidate_stamp": self._candidate_stamp,
+                           "staged_at": self._staged_at,
+                           "promotions": self.promotions,
+                           "rollbacks": self.rollbacks,
+                           "refusals": self.refusals,
+                           "gates": self._last_gates}, f)
+            os.replace(tmp, os.path.join(self._dir, "state.json"))
+        except OSError:
+            pass                     # persistence is best-effort
+
+    # -- lifecycle --
+
+    def stage(self, candidate_tree, *, stamp: Optional[int] = None) -> dict:
+        """Retain the live bundle and canary-publish the candidate to
+        ``promotion_canary_frac`` of the fan-out consumers. Returns the
+        canary coverage (empty when no tree / no relays — the candidate
+        then proves itself on shadow + eval alone)."""
+        with self._lock:
+            if self.state == STATE_CANARY:
+                raise RuntimeError(
+                    "a canary is already staged (stamp "
+                    f"{self._candidate_stamp}) — promote, refuse, or "
+                    "roll back first")
+            live_tree = self.store.current("promotion")
+            self._retained = (live_tree, int(self.store.publish_count))
+            self._candidate = candidate_tree
+            self._candidate_stamp = (int(stamp) if stamp is not None
+                                     else int(self.store.publish_count) + 1)
+            covered: List[int] = []
+            if self.fanout is not None:
+                covered = self.fanout.canary_publish(
+                    candidate_tree, self._candidate_stamp,
+                    frac=self.cfg.promotion_canary_frac)
+            self.state = STATE_CANARY
+            self._staged_at = self.clock()
+            self._persist()
+            return {"candidate_stamp": self._candidate_stamp,
+                    "previous_stamp": self._retained[1],
+                    "canary_consumers": covered}
+
+    def decide(self, *, candidate_return: Optional[float] = None,
+               live_return: Optional[float] = None,
+               calibration_gap: Optional[float] = None,
+               shadow_divergence: Optional[float] = None,
+               shadow_requests: int = 0) -> Tuple[bool, dict]:
+        """Apply the gates. Eval and shadow gates fail CLOSED (a missing
+        signal refuses — a promotion must earn its evidence); the
+        calibration gate fails open when no calibration stream exists
+        (process-actor fleets have none) but bounds it when it does."""
+        cfg = self.cfg
+        gates = {}
+        gates["eval_return"] = {
+            "ok": (candidate_return is not None and live_return is not None
+                   and candidate_return
+                   >= live_return - cfg.promotion_return_tolerance),
+            "candidate": candidate_return, "live": live_return,
+            "tolerance": cfg.promotion_return_tolerance,
+        }
+        gates["calibration"] = {
+            "ok": (calibration_gap is None
+                   or abs(calibration_gap) <= cfg.promotion_calibration_bound),
+            "gap": calibration_gap,
+            "bound": cfg.promotion_calibration_bound,
+        }
+        gates["shadow"] = {
+            "ok": (shadow_requests >= cfg.promotion_min_shadow
+                   and shadow_divergence is not None
+                   and shadow_divergence <= cfg.promotion_divergence_bound),
+            "requests": int(shadow_requests),
+            "min_requests": cfg.promotion_min_shadow,
+            "divergence": shadow_divergence,
+            "bound": cfg.promotion_divergence_bound,
+        }
+        ok = all(g["ok"] for g in gates.values())
+        with self._lock:
+            self._last_gates = gates
+        return ok, gates
+
+    def _publish(self, tree) -> None:
+        self.store.publish(tree)
+        self.root_publishes += 1
+        if self.fanout is not None:
+            self.fanout.clear_canary()
+            self.fanout.on_publish()
+
+    def promote(self) -> int:
+        """Commit the staged candidate: ONE root publish; the fan-out
+        tree re-pumps every relay (incl. the canary slice) from the
+        root. Returns the promoted stamp."""
+        with self._lock:
+            if self.state != STATE_CANARY or self._candidate is None:
+                raise RuntimeError("no staged candidate to promote")
+            self._publish(self._candidate)
+            stamp = self._candidate_stamp
+            self._candidate = None
+            self.state = STATE_PROMOTED
+            self.promotions += 1
+            self._staged_at = None
+            self._persist()
+            return stamp
+
+    def refuse(self, gates: Optional[dict] = None) -> None:
+        """Reject the staged candidate: clear the canary slice back to
+        the root's bundle; the retained previous stays retained (the
+        root was never touched, so nothing re-publishes)."""
+        with self._lock:
+            if self.state != STATE_CANARY:
+                raise RuntimeError("no staged candidate to refuse")
+            if self.fanout is not None:
+                self.fanout.clear_canary()
+            if gates is not None:
+                self._last_gates = gates
+            self._candidate = None
+            self.state = STATE_REFUSED
+            self.refusals += 1
+            self._staged_at = None
+            self._persist()
+
+    def rollback(self) -> int:
+        """One-command rollback: re-publish the retained previous bundle
+        from the root (bit-identical — the tree was snapshotted, never
+        rebuilt). Returns the restored bundle's original stamp."""
+        with self._lock:
+            if self._retained is None:
+                raise RuntimeError(
+                    "nothing retained to roll back to (no promotion was "
+                    "staged from this save_dir)")
+            tree, stamp = self._retained
+            self._publish(tree)
+            self._candidate = None
+            self.state = STATE_ROLLED_BACK
+            self.rollbacks += 1
+            self._staged_at = None
+            self._persist()
+            return stamp
+
+    def block(self) -> dict:
+        with self._lock:
+            age = (self.clock() - self._staged_at
+                   if (self.state == STATE_CANARY
+                       and self._staged_at is not None) else None)
+            return {
+                "state": self.state,
+                "candidate_stamp": self._candidate_stamp,
+                "previous_stamp": (self._retained[1]
+                                   if self._retained is not None else None),
+                "age_s": age,
+                "promotions": self.promotions,
+                "rollbacks": self.rollbacks,
+                "refusals": self.refusals,
+            }

@@ -237,9 +237,10 @@ def instrument_block_sink(cfg: Config, slot: int, sink: Callable,
 def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
               weight_poll: Callable, should_stop: Callable[[], bool],
               max_env_steps: Optional[int] = None, *,
-              telemetry=None) -> int:
+              telemetry=None, quality_feed=None) -> int:
     """Returns total env steps taken. ``block_sink(block)`` ships a finished
-    block; ``weight_poll()`` returns fresh params or None.
+    block; ``weight_poll()`` returns fresh params or None. ``quality_feed``
+    (ISSUE 20) is the optional Q-calibration tap handed to the LocalBuffer.
 
     OWNS ``env`` from here on: closes it on every exit (clean stop or
     crash), in ONE place for all spawners — a respawned actor builds a
@@ -247,7 +248,8 @@ def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
     restart (round-3 advisor)."""
     try:
         return _run_actor(cfg, env, policy, block_sink, weight_poll,
-                          should_stop, max_env_steps, telemetry)
+                          should_stop, max_env_steps, telemetry,
+                          quality_feed)
     finally:
         try:
             env.close()
@@ -257,11 +259,12 @@ def run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
 
 def _run_actor(cfg: Config, env, policy: ActorPolicy, block_sink: Callable,
                weight_poll: Callable, should_stop: Callable[[], bool],
-               max_env_steps: Optional[int] = None, telemetry=None) -> int:
+               max_env_steps: Optional[int] = None, telemetry=None,
+               quality_feed=None) -> int:
     tele = telemetry if telemetry is not None else NULL_TELEMETRY
     spec = ReplaySpec.from_config(cfg)
     lb = LocalBuffer(spec, policy.action_dim, cfg.optim.gamma,
-                     cfg.optim.priority_eta)
+                     cfg.optim.priority_eta, quality_feed=quality_feed)
 
     obs = env.reset()
     policy.observe_reset(obs)
@@ -318,7 +321,7 @@ def run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
                      block_sink: Callable, weight_poll: Callable,
                      should_stop: Callable[[], bool],
                      max_env_steps: Optional[int] = None, *,
-                     telemetry=None) -> int:
+                     telemetry=None, quality_feed=None) -> int:
     """The N-lane twin of ``run_actor``: one jitted (N, 1) policy forward
     steps every lane of a SyncVectorEnv per tick; each lane keeps its own
     LocalBuffer so block content is identical to N scalar actors' (parity-
@@ -328,7 +331,8 @@ def run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
     exit, same contract as run_actor."""
     try:
         return _run_vector_actor(cfg, venv, policy, block_sink, weight_poll,
-                                 should_stop, max_env_steps, telemetry)
+                                 should_stop, max_env_steps, telemetry,
+                                 quality_feed)
     finally:
         try:
             venv.close()
@@ -340,15 +344,17 @@ def _run_vector_actor(cfg: Config, venv, policy: BatchedActorPolicy,
                       block_sink: Callable, weight_poll: Callable,
                       should_stop: Callable[[], bool],
                       max_env_steps: Optional[int] = None,
-                      telemetry=None) -> int:
+                      telemetry=None, quality_feed=None) -> int:
     tele = telemetry if telemetry is not None else NULL_TELEMETRY
     spec = ReplaySpec.from_config(cfg)
     n = venv.num_envs
     if n != policy.num_lanes:
         raise ValueError(f"venv has {n} lanes but policy has "
                          f"{policy.num_lanes}")
+    # lanes share one feed — QualityStats is thread/lane-safe
     buffers = [LocalBuffer(spec, policy.action_dim, cfg.optim.gamma,
-                           cfg.optim.priority_eta) for _ in range(n)]
+                           cfg.optim.priority_eta,
+                           quality_feed=quality_feed) for _ in range(n)]
 
     obs = venv.reset()
     for i in range(n):

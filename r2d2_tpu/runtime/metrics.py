@@ -130,6 +130,15 @@ class TrainMetrics:
         # to the PR13 schema.
         self._quant_fn = None
 
+        # policy-quality pillar (ISSUE 20): a quality-block provider
+        # (QualityLedger.interval_block — Q-calibration join, continuous
+        # per-scenario eval with checkpoint lineage, shadow divergence,
+        # promotion state; the provider also appends the
+        # quality_player{p}.jsonl ledger row) — called once per log();
+        # unattached (telemetry.quality_enabled off, the default) the
+        # record is byte-identical to the PR19 schema.
+        self._quality_fn = None
+
         # elastic fleet plane (ISSUE 15): a replay_service-block
         # provider (per-shard fill, spill occupancy/hit-rate, fan-out
         # relay depth/lag, membership lease counts) attached by the
@@ -267,6 +276,15 @@ class TrainMetrics:
         agreement of the interval's in-graph accuracy probes. Called
         once per log(); None returns omit the block."""
         self._quant_fn = provider
+
+    def set_quality(self, provider) -> None:
+        """Attach the quality-block provider (ISSUE 20): a callable
+        returning ``QualityLedger.interval_block()`` — the interval's
+        Q-calibration gap stats, the latest per-scenario eval rows with
+        checkpoint lineage, shadow-scoring divergence, and the promotion
+        state machine's sub-block. Called once per log(); None returns
+        omit the block (consumers key on its presence)."""
+        self._quality_fn = provider
 
     def set_replay_service(self, provider) -> None:
         """Attach the replay_service-block provider (ISSUE 15): a
@@ -465,6 +483,14 @@ class TrainMetrics:
             rs = self._replay_service_fn()
             if rs is not None:
                 record["replay_service"] = rs
+        if self._quality_fn is not None:
+            # policy-quality block (ISSUE 20): eval return / Q-calibration /
+            # shadow divergence / promotion state. Before the sentinel pass
+            # so the quality_regression / canary_divergence / promotion_stall
+            # rules see their own interval.
+            quality = self._quality_fn()
+            if quality is not None:
+                record["quality"] = quality
         if self._recovery_fn is not None:
             # crash-recovery block (ISSUE 18): snapshot age / restore
             # counts / at-risk blocks / supervisor restarts. Before the

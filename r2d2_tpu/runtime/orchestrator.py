@@ -194,6 +194,29 @@ class PlayerStack:
                 cfg.network.inference_dtype,
                 cfg.telemetry.quant_probe_interval)
             self.metrics.set_quant(self.quant_stats.interval_block)
+        # policy-quality plane (ISSUE 20): the quality aggregator + the
+        # quality_player{p}.jsonl ledger feeding the record's 'quality'
+        # block; the background evaluator and the promotion manager are
+        # built by the actor spawners once the weight store exists.
+        # Default-off: records stay byte-identical to the PR-19 schema.
+        self.quality_stats = None
+        self.quality_ledger = None
+        self.quality_evaluator = None
+        self.promotion = None
+        self.shadow = None
+        self._shadow_mirror = None
+        self._routing_channels: List = []
+        if cfg.telemetry.enabled and cfg.telemetry.quality_enabled:
+            from r2d2_tpu.telemetry import QualityLedger, QualityStats
+            self.quality_stats = QualityStats()
+            try:
+                self.quality_ledger = QualityLedger(
+                    self.quality_stats, cfg.runtime.save_dir or ".",
+                    player_idx, resume=bool(cfg.runtime.resume))
+            except BaseException:
+                self.heartbeats.close()
+                raise
+            self.metrics.set_quality(self.quality_ledger.interval_block)
         # LAST: telemetry board shm + the span-drain's file I/O. Anything
         # raising after an shm allocation would leak the segment (train()
         # only closes stacks that made it into its list), so the file I/O
@@ -333,6 +356,40 @@ class PlayerStack:
             self.serve_server.stop()
         self._start_serve_server()
 
+    def install_shadow(self, candidate_channel, *,
+                       sample_rate: Optional[float] = None, seed: int = 0):
+        """Shadow-score a candidate server (ISSUE 20): mirror a sampled
+        fraction of every routed live request batch to
+        ``candidate_channel`` and feed greedy-agreement divergence into
+        the quality block — the evidence ``PromotionManager.decide``
+        gates on. Installs on every existing router AND every router
+        spawned later; candidate replies never reach clients."""
+        if self.quality_stats is None:
+            raise RuntimeError("shadow scoring needs telemetry."
+                               "quality_enabled (the quality plane)")
+        if self.shadow is not None:
+            raise RuntimeError("a shadow scorer is already installed — "
+                               "clear_shadow() first")
+        from r2d2_tpu.fleet.promotion import ShadowScorer
+        rate = (self.cfg.serve.shadow_sample_rate
+                if sample_rate is None else float(sample_rate))
+        self.shadow = ShadowScorer(candidate_channel, self.quality_stats,
+                                   sample_rate=rate, seed=seed).start()
+        self._shadow_mirror = self.shadow.mirror
+        for ch in self._routing_channels:
+            ch.set_mirror(self._shadow_mirror)
+        return self.shadow
+
+    def clear_shadow(self) -> None:
+        """Uninstall the shadow tap (promotion decided either way)."""
+        if self.shadow is None:
+            return
+        for ch in self._routing_channels:
+            ch.set_mirror(None)
+        self._shadow_mirror = None
+        self.shadow.stop()
+        self.shadow = None
+
     def start_actors_threads(self, stop: threading.Event) -> None:
         cfg = self.cfg
         prep = self._publish_prep
@@ -367,6 +424,24 @@ class PlayerStack:
         self.queue = BlockQueue(use_mp=False)
         self._stop = stop
         self._actor_mode = "thread"
+        if self.quality_stats is not None:
+            # deployment plane (ISSUE 20): the promotion state machine
+            # over THIS store/fan-out tree (its block rides the quality
+            # record via stats.set_promotion), and the continuous-eval
+            # client polling save_dir for new checkpoints — publish
+            # stamps at eval time give the ledger its lineage.
+            from r2d2_tpu.fleet.promotion import PromotionManager
+            from r2d2_tpu.telemetry import QualityEvaluator
+            self.promotion = PromotionManager(
+                cfg.fleet, self.store, fanout=self._fanout,
+                stats=self.quality_stats, save_dir=cfg.runtime.save_dir)
+            self.quality_evaluator = QualityEvaluator(
+                cfg, self.player_idx, self.quality_stats,
+                interval_s=cfg.telemetry.quality_eval_interval_s,
+                rounds=cfg.telemetry.quality_eval_rounds,
+                clients=cfg.telemetry.quality_eval_clients,
+                serve=(cfg.actor.inference == "server"),
+                stamp_fn=lambda: self.store.publish_count).start()
         if self.serve_endpoint is not None:
             # thread-mode serving: the server polls the in-proc store
             # under its own reader id; clients share the stats object so
@@ -419,6 +494,9 @@ class PlayerStack:
             # endpoints — requests aim by client-id hash and re-aim on
             # MISROUTED bounces as the fleet grows/shrinks
             serve_channel = self.serve_fleet.connect()
+            self._routing_channels.append(serve_channel)
+            if self._shadow_mirror is not None:
+                serve_channel.set_mirror(self._shadow_mirror)
         elif self.serve_endpoint is not None:
             serve_channel = self.serve_endpoint.connect()
         else:
@@ -463,6 +541,18 @@ class PlayerStack:
             # consumers stamp OLDER versions, which is the truth)
             weight_version = fo_version
             weight_poll = fo_poll
+        quality_feed = None
+        if self.quality_stats is not None:
+            # Q-calibration tap (ISSUE 20): the slot's LocalBuffers feed
+            # predicted-vs-realized gaps, stamped with the version this
+            # slot is acting with (the PR-5 lineage join)
+            from r2d2_tpu.replay.structs import ReplaySpec
+            from r2d2_tpu.telemetry import make_calibration_feed
+            quality_feed = make_calibration_feed(
+                self.quality_stats, gamma=cfg.optim.gamma,
+                n_steps=ReplaySpec.from_config(cfg).forward,
+                sample_every=cfg.telemetry.quality_calib_sample_every,
+                stamp_fn=weight_version)
         sink = instrument_block_sink(
             cfg, i,
             lambda b: self.queue.put_patient(
@@ -485,7 +575,7 @@ class PlayerStack:
 
         def loop(env=env, policy=policy, run_loop=run_loop,
                  weight_poll=weight_poll, sink=sink,
-                 should_stop=should_stop):
+                 should_stop=should_stop, quality_feed=quality_feed):
             from r2d2_tpu.tools.chaos import ChaosLeave
 
             # the run loop owns env and closes it on every exit
@@ -494,7 +584,8 @@ class PlayerStack:
                          block_sink=sink,
                          weight_poll=weight_poll,
                          should_stop=should_stop,
-                         telemetry=self.telemetry)
+                         telemetry=self.telemetry,
+                         quality_feed=quality_feed)
             except ChaosLeave:
                 # deliberate departure (ISSUE 15): the slot already
                 # parked via on_leave — unwind quietly, not as a crash
@@ -891,6 +982,9 @@ class PlayerStack:
                     "actor_mode": self._actor_mode}
             if self._replay_announce is not None:
                 info["replay_service"] = self._replay_announce
+            if self.promotion is not None:
+                # cli/promote.py --status dials this
+                info["promotion"] = self.promotion.block()
             if self.serve_fleet is not None:
                 info["serving"] = {
                     "servers": sorted(self.serve_fleet.servers),
@@ -976,6 +1070,9 @@ class PlayerStack:
 
     def close(self) -> None:
         self.learner.stop_background()
+        if self.quality_evaluator is not None:
+            self.quality_evaluator.stop()
+        self.clear_shadow()
         if self._lease_server is not None:
             self._lease_server.close()
         if self._service_server is not None:

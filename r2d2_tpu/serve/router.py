@@ -146,6 +146,15 @@ class RoutingChannel:
         self._channels = dict(channels)
         self.shard_map = shard_map
         self.reroutes = 0           # misroute bounces absorbed (tests)
+        self._mirror = None         # shadow-scoring tap (ISSUE 20)
+
+    def set_mirror(self, mirror) -> None:
+        """Install a shadow tap: ``mirror(reqs, replies)`` is called with
+        every request batch AND the live replies dict after each
+        ``request_many`` — the ShadowScorer's intake. The tap must treat
+        both as read-only; it enqueues copies and returns immediately
+        (never blocks the live path). ``None`` uninstalls."""
+        self._mirror = mirror
 
     def _route(self, reqs: Sequence[Request]) -> Dict[int, List[Request]]:
         by_server: Dict[int, List[Request]] = {}
@@ -201,6 +210,12 @@ class RoutingChannel:
                     if rep is not None and rep.status == STATUS_MISROUTED:
                         self.shard_map.apply_wire(rep.shard_map)
                         del out[r.req_id]
+        if self._mirror is not None:
+            # shadow scoring never perturbs the live path
+            try:
+                self._mirror(reqs, out)
+            except Exception:
+                pass
         return out
 
     def request(self, req: Request, timeout: float = 5.0) -> Reply:

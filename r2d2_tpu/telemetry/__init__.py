@@ -88,6 +88,9 @@ from r2d2_tpu.telemetry.histogram import (NBUCKETS, LogHistogram,
                                           value_summary)
 from r2d2_tpu.telemetry.learning import LearningAggregator, LearningDiag
 from r2d2_tpu.telemetry.profiler import ProfilerCapture, trace
+from r2d2_tpu.telemetry.quality import (QualityEvaluator, QualityLedger,
+                                        QualityStats, calibration_join,
+                                        make_calibration_feed)
 from r2d2_tpu.telemetry.quant import QuantStats
 from r2d2_tpu.telemetry.replaydiag import ReplayDiag, ReplayDiagAggregator
 from r2d2_tpu.telemetry.resources import (BufferRegistry, ResourceMonitor,
@@ -102,7 +105,8 @@ __all__ = [
     "AlertEngine", "AlertRule", "BufferRegistry", "CompileMonitor",
     "FleetAggregator", "LearningAggregator", "LearningDiag",
     "LogHistogram",
-    "ProfilerCapture", "QuantStats", "ReplayDiag", "ReplayDiagAggregator",
+    "ProfilerCapture", "QualityEvaluator", "QualityLedger", "QualityStats",
+    "QuantStats", "ReplayDiag", "ReplayDiagAggregator",
     "ResourceMonitor", "RotatingJsonlWriter", "SpanTracer", "StageTimers",
     "Telemetry", "TelemetryBoard", "active_monitor",
     "analytic_component_costs", "aot_coverage", "attribute_trace",
@@ -112,6 +116,7 @@ __all__ = [
     "cumulative_stage_matrix",
     "default_rules", "device_memory_stats", "host_usage",
     "merge_stage_counts", "mesh_row_ranks", "peak_spec",
+    "calibration_join", "make_calibration_feed",
     "percentile", "program_cost",
     "pytree_nbytes", "read_last_jsonl_row", "record_value",
     "register_buffer", "stage_counts_dict", "summarize",

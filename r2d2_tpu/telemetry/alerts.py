@@ -288,6 +288,33 @@ def default_rules(tcfg) -> Tuple[AlertRule, ...]:
         AlertRule("recovery_loop", "threshold",
                   ("recovery", "supervisor", "restarts"),
                   tcfg.alerts_recovery_loop, "crit"),
+        # policy-quality rules (ISSUE 20; the quality block,
+        # telemetry/quality.py — inactive on records without it, i.e.
+        # every run with quality_enabled off):
+        # the continuous-eval mean return fell below a fraction of its
+        # own recent median — the policy the fleet is serving got WORSE
+        # (regression past the publish boundary, not just a noisy
+        # episode; eval snapshots persist across intervals so the
+        # median is over real evals)
+        AlertRule("quality_regression", "drop",
+                  ("quality", "eval", "mean_return"),
+                  tcfg.alerts_quality_regression, "warn", window=w),
+        # shadow-scored candidate disagreeing with the live policy past
+        # the bound — the canary under evaluation does not act like the
+        # policy it would replace (crit: promotion must not proceed). A
+        # shadow-free interval carries divergence=None, which HOLDS the
+        # rule (no data ≠ recovery).
+        AlertRule("canary_divergence", "threshold",
+                  ("quality", "shadow", "divergence"),
+                  tcfg.alerts_canary_divergence, "crit"),
+        # a canary has been staged longer than the ceiling without a
+        # promote/refuse/rollback decision — the deployment plane is
+        # wedged mid-promotion and part of the fleet is serving an
+        # unvetted candidate (age_s is None outside the canary state,
+        # so the rule is inactive the rest of the time)
+        AlertRule("promotion_stall", "threshold",
+                  ("quality", "promotion", "age_s"),
+                  tcfg.alerts_promotion_stall_s, "warn"),
     )
 
 

@@ -1,0 +1,345 @@
+"""Policy-quality observability (ISSUE 20) — the pillar that watches the
+one thing the fleet exists to produce.
+
+Three signals, one ledger:
+
+  * **Q-calibration** — R2D2's own diagnostic (Kapturowski et al.): the gap
+    between the greedy max-Q the actor predicted at decision time and the
+    realized discounted n-step return over the same window. The tap is
+    ``LocalBuffer.finish`` (the only place predicted Q and realized rewards
+    coexist on the host); the join math lives here (``calibration_join``)
+    so it is testable against a per-row python reference. Blocks do NOT
+    carry q-values, so the tap feeds raw per-step quantities straight into
+    the aggregator — thread actors only, the same boundary as the quant
+    accuracy probes (process children have no channel back to this record).
+  * **Continuous eval** — a background ``QualityEvaluator`` re-runs
+    ``cli/evaluate.py``'s rollout machinery (optionally through the serving
+    plane, ``--serve`` style) against each new checkpoint, producing
+    per-scenario return rows that share one schema with the CLI's
+    ``evaluate_scenarios`` (ROADMAP item 5's scenario-coverage axis).
+  * **Shadow scoring** — fed by ``fleet/promotion.ShadowScorer`` through
+    ``on_shadow``: greedy-agreement and max-|ΔQ| divergence of a candidate
+    server against live replies on mirrored traffic.
+
+All of it aggregates in ``QualityStats`` (thread-safe, interval-consumed —
+the QuantStats discipline) and emits as the periodic record's ``quality``
+block plus a ``quality_player{p}.jsonl`` stream (``QualityLedger``) the
+tower tails. Default-off: with ``telemetry.quality_enabled = false``
+nothing here is constructed and records are byte-identical to the PR-19
+schema. Ledger rows carry checkpoint lineage (step, publish stamp, parent
+stamp) so self-play Elo bookkeeping (ROADMAP 5b) can attach later without
+a schema break.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+def calibration_join(qvals: np.ndarray, rewards: np.ndarray, gamma: float,
+                     n_steps: int):
+    """Join predicted Q against realized n-step return for one block.
+
+    ``qvals``: (T+1, A) — per-step Q at decision time plus the bootstrap
+    row (zeros when the episode terminated, matching LocalBuffer's
+    convention, so termination needs no separate flag). ``rewards``: (T,)
+    raw per-step rewards. Returns ``(pred, realized)`` of shape (T,):
+
+      pred[t]     = max_a Q[t, a]
+      realized[t] = sum_{i<m} gamma^i r[t+i] + gamma^m max_a Q[t+m, a],
+                    m = min(n_steps, T - t)
+
+    — the same target convention as ops/returns.initial_priorities, built
+    independently here so the test's per-row python reference actually
+    cross-checks something."""
+    qvals = np.asarray(qvals, np.float64)
+    rewards = np.asarray(rewards, np.float64)
+    T = rewards.shape[0]
+    if qvals.shape[0] != T + 1:
+        raise ValueError(f"qvals rows ({qvals.shape[0]}) must be "
+                         f"len(rewards)+1 ({T + 1})")
+    n = max(int(n_steps), 1)
+    maxq = qvals.max(axis=1)                             # (T+1,)
+    # windowed discounted reward sums: pad so tail windows shorten cleanly
+    kernel = gamma ** np.arange(n)
+    padded = np.concatenate([rewards, np.zeros(n - 1)])
+    # np.convolve flips its kernel; flip back so window t dots r[t:t+n]
+    rsum = np.convolve(padded, kernel[::-1], mode="valid")
+    t = np.arange(T)
+    boot = np.minimum(t + n, T)
+    realized = rsum + gamma ** (boot - t) * maxq[boot]
+    return maxq[:T], realized
+
+
+def make_calibration_feed(stats: "QualityStats", *, gamma: float,
+                          n_steps: int, sample_every: int = 1,
+                          stamp_fn: Optional[Callable[[], int]] = None):
+    """Build the LocalBuffer-side tap: a callable ``feed(qvals, rewards)``
+    invoked once per finished block, sampling every Nth block
+    (``telemetry.quality_calib_sample_every``). ``stamp_fn`` supplies the
+    publish stamp the feeding actor is currently acting with (its fan-out
+    endpoint's adopted version — the PR-5 lineage plumbing), joining the
+    calibration signal to a checkpoint generation."""
+    every = max(int(sample_every), 1)
+    count = [0]
+
+    def feed(qvals, rewards):
+        count[0] += 1
+        if count[0] % every:
+            return
+        pred, realized = calibration_join(qvals, rewards, gamma, n_steps)
+        if pred.size == 0:
+            return
+        gaps = pred - realized
+        stamp = int(stamp_fn()) if stamp_fn is not None else None
+        stats.on_calibration(int(pred.size), float(gaps.sum()),
+                             float(np.abs(gaps).max()), stamp=stamp)
+    return feed
+
+
+_IDLE_PROMOTION = {"state": "idle", "candidate_stamp": None,
+                   "previous_stamp": None, "age_s": None,
+                   "promotions": 0, "rollbacks": 0, "refusals": 0}
+
+
+class QualityStats:
+    """Thread-safe aggregator behind the record's ``quality`` block —
+    calibration taps (actor threads), the evaluator, and the shadow
+    scorer all feed it; ``interval_block()`` consumes the interval
+    (the QuantStats discipline). Interval extrema are None when nothing
+    fed them, which HOLDS the alert rules instead of feeding them
+    zeros."""
+
+    def __init__(self, promotion_block: Optional[Callable[[], dict]] = None):
+        self._lock = threading.Lock()
+        self._promotion_block = promotion_block
+        # calibration (interval-consumed + cumulative)
+        self._cal_samples = 0
+        self._cal_gap_sum = 0.0
+        self._cal_abs_max: Optional[float] = None
+        self._cal_stamp: Optional[int] = None
+        self.calibration_samples_total = 0
+        # latest eval snapshot (persists across intervals so the drop
+        # rule sees a value series, not a one-interval blip)
+        self._eval: Optional[dict] = None
+        self.evals_total = 0
+        # shadow (interval-consumed + cumulative)
+        self._sh_requests = 0
+        self._sh_agreed = 0
+        self._sh_dq_max: Optional[float] = None
+        self._sh_dropped = 0
+        self.shadow_mirrored_total = 0
+
+    def set_promotion(self, provider: Callable[[], dict]) -> None:
+        self._promotion_block = provider
+
+    def on_calibration(self, samples: int, gap_sum: float, gap_abs_max: float,
+                       stamp: Optional[int] = None) -> None:
+        with self._lock:
+            self._cal_samples += int(samples)
+            self._cal_gap_sum += float(gap_sum)
+            if (self._cal_abs_max is None
+                    or gap_abs_max > self._cal_abs_max):
+                self._cal_abs_max = float(gap_abs_max)
+            if stamp is not None:
+                self._cal_stamp = int(stamp)
+            self.calibration_samples_total += int(samples)
+
+    def on_eval(self, scenarios: List[dict], *, step: Optional[int] = None,
+                publish_stamp: Optional[int] = None,
+                parent_stamp: Optional[int] = None) -> None:
+        """Record a completed per-checkpoint eval: per-scenario rows (the
+        ``evaluate_scenarios`` schema) plus the checkpoint's lineage."""
+        eps = sum(int(r.get("episodes", 0)) for r in scenarios)
+        mean = None
+        if eps > 0:
+            mean = sum(float(r["mean_return"]) * int(r.get("episodes", 0))
+                       for r in scenarios) / eps
+        with self._lock:
+            self._eval = {
+                "checkpoint_step": step,
+                "publish_stamp": publish_stamp,
+                "parent_stamp": parent_stamp,
+                "mean_return": mean,
+                "scenarios": list(scenarios),
+            }
+            self.evals_total += 1
+
+    def latest_eval(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._eval) if self._eval is not None else None
+
+    def on_shadow(self, requests: int, agreed: int,
+                  dq_max: Optional[float] = None, dropped: int = 0) -> None:
+        with self._lock:
+            self._sh_requests += int(requests)
+            self._sh_agreed += int(agreed)
+            if dq_max is not None and (self._sh_dq_max is None
+                                       or dq_max > self._sh_dq_max):
+                self._sh_dq_max = float(dq_max)
+            self._sh_dropped += int(dropped)
+            self.shadow_mirrored_total += int(requests)
+
+    def interval_block(self) -> dict:
+        with self._lock:
+            cal = {
+                "samples": self._cal_samples,
+                "gap_mean": (self._cal_gap_sum / self._cal_samples
+                             if self._cal_samples else None),
+                "gap_abs_max": self._cal_abs_max,
+                "stamp": self._cal_stamp,
+                "samples_total": self.calibration_samples_total,
+            }
+            self._cal_samples = 0
+            self._cal_gap_sum = 0.0
+            self._cal_abs_max = None
+            ev = self._eval or {}
+            eval_blk = {
+                "evals_total": self.evals_total,
+                "checkpoint_step": ev.get("checkpoint_step"),
+                "publish_stamp": ev.get("publish_stamp"),
+                "parent_stamp": ev.get("parent_stamp"),
+                "mean_return": ev.get("mean_return"),
+                "scenarios": list(ev.get("scenarios", [])),
+            }
+            reqs = self._sh_requests
+            shadow = {
+                "requests": reqs,
+                "agree_frac": (self._sh_agreed / reqs) if reqs else None,
+                "divergence": (1.0 - self._sh_agreed / reqs) if reqs
+                              else None,
+                "dq_max": self._sh_dq_max,
+                "dropped": self._sh_dropped,
+                "mirrored_total": self.shadow_mirrored_total,
+            }
+            self._sh_requests = 0
+            self._sh_agreed = 0
+            self._sh_dq_max = None
+            self._sh_dropped = 0
+            promo = self._promotion_block
+        promotion = dict(_IDLE_PROMOTION) if promo is None else promo()
+        return {"calibration": cal, "eval": eval_blk, "shadow": shadow,
+                "promotion": promotion}
+
+
+class QualityLedger:
+    """The ``quality_player{p}.jsonl`` stream: one row per metrics
+    interval, shaped like every other plane stream the tower tails —
+    a process-identity header + clock anchor (``proc``, the PR-19
+    convention) and the ``quality`` block under its own key, so
+    ``tools/sentinel.py --stream`` replays it through the unchanged rule
+    paths. ``interval_block()`` is the TrainMetrics provider: it computes
+    the block, appends the row (write failures are counted, never
+    raised — telemetry must not kill the driver loop), and returns the
+    block for the record."""
+
+    def __init__(self, stats: QualityStats, save_dir: str, player_idx: int,
+                 resume: bool = False):
+        from r2d2_tpu.telemetry.tracing import proc_header
+        self.stats = stats
+        self.path = os.path.join(save_dir or ".",
+                                 f"quality_player{player_idx}.jsonl")
+        self._proc = proc_header("quality")
+        self.write_errors = 0
+        self._lock = threading.Lock()
+        if not resume:
+            try:
+                open(self.path, "w").close()
+            except OSError:
+                self.write_errors += 1
+
+    def interval_block(self) -> dict:
+        block = self.stats.interval_block()
+        row = {"t": time.time(), "proc": self._proc, "quality": block}
+        ev = block.get("eval", {})
+        # lineage rides at top level too (ROADMAP 5b's attach point)
+        row["lineage"] = {"step": ev.get("checkpoint_step"),
+                          "publish_stamp": ev.get("publish_stamp"),
+                          "parent_stamp": ev.get("parent_stamp")}
+        try:
+            with self._lock, open(self.path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except (OSError, TypeError, ValueError):
+            self.write_errors += 1
+        return block
+
+
+class QualityEvaluator:
+    """Continuous eval as a background client of the training run: polls
+    ``runtime.save_dir`` for new checkpoints and re-runs the
+    ``cli/evaluate.py`` rollout machinery against each (through the
+    serving plane when ``serve=True`` — eval traffic exercises the same
+    fleet it scores, the SEED evaluation-as-a-service shape). Results
+    land in ``QualityStats.on_eval`` with lineage: the checkpoint step,
+    the publish stamp at eval time (``stamp_fn``), and the PREVIOUS
+    eval's stamp as parent. ``run_once()`` is the synchronous entry the
+    tests and the drill drive directly."""
+
+    def __init__(self, cfg, player_idx: int, stats: QualityStats, *,
+                 interval_s: float = 60.0, rounds: int = 2, clients: int = 2,
+                 serve: bool = True, testing: bool = False,
+                 stamp_fn: Optional[Callable[[], int]] = None):
+        self.cfg = cfg
+        self.player_idx = player_idx
+        self.stats = stats
+        self.interval_s = float(interval_s)
+        self.rounds = int(rounds)
+        self.clients = int(clients)
+        self.serve = bool(serve)
+        self.testing = bool(testing)
+        self.stamp_fn = stamp_fn
+        self.eval_errors = 0
+        self._last_index: Optional[int] = None
+        self._last_stamp: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run_once(self) -> Optional[List[dict]]:
+        """Evaluate the newest checkpoint if it hasn't been scored yet;
+        returns its per-scenario rows (None when nothing new)."""
+        from r2d2_tpu.runtime.checkpoint import list_checkpoints
+        ckpts = list_checkpoints(self.cfg.runtime.save_dir or ".",
+                                 self.cfg.env.game_name, self.player_idx)
+        if not ckpts:
+            return None
+        index, path = ckpts[-1]
+        if self._last_index is not None and index <= self._last_index:
+            return None
+        from r2d2_tpu.cli.evaluate import evaluate_scenarios
+        res = evaluate_scenarios(
+            self.cfg, path, self.rounds, serve=self.serve,
+            serve_clients=self.clients, testing=self.testing,
+            seed=self.cfg.runtime.seed + 777)
+        rows = res["scenarios"]
+        stamp = int(self.stamp_fn()) if self.stamp_fn is not None else None
+        self.stats.on_eval(rows, step=res.get("step"), publish_stamp=stamp,
+                           parent_stamp=self._last_stamp)
+        self._last_index = int(index)
+        self._last_stamp = stamp
+        return rows
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.run_once()
+            except Exception:
+                # eval is best-effort observability: a transient failure
+                # (checkpoint mid-write, serve hiccup) must not kill the
+                # evaluator — count it and retry next interval
+                self.eval_errors += 1
+
+    def start(self) -> "QualityEvaluator":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"quality-eval-p{self.player_idx}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -4,7 +4,9 @@ Every plane of the disaggregated stack already narrates itself into a
 per-process JSONL stream: the learner's ``metrics_player{p}.jsonl``, the
 serving fleet's ``serve_metrics.jsonl``, a standalone ReplayService's
 ``service_metrics_p{p}.jsonl``, the multihost ranks'
-``telemetry_host{r}.jsonl``, and the per-stream alert logs. Until now
+``telemetry_host{r}.jsonl``, the quality ledger's
+``quality_player{p}.jsonl`` (ISSUE 20), and the per-stream alert
+logs. Until now
 NOTHING read them together — a brownout on the serving plane and an
 ingest backlog on the replay plane looked like two unrelated warnings in
 two files, when together they are one story (compute contention). The
@@ -20,7 +22,8 @@ Joined-record shape::
          "learner":        [newest record per player],
          "serve":          newest fleet row or None,
          "replay_service": [newest row per standalone service host],
-         "hosts":          [newest row per multihost rank]},
+         "hosts":          [newest row per multihost rank],
+         "quality":        [newest quality-ledger row per player]},
      "events": [newest alert firings across every alerts stream],
      "clock":  {"anchors": {plane: {...}}, "offsets": {plane: s}},
      "derived": {...}, "alerts": {"active": [...], "fired": [...]}}
@@ -54,6 +57,7 @@ STREAM_GLOBS = (
     ("serve", "serve_metrics.jsonl"),
     ("replay_service", "service_metrics_p*.jsonl"),
     ("hosts", "telemetry_host*.jsonl"),
+    ("quality", "quality_player*.jsonl"),
 )
 ALERT_GLOBS = ("alerts_player*.jsonl", "serve_alerts.jsonl",
                "alerts_host*.jsonl")
@@ -95,6 +99,21 @@ def tower_rules(cfg) -> Tuple[AlertRule, ...]:
         AlertRule("tower_plane_silent", "threshold",
                   ("derived", "stalest_plane_age_s"),
                   t.alerts_missing_rank_age_s, "crit"),
+        # policy-quality twins (ISSUE 20): the same three signals the
+        # in-run sentinel watches, read from the quality-ledger stream
+        # so a tower beside a quality-enabled run catches a regressing
+        # checkpoint / diverging canary even when the run's own engine
+        # is kill-switched
+        AlertRule("tower_quality_regression", "drop",
+                  ("derived", "quality_eval_return"),
+                  t.alerts_quality_regression, "warn",
+                  window=t.alerts_window),
+        AlertRule("tower_canary_divergence", "threshold",
+                  ("derived", "canary_divergence"),
+                  t.alerts_canary_divergence, "crit"),
+        AlertRule("tower_promotion_stall", "threshold",
+                  ("derived", "promotion_age_s"),
+                  t.alerts_promotion_stall_s, "warn"),
     )
 
 
@@ -219,6 +238,26 @@ class TowerCollector:
             derived["shed_while_backlog"] = float(
                 bool(shed) and bool(backlog))
 
+        # the policy-quality plane's view (ISSUE 20): worst-case across
+        # players — the tower flags the WORST checkpoint's regression
+        # and the most-diverged canary, not the average
+        q_rows = list(planes.get("quality") or [])
+        evals = [v for r in q_rows
+                 if (v := record_value(r, ("quality", "eval",
+                                           "mean_return"))) is not None]
+        if evals:
+            derived["quality_eval_return"] = min(evals)
+        divs = [v for r in q_rows
+                if (v := record_value(r, ("quality", "shadow",
+                                          "divergence"))) is not None]
+        if divs:
+            derived["canary_divergence"] = max(divs)
+        p_ages = [v for r in q_rows
+                  if (v := record_value(r, ("quality", "promotion",
+                                            "age_s"))) is not None]
+        if p_ages:
+            derived["promotion_age_s"] = max(p_ages)
+
         if ages:
             derived["plane_ages_s"] = dict(ages)
             derived["stalest_plane_age_s"] = max(ages.values())
@@ -234,6 +273,8 @@ class TowerCollector:
         rows = [("serve", serve_row)] if serve_row else []
         rows += [(f"replay_service/{i}", r)
                  for i, r in enumerate(planes.get("replay_service") or [])]
+        rows += [(f"quality/{i}", r)
+                 for i, r in enumerate(planes.get("quality") or [])]
         for name, row in rows:
             proc = (row or {}).get("proc") or {}
             anchor = proc.get("clock_anchor")
@@ -351,6 +392,29 @@ def render_tower(record: dict) -> str:
         if pl.get("p95_ms") is not None:
             bits.append(f"promo p95={pl['p95_ms']:.0f}ms")
         lines.append(" ".join(bits))
+    for i, row in enumerate(planes.get("quality") or []):
+        q = row.get("quality") or {}
+        ev, cal = q.get("eval") or {}, q.get("calibration") or {}
+        sh, pr = q.get("shadow") or {}, q.get("promotion") or {}
+        lineage = row.get("lineage") or {}
+        bits = [f"quality[{i}]: t={row.get('t', 0):.0f}s"]
+        if ev.get("mean_return") is not None:
+            bits.append(f"eval={ev['mean_return']:.2f}"
+                        + (f"@step{ev['checkpoint_step']}"
+                           if ev.get("checkpoint_step") is not None
+                           else ""))
+        if cal.get("gap_mean") is not None:
+            bits.append(f"calib gap={cal['gap_mean']:+.3f}")
+        if sh.get("divergence") is not None:
+            bits.append(f"shadow div={sh['divergence']:.3f}"
+                        f"/{sh.get('requests', 0)}")
+        if pr.get("state") and pr["state"] != "idle":
+            bits.append(f"promotion={pr['state']}"
+                        + (f" age={pr['age_s']:.0f}s"
+                           if pr.get("age_s") is not None else ""))
+        if lineage.get("publish_stamp") is not None:
+            bits.append(f"stamp={lineage['publish_stamp']}")
+        lines.append(" ".join(bits))
     hosts = planes.get("hosts") or []
     if hosts:
         lines.append(f"hosts: {len(hosts)} rank row(s)")
@@ -359,7 +423,9 @@ def render_tower(record: dict) -> str:
     derived = record.get("derived") or {}
     bits = []
     for key in ("e2e_p95_ms", "ingest_backlog", "serve_shed",
-                "spill_promotion_p95_ms", "stalest_plane_age_s"):
+                "spill_promotion_p95_ms", "quality_eval_return",
+                "canary_divergence", "promotion_age_s",
+                "stalest_plane_age_s"):
         if derived.get(key) is not None:
             bits.append(f"{key}={derived[key]:.4g}")
     if derived.get("shed_while_backlog"):

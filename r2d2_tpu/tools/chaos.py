@@ -1172,6 +1172,287 @@ def run_kill_replay_service_drill(seconds: float = 120.0,
     return report
 
 
+def _trees_equal(a, b) -> bool:
+    """Bit-identity for two param pytrees: same structure, same dtypes,
+    same bytes — the rollback contract is EXACT restoration, so a
+    tolerance would hide the very corruption the drill exists to catch."""
+    import jax
+    import numpy as np
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    return (ta == tb and len(la) == len(lb)
+            and all(np.asarray(x).dtype == np.asarray(y).dtype
+                    and np.array_equal(np.asarray(x), np.asarray(y))
+                    for x, y in zip(la, lb)))
+
+
+def _perturb_head(params, factor: float):
+    """Copy of a param tree with the Q-head's OUTPUT layer (adv_out)
+    scaled by ``factor``. A positive factor preserves every argmax
+    exactly (q scales monotonically, dueling or not — the value stream
+    is action-independent), so it is the HEALTHY candidate: different
+    bytes, identical greedy policy. A negative factor flips argmax to
+    argmin — the CORRUPTED candidate the gates must refuse."""
+    import copy
+
+    import numpy as np
+    out = copy.deepcopy(params)
+    head = out["params"]["head"]["adv_out"]
+    for k in head:
+        head[k] = np.asarray(head[k]) * np.float32(factor)
+    return out
+
+
+def run_promotion_drill(seconds: float = 120.0,
+                        config_overrides: dict = None) -> dict:
+    """Gated canary promotion drill (ISSUE 20 tentpole c): prove on REAL
+    serving + fan-out plumbing that
+
+      * a CORRUPTED candidate (perturbed head weights) staged as a canary
+        is caught by shadow scoring on mirrored live traffic, fires the
+        ``canary_divergence`` alert EXACTLY ONCE, and is refused without
+        the root store ever publishing;
+      * a HEALTHY candidate clears every gate (eval return through the
+        real ``evaluate_scenarios`` rollouts, calibration, shadow) and
+        promotes fleet-wide via ONE root publish — every fan-out consumer
+        adopts the candidate bundle;
+      * one-command ``rollback()`` re-publishes the retained previous
+        bundle BIT-IDENTICALLY (stamp and weight-tree equality asserted).
+
+    Everything runs in-proc: two PolicyServers (live + candidate) over
+    InprocEndpoints, a RoutingChannel with the ShadowScorer installed as
+    its mirror tap, an InProcWeightStore under a FanoutTree, and the
+    in-run AlertEngine evaluating real ``quality`` record blocks."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from r2d2_tpu.cli.evaluate import evaluate_scenarios
+    from r2d2_tpu.config import Config
+    from r2d2_tpu.fleet.fanout import FanoutTree
+    from r2d2_tpu.fleet.promotion import PromotionManager, ShadowScorer
+    from r2d2_tpu.models.network import NetworkApply
+    from r2d2_tpu.runtime.checkpoint import save_checkpoint
+    from r2d2_tpu.runtime.weights import InProcWeightStore
+    from r2d2_tpu.serve import InprocEndpoint, PolicyServer, RemotePolicy
+    from r2d2_tpu.serve.router import RoutingChannel, ShardMap
+    from r2d2_tpu.telemetry import AlertEngine, default_rules
+    from r2d2_tpu.telemetry.quality import (QualityEvaluator, QualityStats,
+                                            make_calibration_feed)
+
+    save_dir = tempfile.mkdtemp(prefix="r2d2_promotion_")
+    overrides = {
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "serve.max_batch": 4, "serve.deadline_ms": 2.0,
+        "serve.shadow_sample_rate": 1.0,
+        "fleet.promotion_min_shadow": 16,
+        "telemetry.enabled": True, "telemetry.quality_enabled": True,
+        "runtime.save_dir": save_dir, "runtime.save_interval": 0,
+    }
+    overrides.update(config_overrides or {})
+    cfg = Config().replace(**overrides)
+    t0 = time.time()
+
+    # -- the three bundles: live, healthy candidate, corrupted candidate --
+    action_dim = 6                    # JaxFakeEnv's action space
+    net = NetworkApply(action_dim, cfg.network, cfg.env.frame_stack,
+                       cfg.env.frame_height, cfg.env.frame_width)
+    live_params = jax.device_get(net.init(jax.random.PRNGKey(0)))
+    healthy = _perturb_head(live_params, 1.001)   # same argmax, new bytes
+    corrupt = _perturb_head(live_params, -1.0)    # argmax -> argmin
+
+    # checkpoints so the eval gate runs the REAL rollout machinery; the
+    # candidate saves under player 1 so the live evaluator's
+    # list_checkpoints poll (player 0) only ever sees the live bundle
+    opt_stub = {"stub": np.zeros(1, np.float32)}
+    live_ckpt = save_checkpoint(save_dir, cfg.env.game_name, 1, 0,
+                                live_params, opt_stub, live_params,
+                                step=100, env_steps=4000,
+                                config_json=cfg.to_json())
+    cand_ckpt = save_checkpoint(save_dir, cfg.env.game_name, 1, 1,
+                                healthy, opt_stub, healthy,
+                                step=200, env_steps=8000,
+                                config_json=cfg.to_json())
+
+    # -- distribution plane: root store + fan-out tree (8 consumers) --
+    store = InProcWeightStore(live_params)
+    fanout = FanoutTree(store, n_consumers=8, degree=2)
+    fanout.pump()                                 # seed relays from root
+    stats = QualityStats()
+    mgr = PromotionManager(cfg.fleet, store, fanout=fanout, stats=stats,
+                           save_dir=save_dir)
+    engine = AlertEngine(default_rules(cfg.telemetry))
+    fired: list = []                              # every firing, in order
+
+    def observe_interval():
+        record = {"quality": stats.interval_block()}
+        fired.extend(a["rule"] for a in engine.evaluate(record)["fired"])
+        return record["quality"]
+
+    # -- serving plane: live server behind a router, candidates shadowed --
+    ep_live = InprocEndpoint()
+    live_srv = PolicyServer(cfg, net, live_params, endpoint=ep_live).start()
+    smap = ShardMap(4, [0] * 4)
+
+    def drive_traffic(chan, steps: int, seed: int) -> None:
+        rng = np.random.default_rng(seed)
+        # a fresh client identity per phase: a reused id would collide
+        # with the previous phase's op-dedup bookkeeping in the cache
+        policy = RemotePolicy(chan, net.action_dim, 0.05, seed=seed,
+                              client_id=seed, timeout_s=30.0)
+        policy.observe_reset(rng.integers(
+            0, 255, (cfg.env.frame_height, cfg.env.frame_width), np.uint8))
+        for _ in range(steps):
+            action, _, _ = policy.act()
+            policy.observe(rng.integers(
+                0, 255, (cfg.env.frame_height, cfg.env.frame_width),
+                np.uint8), action)
+        policy.close()
+
+    def shadow_against(params, seed: int):
+        """Serve ``params`` as the candidate, mirror live traffic at it,
+        and return (scorer, divergence over this phase's requests). Each
+        phase gets its own router (the policy's close() closes the
+        channel) — the mirror tap rides that router."""
+        ep = InprocEndpoint()
+        srv = PolicyServer(cfg, net, params, endpoint=ep).start()
+        scorer = ShadowScorer(ep.connect(), stats,
+                              sample_rate=cfg.serve.shadow_sample_rate,
+                              timeout_s=30.0, seed=seed)
+        chan = RoutingChannel({0: ep_live.connect()}, smap)
+        chan.set_mirror(scorer.mirror)
+        try:
+            drive_traffic(chan, 40, seed)
+            scorer.process_pending()
+        finally:
+            srv.stop()
+        return scorer, scorer.divergence()
+
+    report = {"metric": "promotion_drill", "save_dir": save_dir}
+    verdict = {}
+    evaluator = None
+    try:
+        # -- eval gate evidence: continuous evaluator on the live ckpt
+        # (the real background path: list_checkpoints poll + served
+        # rollouts), candidate scored by the same machinery directly --
+        evaluator = QualityEvaluator(cfg, 0, stats, rounds=2, clients=2,
+                                     serve=True,
+                                     stamp_fn=lambda: store.publish_count)
+        assert evaluator.run_once() is not None
+        seed = cfg.runtime.seed + 777         # the evaluator's eval seed
+        live_eval = evaluate_scenarios(cfg, live_ckpt, 2, seed=seed)
+        cand_eval = evaluate_scenarios(cfg, cand_ckpt, 2, seed=seed)
+        live_return = live_eval["mean_return"]
+        cand_return = cand_eval["mean_return"]
+        # calibration signal through the LocalBuffer-tap plumbing
+        feed = make_calibration_feed(
+            stats, gamma=cfg.optim.gamma,
+            n_steps=cfg.sequence.forward_steps,
+            stamp_fn=lambda: store.publish_count)
+        rng = np.random.default_rng(7)
+        feed(rng.normal(size=(21, action_dim)).astype(np.float32),
+             rng.normal(size=(20,)).astype(np.float32))
+
+        # -- phase 1: the corrupted candidate must be refused --
+        staged1 = mgr.stage(corrupt)
+        canary_slots = staged1["canary_consumers"]
+        canary_live = all(_trees_equal(
+            fanout.endpoints(c)[2](), corrupt) for c in canary_slots)
+        uncovered = [c for c in range(8) if c not in canary_slots]
+        uncovered_live = all(_trees_equal(
+            fanout.endpoints(c)[2](), live_params) for c in uncovered)
+        scorer1, div1 = shadow_against(corrupt, seed=11)
+        q1 = observe_interval()               # fires canary_divergence
+        ok1, gates1 = mgr.decide(
+            candidate_return=cand_return, live_return=live_return,
+            calibration_gap=q1["calibration"]["gap_mean"],
+            shadow_divergence=div1, shadow_requests=scorer1.scored)
+        if not ok1:
+            mgr.refuse(gates1)
+        refused_block = mgr.block()
+        # canary slice back on the live bundle, root untouched
+        canary_cleared = all(_trees_equal(
+            fanout.endpoints(c)[2](), live_params) for c in canary_slots)
+        root_untouched = (store.publish_count == 1
+                          and mgr.root_publishes == 0)
+        observe_interval()                    # no re-fire while refused
+
+        # -- phase 2: the healthy candidate must promote fleet-wide --
+        scorer2, div2 = shadow_against(healthy, seed=23)
+        q2 = observe_interval()               # divergence ~0: rule re-arms
+        staged2 = mgr.stage(healthy, stamp=cand_eval["step"])
+        ok2, gates2 = mgr.decide(
+            candidate_return=cand_return, live_return=live_return,
+            calibration_gap=q2["calibration"]["gap_mean"],
+            shadow_divergence=div2, shadow_requests=scorer2.scored)
+        publishes_before = (store.publish_count, mgr.root_publishes)
+        promoted_stamp = mgr.promote() if ok2 else None
+        one_root_publish = (
+            store.publish_count == publishes_before[0] + 1
+            and mgr.root_publishes == publishes_before[1] + 1)
+        fleet_adopted = all(_trees_equal(
+            fanout.endpoints(c)[2](), healthy) for c in range(8))
+        observe_interval()
+
+        # -- phase 3: one-command rollback, bit-identical --
+        rb_stamp = mgr.rollback()
+        restored = store.current()
+        rollback_identical = (
+            rb_stamp == staged2["previous_stamp"]
+            and _trees_equal(restored, live_params)
+            and all(_trees_equal(fanout.endpoints(c)[2](), live_params)
+                    for c in range(8)))
+        final_q = observe_interval()
+
+        report.update({
+            "duration_s": round(time.time() - t0, 1),
+            "live_return": live_return,
+            "candidate_return": cand_return,
+            "corrupt_divergence": div1,
+            "healthy_divergence": div2,
+            "corrupt_gates": gates1,
+            "healthy_gates": gates2,
+            "canary_consumers": canary_slots,
+            "promoted_stamp": promoted_stamp,
+            "rolled_back_to_stamp": rb_stamp,
+            "alerts_fired": fired,
+            "final_quality": final_q,
+        })
+        verdict = {
+            "eval_gate_real": (live_eval["step"] == 100
+                               and cand_eval["step"] == 200
+                               and gates2["eval_return"]["ok"]),
+            "canary_scoped": (len(canary_slots) >= 2 and canary_live
+                              and uncovered_live),
+            "corrupt_refused": (not ok1
+                                and not gates1["shadow"]["ok"]
+                                and refused_block["state"] == "refused"
+                                and root_untouched and canary_cleared),
+            "canary_divergence_fired_once": (
+                fired.count("canary_divergence") == 1),
+            "healthy_promoted": (ok2
+                                 and promoted_stamp
+                                 == staged2["candidate_stamp"]),
+            "one_root_publish": one_root_publish,
+            "fleet_adopted": fleet_adopted,
+            "rollback_bit_identical": rollback_identical,
+        }
+    finally:
+        if evaluator is not None:
+            evaluator.stop()
+        live_srv.stop()
+    report["verdict"] = verdict
+    return report
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -1207,6 +1488,13 @@ def main(argv=None) -> int:
                         "restart it, assert producer reconnect + "
                         "unacked-tail replay and a bounded-loss "
                         "snapshot restore")
+    p.add_argument("--promotion", action="store_true",
+                   help="run the ISSUE-20 gated-canary promotion drill: "
+                        "a corrupted candidate (perturbed head weights) "
+                        "is refused with canary_divergence fired exactly "
+                        "once; a healthy candidate promotes fleet-wide "
+                        "via ONE root publish; rollback restores the "
+                        "previous bundle bit-identically")
     p.add_argument("--servers", type=int, default=2,
                    help="--serve-fleet: fleet width before the kill")
     p.add_argument("--outage-seconds", type=float, default=6.0,
@@ -1221,7 +1509,9 @@ def main(argv=None) -> int:
             overrides[k] = json.loads(v)
         except (json.JSONDecodeError, ValueError):
             overrides[k] = v
-    if args.kill_learner:
+    if args.promotion:
+        out = run_promotion_drill(args.seconds, config_overrides=overrides)
+    elif args.kill_learner:
         out = run_kill_learner_drill(max(args.seconds, 120.0),
                                      config_overrides=overrides)
     elif args.kill_replay_service:

@@ -316,6 +316,21 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
                 trace[k] = (trace.get(k) or 0) + (v or 0)
             elif v is not None:
                 trace[k] = v
+    # policy-quality evidence (ISSUE 20): sub-block-wise merge, newest
+    # non-null (the eval snapshot persists across intervals and every
+    # sub-block carries its own cumulative totals, so last-wins is
+    # exact; interval-consumed calibration/shadow extrema take the
+    # newest populated interval). None on every run with
+    # quality_enabled off (the key-absence contract).
+    quality = None
+    for r in records:
+        qy = r.get("quality")
+        if not qy:
+            continue
+        if quality is None:
+            quality = dict(qy)
+        else:
+            quality.update({k: v for k, v in qy.items() if v is not None})
     # crash-recovery evidence (ISSUE 18): the newest recovery block —
     # its snapshot counters are cumulative, so last-wins is exact; None
     # on every run with the snapshot plane off (the key-absence
@@ -360,6 +375,7 @@ def run_e2e(seconds: float = 60.0, envs_per_actor: int = 16,
         "serving": serving,
         "quant": quant,
         "trace": trace,
+        "quality": quality,
         "replay_service": replay_service,
         "recovery": recovery,
         "resources": resources,
@@ -682,6 +698,95 @@ def run_tracing_ab(seconds: float, envs_per_actor: int, num_actors: int,
     out["hops_on"] = sorted((tb.get("hops") or {}).keys())
     out["trace_block_off"] = any(
         c.get("trace") for c in cells["tracing_off"])
+    return out
+
+
+def run_promotion_ab(seconds: float, envs_per_actor: int, num_actors: int,
+                     overrides: Optional[dict] = None,
+                     repeats: int = 2) -> dict:
+    """Policy-quality overhead A/B + promotion-drill evidence (ISSUE 20
+    acceptance): the SAME e2e system with ``telemetry.quality_enabled``
+    on vs off, in one artifact. Budget under test: the quality plane's
+    in-band costs — the per-block calibration tap inside
+    ``LocalBuffer.finish`` (run at sample_every=1, bounding the
+    production cadence from above), the QualityStats aggregation, and
+    the per-record ``quality`` block + ``quality_player{p}.jsonl``
+    ledger row assembly — cost <= 2%% on BOTH env-steps/s and learner
+    updates/s. Cells run ABBA-interleaved ``repeats`` times with
+    per-arm medians (the tracing-AB noise treatment) in THREAD mode so
+    the calibration tap actually rides the acting hot path. The ON
+    cells carry the ``quality`` block as end-to-end evidence; the OFF
+    cells prove the records carried no ``quality`` key at all (the
+    kill-switch schema contract).
+
+    A final evidence cell runs the full gated-canary promotion drill
+    (tools/chaos.py ``--promotion``): corrupted candidate refused with
+    ``canary_divergence`` fired exactly once, healthy candidate
+    promoted fleet-wide via ONE root publish, bit-identical rollback."""
+    cells = {"quality_off": [], "quality_on": []}
+    for rep in range(max(repeats, 1)):
+        order = (("quality_off", False), ("quality_on", True))
+        if rep % 2:
+            order = order[::-1]    # ABBA: cancel monotonic host drift
+        for label, on in order:
+            ov = dict(overrides or {})
+            ov["telemetry.quality_enabled"] = on
+            # every finished block feeds the calibration join — denser
+            # than any production cadence, so the measured overhead
+            # bounds the per-emission cost from above
+            ov.setdefault("telemetry.quality_calib_sample_every", 1)
+            cells[label].append(run_e2e(seconds, envs_per_actor,
+                                        num_actors, overrides=ov,
+                                        actor_mode="thread"))
+
+    def med(label, key):
+        return float(np.median([c[key] for c in cells[label]]))
+
+    out = {"quality_off": cells["quality_off"][-1],
+           "quality_on": cells["quality_on"][-1],
+           "repeats": max(repeats, 1),
+           "env_steps_per_sec_cells": {
+               k: [c["env_steps_per_sec"] for c in v]
+               for k, v in cells.items()},
+           "learner_steps_per_sec_cells": {
+               k: [c["learner_steps_per_sec"] for c in v]
+               for k, v in cells.items()}}
+    if med("quality_off", "env_steps_per_sec") > 0:
+        ratio = (med("quality_on", "env_steps_per_sec")
+                 / med("quality_off", "env_steps_per_sec"))
+        out["env_steps_ratio"] = round(ratio, 3)
+        out["overhead_pct"] = round((1.0 - ratio) * 100.0, 2)
+    if med("quality_off", "learner_steps_per_sec") > 0:
+        out["learner_steps_ratio"] = round(
+            med("quality_on", "learner_steps_per_sec")
+            / med("quality_off", "learner_steps_per_sec"), 3)
+    # evidence: merge the ON cells' quality blocks (sub-blocks carry
+    # their own cumulative totals, newest-non-null — the run_e2e merge
+    # semantics again)
+    qb = {}
+    for c in cells["quality_on"]:
+        for k, v in (c.get("quality") or {}).items():
+            if v is not None:
+                qb[k] = v
+    out["quality_block_on"] = bool(qb)
+    out["calibration_samples_on"] = (
+        (qb.get("calibration") or {}).get("samples_total"))
+    out["promotion_state_on"] = (qb.get("promotion") or {}).get("state")
+    out["quality_block_off"] = any(
+        c.get("quality") for c in cells["quality_off"])
+    # the promotion-drill evidence cell: real servers, real mirrors,
+    # real fan-out — the acceptance's refuse/promote/rollback proof
+    from r2d2_tpu.tools.chaos import run_promotion_drill
+    drill = run_promotion_drill(max(seconds, 60.0))
+    out["promotion_drill"] = {
+        "passed": all(drill["verdict"].values()),
+        "verdict": drill["verdict"],
+        "corrupt_divergence": drill.get("corrupt_divergence"),
+        "healthy_divergence": drill.get("healthy_divergence"),
+        "promoted_stamp": drill.get("promoted_stamp"),
+        "rolled_back_to_stamp": drill.get("rolled_back_to_stamp"),
+        "alerts_fired": drill.get("alerts_fired"),
+    }
     return out
 
 
@@ -2159,6 +2264,17 @@ def main(argv=None) -> int:
                         "rows, the env-step->gradient e2e latency "
                         "histogram, per-hop breakdown — as end-to-end "
                         "evidence; one artifact, E2E_r21.json)")
+    p.add_argument("--promotion-ab", type=int, default=0,
+                   help="1: run the e2e phase as the policy-quality "
+                        "on/off A/B instead (ISSUE 20: "
+                        "telemetry.quality_enabled; budget <= 2%% on "
+                        "env-steps/s AND learner updates/s; ABBA-"
+                        "interleaved repeats with per-arm medians in "
+                        "thread mode so the calibration tap rides the "
+                        "acting hot path; the ON cells carry the "
+                        "'quality' block, the OFF cells none; plus the "
+                        "gated-canary promotion drill as the evidence "
+                        "cell; one artifact, E2E_r22.json)")
     p.add_argument("--resources-ab", type=int, default=0,
                    help="1: run the e2e phase as a resource/compile/alerts "
                         "on/off A/B instead (telemetry.resources_enabled; "
@@ -2252,6 +2368,10 @@ def main(argv=None) -> int:
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,
                 overrides=overrides, repeats=args.ab_repeats,
                 snapshot_interval=args.snapshot_interval)
+        elif args.promotion_ab:
+            out["e2e_promotion_ab"] = run_promotion_ab(
+                args.e2e_seconds, args.envs_per_actor, args.num_actors,
+                overrides=overrides, repeats=args.ab_repeats)
         elif args.tracing_ab:
             out["e2e_tracing_ab"] = run_tracing_ab(
                 args.e2e_seconds, args.envs_per_actor, args.num_actors,

@@ -129,6 +129,10 @@ def render_record(record: dict, host_rows: Optional[List[dict]] = None,
         # the dtype + live agreement gauge get their own line
         lines.append("")
         lines.append(render_quant(qb))
+    qy = record.get("quality")
+    if qy:
+        lines.append("")
+        lines.append(render_quality(qy))
     tb = record.get("trace")
     if tb:
         lines.append("")
@@ -521,6 +525,73 @@ def render_learning(lb: dict) -> str:
     if lb.get("nonfinite_steps"):
         lines.append(f"  !! NON-FINITE steps this interval: "
                      f"{lb['nonfinite_steps']} (see nan_dump_player*.json)")
+    return "\n".join(lines)
+
+
+def render_quality(qy: dict) -> str:
+    """The policy-quality panel (ISSUE 20): continuous-eval return per
+    scenario, the in-stream Q-calibration gauge (greedy max-Q at
+    decision time vs realized n-step return), shadow-scoring divergence
+    against a canary candidate, and the promotion state machine — the
+    record's ``quality`` block."""
+    ev = qy.get("eval") or {}
+    cal = qy.get("calibration") or {}
+    sh = qy.get("shadow") or {}
+    pr = qy.get("promotion") or {}
+    head = "quality:"
+    if ev.get("mean_return") is not None:
+        head += (f" eval={ev['mean_return']:.2f}"
+                 + (f" (ckpt step {ev['checkpoint_step']})"
+                    if ev.get("checkpoint_step") is not None else "")
+                 + (f" stamp={ev['publish_stamp']}"
+                    if ev.get("publish_stamp") is not None else "")
+                 + (f"<-{ev['parent_stamp']}"
+                    if ev.get("parent_stamp") is not None else ""))
+    else:
+        head += " (no eval rollout yet)"
+    if ev.get("evals_total"):
+        head += f"  evals={ev['evals_total']}"
+    lines = [head]
+    for row in ev.get("scenarios") or []:
+        lines.append(f"  scenario {row.get('scenario')}: "
+                     f"mean={_fmt(row.get('mean_return'), 8).strip()} "
+                     f"min={_fmt(row.get('min_return'), 8).strip()} "
+                     f"max={_fmt(row.get('max_return'), 8).strip()} "
+                     f"({row.get('episodes', 0)} ep)")
+    if cal.get("samples"):
+        lines.append(
+            f"  calibration: {cal['samples']} joined sample(s) "
+            f"gap={_fmt(cal.get('gap_mean'), 8).strip()}"
+            + (f" |gap|max={_fmt(cal.get('gap_abs_max'), 8).strip()}"
+               if cal.get("gap_abs_max") is not None else "")
+            + (f" stamp={cal['stamp']}"
+               if cal.get("stamp") is not None else "")
+            + f" (total {cal.get('samples_total', 0)})")
+    if sh.get("requests"):
+        bits = [f"  shadow: {sh['requests']} scored"]
+        if sh.get("divergence") is not None:
+            bits.append(f"divergence={sh['divergence']:.3f}")
+        if sh.get("agree_frac") is not None:
+            bits.append(f"agree={100 * sh['agree_frac']:.1f}%")
+        if sh.get("dq_max") is not None:
+            bits.append(f"|dQ|max={sh['dq_max']:.4g}")
+        if sh.get("dropped"):
+            bits.append(f"dropped={sh['dropped']}")
+        bits.append(f"(total {sh.get('mirrored_total', 0)})")
+        lines.append(" ".join(bits))
+    if pr.get("state") and pr["state"] != "idle":
+        bits = [f"  promotion: {pr['state'].upper()}"]
+        if pr.get("age_s") is not None:
+            bits.append(f"age={pr['age_s']:.0f}s")
+        if pr.get("candidate_stamp") is not None:
+            bits.append(f"candidate={pr['candidate_stamp']}")
+        if pr.get("previous_stamp") is not None:
+            bits.append(f"previous={pr['previous_stamp']}")
+        counts = [f"{k}={pr[k]}" for k in
+                  ("promotions", "rollbacks", "refusals") if pr.get(k)]
+        if counts:
+            bits.append(" ".join(counts))
+        lines.append(" ".join(bits))
     return "\n".join(lines)
 
 

@@ -36,7 +36,11 @@ engine at an arbitrary metrics-format JSONL — the serving fleet's
 ``service_metrics_p{p}.jsonl`` — replayed or tailed (``--follow``)
 exactly like the player stream; their ``serving`` / ``replay_service``
 blocks sit at the same record paths, so the plane rules evaluate
-unchanged.
+unchanged. The quality ledger's ``quality_player{p}.jsonl`` (ISSUE 20)
+is the same shape again — each row carries a ``proc`` identity header
+with a clock anchor plus the ``quality`` block at its in-run record
+path, so the ``quality_regression`` / ``canary_divergence`` /
+``promotion_stall`` rules evaluate against it directly.
 
     python -m r2d2_tpu.tools.sentinel --dir models                # replay
     python -m r2d2_tpu.tools.sentinel --dir models --follow       # live
@@ -181,11 +185,13 @@ def main(argv=None) -> int:
                    help="replay/tail an ARBITRARY metrics-format JSONL "
                         "through the engine instead of the player stream "
                         "— the per-process rows the serve fleet "
-                        "(serve_metrics.jsonl) and a standalone "
-                        "ReplayService (service_metrics_p{p}.jsonl) "
-                        "write (ISSUE 19); their blocks sit at the same "
-                        "record paths, so the serving / replay_service "
-                        "rules evaluate unchanged")
+                        "(serve_metrics.jsonl), a standalone "
+                        "ReplayService (service_metrics_p{p}.jsonl), and "
+                        "the quality ledger (quality_player{p}.jsonl) "
+                        "write (ISSUEs 19/20); their blocks sit at the "
+                        "same record paths, so the serving / "
+                        "replay_service / quality rules evaluate "
+                        "unchanged")
     p.add_argument("--alerts-stream", default="",
                    help="replay/tail an existing alerts JSONL "
                         "(alerts_player{p}.jsonl or alerts_host{r}.jsonl) "

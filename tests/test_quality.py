@@ -1,0 +1,522 @@
+"""Policy-quality observability plane tests (ISSUE 20): the calibration
+join vs a per-row python reference, the QualityStats interval/aggregation
+semantics, shadow scoring that NEVER mutates live serving state, the
+gated canary promotion state machine (stage/refuse/promote/rollback +
+persistence across a process restart), record-schema stability under the
+kill switch, pre-PR20 config round-trips, and the three quality alert
+rules (in-run + their tower twins)."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2_tpu.config import Config
+from r2d2_tpu.telemetry.quality import (QualityLedger, QualityStats,
+                                        calibration_join,
+                                        make_calibration_feed)
+
+
+def small_cfg(**overrides) -> Config:
+    cfg = Config().replace(**{
+        "env.game_name": "Fake",
+        "env.frame_height": 24, "env.frame_width": 24, "env.frame_stack": 2,
+        "network.hidden_dim": 16, "network.cnn_out_dim": 32,
+        "network.conv_layers": ((8, 4, 2), (16, 3, 1)),
+        "sequence.burn_in_steps": 4, "sequence.learning_steps": 5,
+        "sequence.forward_steps": 3,
+        "replay.capacity": 800, "replay.block_length": 20,
+        "replay.batch_size": 8, "replay.learning_starts": 100,
+        "runtime.save_interval": 0,
+    })
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+# ---------------------------------------------------------------------------
+# Q-calibration: the join math vs a per-row python reference
+
+
+def test_calibration_join_matches_reference(rng):
+    """calibration_join's vectorized window math equals the obvious
+    per-row python loop for every (t, n_steps) combination, including
+    windows that shorten at the episode tail."""
+    T, A = 17, 6
+    qvals = rng.standard_normal((T + 1, A)).astype(np.float32)
+    rewards = rng.standard_normal(T).astype(np.float32)
+    for n_steps in (1, 3, 5, T + 4):       # incl. n > T (all-tail windows)
+        gamma = 0.97
+        pred, realized = calibration_join(qvals, rewards, gamma, n_steps)
+        assert pred.shape == realized.shape == (T,)
+        maxq = qvals.astype(np.float64).max(axis=1)
+        for t in range(T):
+            m = min(max(n_steps, 1), T - t)
+            ref = sum(gamma ** i * float(rewards[t + i]) for i in range(m))
+            ref += gamma ** m * maxq[t + m]
+            assert abs(realized[t] - ref) < 1e-9, (t, n_steps)
+            assert pred[t] == maxq[t]
+
+
+def test_calibration_join_terminal_bootstrap(rng):
+    """A zero bootstrap row (LocalBuffer's termination convention) makes
+    the tail window a pure discounted reward sum — no explicit terminal
+    flag needed."""
+    T = 5
+    qvals = rng.standard_normal((T + 1, 4)).astype(np.float32)
+    qvals[-1] = 0.0
+    rewards = np.ones(T, np.float32)
+    gamma = 0.5
+    _, realized = calibration_join(qvals, rewards, gamma, n_steps=T)
+    ref = sum(gamma ** i for i in range(T))     # bootstrap term is 0
+    assert abs(realized[0] - ref) < 1e-9
+    with pytest.raises(ValueError, match="qvals rows"):
+        calibration_join(qvals[:-1], rewards, gamma, 3)
+
+
+def test_calibration_feed_sampling_and_stamp():
+    """The LocalBuffer tap samples every Nth finished block and joins
+    the feeding actor's adopted publish stamp onto the signal."""
+    stats = QualityStats()
+    stamps = iter(range(10, 20))
+    feed = make_calibration_feed(stats, gamma=0.99, n_steps=3,
+                                 sample_every=2,
+                                 stamp_fn=lambda: next(stamps))
+    q = np.ones((6, 4), np.float32)
+    r = np.zeros(5, np.float32)
+    for _ in range(5):
+        feed(q, r)
+    block = stats.interval_block()["calibration"]
+    # blocks 2 and 4 of 5 sampled, 5 rows each
+    assert block["samples"] == 10 == block["samples_total"]
+    assert block["stamp"] == 11                 # second stamp_fn draw
+    # gap = pred - realized = 1 - gamma^m (m shortens at the tail:
+    # 3,3,3,2,1 over the 5 rows); the abs max is the full-window row
+    gaps = [1.0 - 0.99 ** m for m in (3, 3, 3, 2, 1)]
+    assert abs(block["gap_mean"] - np.mean(gaps)) < 1e-9
+    assert abs(block["gap_abs_max"] - max(gaps)) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# QualityStats: interval consumption + per-scenario eval aggregation
+
+
+def test_quality_stats_interval_semantics():
+    s = QualityStats()
+    empty = s.interval_block()
+    assert empty["calibration"]["samples"] == 0
+    assert empty["calibration"]["gap_mean"] is None
+    assert empty["shadow"]["divergence"] is None    # None HOLDS the rules
+    assert empty["promotion"]["state"] == "idle"
+    s.on_calibration(4, 2.0, 0.9)
+    s.on_shadow(8, 6, dq_max=0.5)
+    s.on_shadow(2, 2, dropped=3)
+    b = s.interval_block()
+    assert b["calibration"]["samples"] == 4
+    assert abs(b["calibration"]["gap_mean"] - 0.5) < 1e-9
+    sh = b["shadow"]
+    assert sh["requests"] == 10 and sh["dropped"] == 3
+    assert abs(sh["agree_frac"] - 0.8) < 1e-9
+    assert abs(sh["divergence"] - 0.2) < 1e-9
+    assert sh["dq_max"] == 0.5 and sh["mirrored_total"] == 10
+    # consumed: next interval is clean, but cumulative totals persist
+    b2 = s.interval_block()
+    assert b2["shadow"]["requests"] == 0
+    assert b2["calibration"]["samples_total"] == 4
+    assert b2["shadow"]["mirrored_total"] == 10
+
+
+def test_quality_stats_eval_aggregation_and_lineage():
+    """Per-scenario rows aggregate episode-weighted; the eval snapshot
+    PERSISTS across intervals (the drop rule needs a value series) and
+    carries checkpoint lineage."""
+    s = QualityStats()
+    rows = [{"scenario": "eps0", "episodes": 3, "mean_return": 10.0},
+            {"scenario": "eps5", "episodes": 1, "mean_return": 2.0}]
+    s.on_eval(rows, step=700, publish_stamp=9, parent_stamp=4)
+    for _ in range(2):                          # persists across intervals
+        ev = s.interval_block()["eval"]
+        assert ev["evals_total"] == 1
+        assert abs(ev["mean_return"] - 8.0) < 1e-9   # (3*10 + 1*2) / 4
+        assert ev["checkpoint_step"] == 700
+        assert ev["publish_stamp"] == 9 and ev["parent_stamp"] == 4
+        assert [r["scenario"] for r in ev["scenarios"]] == ["eps0", "eps5"]
+
+
+# ---------------------------------------------------------------------------
+# shadow scoring: mirrored traffic never touches the live path
+
+
+def _req(req_id, kind=None):
+    from r2d2_tpu.serve.transport import KIND_STEP, Request
+    return Request(client_id=1, req_id=req_id,
+                   kind=KIND_STEP if kind is None else kind,
+                   op_seq=req_id, reply_to=f"ring-{req_id}")
+
+
+def _rep(req_id, q):
+    from r2d2_tpu.serve.transport import Reply
+    return Reply(req_id=req_id, action=int(np.argmax(q)),
+                 q=np.asarray(q, np.float32))
+
+
+class _EchoChannel:
+    """A candidate channel that records what it was asked and answers
+    with a fixed q-vector — enough to prove the scorer sends COPIES."""
+
+    def __init__(self, q):
+        self.q = np.asarray(q, np.float32)
+        self.seen = []
+
+    def request_many(self, reqs, timeout=None):
+        self.seen.extend(reqs)
+        return {r.req_id: _rep(r.req_id, self.q) for r in reqs}
+
+
+def test_shadow_scorer_never_mutates_live():
+    """The mirror side effects stop at the scorer: live Request/Reply
+    objects are unchanged field-for-field, the candidate sees COPIES
+    with reply_to stripped, and candidate replies are never handed
+    back toward clients (divergence is observable only via stats)."""
+    from r2d2_tpu.fleet.promotion import ShadowScorer
+    stats = QualityStats()
+    live_q = [0.1, 0.9, 0.0]
+    cand = _EchoChannel([0.9, 0.1, 0.0])        # argmax flipped: diverges
+    scorer = ShadowScorer(cand, stats, sample_rate=1.0, seed=0)
+    reqs = [_req(i) for i in range(6)]
+    replies = {r.req_id: _rep(r.req_id, live_q) for r in reqs}
+    frozen = {rid: dataclasses.replace(rep) for rid, rep in replies.items()}
+    scorer.mirror(reqs, replies)
+    assert scorer.process_pending() == 6
+    # live replies bit-unchanged, and still the LIVE policy's answers
+    for rid, rep in replies.items():
+        assert rep.action == frozen[rid].action == 1
+        np.testing.assert_array_equal(rep.q, frozen[rid].q)
+    # the candidate was driven with copies: reply_to stripped, live
+    # request objects untouched
+    assert all(c.reply_to == "" for c in cand.seen)
+    assert all(r.reply_to == f"ring-{r.req_id}" for r in reqs)
+    assert scorer.divergence() == 1.0
+    assert stats.interval_block()["shadow"]["divergence"] == 1.0
+
+
+def test_shadow_scorer_sampling_drops_and_errors():
+    from r2d2_tpu.fleet.promotion import ShadowScorer
+    from r2d2_tpu.serve.transport import KIND_BOOTSTRAP, STATUS_EXPIRED
+
+    stats = QualityStats()
+
+    class _Boom:
+        def request_many(self, reqs, timeout=None):
+            raise RuntimeError("candidate down")
+
+    # non-step and non-OK live pairs never enqueue
+    scorer = ShadowScorer(_Boom(), stats, sample_rate=1.0)
+    bad_rep = _rep(0, [1.0, 0.0])
+    bad_rep.status = STATUS_EXPIRED
+    scorer.mirror([_req(0), _req(1, kind=KIND_BOOTSTRAP)],
+                  {0: bad_rep, 1: _rep(1, [1.0, 0.0])})
+    assert scorer.mirrored == 0
+    # overflow of the bounded queue is counted, never blocks
+    scorer2 = ShadowScorer(_Boom(), stats, sample_rate=1.0, max_queue=2)
+    reqs = [_req(i) for i in range(5)]
+    scorer2.mirror(reqs, {r.req_id: _rep(r.req_id, [1.0, 0.0])
+                          for r in reqs})
+    assert scorer2.dropped == 3 and scorer2.mirrored == 5
+    # a dead candidate is an error count, not an exception on the drain
+    assert scorer2.process_pending() == 0
+    assert scorer2.errors == 1
+    assert stats.interval_block()["shadow"]["dropped"] == 3
+
+
+# ---------------------------------------------------------------------------
+# promotion state machine
+
+
+def _tree(seed, shape=(3, 2)):
+    rng = np.random.default_rng(seed)
+    return {"params": {"head": rng.standard_normal(shape)
+                       .astype(np.float32)}}
+
+
+def _trees_equal(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    return (ta == tb and len(la) == len(lb)
+            and all(np.asarray(x).dtype == np.asarray(y).dtype
+                    and np.array_equal(np.asarray(x), np.asarray(y))
+                    for x, y in zip(la, lb)))
+
+
+def _promo(tmp_path, n_consumers=8, degree=2):
+    from r2d2_tpu.fleet.fanout import FanoutTree
+    from r2d2_tpu.fleet.promotion import PromotionManager
+    from r2d2_tpu.runtime.weights import InProcWeightStore
+    cfg = small_cfg()
+    live = _tree(0)
+    store = InProcWeightStore(live)
+    fanout = FanoutTree(store, n_consumers=n_consumers, degree=degree)
+    fanout.pump()
+    mgr = PromotionManager(cfg.fleet, store, fanout=fanout,
+                           save_dir=str(tmp_path))
+    return cfg, live, store, fanout, mgr
+
+
+def test_promotion_refuse_promote_rollback_round_trip(tmp_path):
+    """The full lifecycle on real store + fan-out plumbing: a refused
+    canary never touches the root, a promotion is ONE root publish that
+    every consumer adopts, and rollback restores the previous bundle
+    bit-identically."""
+    cfg, live, store, fanout, mgr = _promo(tmp_path)
+    corrupt, healthy = _tree(1), _tree(2)
+
+    staged = mgr.stage(corrupt, stamp=77)
+    assert staged["previous_stamp"] == 1        # construction publication
+    assert staged["canary_consumers"] == [6, 7]     # high-slot leaf relay
+    # canary scoped: covered slots read the candidate, others the live
+    for c in range(8):
+        cur = fanout.endpoints(c)[2]()
+        assert _trees_equal(cur, corrupt if c >= 6 else live), c
+    ok, gates = mgr.decide(candidate_return=1.0, live_return=1.0,
+                           shadow_divergence=0.9, shadow_requests=64)
+    assert not ok and not gates["shadow"]["ok"] and gates["eval_return"]["ok"]
+    mgr.refuse(gates)
+    assert store.publish_count == 1 and mgr.root_publishes == 0
+    for c in range(8):                          # canary cleared to live
+        assert _trees_equal(fanout.endpoints(c)[2](), live)
+
+    mgr.stage(healthy, stamp=88)
+    ok, _ = mgr.decide(candidate_return=1.0, live_return=1.0,
+                       calibration_gap=0.5, shadow_divergence=0.0,
+                       shadow_requests=64)
+    assert ok
+    before = (store.publish_count, mgr.root_publishes)
+    assert mgr.promote() == 88
+    assert (store.publish_count, mgr.root_publishes) == (before[0] + 1,
+                                                         before[1] + 1)
+    for c in range(8):                          # fleet-wide adoption
+        assert _trees_equal(fanout.endpoints(c)[2](), healthy)
+
+    assert mgr.rollback() == 1                  # the retained stamp
+    assert _trees_equal(store.current(), live)
+    for c in range(8):
+        assert _trees_equal(fanout.endpoints(c)[2](), live)
+    blk = mgr.block()
+    assert blk["state"] == "rolled_back"
+    assert (blk["promotions"], blk["rollbacks"], blk["refusals"]) == (1, 1, 1)
+
+
+def test_promotion_gates_fail_closed():
+    """Eval and shadow gates refuse on MISSING evidence; the calibration
+    gate fails open with no stream but bounds a present one."""
+    from r2d2_tpu.fleet.promotion import PromotionManager
+    from r2d2_tpu.runtime.weights import InProcWeightStore
+    cfg = small_cfg()
+    mgr = PromotionManager(cfg.fleet, InProcWeightStore(_tree(0)))
+    ok, gates = mgr.decide(candidate_return=None, live_return=1.0,
+                           shadow_divergence=0.0, shadow_requests=64)
+    assert not ok and not gates["eval_return"]["ok"]
+    ok, gates = mgr.decide(candidate_return=1.0, live_return=1.0,
+                           shadow_divergence=None, shadow_requests=0)
+    assert not ok and not gates["shadow"]["ok"]
+    ok, gates = mgr.decide(candidate_return=1.0, live_return=1.0,
+                           calibration_gap=None, shadow_divergence=0.0,
+                           shadow_requests=cfg.fleet.promotion_min_shadow)
+    assert ok and gates["calibration"]["ok"]
+    ok, gates = mgr.decide(
+        candidate_return=1.0, live_return=1.0,
+        calibration_gap=cfg.fleet.promotion_calibration_bound + 1,
+        shadow_divergence=0.0,
+        shadow_requests=cfg.fleet.promotion_min_shadow)
+    assert not ok and not gates["calibration"]["ok"]
+    # the tolerance band: slightly-worse passes, clearly-worse refuses
+    tol = cfg.fleet.promotion_return_tolerance
+    assert mgr.decide(candidate_return=1.0 - tol / 2, live_return=1.0,
+                      shadow_divergence=0.0, shadow_requests=64)[0]
+    assert not mgr.decide(candidate_return=1.0 - 2 * tol, live_return=1.0,
+                          shadow_divergence=0.0, shadow_requests=64)[0]
+
+
+def test_promotion_state_guards(tmp_path):
+    from r2d2_tpu.fleet.promotion import PromotionManager
+    from r2d2_tpu.runtime.weights import InProcWeightStore
+    cfg = small_cfg()
+    mgr = PromotionManager(cfg.fleet, InProcWeightStore(_tree(0)))
+    with pytest.raises(RuntimeError, match="no staged candidate"):
+        mgr.promote()
+    with pytest.raises(RuntimeError, match="no staged candidate"):
+        mgr.refuse()
+    with pytest.raises(RuntimeError, match="nothing retained"):
+        mgr.rollback()
+    mgr.stage(_tree(1))
+    with pytest.raises(RuntimeError, match="already staged"):
+        mgr.stage(_tree(2))
+
+
+def test_promotion_persists_across_restart(tmp_path):
+    """The retained previous bundle + counters survive the process: a
+    FRESH manager on the same save_dir can still roll back
+    bit-identically after a promote-then-crash."""
+    from r2d2_tpu.fleet.promotion import PromotionManager
+    from r2d2_tpu.runtime.weights import InProcWeightStore
+    cfg, live = small_cfg(), _tree(0)
+    store = InProcWeightStore(live)
+    mgr = PromotionManager(cfg.fleet, store, save_dir=str(tmp_path))
+    mgr.stage(_tree(2), stamp=55)
+    assert mgr.promote() == 55
+
+    mgr2 = PromotionManager(cfg.fleet, store, save_dir=str(tmp_path))
+    assert mgr2.state == "promoted" and mgr2.promotions == 1
+    blk = mgr2.block()
+    assert blk["candidate_stamp"] == 55 and blk["previous_stamp"] == 1
+    assert mgr2.rollback() == 1
+    assert _trees_equal(store.current(), live)
+
+
+# ---------------------------------------------------------------------------
+# record schema + ledger stream + config
+
+
+def test_record_schema_stable_without_quality(tmp_path):
+    """No provider attached (quality_enabled off, the default): the
+    record carries no 'quality' key — byte-identical to the PR-19
+    schema — and no ledger file exists."""
+    from r2d2_tpu.runtime.metrics import TrainMetrics
+    m = TrainMetrics(0, str(tmp_path))
+    record = m.log(1.0)
+    assert "quality" not in record
+    assert "quality" not in json.dumps(record)
+    assert not list(tmp_path.glob("quality_player*.jsonl"))
+    m2 = TrainMetrics(1, str(tmp_path))
+    stats = QualityStats()
+    m2.set_quality(QualityLedger(stats, str(tmp_path), 1).interval_block)
+    record2 = m2.log(1.0)
+    assert set(record2["quality"]) == {"calibration", "eval", "shadow",
+                                       "promotion"}
+
+
+def test_quality_ledger_rows(tmp_path):
+    """One ledger row per interval: the proc identity header + clock
+    anchor (the tower's join key), the quality block, and top-level
+    lineage — and the sentinel's engine evaluates the stream directly."""
+    from r2d2_tpu.tools.sentinel import build_engine, replay_stream
+    stats = QualityStats()
+    ledger = QualityLedger(stats, str(tmp_path), 0)
+    stats.on_eval([{"scenario": "eps0", "episodes": 2, "mean_return": 7.0}],
+                  step=300, publish_stamp=5, parent_stamp=2)
+    stats.on_shadow(40, 10)                     # divergence 0.75: crit
+    ledger.interval_block()
+    rows = [json.loads(line) for line in
+            open(os.path.join(str(tmp_path), "quality_player0.jsonl"))]
+    assert len(rows) == 1 and ledger.write_errors == 0
+    row = rows[0]
+    assert row["proc"]["plane"] == "quality" and "t" in row
+    assert "clock_anchor" in row["proc"]        # the tower's join key
+    assert row["lineage"] == {"step": 300, "publish_stamp": 5,
+                              "parent_stamp": 2}
+    fired = []
+    summary = replay_stream(rows, build_engine(),
+                            emit=lambda line: fired.append(line))
+    assert summary["crit"] == 1                 # canary_divergence
+    assert any("canary_divergence" in line for line in fired)
+
+
+def test_config_round_trip_pre_pr20():
+    # pre-PR20 dicts (no quality/promotion knobs) load with defaults
+    d = Config().to_dict()
+    for key in ("quality_enabled", "quality_eval_interval_s",
+                "quality_eval_rounds", "quality_eval_clients",
+                "quality_calib_sample_every", "alerts_quality_regression",
+                "alerts_canary_divergence", "alerts_promotion_stall_s"):
+        d["telemetry"].pop(key)
+    d["serve"].pop("shadow_sample_rate")
+    for key in ("promotion_return_tolerance", "promotion_calibration_bound",
+                "promotion_divergence_bound", "promotion_min_shadow",
+                "promotion_canary_frac"):
+        d["fleet"].pop(key)
+    cfg = Config.from_dict(d)
+    assert cfg.telemetry.quality_enabled is False
+    assert cfg.serve.shadow_sample_rate == 0.0
+    assert cfg.fleet.promotion_canary_frac == 0.25
+    # full round-trip with the plane on
+    cfg_on = small_cfg(**{"telemetry.quality_enabled": True,
+                          "serve.shadow_sample_rate": 0.5,
+                          "fleet.promotion_min_shadow": 8})
+    back = Config.from_json(cfg_on.to_json())
+    assert back.telemetry.quality_enabled is True
+    assert back.serve.shadow_sample_rate == 0.5
+    assert back.fleet.promotion_min_shadow == 8
+    with pytest.raises(ValueError, match="shadow_sample_rate"):
+        small_cfg(**{"serve.shadow_sample_rate": 1.5})
+    with pytest.raises(ValueError, match="quality_calib_sample_every"):
+        small_cfg(**{"telemetry.quality_calib_sample_every": 0})
+
+
+# ---------------------------------------------------------------------------
+# alert rules: in-run + tower twins
+
+
+def test_quality_alert_rules_fire_and_rearm():
+    from r2d2_tpu.telemetry import AlertEngine, default_rules
+    cfg = small_cfg()
+    engine = AlertEngine(default_rules(cfg.telemetry))
+    names = {r.name for r in engine.rules}
+    assert {"quality_regression", "canary_divergence",
+            "promotion_stall"} <= names
+
+    def rec(div=None, age=None):
+        return {"quality": {"shadow": {"divergence": div},
+                            "promotion": {"age_s": age}}}
+
+    # canary_divergence: crit on the bound, EDGE-fired exactly once
+    assert engine.evaluate(rec(div=0.1))["fired"] == []
+    fired = engine.evaluate(rec(div=0.9))["fired"]
+    assert [a["rule"] for a in fired] == ["canary_divergence"]
+    assert fired[0]["severity"] == "crit"
+    # a shadow-free interval (None) HOLDS the breach — no refire
+    held = engine.evaluate(rec(div=None))
+    assert held["fired"] == [] and "canary_divergence" in held["active"]
+    # recovery re-arms; the next breach fires again
+    assert engine.evaluate(rec(div=0.0))["fired"] == []
+    assert len(engine.evaluate(rec(div=0.9))["fired"]) == 1
+    # promotion_stall rides the canary age (None outside canary = inert)
+    stall = engine.evaluate(
+        rec(age=cfg.telemetry.alerts_promotion_stall_s + 1))["fired"]
+    assert [a["rule"] for a in stall] == ["promotion_stall"]
+
+    # quality_regression: eval mean_return collapsing below the window
+    # baseline fraction
+    eng2 = AlertEngine(default_rules(cfg.telemetry))
+    for _ in range(cfg.telemetry.alerts_window):
+        assert all(a["rule"] != "quality_regression" for a in eng2.evaluate(
+            {"quality": {"eval": {"mean_return": 10.0}}})["fired"])
+    out = eng2.evaluate({"quality": {"eval": {"mean_return": 1.0}}})
+    assert any(a["rule"] == "quality_regression" for a in out["fired"])
+
+
+def test_tower_quality_twins():
+    """The tower watches the same three signals via its derived
+    worst-case join over quality_player*.jsonl rows."""
+    from r2d2_tpu.telemetry.tower import TowerCollector, tower_rules
+    cfg = small_cfg()
+    names = {r.name for r in tower_rules(cfg)}
+    assert {"tower_quality_regression", "tower_canary_divergence",
+            "tower_promotion_stall"} <= names
+
+    def qrow(ret, div, age):
+        return {"quality": {"eval": {"mean_return": ret},
+                            "shadow": {"divergence": div},
+                            "promotion": {"age_s": age}}}
+
+    derived = TowerCollector.derive(
+        {"learner": [], "quality": [qrow(5.0, 0.1, 10.0),
+                                    qrow(2.0, 0.6, 900.0)]})
+    # worst-case across players: min return, max divergence, max age
+    assert derived["quality_eval_return"] == 2.0
+    assert derived["canary_divergence"] == 0.6
+    assert derived["promotion_age_s"] == 900.0
+    # no quality plane: none of the keys appear (rules stay inert)
+    empty = TowerCollector.derive({"learner": [], "quality": []})
+    assert not {"quality_eval_return", "canary_divergence",
+                "promotion_age_s"} & set(empty)

@@ -524,7 +524,10 @@ def test_tower_rules_table():
     assert set(rules) == {"tower_e2e_latency_growth",
                           "tower_shed_while_backlog",
                           "tower_spill_promotion_latency",
-                          "tower_plane_silent"}
+                          "tower_plane_silent",
+                          "tower_quality_regression",
+                          "tower_canary_divergence",
+                          "tower_promotion_stall"}
     for r in rules.values():
         assert r.path[0] == "derived"      # tower rules read the JOIN
     assert rules["tower_shed_while_backlog"].severity == "crit"
